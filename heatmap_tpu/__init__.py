@@ -1,0 +1,22 @@
+"""heatmap_tpu — a TPU-native geospatial heatmap aggregation framework.
+
+Re-imagines the capabilities of the reference Spark heatmap job
+(reference heatmap.py / tile.py) as a JAX/XLA-first engine.
+
+Shipped subpackages (this list tracks the tree; see SURVEY.md §7 for the
+full build plan):
+
+- ``tilemath`` — vectorized Web-Mercator projection, integer tile keys,
+  Morton codes (replaces reference tile.py's string ids and scalar trig).
+"""
+
+__version__ = "0.1.0"
+
+from heatmap_tpu.tilemath import (  # noqa: F401
+    Tile,
+    column_from_longitude,
+    latitude_from_row,
+    longitude_from_column,
+    row_from_latitude,
+    tile_id_from_lat_long,
+)

@@ -1,0 +1,32 @@
+"""Tile geometry core: vectorized Web-Mercator math and integer tile keys.
+
+Semantics contract with the reference (reference tile.py:8-30):
+floor-based binning, no pole clamping, no antimeridian wraparound.
+"""
+
+from heatmap_tpu.tilemath.mercator import (  # noqa: F401
+    MAX_LATITUDE,
+    column_from_longitude,
+    latitude_from_row,
+    longitude_from_column,
+    mercator_x,
+    mercator_y,
+    project_points,
+    row_from_latitude,
+)
+from heatmap_tpu.tilemath.keys import (  # noqa: F401
+    children_rowcol,
+    pack_key,
+    parent_rowcol,
+    parse_tile_id,
+    rowcol_at_zoom,
+    tile_id_from_lat_long,
+    tile_id_string,
+    unpack_key,
+)
+from heatmap_tpu.tilemath.morton import (  # noqa: F401
+    morton_decode,
+    morton_encode,
+    morton_parent,
+)
+from heatmap_tpu.tilemath.tile import Tile  # noqa: F401

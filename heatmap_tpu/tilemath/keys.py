@@ -1,0 +1,152 @@
+"""Integer tile keys and the string-id compatibility codec.
+
+The reference addresses tiles with ``"zoom_row_col"`` strings built and
+re-parsed in every mapper (reference tile.py:32-58) and coarsens tiles by
+round-tripping centers through inverse+forward projection per level
+(reference tile.py:60-64, heatmap.py:60-61). On TPU, tiles are integers:
+
+- ``(row, col)`` int32 pairs at a given zoom (rows/cols fit int32 for all
+  zoom <= 30);
+- a packed int64 ``pack_key(zoom, row, col)`` when a single sortable
+  scalar is needed (requires x64);
+- Morton codes (see morton.py) when pyramid-order locality is needed.
+
+Parent/child navigation is pure bit arithmetic — ``parent = (r>>1, c>>1)``
+— which is mathematically identical to the reference's center
+re-projection for in-range tiles (proved + property-tested in
+tests/test_keys.py): the tile center is strictly inside the tile, so
+re-binning it one zoom coarser always lands on the half-resolution tile.
+
+Strings appear only at the egress boundary for compatibility.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Packed-key layout: | zoom:6 | row:29 | col:29 | — zooms 0..30 lossless.
+_ROW_BITS = 29
+_COL_BITS = 29
+
+
+def pack_key(zoom, row, col):
+    """Pack (zoom, row, col) into a sortable int64 scalar key.
+
+    Sort order is (zoom, row, col) lexicographic. Requires x64: without
+    it the int64 request silently downgrades to int32 and the shifts
+    wrap, so refuse loudly instead.
+    """
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "pack_key needs int64 keys; enable x64 (jax.config.update"
+            "('jax_enable_x64', True)) or use Morton int32 codes for zoom<=15"
+        )
+    z = jnp.asarray(zoom, jnp.int64)
+    r = jnp.asarray(row, jnp.int64)
+    c = jnp.asarray(col, jnp.int64)
+    return (z << (_ROW_BITS + _COL_BITS)) | (r << _COL_BITS) | c
+
+
+def unpack_key(key):
+    """Inverse of :func:`pack_key` -> (zoom, row, col) int32."""
+    k = jnp.asarray(key, jnp.int64)
+    col = (k & ((1 << _COL_BITS) - 1)).astype(jnp.int32)
+    row = ((k >> _COL_BITS) & ((1 << _ROW_BITS) - 1)).astype(jnp.int32)
+    zoom = (k >> (_ROW_BITS + _COL_BITS)).astype(jnp.int32)
+    return zoom, row, col
+
+
+def parent_rowcol(row, col):
+    """Tile at zoom-1 containing (row, col): a right shift.
+
+    Equivalent to the reference's center re-projection (reference
+    tile.py:60-61) for in-range tiles; see module docstring.
+    """
+    return row >> 1, col >> 1
+
+
+def rowcol_at_zoom(row, col, from_zoom, to_zoom):
+    """Re-bin a tile's (row, col) from ``from_zoom`` to a coarser ``to_zoom``."""
+    if to_zoom > from_zoom:
+        raise ValueError(
+            f"rowcol_at_zoom only coarsens: from_zoom={from_zoom} -> to_zoom={to_zoom}"
+        )
+    shift = from_zoom - to_zoom
+    return row >> shift, col >> shift
+
+
+def children_rowcol(row, col):
+    """The four zoom+1 children of (row, col) as ((r,c) x 4).
+
+    Matches the set produced by the reference's quadrant-midpoint
+    re-binning (reference tile.py:88-98).
+    """
+    r2, c2 = row * 2, col * 2
+    return ((r2, c2), (r2, c2 + 1), (r2 + 1, c2), (r2 + 1, c2 + 1))
+
+
+# ---------------------------------------------------------------------------
+# Host-side string codec (egress-boundary compatibility with the reference's
+# "zoom_row_col" ids, reference tile.py:56-58).
+# ---------------------------------------------------------------------------
+
+
+def tile_id_string(zoom, row, col) -> str:
+    """Reference-format tile id string (reference tile.py:56-58)."""
+    return f"{int(zoom)}_{int(row)}_{int(col)}"
+
+
+def parse_tile_id(tile_id: str):
+    """Parse ``"zoom_row_col"`` -> (zoom, row, col) or None if malformed.
+
+    None-on-malformed mirrors reference tile.py:33-36.
+    """
+    parts = tile_id.split("_")
+    if len(parts) != 3:
+        return None
+    try:
+        return int(parts[0]), int(parts[1]), int(parts[2])
+    except ValueError:
+        return None
+
+
+def tile_id_from_lat_long(latitude, longitude, zoom) -> str:
+    """Scalar host-side convenience mirroring reference tile.py:8-13.
+
+    Delegates to the single scalar-projection implementation in
+    tilemath.tile (CPython platform-libm doubles, so results agree with
+    the reference bit-for-bit).
+    """
+    from heatmap_tpu.tilemath import tile as _tile
+
+    row = int(_tile._row_from_latitude(latitude, zoom))
+    col = int(_tile._column_from_longitude(longitude, zoom))
+    return tile_id_string(zoom, row, col)
+
+
+def tile_ids_to_arrays(tile_ids):
+    """Vectorize a sequence of string ids -> (zoom, row, col) int32 numpy arrays.
+
+    Malformed ids are dropped (reference returns None for them,
+    reference tile.py:35-36); returns the keep-mask as the 4th element.
+    """
+    zooms, rows, cols, keep = [], [], [], []
+    for tid in tile_ids:
+        parsed = parse_tile_id(tid)
+        if parsed is None:
+            keep.append(False)
+            continue
+        keep.append(True)
+        z, r, c = parsed
+        zooms.append(z)
+        rows.append(r)
+        cols.append(c)
+    return (
+        np.asarray(zooms, np.int32),
+        np.asarray(rows, np.int32),
+        np.asarray(cols, np.int32),
+        np.asarray(keep, bool),
+    )

@@ -1,0 +1,97 @@
+"""Morton (Z-order) codes for tile keys.
+
+Why Morton codes: the pyramid parent of a Morton code is ``code >> 2``,
+and the right shift *preserves sort order*. So one device-side sort of
+detail-zoom codes serves every level of the rollup — each coarser level
+is a segment-sum over already-sorted keys. This replaces the reference's
+per-level reduceByKey/groupByKey shuffle pair (reference
+heatmap.py:109-117; 32 shuffles per run, SURVEY.md §3.3) with zero
+re-sorts and zero re-projections.
+
+Two widths:
+- int32 codes hold zooms <= 15 (2x15 = 30 bits) — the fast TPU path and
+  enough for the z0-z15 north-star pyramid (BASELINE.md).
+- int64 codes hold zooms <= 29 — covers the reference's z21 detail grid
+  (reference heatmap.py:27); requires x64.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _part1by1_32(x):
+    """Spread the low 16 bits of int32 x into the even bit positions."""
+    x = x & 0x0000FFFF
+    x = (x | (x << 8)) & 0x00FF00FF
+    x = (x | (x << 4)) & 0x0F0F0F0F
+    x = (x | (x << 2)) & 0x33333333
+    x = (x | (x << 1)) & 0x55555555
+    return x
+
+
+def _compact1by1_32(x):
+    """Inverse of :func:`_part1by1_32`."""
+    x = x & 0x55555555
+    x = (x | (x >> 1)) & 0x33333333
+    x = (x | (x >> 2)) & 0x0F0F0F0F
+    x = (x | (x >> 4)) & 0x00FF00FF
+    x = (x | (x >> 8)) & 0x0000FFFF
+    return x
+
+
+def _part1by1_64(x):
+    """Spread the low 32 bits of int64 x into the even bit positions."""
+    x = x & 0x00000000FFFFFFFF
+    x = (x | (x << 16)) & 0x0000FFFF0000FFFF
+    x = (x | (x << 8)) & 0x00FF00FF00FF00FF
+    x = (x | (x << 4)) & 0x0F0F0F0F0F0F0F0F
+    x = (x | (x << 2)) & 0x3333333333333333
+    x = (x | (x << 1)) & 0x5555555555555555
+    return x
+
+
+def _compact1by1_64(x):
+    """Inverse of :func:`_part1by1_64`."""
+    x = x & 0x5555555555555555
+    x = (x | (x >> 1)) & 0x3333333333333333
+    x = (x | (x >> 2)) & 0x0F0F0F0F0F0F0F0F
+    x = (x | (x >> 4)) & 0x00FF00FF00FF00FF
+    x = (x | (x >> 8)) & 0x0000FFFF0000FFFF
+    x = (x | (x >> 16)) & 0x00000000FFFFFFFF
+    return x
+
+
+def morton_encode(row, col, dtype=jnp.int32):
+    """Interleave (row, col) into a Z-order code; row occupies odd bits.
+
+    ``dtype=jnp.int32`` supports zooms <= 15; ``jnp.int64`` (x64 only)
+    supports zooms <= 29.
+    """
+    if jnp.dtype(dtype).itemsize == 4:
+        r = jnp.asarray(row, jnp.int32)
+        c = jnp.asarray(col, jnp.int32)
+        return (_part1by1_32(r) << 1) | _part1by1_32(c)
+    r = jnp.asarray(row, jnp.int64)
+    c = jnp.asarray(col, jnp.int64)
+    return (_part1by1_64(r) << 1) | _part1by1_64(c)
+
+
+def morton_decode(code):
+    """Z-order code -> (row, col), dtype-matched to the code."""
+    code = jnp.asarray(code)
+    if code.dtype.itemsize == 4:
+        return _compact1by1_32(code >> 1), _compact1by1_32(code)
+    return (
+        _compact1by1_64(code >> 1).astype(jnp.int64),
+        _compact1by1_64(code).astype(jnp.int64),
+    )
+
+
+def morton_parent(code, levels=1):
+    """The ancestor code ``levels`` zooms coarser: a right shift by 2*levels.
+
+    Order-preserving — sorted codes stay sorted after this, which is the
+    whole point (module docstring).
+    """
+    return code >> (2 * levels)

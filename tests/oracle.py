@@ -1,0 +1,143 @@
+"""Pure-Python oracle of the reference job's semantics, for golden tests.
+
+Re-implements (NOT copies) the behavioral contract documented in
+SURVEY.md §2/§3/§8 from the reference formulas (reference tile.py:8-30,
+heatmap.py:25-129): scalar CPython-double tile math, user-group routing,
+the per-level flatMap→reduceByKey→map→groupByKey cascade — including its
+latent '`all`'-amplification bug (SURVEY.md §8.1), reproducible here so
+the framework's compat mode can be tested against it.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+
+DETAIL_ZOOM_DELTA = 5
+KEY_SEP = "|"
+
+
+# -- scalar tile math (reference tile.py:16-30 semantics) -------------------
+
+
+def row_from_latitude(lat: float, zoom: int) -> float:
+    phi = lat * math.pi / 180
+    return math.floor(
+        (1 - math.log(math.tan(phi) + 1 / math.cos(phi)) / math.pi) / 2 * (1 << zoom)
+    )
+
+
+def column_from_longitude(lon: float, zoom: int) -> float:
+    return math.floor((lon + 180.0) / 360.0 * (1 << zoom))
+
+
+def latitude_from_row(row: float, zoom: int) -> float:
+    n = math.pi - 2.0 * math.pi * row / (1 << zoom)
+    return 180.0 / math.pi * math.atan(0.5 * (math.exp(n) - math.exp(-n)))
+
+
+def longitude_from_column(col: float, zoom: int) -> float:
+    return float(col) / (1 << zoom) * 360.0 - 180.0
+
+
+def tile_id(lat: float, lon: float, zoom: int) -> str:
+    return f"{zoom}_{int(row_from_latitude(lat, zoom))}_{int(column_from_longitude(lon, zoom))}"
+
+
+def tile_center(tid: str):
+    z, r, c = (int(p) for p in tid.split("_"))
+    lat_n = latitude_from_row(r, z)
+    lat_s = latitude_from_row(r + 1, z)
+    lon_w = longitude_from_column(c, z)
+    lon_e = longitude_from_column(c + 1, z)
+    return (lat_n + lat_s) / 2.0, (lon_e + lon_w) / 2.0, z
+
+
+# -- pipeline semantics (reference heatmap.py) ------------------------------
+
+
+def user_groups(user_id: str):
+    """Reference heatmap.py:64-70: 'all' + routed user id (x-excluded, rt- pooled)."""
+    groups = ["all"]
+    if not user_id[:1] == "x":
+        groups.append("route" if user_id[:3] == "rt-" else user_id)
+    return groups
+
+
+def load_points(rows, detail_zoom: int):
+    """Reference dataframe_loader semantics (heatmap.py:25-36)."""
+    out = []
+    for row in rows:
+        if row.get("source") == "background":
+            continue
+        out.append(
+            {
+                "tileId": tile_id(row["latitude"], row["longitude"], detail_zoom),
+                "userId": row["user_id"],
+                "count": 1.0,
+            }
+        )
+    return out
+
+
+def cascade(locations, detail_zoom: int, min_detail_zoom: int, amplify_all: bool = True):
+    """The reference build_heatmaps cascade (heatmap.py:107-118).
+
+    Returns {(userId|timespan|coarseTileId): {detailTileId: count}} for
+    detail zooms ``detail_zoom`` down to ``min_detail_zoom+1``.
+
+    ``amplify_all=True`` reproduces the reference's re-expansion of
+    already-aggregated records each level (the '`all`' amplification,
+    SURVEY.md §8.1: all_z = 2*all_{z+1} + sum_users user_{z+1}).
+    ``amplify_all=False`` computes the mathematically correct rollup:
+    group expansion applied once, at the detail level.
+    """
+    heatmaps = {}
+    if amplify_all:
+        records = [
+            (loc["userId"], loc["tileId"], loc["count"]) for loc in locations
+        ]
+    else:
+        # Correct mode: expand groups once at ingest.
+        records = [
+            (g, loc["tileId"], loc["count"])
+            for loc in locations
+            for g in user_groups(loc["userId"])
+        ]
+
+    for zoom in range(detail_zoom, min_detail_zoom, -1):
+        # flatMap(mapper): re-bin tile center at `zoom`, expand groups
+        # (reference heatmap.py:57-77).
+        counts = defaultdict(float)
+        for user_id, tid, count in records:
+            lat, lon, _ = tile_center(tid)
+            new_tid = tile_id(lat, lon, zoom)
+            if amplify_all:
+                for g in user_groups(user_id):
+                    counts[(g, new_tid)] += count
+            else:
+                counts[(user_id, new_tid)] += count
+
+        # map_to_resultset + groupByKey (reference heatmap.py:79-90,112).
+        level = defaultdict(dict)
+        for (user_id, tid), count in counts.items():
+            lat, lon, z = tile_center(tid)
+            coarse = tile_id(lat, lon, z - DETAIL_ZOOM_DELTA)
+            level[f"{user_id}{KEY_SEP}alltime{KEY_SEP}{coarse}"][tid] = count
+        heatmaps.update(level)
+
+        # heatmap_to_locations (reference heatmap.py:92-105): next level
+        # consumes this level's aggregates.
+        records = [
+            (key.split(KEY_SEP)[0], tid, cnt)
+            for key, hm in level.items()
+            for tid, cnt in hm.items()
+        ]
+    return heatmaps
+
+
+def run_job(rows, detail_zoom: int = 21, min_detail_zoom: int = 5, amplify_all: bool = True):
+    """End-to-end oracle of batchMain (reference heatmap.py:152-158), sans I/O."""
+    return cascade(
+        load_points(rows, detail_zoom), detail_zoom, min_detail_zoom, amplify_all
+    )

@@ -1,0 +1,140 @@
+"""Tests for integer tile keys, Morton codes, and the string codec."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from heatmap_tpu.tilemath import keys, morton
+import oracle
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(3)
+    zooms = rng.integers(0, 30, 1000)
+    rows = np.array([rng.integers(0, 1 << z) if z else 0 for z in zooms])
+    cols = np.array([rng.integers(0, 1 << z) if z else 0 for z in zooms])
+    packed = keys.pack_key(zooms, rows, cols)
+    z, r, c = keys.unpack_key(packed)
+    np.testing.assert_array_equal(np.asarray(z), zooms)
+    np.testing.assert_array_equal(np.asarray(r), rows)
+    np.testing.assert_array_equal(np.asarray(c), cols)
+
+
+def test_pack_key_sort_order():
+    # Lexicographic (zoom, row, col) ordering survives packing.
+    rng = np.random.default_rng(4)
+    zooms = rng.integers(0, 22, 500)
+    rows = rng.integers(0, 1 << 21, 500)
+    cols = rng.integers(0, 1 << 21, 500)
+    packed = np.asarray(keys.pack_key(zooms, rows, cols))
+    order = np.argsort(packed, kind="stable")
+    lex = np.lexsort((cols, rows, zooms))
+    np.testing.assert_array_equal(
+        packed[order], packed[lex]
+    )
+
+
+def test_parent_equals_reference_center_reprojection():
+    """parent = (r>>1, c>>1) must equal the reference's center re-binning
+    (reference tile.py:60-61) — the correctness basis for the whole
+    shift-based pyramid (SURVEY.md §7)."""
+    rng = np.random.default_rng(5)
+    for zoom in [1, 2, 8, 16, 21]:
+        n = 1 << zoom
+        rows = rng.integers(0, n, 300)
+        cols = rng.integers(0, n, 300)
+        pr, pc = keys.parent_rowcol(rows, cols)
+        for r, c, er, ec in zip(rows, cols, pr, pc):
+            lat, lon, _ = oracle.tile_center(f"{zoom}_{r}_{c}")
+            expected = oracle.tile_id(lat, lon, zoom - 1)
+            assert expected == f"{zoom - 1}_{er}_{ec}"
+
+
+def test_rowcol_at_zoom_matches_iterated_reprojection():
+    # Multi-level coarsening (z21 -> z16, the DETAIL_ZOOM_DELTA=5 re-key of
+    # reference heatmap.py:89) equals 5 single-level reference steps.
+    rng = np.random.default_rng(6)
+    zoom = 21
+    rows = rng.integers(0, 1 << zoom, 100)
+    cols = rng.integers(0, 1 << zoom, 100)
+    r16, c16 = keys.rowcol_at_zoom(rows, cols, zoom, 16)
+    for r, c, er, ec in zip(rows, cols, r16, c16):
+        lat, lon, _ = oracle.tile_center(f"{zoom}_{r}_{c}")
+        expected = oracle.tile_id(lat, lon, 16)
+        assert expected == f"16_{er}_{ec}"
+
+
+def test_children_rowcol():
+    for r, c in [(0, 0), (3, 5), (100, 2047)]:
+        kids = keys.children_rowcol(r, c)
+        assert set(kids) == {
+            (2 * r, 2 * c),
+            (2 * r, 2 * c + 1),
+            (2 * r + 1, 2 * c),
+            (2 * r + 1, 2 * c + 1),
+        }
+        for kr, kc in kids:
+            assert keys.parent_rowcol(kr, kc) == (r, c)
+
+
+def test_string_codec():
+    assert keys.tile_id_string(10, 5, 7) == "10_5_7"
+    assert keys.parse_tile_id("10_5_7") == (10, 5, 7)
+    assert keys.parse_tile_id("garbage") is None
+    assert keys.parse_tile_id("1_2_3_4") is None
+
+
+def test_tile_id_from_lat_long_matches_oracle():
+    rng = np.random.default_rng(7)
+    lats = rng.uniform(-85, 85, 200)
+    lons = rng.uniform(-180, 180, 200)
+    for la, lo in zip(lats, lons):
+        for zoom in (10, 21):
+            assert keys.tile_id_from_lat_long(la, lo, zoom) == oracle.tile_id(
+                la, lo, zoom
+            )
+
+
+def test_tile_ids_to_arrays():
+    z, r, c, keep = keys.tile_ids_to_arrays(["3_1_2", "bad", "21_100_200"])
+    np.testing.assert_array_equal(z, [3, 21])
+    np.testing.assert_array_equal(r, [1, 100])
+    np.testing.assert_array_equal(c, [2, 200])
+    np.testing.assert_array_equal(keep, [True, False, True])
+
+
+# -- Morton codes -----------------------------------------------------------
+
+
+def test_morton_roundtrip_int32():
+    rng = np.random.default_rng(8)
+    rows = rng.integers(0, 1 << 15, 5000).astype(np.int32)
+    cols = rng.integers(0, 1 << 15, 5000).astype(np.int32)
+    code = morton.morton_encode(rows, cols, dtype=jnp.int32)
+    r, c = morton.morton_decode(code)
+    np.testing.assert_array_equal(np.asarray(r), rows)
+    np.testing.assert_array_equal(np.asarray(c), cols)
+
+
+def test_morton_roundtrip_int64():
+    rng = np.random.default_rng(9)
+    rows = rng.integers(0, 1 << 21, 5000)
+    cols = rng.integers(0, 1 << 21, 5000)
+    code = morton.morton_encode(rows, cols, dtype=jnp.int64)
+    r, c = morton.morton_decode(code)
+    np.testing.assert_array_equal(np.asarray(r), rows)
+    np.testing.assert_array_equal(np.asarray(c), cols)
+
+
+def test_morton_parent_is_shift_and_order_preserving():
+    rng = np.random.default_rng(10)
+    rows = rng.integers(0, 1 << 15, 3000).astype(np.int32)
+    cols = rng.integers(0, 1 << 15, 3000).astype(np.int32)
+    code = np.asarray(morton.morton_encode(rows, cols, dtype=jnp.int32))
+    parent = np.asarray(morton.morton_parent(code))
+    pr, pc = morton.morton_decode(jnp.asarray(parent))
+    np.testing.assert_array_equal(np.asarray(pr), rows >> 1)
+    np.testing.assert_array_equal(np.asarray(pc), cols >> 1)
+    # Order preservation: sorted codes stay sorted under the parent shift.
+    sorted_codes = np.sort(code)
+    parents_of_sorted = sorted_codes >> 2
+    assert np.all(np.diff(parents_of_sorted) >= 0)

@@ -1,0 +1,118 @@
+"""Golden tests: vectorized Mercator vs the scalar CPython-double oracle."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heatmap_tpu.tilemath import mercator
+import oracle
+
+
+def _random_points(n, seed=0, lat_range=(-85.0, 85.0), lon_range=(-180.0, 179.9999)):
+    rng = np.random.default_rng(seed)
+    lats = rng.uniform(*lat_range, n)
+    lons = rng.uniform(*lon_range, n)
+    return lats, lons
+
+
+@pytest.mark.parametrize("zoom", [0, 1, 5, 10, 15, 18, 21])
+def test_row_col_bit_identity_f64(zoom):
+    lats, lons = _random_points(20_000, seed=zoom)
+    rows = np.asarray(mercator.row_from_latitude(lats, zoom, dtype=jnp.float64))
+    cols = np.asarray(mercator.column_from_longitude(lons, zoom, dtype=jnp.float64))
+    exp_rows = np.array([oracle.row_from_latitude(la, zoom) for la in lats])
+    exp_cols = np.array([oracle.column_from_longitude(lo, zoom) for lo in lons])
+    np.testing.assert_array_equal(rows, exp_rows)
+    np.testing.assert_array_equal(cols, exp_cols)
+
+
+@pytest.mark.parametrize("zoom,max_rate", [(5, 2e-4), (10, 7e-3), (15, 0.15)])
+def test_f32_fast_path_agreement(zoom, max_rate):
+    # f32 is the fast TPU path. Its mercator_y carries a ~25-ulp error
+    # (tan/log chain, amplified by sec(lat) conditioning at high
+    # latitudes), so the boundary-mismatch rate grows as ~2^zoom * err.
+    # These thresholds document the measured contract; exact binning uses
+    # f64 or the host-side native loader (mercator.py precision policy).
+    lats, lons = _random_points(50_000, seed=7)
+    r32 = np.asarray(mercator.row_from_latitude(lats, zoom, dtype=jnp.float32))
+    r64 = np.asarray(mercator.row_from_latitude(lats, zoom, dtype=jnp.float64))
+    mismatch = np.mean(r32 != r64)
+    assert mismatch < max_rate, f"f32 row mismatch rate {mismatch} at z{zoom}"
+    # Mismatches, when they occur, are off by exactly one row.
+    diff = np.abs(r32[r32 != r64] - r64[r32 != r64])
+    if diff.size:
+        assert diff.max() == 1.0
+
+
+def test_inverse_projection_matches_oracle():
+    # Continuous outputs can differ from libm by ~1 ulp (XLA's exp/atan
+    # are not the platform libm), so assert ulp-tight closeness here;
+    # *tile assignment* identity (the thing that matters) is asserted in
+    # test_keys.py::test_parent_equals_reference_center_reprojection.
+    zooms = [1, 8, 16, 21]
+    for zoom in zooms:
+        rows = np.arange(0, 1 << min(zoom, 12), max(1, (1 << min(zoom, 12)) // 257))
+        lat = np.asarray(mercator.latitude_from_row(rows, zoom, dtype=jnp.float64))
+        exp = np.array([oracle.latitude_from_row(r, zoom) for r in rows])
+        np.testing.assert_allclose(lat, exp, rtol=1e-12, atol=1e-11)
+        lon = np.asarray(mercator.longitude_from_column(rows, zoom, dtype=jnp.float64))
+        exp_lon = np.array([oracle.longitude_from_column(r, zoom) for r in rows])
+        np.testing.assert_array_equal(lon, exp_lon)  # lon path is arithmetic-only
+
+
+def test_no_clamp_quirks():
+    # SURVEY.md §8.5: no pole clamp, no antimeridian wrap.
+    zoom = 10
+    # lon == 180 -> column == 2^zoom (out of range, preserved behavior).
+    col = float(mercator.column_from_longitude(180.0, zoom, dtype=jnp.float64))
+    assert col == float(1 << zoom)
+    # |lat| beyond the mercator edge -> row outside [0, 2^zoom).
+    row_hi = float(mercator.row_from_latitude(89.0, zoom, dtype=jnp.float64))
+    assert row_hi < 0 or row_hi >= (1 << zoom) or row_hi == 0
+    assert row_hi == oracle.row_from_latitude(89.0, zoom)
+    # lat == 90 -> non-finite (tan/cos blow up), not an exception.
+    row_pole = mercator.row_from_latitude(90.0, zoom, dtype=jnp.float64)
+    # CPython raises/returns inf depending on libm; we just require non-crash
+    # and that project_points masks it out.
+    _, _, valid = mercator.project_points(
+        np.array([90.0, 0.0]), np.array([0.0, 0.0]), zoom
+    )
+    assert not bool(valid[0]) and bool(valid[1])
+    del row_pole
+
+
+def test_project_points_validity_mask():
+    zoom = 8
+    lats = np.array([0.0, 86.0, -86.0, 90.0, 45.0])
+    lons = np.array([0.0, 0.0, 0.0, 0.0, 180.0])
+    row, col, valid = mercator.project_points(lats, lons, zoom)
+    assert valid.tolist() == [True, False, False, False, False]
+    assert 0 <= int(row[0]) < (1 << zoom)
+    assert 0 <= int(col[0]) < (1 << zoom)
+
+
+def test_floor_semantics_negative():
+    # floor, not truncation: a latitude slightly above the mercator edge
+    # gives row -1, not 0 (SURVEY.md §8.5).
+    zoom = 4
+    lat = 85.3  # above MAX_LATITUDE -> mercator_y slightly negative
+    row = float(mercator.row_from_latitude(lat, zoom, dtype=jnp.float64))
+    assert row == oracle.row_from_latitude(lat, zoom)
+    assert row == -1.0
+
+
+def test_max_latitude_constant():
+    assert math.isclose(mercator.MAX_LATITUDE, 85.05112877980659, abs_tol=1e-12)
+
+
+def test_tile_center_matches_oracle():
+    zoom = 12
+    rows = np.array([0, 100, 2047, 4095])
+    cols = np.array([5, 999, 4000, 0])
+    lat, lon = mercator.tile_center_latlon(rows, cols, zoom, dtype=jnp.float64)
+    for i in range(len(rows)):
+        exp_lat, exp_lon, _ = oracle.tile_center(f"{zoom}_{rows[i]}_{cols[i]}")
+        np.testing.assert_allclose(float(lat[i]), exp_lat, rtol=1e-12, atol=1e-11)
+        assert float(lon[i]) == exp_lon

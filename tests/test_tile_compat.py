@@ -1,0 +1,71 @@
+"""API-parity tests for the scalar Tile compatibility class."""
+
+import numpy as np
+
+from heatmap_tpu.tilemath import Tile
+import oracle
+
+
+def test_classmethod_surface():
+    for name in (
+        "tile_id_from_lat_long",
+        "tile_from_tile_id",
+        "tile_id_from_row_column",
+        "decode_tile_id",
+        "tile_ids_for_all_zoom_levels",
+        "row_from_latitude",
+        "column_from_longitude",
+        "latitude_from_row",
+        "longitude_from_column",
+    ):
+        assert callable(getattr(Tile, name)), name
+    assert Tile.MAX_ZOOM == 16 and Tile.MIN_ZOOM == 0
+
+
+def test_tile_id_matches_oracle():
+    rng = np.random.default_rng(0)
+    for la, lo in zip(rng.uniform(-85, 85, 100), rng.uniform(-180, 180, 100)):
+        for z in (0, 7, 16, 21):
+            assert Tile.tile_id_from_lat_long(la, lo, z) == oracle.tile_id(la, lo, z)
+
+
+def test_tile_from_tile_id_fields():
+    t = Tile.tile_from_tile_id("10_397_163")
+    assert (t.zoom, t.row, t.column) == (10, 397, 163)
+    exp_lat, exp_lon, _ = oracle.tile_center("10_397_163")
+    assert t.center_latitude == exp_lat
+    assert t.center_longitude == exp_lon
+    assert t.latitude_north > t.center_latitude > t.latitude_south
+    assert t.longitude_west < t.center_longitude < t.longitude_east
+    assert Tile.tile_from_tile_id("malformed") is None
+    assert Tile.tile_from_tile_id("1_2") is None
+
+
+def test_decode_tile_id():
+    assert Tile.decode_tile_id("5_10_20") == {
+        "id": "5_10_20",
+        "zoom": 5,
+        "row": 10,
+        "column": 20,
+    }
+    assert Tile.decode_tile_id("nope") is None
+
+
+def test_parent_and_children_roundtrip():
+    t = Tile.tile_from_tile_id("10_397_163")
+    assert t.parent_id() == "9_198_81"
+    p = t.parent()
+    assert (p.row, p.column) == (t.row >> 1, t.column >> 1)
+    kids = t.children()
+    assert len(kids) == 4
+    for kid in kids:
+        kt = Tile.tile_from_tile_id(kid)
+        assert kt.zoom == 11
+        assert (kt.row >> 1, kt.column >> 1) == (t.row, t.column)
+
+
+def test_tile_ids_for_all_zoom_levels_excludes_zoom0():
+    ids = Tile.tile_ids_for_all_zoom_levels("16_25000_11000")
+    assert len(ids) == 16  # zooms 16..1, zoom 0 excluded (reference quirk)
+    assert ids[0].startswith("16_")
+    assert ids[-1].startswith("1_")
