@@ -8,6 +8,9 @@ full build plan):
 
 - ``tilemath`` — vectorized Web-Mercator projection, integer tile keys,
   Morton codes (replaces reference tile.py's string ids and scalar trig).
+- ``ops`` — dense window-raster histograms, fixed-capacity sparse
+  sort+segment-sum aggregation, and zoom-pyramid rollups (replaces
+  Spark's reduceByKey/groupByKey shuffles, reference heatmap.py:111-112).
 """
 
 __version__ = "0.1.0"
@@ -19,4 +22,14 @@ from heatmap_tpu.tilemath import (  # noqa: F401
     longitude_from_column,
     row_from_latitude,
     tile_id_from_lat_long,
+)
+from heatmap_tpu.ops import (  # noqa: F401
+    Window,
+    aggregate_keys,
+    bin_points_window,
+    bin_rowcol_window,
+    coarsen_raster,
+    pyramid_from_raster,
+    pyramid_sparse_morton,
+    window_from_bounds,
 )

@@ -1,0 +1,30 @@
+"""Aggregation kernels: the TPU-native replacement for Spark's shuffles.
+
+The reference aggregates with reduceByKey/groupByKey over string keys
+(reference heatmap.py:111-112; 32 shuffles per run, SURVEY.md §3.3).
+Here the same work is three jit-compiled primitives:
+
+- ``histogram``: dense window-raster scatter-add — points -> (H, W)
+  counts for a bounded tile window at one zoom.
+- ``sparse``: fixed-capacity sort + segment-sum over integer keys —
+  the global / per-user aggregation path, XLA-friendly (static shapes,
+  no data-dependent control flow).
+- ``pyramid``: zoom rollups — 2x2 reshape-sums on rasters, and
+  order-preserving Morton-shift re-aggregation on sparse keys.
+"""
+
+from heatmap_tpu.ops.histogram import (  # noqa: F401
+    Window,
+    bin_points_window,
+    bin_rowcol_window,
+    window_from_bounds,
+)
+from heatmap_tpu.ops.sparse import (  # noqa: F401
+    aggregate_keys,
+    aggregate_sorted_keys,
+)
+from heatmap_tpu.ops.pyramid import (  # noqa: F401
+    coarsen_raster,
+    pyramid_from_raster,
+    pyramid_sparse_morton,
+)

@@ -1,0 +1,96 @@
+"""Zoom-pyramid rollups: reshape-sums (dense) and Morton shifts (sparse).
+
+The reference coarsens one zoom per Spark stage by round-tripping every
+aggregate through inverse+forward projection (reference heatmap.py:60-61,
+109-117) — 15 redundant trig passes and 32 shuffles. With integer tile
+keys the parent relation is a bit shift (tilemath/keys.py), so:
+
+- dense: a full pyramid from a window raster is a chain of 2x2
+  reshape+sums, entirely on-device, zero trig;
+- sparse: Morton codes sorted once at detail zoom stay sorted under the
+  ``>> 2`` parent shift, so every coarser level is a plain segment-sum
+  over the already-sorted order (ops/sparse.py).
+
+Equivalence to the reference's center-re-projection is property-tested
+in tests/test_keys.py::test_parent_equals_reference_center_reprojection.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from heatmap_tpu.ops import sparse as sparse_ops
+
+
+def coarsen_raster(raster):
+    """Sum 2x2 blocks: (..., H, W) -> (..., H//2, W//2).
+
+    Requires even H, W (Window.aligned_to guarantees this for aligned
+    windows).
+    """
+    *batch, h, w = raster.shape
+    if h % 2 or w % 2:
+        raise ValueError(f"raster {raster.shape} not 2x2-coarsenable")
+    r = raster.reshape(*batch, h // 2, 2, w // 2, 2)
+    return r.sum(axis=(-3, -1))
+
+
+def pyramid_from_raster(raster, levels: int):
+    """Full rollup: returns [raster, coarsen(raster), ...] — levels+1 entries.
+
+    The i-th entry is the detail raster coarsened i zooms; with an
+    aligned Window the entry at level i covers rows
+    [row0>>i, (row0+H)>>i) of the global grid at zoom-i.
+    """
+    out = [raster]
+    for _ in range(levels):
+        raster = coarsen_raster(raster)
+        out.append(raster)
+    return out
+
+
+def pyramid_sparse_morton(
+    codes,
+    weights=None,
+    valid=None,
+    levels: int = 0,
+    capacity=None,
+    acc_dtype=None,
+):
+    """Sparse pyramid: per-level (unique Morton codes, sums) from point codes.
+
+    Sorts once at detail zoom, then re-reduces the shifted (still
+    sorted) codes per level. Levels beyond the first operate on the
+    previous level's unique codes (capacity-sized), not the raw points,
+    so total work is O(N log N + N + levels * capacity).
+
+    Returns a list of (codes[capacity_i], sums[capacity_i], n_unique),
+    entry 0 at detail zoom, entry i coarsened by i zooms.
+    ``capacity`` may be an int (same for all levels) or a per-level list.
+    """
+    codes = jnp.asarray(codes)
+    n = codes.shape[0]
+    caps = (
+        [capacity or n] * (levels + 1)
+        if capacity is None or isinstance(capacity, int)
+        else list(capacity)
+    )
+    if len(caps) != levels + 1:
+        raise ValueError(f"need {levels + 1} capacities, got {len(caps)}")
+
+    out = []
+    uniq, sums, count = sparse_ops.aggregate_keys(
+        codes, weights=weights, valid=valid, capacity=caps[0], acc_dtype=acc_dtype
+    )
+    out.append((uniq, sums, count))
+    sentinel = jnp.iinfo(codes.dtype).max
+    for lvl in range(1, levels + 1):
+        # Parent codes of the previous level's uniques; sentinel slots
+        # must stay sentinel (a plain shift would corrupt them into
+        # plausible-looking codes).
+        parents = jnp.where(uniq == sentinel, sentinel, uniq >> 2)
+        uniq, sums, count = sparse_ops.aggregate_sorted_keys(
+            parents, sums, caps[lvl], sentinel=sentinel
+        )
+        out.append((uniq, sums, count))
+    return out

@@ -62,12 +62,21 @@ def _compact1by1_64(x):
     return x
 
 
-def morton_encode(row, col, dtype=jnp.int32):
+def morton_encode(row, col, dtype=jnp.int32, zoom=None):
     """Interleave (row, col) into a Z-order code; row occupies odd bits.
 
     ``dtype=jnp.int32`` supports zooms <= 15; ``jnp.int64`` (x64 only)
-    supports zooms <= 29.
+    supports zooms <= 29. Coordinates beyond the dtype's range would be
+    silently bit-truncated into aliased codes, so pass the static
+    ``zoom`` whenever it is known to get a loud error instead.
     """
+    if zoom is not None:
+        limit = 15 if jnp.dtype(dtype).itemsize == 4 else 29
+        if zoom > limit:
+            raise ValueError(
+                f"morton {jnp.dtype(dtype).name} codes hold zooms <= {limit}, "
+                f"got zoom={zoom}; use a wider dtype"
+            )
     if jnp.dtype(dtype).itemsize == 4:
         r = jnp.asarray(row, jnp.int32)
         c = jnp.asarray(col, jnp.int32)

@@ -1,0 +1,258 @@
+"""Tests for aggregation ops: dense histograms, sparse reduce, pyramids."""
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heatmap_tpu.ops import (
+    Window,
+    bin_points_window,
+    bin_rowcol_window,
+    coarsen_raster,
+    pyramid_from_raster,
+    pyramid_sparse_morton,
+    window_from_bounds,
+    aggregate_keys,
+)
+from heatmap_tpu.tilemath import mercator, morton
+import oracle
+
+
+def _rand_points(n, seed=0, lat=(30.0, 60.0), lon=(-10.0, 30.0)):
+    rng = np.random.default_rng(seed)
+    return rng.uniform(*lat, n), rng.uniform(*lon, n)
+
+
+# -- Window ----------------------------------------------------------------
+
+
+def test_window_validation():
+    Window(zoom=4, row0=0, col0=0, height=16, width=16)
+    with pytest.raises(ValueError):
+        Window(zoom=4, row0=8, col0=0, height=16, width=16)
+    with pytest.raises(ValueError):
+        Window(zoom=4, row0=0, col0=-1, height=4, width=4)
+
+
+def test_window_from_bounds_covers_points():
+    lats, lons = _rand_points(2000, seed=1)
+    win = window_from_bounds((30.0, 60.0), (-10.0, 30.0), zoom=10, align_levels=3)
+    assert win.aligned_to(3)
+    row, col, valid = mercator.project_points(lats, lons, 10)
+    assert bool(valid.all())
+    r = np.asarray(row)
+    c = np.asarray(col)
+    assert (r >= win.row0).all() and (r < win.row0 + win.height).all()
+    assert (c >= win.col0).all() and (c < win.col0 + win.width).all()
+
+
+def test_window_pad_multiple_stays_in_grid():
+    win = window_from_bounds((84.0, 85.0), (170.0, 179.9), zoom=6, pad_multiple=16)
+    assert win.row0 + win.height <= 1 << 6
+    assert win.col0 + win.width <= 1 << 6
+    assert win.height % 16 == 0
+
+
+def test_window_rejects_empty_and_polar_bounds():
+    with pytest.raises(ValueError):
+        Window(zoom=4, row0=0, col0=0, height=0, width=4)
+    with pytest.raises(ValueError):
+        Window(zoom=4, row0=0, col0=0, height=-8, width=4)
+    # Bbox entirely poleward of the mercator edge covers no tiles.
+    with pytest.raises(ValueError):
+        window_from_bounds((86.0, 89.0), (10.0, 20.0), zoom=8)
+
+
+def test_window_pad_uses_lcm_not_product():
+    # align 2^3=8 with pad_multiple=16 -> quantum lcm=16, not 128.
+    win = window_from_bounds(
+        (52.4, 52.6), (13.3, 13.5), zoom=12, align_levels=3, pad_multiple=16
+    )
+    assert win.height % 16 == 0 and win.width % 16 == 0
+    assert win.aligned_to(3)
+    assert win.height <= 32 and win.width <= 32
+
+
+def test_morton_encode_zoom_guard():
+    with pytest.raises(ValueError):
+        morton.morton_encode(np.int32(0), np.int32(0), dtype=jnp.int32, zoom=16)
+    morton.morton_encode(np.int32(0), np.int32(0), dtype=jnp.int32, zoom=15)
+
+
+# -- dense histogram -------------------------------------------------------
+
+
+def test_bin_points_window_matches_numpy():
+    lats, lons = _rand_points(10_000, seed=2)
+    zoom = 10
+    win = window_from_bounds((30.0, 60.0), (-10.0, 30.0), zoom=zoom)
+    raster = np.asarray(bin_points_window(lats, lons, win))
+    assert raster.sum() == 10_000
+
+    expected = np.zeros(win.shape, np.int64)
+    for la, lo in zip(lats, lons):
+        r = int(oracle.row_from_latitude(la, zoom)) - win.row0
+        c = int(oracle.column_from_longitude(lo, zoom)) - win.col0
+        expected[r, c] += 1
+    np.testing.assert_array_equal(raster, expected)
+
+
+def test_bin_weighted_and_out_of_window_drop():
+    win = Window(zoom=5, row0=8, col0=8, height=4, width=4)
+    rows = np.array([8, 8, 9, 0, 31], np.int32)  # last two outside
+    cols = np.array([8, 8, 11, 0, 31], np.int32)
+    w = np.array([1.5, 2.5, 3.0, 100.0, 100.0], np.float32)
+    raster = np.asarray(bin_rowcol_window(rows, cols, win, weights=w))
+    assert raster.dtype == np.float32
+    assert raster.sum() == pytest.approx(7.0)
+    assert raster[0, 0] == pytest.approx(4.0)
+    assert raster[1, 3] == pytest.approx(3.0)
+
+
+def test_bin_respects_valid_mask():
+    win = Window(zoom=8, row0=0, col0=0, height=8, width=8)
+    rows = np.array([0, 1], np.int32)
+    cols = np.array([0, 1], np.int32)
+    valid = np.array([True, False])
+    raster = np.asarray(bin_rowcol_window(rows, cols, win, valid=valid))
+    assert raster.sum() == 1
+
+
+def test_bin_points_jit_compatible():
+    win = Window(zoom=10, row0=0, col0=0, height=64, width=64)
+    lats = np.full(100, 84.5)
+    lons = np.full(100, -179.0)
+
+    fn = jax.jit(lambda la, lo: bin_points_window(la, lo, win))
+    raster = np.asarray(fn(lats, lons))
+    assert raster.sum() == 100
+
+
+# -- pyramid (dense) -------------------------------------------------------
+
+
+def test_coarsen_raster():
+    r = jnp.arange(16, dtype=jnp.int32).reshape(4, 4)
+    c = np.asarray(coarsen_raster(r))
+    np.testing.assert_array_equal(c, [[10, 18], [42, 50]])
+    with pytest.raises(ValueError):
+        coarsen_raster(jnp.zeros((3, 4)))
+
+
+def test_pyramid_preserves_totals_and_alignment():
+    lats, lons = _rand_points(5000, seed=3)
+    zoom, levels = 12, 5
+    win = window_from_bounds((30.0, 60.0), (-10.0, 30.0), zoom=zoom, align_levels=levels)
+    raster = bin_points_window(lats, lons, win)
+    pyr = pyramid_from_raster(raster, levels)
+    assert len(pyr) == levels + 1
+    for lvl, level_raster in enumerate(pyr):
+        assert int(level_raster.sum()) == 5000
+        assert level_raster.shape == (win.height >> lvl, win.width >> lvl)
+
+    # Level counts must equal direct binning at the coarser zoom
+    # (the shift-pyramid == reference center-re-projection contract).
+    for lvl in (1, 3, 5):
+        sub_zoom = zoom - lvl
+        sub_win = Window(
+            zoom=sub_zoom,
+            row0=win.row0 >> lvl,
+            col0=win.col0 >> lvl,
+            height=win.height >> lvl,
+            width=win.width >> lvl,
+        )
+        direct = np.asarray(bin_points_window(lats, lons, sub_win))
+        np.testing.assert_array_equal(np.asarray(pyr[lvl]), direct)
+
+
+# -- sparse ----------------------------------------------------------------
+
+
+def test_aggregate_keys_matches_counter():
+    rng = np.random.default_rng(4)
+    keys = rng.integers(0, 50, 1000).astype(np.int32)
+    uniq, sums, n = aggregate_keys(keys)
+    n = int(n)
+    expected = collections.Counter(keys.tolist())
+    assert n == len(expected)
+    got = dict(zip(np.asarray(uniq[:n]).tolist(), np.asarray(sums[:n]).tolist()))
+    assert got == {int(k): int(v) for k, v in expected.items()}
+    # Sorted ascending, sentinel-padded.
+    assert np.all(np.diff(np.asarray(uniq[:n])) > 0)
+    assert np.all(np.asarray(uniq[n:]) == np.iinfo(np.int32).max)
+    assert np.asarray(sums[n:]).sum() == 0
+
+
+def test_aggregate_keys_weighted_valid_capacity():
+    keys = np.array([5, 5, 3, 3, 3, 9], np.int32)
+    w = np.array([1.0, 2.0, 10.0, 20.0, 30.0, 7.0], np.float32)
+    valid = np.array([True, True, True, True, True, False])
+    uniq, sums, n = aggregate_keys(keys, weights=w, valid=valid, capacity=4)
+    assert int(n) == 2
+    np.testing.assert_array_equal(np.asarray(uniq[:2]), [3, 5])
+    np.testing.assert_allclose(np.asarray(sums[:2]), [60.0, 3.0])
+
+
+def test_aggregate_keys_capacity_overflow_drops():
+    keys = np.array([1, 2, 3, 4], np.int32)
+    uniq, sums, n = aggregate_keys(keys, capacity=2)
+    # n reports true uniques; only first `capacity` sorted keys materialize.
+    assert int(n) == 4
+    np.testing.assert_array_equal(np.asarray(uniq), [1, 2])
+
+
+def test_aggregate_keys_jit():
+    fn = jax.jit(lambda k: aggregate_keys(k, capacity=8))
+    uniq, sums, n = fn(jnp.asarray(np.array([2, 2, 7], np.int32)))
+    assert int(n) == 2
+
+
+# -- sparse morton pyramid -------------------------------------------------
+
+
+def test_pyramid_sparse_morton_matches_counters():
+    rng = np.random.default_rng(5)
+    zoom, levels = 12, 4
+    rows = rng.integers(0, 1 << zoom, 3000).astype(np.int32)
+    cols = rng.integers(0, 1 << zoom, 3000).astype(np.int32)
+    codes = np.asarray(morton.morton_encode(rows, cols, dtype=jnp.int32))
+
+    out = pyramid_sparse_morton(jnp.asarray(codes), levels=levels, capacity=3000)
+    assert len(out) == levels + 1
+    for lvl, (uniq, sums, n) in enumerate(out):
+        n = int(n)
+        expected = collections.Counter(
+            zip((rows >> lvl).tolist(), (cols >> lvl).tolist())
+        )
+        assert n == len(expected)
+        u = np.asarray(uniq[:n])
+        s = np.asarray(sums[:n])
+        dec_r, dec_c = morton.morton_decode(jnp.asarray(u))
+        got = dict(
+            zip(
+                zip(np.asarray(dec_r).tolist(), np.asarray(dec_c).tolist()),
+                s.tolist(),
+            )
+        )
+        assert got == dict(expected)
+        assert int(s.sum()) == 3000
+
+
+def test_pyramid_sparse_morton_weighted_with_invalid():
+    zoom = 6
+    rows = np.array([1, 1, 2, 3], np.int32)
+    cols = np.array([1, 1, 2, 3], np.int32)
+    codes = morton.morton_encode(rows, cols, dtype=jnp.int32)
+    w = np.array([1.0, 2.0, 4.0, 8.0], np.float32)
+    valid = np.array([True, True, True, False])
+    out = pyramid_sparse_morton(
+        codes, weights=w, valid=valid, levels=zoom, capacity=4
+    )
+    # Top level: everything in one root tile, sum excludes invalid lane.
+    uniq, sums, n = out[-1]
+    assert int(n) == 1
+    assert float(sums[0]) == pytest.approx(7.0)
+    assert int(uniq[0]) == 0
