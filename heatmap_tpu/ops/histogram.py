@@ -76,6 +76,12 @@ def window_from_bounds(
     pyramid alignment); ``pad_multiple`` additionally pads height/width
     up to a multiple (e.g. 256 to keep rasters TPU-lane friendly).
     """
+    if align_levels > zoom:
+        raise ValueError(
+            f"align_levels={align_levels} exceeds zoom={zoom}: the grid has "
+            f"only 2^{zoom} tiles per side, so 2^{align_levels}-alignment is "
+            "impossible"
+        )
     lat_lo, lat_hi = min(lat_range), max(lat_range)
     lon_lo, lon_hi = min(lon_range), max(lon_range)
     n = 1 << zoom
@@ -107,7 +113,13 @@ def window_from_bounds(
 
     height, row0 = _pad(height, row0)
     width, col0 = _pad(width, col0)
-    return Window(zoom=zoom, row0=row0, col0=col0, height=height, width=width)
+    win = Window(zoom=zoom, row0=row0, col0=col0, height=height, width=width)
+    if align_levels and not win.aligned_to(align_levels):
+        raise ValueError(
+            f"could not align window to 2^{align_levels} boundaries within "
+            f"the z{zoom} grid: {win}"
+        )
+    return win
 
 
 def bin_rowcol_window(row, col, window: Window, weights=None, valid=None, dtype=None):
@@ -137,17 +149,21 @@ def bin_points_window(
     longitude,
     window: Window,
     weights=None,
+    valid=None,
     proj_dtype=None,
     dtype=None,
 ):
     """Project lat/lon points and scatter-add them into a window raster.
 
     ``proj_dtype`` picks the projection precision (mercator.py policy:
-    f64 exact when x64 is on, f32 fast otherwise).
+    f64 exact when x64 is on, f32 fast otherwise). ``valid`` ANDs with
+    the projection validity mask (used e.g. for padding lanes).
     """
-    row, col, valid = mercator.project_points(
+    row, col, proj_valid = mercator.project_points(
         latitude, longitude, window.zoom, dtype=proj_dtype
     )
+    if valid is not None:
+        proj_valid = proj_valid & valid
     return bin_rowcol_window(
-        row, col, window, weights=weights, valid=valid, dtype=dtype
+        row, col, window, weights=weights, valid=proj_valid, dtype=dtype
     )

@@ -24,8 +24,12 @@ def aggregate_keys(keys, weights=None, valid=None, capacity=None, acc_dtype=None
     """Reduce-by-key: sum ``weights`` per unique key.
 
     Args:
-      keys: int array [N] (any integer dtype; int32 Morton codes are the
-        fast path).
+      keys: int array [N]. Any integer dtype, EXCEPT that the dtype's
+        maximum value is reserved as the internal sentinel — a key equal
+        to ``iinfo(dtype).max`` would be silently dropped. All tile-key
+        encodings in this framework stay well below it (int32 Morton
+        codes <= 2^31-2 at z15, packed int64 keys use 58 bits), so this
+        only matters for caller-invented key schemes.
       weights: [N] or None (None counts occurrences in int32).
       valid: optional bool [N]; invalid lanes are excluded entirely.
       capacity: max distinct keys to emit (default N). Distinct keys

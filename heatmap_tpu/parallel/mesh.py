@@ -1,0 +1,54 @@
+"""Mesh construction and host-side sharding helpers."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names. ``data`` shards points (the RDD-partition analog);
+# ``tile`` shards raster/tile space (the reducer-partition analog,
+# SURVEY.md §2.3 "spatial parallelism").
+DATA_AXIS = "data"
+TILE_AXIS = "tile"
+
+
+def make_mesh(data: int | None = None, tile: int = 1, devices=None) -> Mesh:
+    """Build a (data, tile) mesh over ``devices``.
+
+    ``data=None`` uses all remaining devices on the data axis. On a
+    multi-host platform, pass ``jax.devices()`` after
+    ``jax.distributed.initialize()`` and the same code spans DCN.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if data is None:
+        if n % tile:
+            raise ValueError(f"{n} devices not divisible by tile={tile}")
+        data = n // tile
+    if data * tile > n:
+        raise ValueError(f"mesh {data}x{tile} needs {data * tile} devices, have {n}")
+    grid = np.asarray(devices[: data * tile]).reshape(data, tile)
+    return Mesh(grid, (DATA_AXIS, TILE_AXIS))
+
+
+def pad_to_multiple(arrays, multiple: int, valid=None):
+    """Pad 1-D point arrays to a length multiple with an explicit mask.
+
+    shard_map needs the sharded dimension divisible by the mesh axis
+    size; the pad lanes are marked invalid so kernels drop them (the
+    same masking path used for out-of-range points).
+
+    Returns (padded_arrays_list, valid_mask).
+    """
+    n = arrays[0].shape[0]
+    for a in arrays:
+        if a.shape[0] != n:
+            raise ValueError("point arrays must share their leading dimension")
+    pad = (-n) % multiple
+    mask = np.ones(n, bool) if valid is None else np.asarray(valid, bool).copy()
+    if pad == 0:
+        return list(arrays), mask
+    padded = [np.concatenate([np.asarray(a), np.zeros((pad,), a.dtype)]) for a in arrays]
+    mask = np.concatenate([mask, np.zeros(pad, bool)])
+    return padded, mask

@@ -1,0 +1,262 @@
+"""shard_map kernels: data-parallel binning with collective merges.
+
+Communication mapping from the reference (SURVEY.md §2.3):
+
+| reference (Spark)                   | here (XLA collectives)            |
+|-------------------------------------|-----------------------------------|
+| RDD partitions of `locations`       | points sharded on the data axis   |
+| reduceByKey shuffle (heatmap.py:111)| lax.psum of partial rasters       |
+| groupByKey shuffle (heatmap.py:112) | lax.psum_scatter (sharded raster) |
+|                                     | / all_gather + local re-reduce    |
+| external shuffle service            | — (ICI/DCN, no spill)             |
+
+All kernels are pure and shard_map-traced over the mesh from
+parallel.mesh; wrap in ``jax.jit`` for the compiled path. They require
+the ``tile`` mesh axis to be 1 for now (points use only the data axis;
+the tile axis is reserved for raster/tile-space sharding).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from heatmap_tpu.ops import histogram, pyramid as pyramid_ops, sparse as sparse_ops
+from heatmap_tpu.parallel.mesh import DATA_AXIS, TILE_AXIS
+
+
+def _data_size(mesh: Mesh) -> int:
+    if mesh.shape[TILE_AXIS] != 1:
+        raise NotImplementedError(
+            "sharded kernels currently require a tile axis of size 1 "
+            f"(got {mesh.shape[TILE_AXIS]})"
+        )
+    return mesh.shape[DATA_AXIS]
+
+
+def _ones_like_weights(weights, n, dtype):
+    return jnp.ones((n,), dtype) if weights is None else jnp.asarray(weights, dtype)
+
+
+def bin_points_replicated(
+    latitude,
+    longitude,
+    window: histogram.Window,
+    mesh: Mesh,
+    weights=None,
+    valid=None,
+    proj_dtype=None,
+    dtype=None,
+):
+    """Bin sharded points into a window raster, psum-merged -> replicated.
+
+    The direct reduceByKey replacement: every device bins its point
+    shard into a full local (H, W) raster, then one ``lax.psum`` over
+    ICI merges them. Point arrays must be divisible by the data axis
+    size (see mesh.pad_to_multiple).
+    """
+    _data_size(mesh)
+    if dtype is None:
+        dtype = jnp.int32 if weights is None else jnp.float32
+    n = latitude.shape[0]
+    w = _ones_like_weights(weights, n, dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+
+    def local(la, lo, w, v):
+        raster = histogram.bin_points_window(
+            la, lo, window, weights=w, valid=v, proj_dtype=proj_dtype, dtype=dtype
+        )
+        return lax.psum(raster, DATA_AXIS)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(),
+    )
+    return fn(latitude, longitude, w, v)
+
+
+def bin_points_rowsharded(
+    latitude,
+    longitude,
+    window: histogram.Window,
+    mesh: Mesh,
+    weights=None,
+    valid=None,
+    proj_dtype=None,
+    dtype=None,
+):
+    """Bin sharded points into a raster left row-sharded across devices.
+
+    The groupByKey replacement: ``lax.psum_scatter`` merges partial
+    rasters AND leaves device i owning row block i — each device holds
+    its slice of merged tile space, like a Spark reducer holding its key
+    range, but the "shuffle" rides ICI as one fused collective. Global
+    result shape (H, W), sharded (H/D, W) per device; window.height must
+    divide by the data axis size.
+    """
+    ndev = _data_size(mesh)
+    if window.height % ndev:
+        raise ValueError(f"window height {window.height} not divisible by {ndev}")
+    if dtype is None:
+        dtype = jnp.int32 if weights is None else jnp.float32
+    n = latitude.shape[0]
+    w = _ones_like_weights(weights, n, dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+
+    def local(la, lo, w, v):
+        raster = histogram.bin_points_window(
+            la, lo, window, weights=w, valid=v, proj_dtype=proj_dtype, dtype=dtype
+        )
+        return lax.psum_scatter(raster, DATA_AXIS, scatter_dimension=0, tiled=True)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+    )
+    return fn(latitude, longitude, w, v)
+
+
+def pyramid_rowsharded(raster, levels: int, mesh: Mesh):
+    """Pyramid over a row-sharded raster (output of bin_points_rowsharded).
+
+    Levels coarsen locally while every device's row block stays evenly
+    divisible; the remaining coarse levels run replicated after one
+    ``all_gather``. Returns ``levels+1`` rasters: the first
+    ``local_levels+1`` row-sharded, the rest replicated — callers can
+    inspect ``.sharding`` or just use the values.
+    """
+    ndev = _data_size(mesh)
+    h, w = raster.shape
+    block_h = h // ndev
+    local_levels = 0
+    while local_levels < levels and (block_h >> local_levels) % 2 == 0:
+        local_levels += 1
+    gather_levels = levels - local_levels
+
+    def body(block):
+        outs = [block]
+        for _ in range(local_levels):
+            block = pyramid_ops.coarsen_raster(block)
+            outs.append(block)
+        if gather_levels:
+            full = lax.all_gather(block, DATA_AXIS, axis=0, tiled=True)
+            for _ in range(gather_levels):
+                full = pyramid_ops.coarsen_raster(full)
+                outs.append(full)
+        return tuple(outs)
+
+    out_specs = tuple(
+        [P(DATA_AXIS)] * (local_levels + 1) + [P()] * gather_levels
+    )
+    # Outputs after the all_gather are replicated by construction; VMA
+    # can't infer that statically, hence check_vma=False.
+    fn = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=out_specs,
+        check_vma=False,
+    )
+    return list(fn(raster))
+
+
+def aggregate_keys_sharded(
+    keys, mesh: Mesh, weights=None, valid=None, capacity=None, acc_dtype=None
+):
+    """Global reduce-by-key over sharded keys -> replicated uniques/sums.
+
+    Per-device sort+segment-sum (ops/sparse.py), then an ``all_gather``
+    of the compact per-device results and a local re-reduce — the
+    all-reduce formulation of reduceByKey for sparse keys. ``capacity``
+    bounds BOTH the per-device and the merged unique counts.
+    """
+    _data_size(mesh)
+    keys = jnp.asarray(keys)
+    n = keys.shape[0]
+    capacity = n if capacity is None else capacity
+    if acc_dtype is None:
+        acc_dtype = jnp.int32 if weights is None else jnp.float32
+    w = _ones_like_weights(weights, n, acc_dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+    sentinel = jnp.iinfo(keys.dtype).max
+
+    def body(k, w, v):
+        u, s, _ = sparse_ops.aggregate_keys(
+            k, weights=w, valid=v, capacity=capacity, acc_dtype=acc_dtype
+        )
+        gu = lax.all_gather(u, DATA_AXIS, axis=0, tiled=True)
+        gs = lax.all_gather(s, DATA_AXIS, axis=0, tiled=True)
+        return sparse_ops.aggregate_keys(
+            gu, weights=gs, valid=gu != sentinel, capacity=capacity,
+            acc_dtype=acc_dtype,
+        )
+
+    # Replicated-by-construction outputs (post-all_gather re-reduce).
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return fn(keys, w, v)
+
+
+def pyramid_sparse_morton_sharded(
+    codes,
+    mesh: Mesh,
+    weights=None,
+    valid=None,
+    levels: int = 0,
+    capacity=None,
+    acc_dtype=None,
+):
+    """Sharded sparse pyramid: merge detail level once, then roll up.
+
+    Each device reduces its shard at detail zoom; one all_gather merges
+    the compact per-device results; the full pyramid then rolls up from
+    the merged (already sorted) uniques via Morton shifts — replicated,
+    since post-merge work is O(levels * capacity), tiny next to binning.
+    """
+    _data_size(mesh)
+    codes = jnp.asarray(codes)
+    n = codes.shape[0]
+    capacity = n if capacity is None else capacity
+    if acc_dtype is None:
+        acc_dtype = jnp.int32 if weights is None else jnp.float32
+    w = _ones_like_weights(weights, n, acc_dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+    sentinel = jnp.iinfo(codes.dtype).max
+
+    def body(k, w, v):
+        u, s, _ = sparse_ops.aggregate_keys(
+            k, weights=w, valid=v, capacity=capacity, acc_dtype=acc_dtype
+        )
+        gu = lax.all_gather(u, DATA_AXIS, axis=0, tiled=True)
+        gs = lax.all_gather(s, DATA_AXIS, axis=0, tiled=True)
+        return tuple(
+            pyramid_ops.pyramid_sparse_morton(
+                gu,
+                weights=gs,
+                valid=gu != sentinel,
+                levels=levels,
+                capacity=capacity,
+                acc_dtype=acc_dtype,
+            )
+        )
+
+    out_specs = tuple((P(), P(), P()) for _ in range(levels + 1))
+    # Replicated-by-construction outputs (post-all_gather rollup).
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    return list(fn(codes, w, v))

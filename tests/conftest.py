@@ -1,11 +1,14 @@
 """Test harness: 8 virtual CPU devices + x64, per SURVEY.md §4.3.
 
-Must run before the first `import jax` anywhere in the test session.
+Must run before the first backend initialization anywhere in the test
+session. Note: the environment's axon TPU plugin (sitecustomize) forces
+``jax_platforms=axon`` via jax.config at interpreter start, so the
+JAX_PLATFORMS env var is ineffective — the override must go through
+``jax.config.update`` after importing jax.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -14,13 +17,19 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _assert_cpu_mesh():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    assert devs[0].platform == "cpu"
+
+
 @pytest.fixture(scope="session")
 def devices():
-    devs = jax.devices()
-    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
-    return devs
+    return jax.devices()

@@ -256,3 +256,14 @@ def test_pyramid_sparse_morton_weighted_with_invalid():
     assert int(n) == 1
     assert float(sums[0]) == pytest.approx(7.0)
     assert int(uniq[0]) == 0
+
+
+def test_aggregate_keys_sentinel_reservation_documented():
+    # intmax keys are reserved as sentinel and dropped; pinned behavior.
+    uniq, sums, n = aggregate_keys(np.array([5, np.iinfo(np.int32).max], np.int32))
+    assert int(n) == 1 and int(sums[0]) == 1
+
+
+def test_window_from_bounds_rejects_impossible_alignment():
+    with pytest.raises(ValueError):
+        window_from_bounds((30, 60), (-10, 30), zoom=3, align_levels=5)

@@ -1,0 +1,168 @@
+"""Sharded kernels on the 8-device virtual CPU mesh (SURVEY.md §4.3)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heatmap_tpu.ops import (
+    bin_points_window,
+    pyramid_from_raster,
+    pyramid_sparse_morton,
+    aggregate_keys,
+    window_from_bounds,
+)
+from heatmap_tpu.parallel import (
+    aggregate_keys_sharded,
+    bin_points_replicated,
+    bin_points_rowsharded,
+    make_mesh,
+    pad_to_multiple,
+    pyramid_rowsharded,
+    pyramid_sparse_morton_sharded,
+)
+from heatmap_tpu.tilemath import mercator, morton
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+def _points(n=10_007, seed=0):  # deliberately not divisible by 8
+    rng = np.random.default_rng(seed)
+    return (
+        rng.uniform(35.0, 55.0, n),
+        rng.uniform(-5.0, 20.0, n),
+    )
+
+
+def test_make_mesh_shapes():
+    m = make_mesh()
+    assert m.shape == {"data": 8, "tile": 1}
+    m2 = make_mesh(data=4, tile=2)
+    assert m2.shape == {"data": 4, "tile": 2}
+    with pytest.raises(ValueError):
+        make_mesh(data=5, tile=2)
+
+
+def test_pad_to_multiple():
+    a = np.arange(10, dtype=np.float32)
+    (pa,), mask = pad_to_multiple([a], 8)
+    assert pa.shape == (16,) and mask.sum() == 10
+    (pb,), mask2 = pad_to_multiple([a], 5)
+    assert pb.shape == (10,) and mask2.all()
+
+
+def test_replicated_binning_matches_single_device(mesh):
+    lats, lons = _points()
+    win = window_from_bounds((35.0, 55.0), (-5.0, 20.0), zoom=10, align_levels=3)
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    got = np.asarray(
+        bin_points_replicated(jnp.asarray(pla), jnp.asarray(plo), win, mesh,
+                              valid=jnp.asarray(valid))
+    )
+    want = np.asarray(bin_points_window(lats, lons, win))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == len(lats)
+
+
+def test_rowsharded_binning_matches_single_device(mesh):
+    lats, lons = _points(seed=1)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=10, align_levels=3, pad_multiple=8
+    )
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    sharded = bin_points_rowsharded(
+        jnp.asarray(pla), jnp.asarray(plo), win, mesh, valid=jnp.asarray(valid)
+    )
+    assert sharded.shape == win.shape  # global logical shape
+    want = np.asarray(bin_points_window(lats, lons, win))
+    np.testing.assert_array_equal(np.asarray(sharded), want)
+
+
+def test_rowsharded_weighted(mesh):
+    lats, lons = _points(seed=2)
+    w = np.random.default_rng(3).uniform(0.0, 2.0, len(lats)).astype(np.float32)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=9, align_levels=0, pad_multiple=8
+    )
+    (pla, plo, pw), valid = pad_to_multiple([lats, lons, w], 8)
+    got = np.asarray(
+        bin_points_rowsharded(
+            jnp.asarray(pla), jnp.asarray(plo), win, mesh,
+            weights=jnp.asarray(pw), valid=jnp.asarray(valid),
+        )
+    )
+    want = np.asarray(bin_points_window(lats, lons, win, weights=w))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_pyramid_rowsharded_matches_dense(mesh):
+    lats, lons = _points(seed=4)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=11, align_levels=6, pad_multiple=8
+    )
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    sharded = bin_points_rowsharded(
+        jnp.asarray(pla), jnp.asarray(plo), win, mesh, valid=jnp.asarray(valid)
+    )
+    levels = 6
+    pyr = pyramid_rowsharded(sharded, levels, mesh)
+    want_raster = bin_points_window(lats, lons, win)
+    want_pyr = pyramid_from_raster(want_raster, levels)
+    assert len(pyr) == levels + 1
+    for got, want in zip(pyr, want_pyr):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_aggregate_keys_sharded_matches_local(mesh):
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 500, 8 * 1000).astype(np.int32)
+    w = rng.uniform(0, 1, keys.size).astype(np.float32)
+    gu, gs, gn = aggregate_keys_sharded(
+        jnp.asarray(keys), mesh, weights=jnp.asarray(w), capacity=1024
+    )
+    lu, ls, ln = aggregate_keys(jnp.asarray(keys), weights=jnp.asarray(w), capacity=8192)
+    n = int(gn)
+    assert n == int(ln)
+    np.testing.assert_array_equal(np.asarray(gu[:n]), np.asarray(lu[:n]))
+    np.testing.assert_allclose(np.asarray(gs[:n]), np.asarray(ls[:n]), rtol=1e-5)
+
+
+def test_pyramid_sparse_sharded_matches_local(mesh):
+    lats, lons = _points(seed=6)
+    zoom, levels = 12, 5
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    row, col, pvalid = mercator.project_points(pla, plo, zoom)
+    codes = morton.morton_encode(row, col, dtype=jnp.int32, zoom=zoom)
+    v = jnp.asarray(valid) & pvalid
+
+    got = pyramid_sparse_morton_sharded(
+        codes, mesh, valid=v, levels=levels, capacity=16384
+    )
+    want = pyramid_sparse_morton(codes, valid=v, levels=levels, capacity=len(pla))
+    assert len(got) == len(want)
+    for (gu, gs, gn), (wu, ws, wn) in zip(got, want):
+        n = int(wn)
+        assert int(gn) == n
+        np.testing.assert_array_equal(np.asarray(gu[:n]), np.asarray(wu[:n]))
+        np.testing.assert_array_equal(np.asarray(gs[:n]), np.asarray(ws[:n]))
+
+
+def test_sharded_kernels_under_jit(mesh):
+    # The compiled path used in production: whole step under jax.jit.
+    lats, lons = _points(seed=7, n=8 * 512)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=8, align_levels=2, pad_multiple=8
+    )
+
+    @jax.jit
+    def step(la, lo):
+        raster = bin_points_rowsharded(la, lo, win, mesh)
+        return pyramid_rowsharded(raster, 2, mesh)
+
+    pyr = step(jnp.asarray(lats), jnp.asarray(lons))
+    want = pyramid_from_raster(bin_points_window(lats, lons, win), 2)
+    for got, w in zip(pyr, want):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(w))
