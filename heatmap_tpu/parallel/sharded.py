@@ -175,10 +175,13 @@ def aggregate_keys_sharded(
     all-reduce formulation of reduceByKey for sparse keys. ``capacity``
     bounds BOTH the per-device and the merged unique counts.
     """
-    _data_size(mesh)
+    ndev = _data_size(mesh)
     keys = jnp.asarray(keys)
     n = keys.shape[0]
     capacity = n if capacity is None else capacity
+    # Per-device stage: a shard holds at most n//ndev distinct keys, so
+    # sizing it by the global capacity would only inflate the all_gather.
+    local_capacity = min(capacity, n // ndev)
     if acc_dtype is None:
         acc_dtype = jnp.int32 if weights is None else jnp.float32
     w = _ones_like_weights(weights, n, acc_dtype)
@@ -186,15 +189,24 @@ def aggregate_keys_sharded(
     sentinel = jnp.iinfo(keys.dtype).max
 
     def body(k, w, v):
-        u, s, _ = sparse_ops.aggregate_keys(
-            k, weights=w, valid=v, capacity=capacity, acc_dtype=acc_dtype
+        u, s, local_n = sparse_ops.aggregate_keys(
+            k, weights=w, valid=v, capacity=local_capacity, acc_dtype=acc_dtype
         )
         gu = lax.all_gather(u, DATA_AXIS, axis=0, tiled=True)
         gs = lax.all_gather(s, DATA_AXIS, axis=0, tiled=True)
-        return sparse_ops.aggregate_keys(
+        mu, ms, mn = sparse_ops.aggregate_keys(
             gu, weights=gs, valid=gu != sentinel, capacity=capacity,
             acc_dtype=acc_dtype,
         )
+        # Keep the documented overflow contract (ops/sparse.py): if ANY
+        # device overflowed its local stage, keys were already dropped
+        # before the merge and the merged count can look clean — force
+        # the returned n_unique past capacity so callers detect it.
+        local_overflow = lax.pmax(
+            (local_n > local_capacity).astype(jnp.int32), DATA_AXIS
+        )
+        mn = jnp.where(local_overflow > 0, jnp.maximum(mn, capacity + 1), mn)
+        return mu, ms, mn
 
     # Replicated-by-construction outputs (post-all_gather re-reduce).
     fn = jax.shard_map(
@@ -223,10 +235,11 @@ def pyramid_sparse_morton_sharded(
     the merged (already sorted) uniques via Morton shifts — replicated,
     since post-merge work is O(levels * capacity), tiny next to binning.
     """
-    _data_size(mesh)
+    ndev = _data_size(mesh)
     codes = jnp.asarray(codes)
     n = codes.shape[0]
     capacity = n if capacity is None else capacity
+    local_capacity = min(capacity, n // ndev)
     if acc_dtype is None:
         acc_dtype = jnp.int32 if weights is None else jnp.float32
     w = _ones_like_weights(weights, n, acc_dtype)
@@ -234,20 +247,32 @@ def pyramid_sparse_morton_sharded(
     sentinel = jnp.iinfo(codes.dtype).max
 
     def body(k, w, v):
-        u, s, _ = sparse_ops.aggregate_keys(
-            k, weights=w, valid=v, capacity=capacity, acc_dtype=acc_dtype
+        u, s, local_n = sparse_ops.aggregate_keys(
+            k, weights=w, valid=v, capacity=local_capacity, acc_dtype=acc_dtype
         )
         gu = lax.all_gather(u, DATA_AXIS, axis=0, tiled=True)
         gs = lax.all_gather(s, DATA_AXIS, axis=0, tiled=True)
+        out = pyramid_ops.pyramid_sparse_morton(
+            gu,
+            weights=gs,
+            valid=gu != sentinel,
+            levels=levels,
+            capacity=capacity,
+            acc_dtype=acc_dtype,
+        )
+        # Propagate per-device overflow into every level's n_unique so
+        # the ops/sparse.py overflow contract holds (see
+        # aggregate_keys_sharded).
+        local_overflow = lax.pmax(
+            (local_n > local_capacity).astype(jnp.int32), DATA_AXIS
+        )
         return tuple(
-            pyramid_ops.pyramid_sparse_morton(
-                gu,
-                weights=gs,
-                valid=gu != sentinel,
-                levels=levels,
-                capacity=capacity,
-                acc_dtype=acc_dtype,
+            (
+                lu,
+                ls,
+                jnp.where(local_overflow > 0, jnp.maximum(ln, capacity + 1), ln),
             )
+            for (lu, ls, ln) in out
         )
 
     out_specs = tuple((P(), P(), P()) for _ in range(levels + 1))

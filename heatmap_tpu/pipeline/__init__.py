@@ -1,0 +1,28 @@
+"""End-to-end heatmap pipeline: ingest -> group -> bin -> pyramid -> blobs.
+
+Reproduces the full semantic surface of the reference job
+(reference heatmap.py:batchMain, 152-158) on the TPU-native engine:
+
+- ``groups``   — user-id routing rules (reference heatmap.py:64-70).
+- ``timespan`` — timespan labels (reference heatmap.py:38-52), fully
+  implemented (the reference's is dead code beyond "alltime").
+- ``cascade``  — the 16-level zoom cascade and blob regrouping
+  (reference heatmap.py:107-118), in correct-rollup mode and in a
+  compat mode reproducing the reference's 'all'-amplification quirk.
+- ``batch``    — orchestration equivalent to batchMain.
+"""
+
+from heatmap_tpu.pipeline.groups import (  # noqa: F401
+    ALL_GROUP,
+    UserVocab,
+    route_user,
+)
+from heatmap_tpu.pipeline.timespan import timespan_label  # noqa: F401
+from heatmap_tpu.pipeline.cascade import (  # noqa: F401
+    CascadeConfig,
+    build_cascade,
+)
+from heatmap_tpu.pipeline.batch import (  # noqa: F401
+    BatchJobConfig,
+    run_batch,
+)

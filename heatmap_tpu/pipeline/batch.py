@@ -1,0 +1,162 @@
+"""Batch job orchestration: the TPU-native ``batchMain``.
+
+End-to-end equivalent of reference heatmap.py:152-158:
+
+    rows -> dataframe_loader -> build_heatmaps -> heatmap_to_json -> sink
+
+with the Spark RDD program replaced by: host-side ingest filtering +
+vocab building (strings never reach the device), one f64 projection to
+detail-zoom Morton codes, the single-sort composite-key cascade on
+device (cascade.py), and host-side blob egress.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from heatmap_tpu.pipeline import cascade as cascade_mod
+from heatmap_tpu.tilemath import mercator, morton
+from heatmap_tpu.pipeline.groups import ALL_GROUP, EXCLUDED, UserVocab
+from heatmap_tpu.pipeline.timespan import TimespanVocab
+
+BACKGROUND_SOURCE = "background"  # dropped at ingest, reference heatmap.py:28-29
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchJobConfig:
+    """Flags replacing the reference's hard-coded constants
+    (reference heatmap.py:16-23; SURVEY.md §5 "config system")."""
+
+    detail_zoom: int = 21
+    min_detail_zoom: int = 5
+    result_delta: int = 5
+    timespans: tuple = ("alltime",)
+    # Reference-compat quirks (SURVEY.md §8.1, §8.2), off by default:
+    amplify_all: bool = False
+    first_timespan_only: bool = False
+    capacity: int | None = None
+
+    def cascade_config(self) -> cascade_mod.CascadeConfig:
+        return cascade_mod.CascadeConfig(
+            detail_zoom=self.detail_zoom,
+            min_detail_zoom=self.min_detail_zoom,
+            result_delta=self.result_delta,
+            amplify_all=self.amplify_all,
+        )
+
+
+def load_rows(rows):
+    """Ingest filter + column extraction (reference dataframe_loader,
+    heatmap.py:25-36): drops ``source == "background"`` rows, keeps
+    (latitude, longitude, user_id, timestamp), count 1.0 each.
+
+    ``rows``: iterable of dicts with the reference's column names.
+    Returns dict of host arrays/lists.
+    """
+    lats, lons, users, stamps = [], [], [], []
+    for row in rows:
+        if row.get("source") == BACKGROUND_SOURCE:
+            continue
+        lats.append(row["latitude"])
+        lons.append(row["longitude"])
+        users.append(row["user_id"])
+        stamps.append(row.get("timestamp"))
+    return {
+        "latitude": np.asarray(lats, np.float64),
+        "longitude": np.asarray(lons, np.float64),
+        "user_id": users,
+        "timestamp": stamps,
+    }
+
+
+def project_detail_codes(lat: np.ndarray, lon: np.ndarray, detail_zoom: int):
+    """Host-side f64 projection to detail-zoom Morton codes + validity.
+
+    Delegates to the single host projection/encode implementations in
+    tilemath (mercator.project_points_np, morton.morton_encode_np).
+    """
+    row, col, valid = mercator.project_points_np(lat, lon, detail_zoom)
+    return morton.morton_encode_np(row, col), valid
+
+
+def build_emissions(codes, valid, group_ids, timestamps, config: BatchJobConfig):
+    """Expand points into (code, slot) emissions + slot name table.
+
+    Mirrors the reference mapper's group expansion (heatmap.py:64-75):
+    each point emits once for 'all' and once for its routed group (if
+    not excluded), for each requested timespan. With
+    ``first_timespan_only`` (reference early-return quirk, SURVEY.md
+    §8.2) only the first timespan emits.
+    """
+    ts_vocab = TimespanVocab()
+    timespans = (
+        config.timespans[:1] if config.first_timespan_only else config.timespans
+    )
+    per_ts_ids = [ts_vocab.label_ids(t, timestamps) for t in timespans]
+    n_groups = int(group_ids.max(initial=ALL_GROUP)) + 1
+    emit_codes, emit_slots, emit_valid = [], [], []
+    for ts_ids in per_ts_ids:
+        # 'all' emission for every point.
+        emit_codes.append(codes)
+        emit_slots.append(ts_ids.astype(np.int64) * n_groups + ALL_GROUP)
+        emit_valid.append(valid)
+        # per-user emission for non-excluded points.
+        keep = group_ids != EXCLUDED
+        emit_codes.append(codes)
+        emit_slots.append(
+            ts_ids.astype(np.int64) * n_groups + np.where(keep, group_ids, 0)
+        )
+        emit_valid.append(valid & keep)
+    return (
+        np.concatenate(emit_codes),
+        np.concatenate(emit_slots),
+        np.concatenate(emit_valid),
+        ts_vocab,
+        n_groups,
+    )
+
+
+def run_batch(rows, config: BatchJobConfig | None = None, as_json: bool = False):
+    """The full job: rows in, heatmap blobs out (reference batchMain).
+
+    Returns {"user|timespan|coarseTileId": {detailTileId: count}} — or
+    with ``as_json=True`` the inner dicts as JSON strings, matching the
+    reference's (id, heatmap-json) output records
+    (reference heatmap.py:156-157).
+    """
+    config = config or BatchJobConfig()
+    data = load_rows(rows)
+    if len(data["latitude"]) == 0:
+        return {}
+
+    vocab = UserVocab()
+    group_ids = vocab.group_ids(data["user_id"])
+    codes, valid = project_detail_codes(
+        data["latitude"], data["longitude"], config.detail_zoom
+    )
+    e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
+        codes, valid, group_ids, data["timestamp"], config
+    )
+    n_slots = len(ts_vocab) * n_groups
+
+    ccfg = config.cascade_config()
+    levels = cascade_mod.build_cascade(
+        e_codes,
+        e_slots,
+        ccfg,
+        n_slots=n_slots,
+        valid=e_valid,
+        capacity=config.capacity or len(e_codes),
+    )
+    slot_names = {
+        t * n_groups + g: (vocab.name_for(g), ts_vocab.label_for(t))
+        for t in range(len(ts_vocab))
+        for g in range(n_groups)
+    }
+    blobs = cascade_mod.emit_blobs(levels, ccfg, slot_names)
+    if as_json:
+        return {k: json.dumps(v) for k, v in blobs.items()}
+    return blobs

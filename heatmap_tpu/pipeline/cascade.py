@@ -1,0 +1,200 @@
+"""The zoom cascade: one sorted composite key, sixteen levels, zero shuffles.
+
+The reference runs 16 Spark stages, each re-projecting every aggregate's
+tile center and shuffling twice (reference heatmap.py:107-118;
+SURVEY.md §3.3: 32 shuffles). Here the whole cascade is ONE device-side
+sparse pyramid over composite integer keys:
+
+    key = slot * 4^detail_zoom + morton_code,  slot = timespan*G + group
+
+Because the slot multiplier is a power of four, ``key >> 2`` coarsens
+the Morton part one zoom while leaving the (timespan, group) slot
+intact, and preserves sort order — so every cascade level is a plain
+segment-sum over the order established by a single sort
+(ops/pyramid.pyramid_sparse_morton).
+
+Blob regrouping (reference map_to_resultset + groupByKey,
+heatmap.py:79-90,112) happens host-side at egress: the blob id is just
+``key >> 2*result_delta``, no second shuffle.
+
+The reference's '`all`'-amplification quirk (SURVEY.md §8.1:
+``all_z = 2*all_{z+1} + sum_users user_{z+1}``) is reproduced on demand
+by ``amplify_all=True`` as a host-side post-pass over the correct
+per-level aggregates; per-user counts are identical in both modes, as
+they are in the reference.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from heatmap_tpu.ops import pyramid as pyramid_ops
+from heatmap_tpu.pipeline.groups import ALL_GROUP
+from heatmap_tpu.tilemath import keys as keys_mod
+from heatmap_tpu.tilemath.morton import morton_decode_np
+
+
+@dataclasses.dataclass(frozen=True)
+class CascadeConfig:
+    """Static cascade parameters (reference constants, heatmap.py:16-17).
+
+    Levels run at detail zooms ``detail_zoom`` down to
+    ``min_detail_zoom + 1`` inclusive (reference range(21, 5, -1) ->
+    z21..z6); each level's blobs are keyed by the tile ``result_delta``
+    zooms coarser (z16..z1).
+    """
+
+    detail_zoom: int = 21
+    min_detail_zoom: int = 5
+    result_delta: int = 5
+    amplify_all: bool = False
+
+    @property
+    def n_levels(self) -> int:
+        return self.detail_zoom - self.min_detail_zoom - 1
+
+    def __post_init__(self):
+        if self.min_detail_zoom + 1 > self.detail_zoom:
+            raise ValueError(f"empty cascade: {self}")
+        if self.detail_zoom - self.n_levels - self.result_delta < 0:
+            raise ValueError(
+                f"result tiles would go below zoom 0: {self} "
+                f"(min detail zoom {self.min_detail_zoom + 1} needs "
+                f"result_delta <= {self.min_detail_zoom + 1})"
+            )
+
+
+def composite_keys(codes, slots, detail_zoom: int, n_slots: int):
+    """Pack (slot, morton_code) into one sortable, shiftable int64 key."""
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "the composite-key cascade needs int64 keys; enable x64 "
+            "(jax.config.update('jax_enable_x64', True)) first"
+        )
+    code_bits = 2 * detail_zoom
+    if code_bits + max(1, int(np.ceil(np.log2(max(n_slots, 2))))) >= 63:
+        raise ValueError(
+            f"composite keys overflow int64: zoom {detail_zoom} with {n_slots} slots"
+        )
+    codes = jnp.asarray(codes, jnp.int64)
+    slots = jnp.asarray(slots, jnp.int64)
+    return (slots << code_bits) | codes
+
+
+def decode_level_keys(level_keys: np.ndarray, detail_zoom: int, level: int):
+    """Host-side inverse at pyramid ``level``: -> (slot, morton_code)."""
+    code_bits = 2 * (detail_zoom - level)
+    k = np.asarray(level_keys, np.int64)
+    return k >> code_bits, k & ((1 << code_bits) - 1)
+
+
+def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
+                  weights=None, valid=None, capacity=None):
+    """Device-side cascade: per-level (composite key, sum) aggregates.
+
+    Args:
+      codes: detail-zoom Morton codes per emission.
+      slots: (timespan*G + group) slot id per emission.
+      weights/valid/capacity: as in ops.pyramid.pyramid_sparse_morton.
+
+    Returns the list of per-level (keys, sums, n_unique) — level i at
+    detail zoom ``config.detail_zoom - i``.
+    """
+    ck = composite_keys(codes, slots, config.detail_zoom, n_slots)
+    return pyramid_ops.pyramid_sparse_morton(
+        ck,
+        weights=weights,
+        valid=valid,
+        levels=config.n_levels,
+        capacity=capacity,
+    )
+
+
+def emit_blobs(level_data, config: CascadeConfig, slot_names):
+    """Host-side egress: per-level aggregates -> reference-format blobs.
+
+    ``level_data``: list of (keys, sums, n_unique) numpy-able arrays
+    from :func:`build_cascade`. ``slot_names``: slot id ->
+    (user_name, timespan_label).
+
+    Returns {"user|timespan|coarseTileId": {detailTileId: float count}}
+    exactly like the reference write path (reference heatmap.py:54-55,
+    79-90,128-129 — including float counts, SURVEY.md §8.8).
+    """
+    blobs: dict[str, dict[str, float]] = {}
+    sep = "|"  # reference KEY_SEPERATOR [sic], heatmap.py:18
+
+    amplified = _amplified_all(level_data, config, slot_names) if config.amplify_all else None
+
+    for level in range(config.n_levels + 1):
+        keys_arr, sums, n = (np.asarray(x) for x in level_data[level])
+        n = int(n)
+        if n > keys_arr.shape[0]:
+            raise ValueError(
+                f"cascade level {level} overflowed capacity "
+                f"({n} uniques > {keys_arr.shape[0]}); raise `capacity`"
+            )
+        keys_arr, sums = keys_arr[:n], sums[:n]
+        zoom = config.detail_zoom - level
+        slot_ids, codes = decode_level_keys(keys_arr, config.detail_zoom, level)
+        rows, cols = morton_decode_np(codes)
+        c_rows, c_cols = rows >> config.result_delta, cols >> config.result_delta
+        coarse_zoom = zoom - config.result_delta
+
+        values = sums.astype(np.float64)
+
+        for i in range(len(keys_arr)):
+            user, ts = slot_names[int(slot_ids[i])]
+            value = float(values[i])
+            if amplified is not None and user == "all":
+                value = amplified.values[level].get((ts, int(codes[i])), value)
+            blob_id = (
+                f"{user}{sep}{ts}{sep}"
+                f"{keys_mod.tile_id_string(coarse_zoom, c_rows[i], c_cols[i])}"
+            )
+            detail_id = keys_mod.tile_id_string(zoom, rows[i], cols[i])
+            blobs.setdefault(blob_id, {})[detail_id] = value
+    return blobs
+
+
+class _amplified_all:
+    """Reference-compat 'all' counts via the SURVEY.md §8.1 recurrence.
+
+    A_0 = all_0 (correct);  A_L = 2 * rollup(A_{L-1}) + sum_users user_L.
+    Per-user counts are untouched. Computed per (timespan, tile) on the
+    host from the correct level aggregates.
+    """
+
+    def __init__(self, level_data, config: CascadeConfig, slot_names):
+        self.values: list[dict] = []  # level -> {(ts, code): amplified}
+        prev: dict = {}
+        for level in range(config.n_levels + 1):
+            keys_arr, sums, n = (np.asarray(x) for x in level_data[level])
+            keys_arr, sums = keys_arr[: int(n)], sums[: int(n)]
+            slot_ids, codes = decode_level_keys(keys_arr, config.detail_zoom, level)
+            cur: dict = {}
+            user_total: dict = {}
+            all_correct: dict = {}
+            for s, code, v in zip(slot_ids, codes, sums.astype(np.float64)):
+                user, ts = slot_names[int(s)]
+                key = (ts, int(code))
+                if user == "all":
+                    all_correct[key] = v
+                else:
+                    user_total[key] = user_total.get(key, 0.0) + v
+            if level == 0:
+                cur = dict(all_correct)
+            else:
+                rolled: dict = {}
+                for (ts, code), v in prev.items():
+                    pk = (ts, code >> 2)
+                    rolled[pk] = rolled.get(pk, 0.0) + v
+                for key in all_correct:
+                    cur[key] = 2.0 * rolled.get(key, 0.0) + user_total.get(key, 0.0)
+            self.values.append(cur)
+            prev = cur

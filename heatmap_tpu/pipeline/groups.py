@@ -1,0 +1,74 @@
+"""User-group routing: the reference's per-user heatmap rules.
+
+Reference heatmap.py:64-70 semantics, reproduced exactly:
+
+- every point counts toward the ``'all'`` group;
+- user ids starting with ``'x'`` are excluded from per-user heatmaps
+  (they still count in ``'all'``);
+- user ids starting with ``'rt-'`` are pooled under ``"route"``;
+- everyone else gets their own per-user group.
+
+Strings stay on the host; devices see dense int32 group ids
+(``ALL_GROUP == 0``; excluded points get ``EXCLUDED``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ALL_GROUP = 0
+EXCLUDED = -1
+
+ALL_NAME = "all"
+ROUTE_NAME = "route"
+
+
+def route_user(user_id: str):
+    """Routed per-user group name, or None if excluded (x-prefix).
+
+    Mirrors reference heatmap.py:65-70 (prefix tests via slicing, so a
+    bare ``"x"`` or ``"rt-"`` id behaves identically to the reference).
+    """
+    if user_id[:1] == "x":
+        return None
+    if user_id[:3] == "rt-":
+        return ROUTE_NAME
+    return user_id
+
+
+class UserVocab:
+    """Host-side bidirectional map: routed group name <-> dense int id.
+
+    Group 0 is always ``'all'``. Built incrementally so streaming
+    micro-batches can extend it.
+    """
+
+    def __init__(self):
+        self._names = [ALL_NAME]
+        self._ids = {ALL_NAME: ALL_GROUP}
+
+    def __len__(self):
+        return len(self._names)
+
+    @property
+    def names(self):
+        return tuple(self._names)
+
+    def id_for(self, group_name: str) -> int:
+        gid = self._ids.get(group_name)
+        if gid is None:
+            gid = len(self._names)
+            self._names.append(group_name)
+            self._ids[group_name] = gid
+        return gid
+
+    def name_for(self, gid: int) -> str:
+        return self._names[gid]
+
+    def group_ids(self, user_ids) -> np.ndarray:
+        """Vectorize: per-point routed group id (EXCLUDED for x-users)."""
+        out = np.empty(len(user_ids), np.int32)
+        for i, uid in enumerate(user_ids):
+            name = route_user(uid)
+            out[i] = EXCLUDED if name is None else self.id_for(name)
+        return out

@@ -25,9 +25,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-# Packed-key layout: | zoom:6 | row:29 | col:29 | — zooms 0..30 lossless.
+# Packed-key layout: | zoom:6 | row:29 | col:29 | — zooms 0..29 lossless
+# (rows/cols at zoom z need z bits; z30 would need 30-bit fields).
 _ROW_BITS = 29
 _COL_BITS = 29
+MAX_PACK_ZOOM = 29
 
 
 def pack_key(zoom, row, col):
@@ -44,6 +46,13 @@ def pack_key(zoom, row, col):
             "pack_key needs int64 keys; enable x64 (jax.config.update"
             "('jax_enable_x64', True)) or use Morton int32 codes for zoom<=15"
         )
+    try:  # loud zoom-range check when zoom is concrete (host values)
+        if int(np.max(np.asarray(zoom))) > MAX_PACK_ZOOM:
+            raise ValueError(
+                f"pack_key fields hold zooms <= {MAX_PACK_ZOOM}; got {zoom}"
+            )
+    except (TypeError, jax.errors.ConcretizationTypeError):
+        pass  # traced zoom: caller is responsible for the range
     z = jnp.asarray(zoom, jnp.int64)
     r = jnp.asarray(row, jnp.int64)
     c = jnp.asarray(col, jnp.int64)

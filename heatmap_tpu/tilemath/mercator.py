@@ -126,6 +126,32 @@ def project_points(latitude, longitude, zoom, dtype=None):
     return row, col, valid
 
 
+def project_points_np(latitude, longitude, zoom):
+    """Host-side numpy-f64 projection: -> (row, col, valid) int64/bool.
+
+    The exact-precision host path (same operation order as the jnp
+    version above and reference tile.py:17,21); used by the batch
+    pipeline so device dtype policy can't affect ingest binning.
+    """
+    import numpy as np
+
+    n = 1 << zoom
+    lat = np.asarray(latitude, np.float64)
+    lon = np.asarray(longitude, np.float64)
+    with np.errstate(all="ignore"):
+        phi = lat * _PI / 180
+        y = (1 - np.log(np.tan(phi) + 1 / np.cos(phi)) / _PI) / 2
+        frow = np.floor(y * n)
+        fcol = np.floor((lon + 180.0) / 360.0 * n)
+    valid = (
+        np.isfinite(frow) & np.isfinite(fcol)
+        & (frow >= 0) & (frow < n) & (fcol >= 0) & (fcol < n)
+    )
+    row = np.where(valid, frow, 0).astype(np.int64)
+    col = np.where(valid, fcol, 0).astype(np.int64)
+    return row, col, valid
+
+
 def tile_center_latlon(row, column, zoom, dtype=None):
     """Center (lat, lon) of tiles, as the reference computes it.
 

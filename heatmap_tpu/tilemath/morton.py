@@ -104,3 +104,45 @@ def morton_parent(code, levels=1):
     whole point (module docstring).
     """
     return code >> (2 * levels)
+
+
+# ---------------------------------------------------------------------------
+# Host-side numpy variants (single source of truth for host pipelines —
+# pipeline/batch.py encodes with these, pipeline/cascade.py decodes).
+# ---------------------------------------------------------------------------
+
+import numpy as np  # noqa: E402
+
+
+def morton_encode_np(row, col) -> np.ndarray:
+    """Numpy 64-bit Morton encode (zooms <= 29, like the jnp int64 path)."""
+
+    def part(x):
+        x = np.asarray(x, np.uint64) & np.uint64(0xFFFFFFFF)
+        x = (x | (x << np.uint64(16))) & np.uint64(0x0000FFFF0000FFFF)
+        x = (x | (x << np.uint64(8))) & np.uint64(0x00FF00FF00FF00FF)
+        x = (x | (x << np.uint64(4))) & np.uint64(0x0F0F0F0F0F0F0F0F)
+        x = (x | (x << np.uint64(2))) & np.uint64(0x3333333333333333)
+        x = (x | (x << np.uint64(1))) & np.uint64(0x5555555555555555)
+        return x
+
+    return ((part(row) << np.uint64(1)) | part(col)).astype(np.int64)
+
+
+def morton_decode_np(code) -> tuple[np.ndarray, np.ndarray]:
+    """Numpy 64-bit Morton decode -> (row, col) int64."""
+    code = np.asarray(code, np.uint64)
+
+    def compact(x):
+        x &= np.uint64(0x5555555555555555)
+        x = (x | (x >> np.uint64(1))) & np.uint64(0x3333333333333333)
+        x = (x | (x >> np.uint64(2))) & np.uint64(0x0F0F0F0F0F0F0F0F)
+        x = (x | (x >> np.uint64(4))) & np.uint64(0x00FF00FF00FF00FF)
+        x = (x | (x >> np.uint64(8))) & np.uint64(0x0000FFFF0000FFFF)
+        x = (x | (x >> np.uint64(16))) & np.uint64(0x00000000FFFFFFFF)
+        return x
+
+    return (
+        compact(code >> np.uint64(1)).astype(np.int64),
+        compact(code).astype(np.int64),
+    )

@@ -138,3 +138,11 @@ def test_morton_parent_is_shift_and_order_preserving():
     sorted_codes = np.sort(code)
     parents_of_sorted = sorted_codes >> 2
     assert np.all(np.diff(parents_of_sorted) >= 0)
+
+
+def test_pack_key_rejects_zoom_30():
+    import pytest
+
+    with pytest.raises(ValueError):
+        keys.pack_key(30, 0, 0)
+    keys.pack_key(29, (1 << 29) - 1, (1 << 29) - 1)  # max lossless

@@ -166,3 +166,14 @@ def test_sharded_kernels_under_jit(mesh):
     want = pyramid_from_raster(bin_points_window(lats, lons, win), 2)
     for got, w in zip(pyr, want):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(w))
+
+
+def test_aggregate_keys_sharded_local_overflow_signal(mesh):
+    # Review repro: device-local capacity overflow must surface in
+    # n_unique even when the merged count looks clean.
+    keys = np.concatenate(
+        [np.array([0, 1, 2, 3, 4, 5], np.int32)]
+        + [np.array([0, 1, 2, 3, 4, 0], np.int32)] * 7
+    )
+    gu, gs, gn = aggregate_keys_sharded(jnp.asarray(keys), mesh, capacity=5)
+    assert int(gn) > 5  # overflow signalled (device 0 dropped key 5)

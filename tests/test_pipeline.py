@@ -1,0 +1,190 @@
+"""Golden end-to-end tests: pipeline vs the pure-Python reference oracle."""
+
+import json
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.pipeline import (
+    BatchJobConfig,
+    UserVocab,
+    route_user,
+    run_batch,
+    timespan_label,
+)
+from heatmap_tpu.pipeline.groups import ALL_GROUP, EXCLUDED
+import oracle
+
+
+def _rows(n=500, seed=0, users=("alice", "bob", "rt-bus7", "rt-tram2", "xscout", "carol")):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        rows.append(
+            {
+                "latitude": float(rng.uniform(40.0, 55.0)),
+                "longitude": float(rng.uniform(-5.0, 15.0)),
+                "user_id": users[int(rng.integers(0, len(users)))],
+                "timestamp": 1_500_000_000_000 + int(rng.integers(0, 10**9)),
+                "source": "gps" if rng.uniform() > 0.1 else "background",
+            }
+        )
+    return rows
+
+
+# -- unit semantics --------------------------------------------------------
+
+
+def test_route_user_rules():
+    # Reference heatmap.py:64-70 semantics.
+    assert route_user("alice") == "alice"
+    assert route_user("rt-bus7") == "route"
+    assert route_user("rt-") == "route"
+    assert route_user("xscout") is None
+    assert route_user("x") is None
+    # 'rt' without dash is a normal user; 'Xupper' is NOT excluded.
+    assert route_user("rtbus") == "rtbus"
+    assert route_user("Xupper") == "Xupper"
+    for uid in ("alice", "rt-bus7", "xscout", "x", "rtbus"):
+        expected = oracle.user_groups(uid)
+        got = ["all"] + ([route_user(uid)] if route_user(uid) else [])
+        assert got == expected
+
+
+def test_user_vocab():
+    v = UserVocab()
+    ids = v.group_ids(["alice", "rt-a", "rt-b", "xs", "alice"])
+    assert ids[0] == ids[4] != ALL_GROUP
+    assert ids[1] == ids[2]  # pooled under route
+    assert ids[3] == EXCLUDED
+    assert v.name_for(ALL_GROUP) == "all"
+
+
+def test_timespan_labels():
+    import datetime
+
+    d = datetime.date(2017, 3, 7)
+    assert timespan_label("alltime", d) == "alltime"
+    assert timespan_label("year", d) == "2017"
+    assert timespan_label("month", d) == "2017-03"
+    assert timespan_label("day", d) == "2017-03-07"
+    with pytest.raises(ValueError):
+        timespan_label("week", d)
+
+
+# -- golden end-to-end -----------------------------------------------------
+
+
+@pytest.mark.parametrize("detail_zoom,min_zoom", [(12, 5), (21, 16)])
+def test_batch_matches_oracle_correct_mode(detail_zoom, min_zoom):
+    rows = _rows(n=300, seed=detail_zoom)
+    cfg = BatchJobConfig(detail_zoom=detail_zoom, min_detail_zoom=min_zoom)
+    got = run_batch(rows, cfg)
+    want = oracle.run_job(
+        rows, detail_zoom=detail_zoom, min_detail_zoom=min_zoom, amplify_all=False
+    )
+    assert got.keys() == want.keys()
+    for key in want:
+        assert got[key] == want[key], key
+
+
+@pytest.mark.parametrize("detail_zoom,min_zoom", [(12, 5), (21, 16)])
+def test_batch_matches_oracle_amplified_compat(detail_zoom, min_zoom):
+    # Reference-compat mode must reproduce the 'all'-amplification bug
+    # (SURVEY.md §8.1) exactly as the faithful oracle simulates it.
+    rows = _rows(n=300, seed=100 + detail_zoom)
+    cfg = BatchJobConfig(
+        detail_zoom=detail_zoom, min_detail_zoom=min_zoom, amplify_all=True
+    )
+    got = run_batch(rows, cfg)
+    want = oracle.run_job(
+        rows, detail_zoom=detail_zoom, min_detail_zoom=min_zoom, amplify_all=True
+    )
+    assert got.keys() == want.keys()
+    for key in want:
+        assert got[key] == pytest.approx(want[key]), key
+
+
+def test_amplified_all_growth_pattern():
+    # The survey's 4-point example: totals 4 -> 11 -> 25 over three levels
+    # (SURVEY.md §8.1) when all points share one tile deep in the pyramid.
+    rows = [
+        {"latitude": 50.0001, "longitude": 8.0001, "user_id": u, "source": "gps"}
+        for u in ("a", "b", "c", "xd")
+    ]
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=7, amplify_all=True)
+    blobs = run_batch(rows, cfg)
+    all_totals = {}
+    for key, hm in blobs.items():
+        user, ts, coarse = key.split("|")
+        if user == "all":
+            zoom = int(coarse.split("_")[0]) + 5
+            all_totals[zoom] = sum(hm.values())
+    assert all_totals[10] == 4.0
+    assert all_totals[9] == 2 * 4 + 3
+    assert all_totals[8] == 2 * 11 + 3
+
+
+def test_background_rows_dropped():
+    rows = [
+        {"latitude": 50.0, "longitude": 8.0, "user_id": "a", "source": "background"},
+        {"latitude": 50.0, "longitude": 8.0, "user_id": "a", "source": "gps"},
+    ]
+    blobs = run_batch(rows, BatchJobConfig(detail_zoom=8, min_detail_zoom=6))
+    total = sum(v for hm in blobs.items() if hm[0].startswith("all|") for v in hm[1].values())
+    assert total == 2.0  # one point at two levels (z8, z7)
+
+
+def test_empty_input():
+    assert run_batch([]) == {}
+    assert run_batch([{"latitude": 1, "longitude": 1, "user_id": "a",
+                       "source": "background"}]) == {}
+
+
+def test_as_json_output_shape():
+    rows = _rows(n=50, seed=9)
+    blobs = run_batch(rows, BatchJobConfig(detail_zoom=10, min_detail_zoom=8),
+                      as_json=True)
+    for key, payload in blobs.items():
+        user, ts, coarse = key.split("|")
+        assert ts == "alltime"
+        decoded = json.loads(payload)
+        assert all(isinstance(v, float) for v in decoded.values())
+        # detail ids sit exactly result_delta zooms below the coarse id.
+        cz = int(coarse.split("_")[0])
+        for det in decoded:
+            assert int(det.split("_")[0]) == cz + 5
+
+
+def test_multi_timespan_emission():
+    import datetime
+
+    rows = [
+        {
+            "latitude": 50.0,
+            "longitude": 8.0,
+            "user_id": "a",
+            "timestamp": datetime.datetime(2017, 3, 7, 12, 0),
+            "source": "gps",
+        },
+        {
+            "latitude": 50.0,
+            "longitude": 8.0,
+            "user_id": "a",
+            "timestamp": datetime.datetime(2018, 4, 1, 12, 0),
+            "source": "gps",
+        },
+    ]
+    cfg = BatchJobConfig(
+        detail_zoom=8, min_detail_zoom=6, timespans=("alltime", "year", "month")
+    )
+    blobs = run_batch(rows, cfg)
+    labels = {k.split("|")[1] for k in blobs}
+    assert labels == {"alltime", "2017", "2018", "2017-03", "2018-04"}
+    # Quirk-compat mode: only the first timespan emits (SURVEY.md §8.2).
+    cfg_q = BatchJobConfig(
+        detail_zoom=8, min_detail_zoom=6,
+        timespans=("alltime", "year"), first_timespan_only=True,
+    )
+    labels_q = {k.split("|")[1] for k in run_batch(rows, cfg_q)}
+    assert labels_q == {"alltime"}
