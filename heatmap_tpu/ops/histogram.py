@@ -75,6 +75,10 @@ def window_from_bounds(
     ``align_levels`` rounds the window out to 2^levels boundaries (for
     pyramid alignment); ``pad_multiple`` additionally pads height/width
     up to a multiple (e.g. 256 to keep rasters TPU-lane friendly).
+    Alignment is guaranteed (or a ValueError); the pad multiple is
+    best-effort — it clamps to the grid size when the z``zoom`` grid is
+    smaller than the requested multiple, so callers needing exact
+    divisibility (e.g. row-sharding) must check the returned shape.
     """
     if align_levels > zoom:
         raise ValueError(

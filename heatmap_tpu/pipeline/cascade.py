@@ -115,22 +115,14 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     )
 
 
-def emit_blobs(level_data, config: CascadeConfig, slot_names):
-    """Host-side egress: per-level aggregates -> reference-format blobs.
+def decode_levels(level_data, config: CascadeConfig):
+    """One decode pass shared by all egress consumers.
 
-    ``level_data``: list of (keys, sums, n_unique) numpy-able arrays
-    from :func:`build_cascade`. ``slot_names``: slot id ->
-    (user_name, timespan_label).
-
-    Returns {"user|timespan|coarseTileId": {detailTileId: float count}}
-    exactly like the reference write path (reference heatmap.py:54-55,
-    79-90,128-129 — including float counts, SURVEY.md §8.8).
+    Returns per-level dicts of numpy arrays:
+    {slot, code, row, col, zoom, value} — values float64 (reference
+    emits float counts, SURVEY.md §8.8). Raises on capacity overflow.
     """
-    blobs: dict[str, dict[str, float]] = {}
-    sep = "|"  # reference KEY_SEPERATOR [sic], heatmap.py:18
-
-    amplified = _amplified_all(level_data, config, slot_names) if config.amplify_all else None
-
+    out = []
     for level in range(config.n_levels + 1):
         keys_arr, sums, n = (np.asarray(x) for x in level_data[level])
         n = int(n)
@@ -140,61 +132,141 @@ def emit_blobs(level_data, config: CascadeConfig, slot_names):
                 f"({n} uniques > {keys_arr.shape[0]}); raise `capacity`"
             )
         keys_arr, sums = keys_arr[:n], sums[:n]
-        zoom = config.detail_zoom - level
         slot_ids, codes = decode_level_keys(keys_arr, config.detail_zoom, level)
         rows, cols = morton_decode_np(codes)
-        c_rows, c_cols = rows >> config.result_delta, cols >> config.result_delta
-        coarse_zoom = zoom - config.result_delta
+        out.append(
+            {
+                "zoom": config.detail_zoom - level,
+                "slot": slot_ids,
+                "code": codes,
+                "row": rows,
+                "col": cols,
+                "value": sums.astype(np.float64),
+            }
+        )
+    return out
 
-        values = sums.astype(np.float64)
 
-        for i in range(len(keys_arr)):
-            user, ts = slot_names[int(slot_ids[i])]
-            value = float(values[i])
-            if amplified is not None and user == "all":
-                value = amplified.values[level].get((ts, int(codes[i])), value)
-            blob_id = (
-                f"{user}{sep}{ts}{sep}"
-                f"{keys_mod.tile_id_string(coarse_zoom, c_rows[i], c_cols[i])}"
+def emit_level_arrays(level_data, config: CascadeConfig, slot_names):
+    """Columnar egress (the production path): per-level numpy arrays.
+
+    Adds coarse (blob) tile coordinates and resolves slot names to
+    (user, timespan) index arrays; sinks can write these columns
+    directly (files/Arrow/Cassandra batches) without any per-element
+    Python. Applies the amplify_all compat patch when configured.
+    """
+    levels = decode_levels(level_data, config)
+    if config.amplify_all:
+        _patch_amplified(levels, slot_names)
+    n_slots = max(slot_names) + 1
+    users = np.array([slot_names.get(s, ("?", "?"))[0] for s in range(n_slots)])
+    tss = np.array([slot_names.get(s, ("?", "?"))[1] for s in range(n_slots)])
+    for lvl in levels:
+        lvl["user"] = users[lvl["slot"]]
+        lvl["timespan"] = tss[lvl["slot"]]
+        lvl["coarse_zoom"] = lvl["zoom"] - config.result_delta
+        lvl["coarse_row"] = lvl["row"] >> config.result_delta
+        lvl["coarse_col"] = lvl["col"] >> config.result_delta
+    return levels
+
+
+def emit_blobs(level_data, config: CascadeConfig, slot_names):
+    """Reference-format blob egress.
+
+    Returns {"user|timespan|coarseTileId": {detailTileId: float count}}
+    exactly like the reference write path (reference heatmap.py:54-55,
+    79-90,128-129). String/dict building is vectorized with np.char;
+    the per-blob dict assembly is inherently Python-object bound — use
+    :func:`emit_level_arrays` for bulk sinks.
+    """
+    sep = "|"  # reference KEY_SEPERATOR [sic], heatmap.py:18
+    blobs: dict[str, dict[str, float]] = {}
+    for lvl in emit_level_arrays(level_data, config, slot_names):
+        if len(lvl["slot"]) == 0:
+            continue
+        blob_ids = np.char.add(
+            np.char.add(lvl["user"], sep + lvl["timespan"] + sep),
+            _tile_id_strings(lvl["coarse_zoom"], lvl["coarse_row"], lvl["coarse_col"]),
+        )
+        detail_ids = _tile_id_strings(lvl["zoom"], lvl["row"], lvl["col"])
+        values = lvl["value"]
+        # Group by blob id: sort once, slice runs.
+        order = np.argsort(blob_ids, kind="stable")
+        sorted_ids = blob_ids[order]
+        starts = np.flatnonzero(
+            np.concatenate([[True], sorted_ids[1:] != sorted_ids[:-1]])
+        )
+        bounds = np.append(starts, len(sorted_ids))
+        for k, s in enumerate(starts):
+            e = bounds[k + 1]
+            idx = order[s:e]
+            blobs.setdefault(str(sorted_ids[s]), {}).update(
+                zip(detail_ids[idx].tolist(), values[idx].tolist())
             )
-            detail_id = keys_mod.tile_id_string(zoom, rows[i], cols[i])
-            blobs.setdefault(blob_id, {})[detail_id] = value
     return blobs
 
 
-class _amplified_all:
-    """Reference-compat 'all' counts via the SURVEY.md §8.1 recurrence.
+def _tile_id_strings(zoom, rows, cols):
+    """Vectorized reference tile-id strings "zoom_row_col"."""
+    z = np.char.add(np.asarray(zoom).astype(str), "_")
+    return np.char.add(
+        np.char.add(np.char.add(z, rows.astype(str)), "_"), cols.astype(str)
+    )
+
+
+def _patch_amplified(levels, slot_names):
+    """In-place 'all' amplification (SURVEY.md §8.1 recurrence):
 
     A_0 = all_0 (correct);  A_L = 2 * rollup(A_{L-1}) + sum_users user_L.
-    Per-user counts are untouched. Computed per (timespan, tile) on the
-    host from the correct level aggregates.
+    Per-user counts untouched, exactly as in the reference.
     """
-
-    def __init__(self, level_data, config: CascadeConfig, slot_names):
-        self.values: list[dict] = []  # level -> {(ts, code): amplified}
-        prev: dict = {}
-        for level in range(config.n_levels + 1):
-            keys_arr, sums, n = (np.asarray(x) for x in level_data[level])
-            keys_arr, sums = keys_arr[: int(n)], sums[: int(n)]
-            slot_ids, codes = decode_level_keys(keys_arr, config.detail_zoom, level)
-            cur: dict = {}
+    is_all_slot = np.array(
+        [slot_names.get(s, ("?",))[0] == "all" for s in range(max(slot_names) + 1)]
+    )
+    prev: dict = {}
+    for level, lvl in enumerate(levels):
+        all_mask = is_all_slot[lvl["slot"]]
+        cur: dict = {}
+        if level == 0:
+            for s, code, v in zip(
+                lvl["slot"][all_mask], lvl["code"][all_mask], lvl["value"][all_mask]
+            ):
+                cur[(int(s), int(code))] = v
+        else:
+            rolled: dict = {}
+            for (s, code), v in prev.items():
+                pk = (s, code >> 2)
+                rolled[pk] = rolled.get(pk, 0.0) + v
+            # sum over non-all slots sharing the same timespan: non-all
+            # slots at this level map to the all-slot of their timespan
+            # via slot - group (slot = ts*G + g, all has g = 0).
             user_total: dict = {}
-            all_correct: dict = {}
-            for s, code, v in zip(slot_ids, codes, sums.astype(np.float64)):
-                user, ts = slot_names[int(s)]
-                key = (ts, int(code))
-                if user == "all":
-                    all_correct[key] = v
-                else:
-                    user_total[key] = user_total.get(key, 0.0) + v
-            if level == 0:
-                cur = dict(all_correct)
-            else:
-                rolled: dict = {}
-                for (ts, code), v in prev.items():
-                    pk = (ts, code >> 2)
-                    rolled[pk] = rolled.get(pk, 0.0) + v
-                for key in all_correct:
-                    cur[key] = 2.0 * rolled.get(key, 0.0) + user_total.get(key, 0.0)
-            self.values.append(cur)
-            prev = cur
+            ts_base = _all_slot_of(lvl["slot"], is_all_slot)
+            um = ~all_mask
+            for s, code, v in zip(ts_base[um], lvl["code"][um], lvl["value"][um]):
+                k = (int(s), int(code))
+                user_total[k] = user_total.get(k, 0.0) + v
+            for s, code in zip(lvl["slot"][all_mask], lvl["code"][all_mask]):
+                k = (int(s), int(code))
+                cur[k] = 2.0 * rolled.get(k, 0.0) + user_total.get(k, 0.0)
+        # Patch the level's 'all' values in place.
+        patched = np.array(
+            [
+                cur.get((int(s), int(code)), v)
+                for s, code, v in zip(lvl["slot"], lvl["code"], lvl["value"])
+            ]
+        ) if len(lvl["slot"]) else lvl["value"]
+        lvl["value"] = np.where(all_mask, patched, lvl["value"]) if len(lvl["slot"]) else lvl["value"]
+        prev = cur
+
+
+def _all_slot_of(slots, is_all_slot):
+    """Map each slot to the 'all' slot of its timespan block.
+
+    Slots are ts*G + g with g=0 the 'all' group, so the all-slot is the
+    largest all-slot <= slot: computed via searchsorted over the sorted
+    all-slot ids.
+    """
+    all_ids = np.flatnonzero(is_all_slot)
+    pos = np.searchsorted(all_ids, slots, side="right") - 1
+    return all_ids[pos]

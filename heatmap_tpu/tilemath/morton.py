@@ -81,6 +81,14 @@ def morton_encode(row, col, dtype=jnp.int32, zoom=None):
         r = jnp.asarray(row, jnp.int32)
         c = jnp.asarray(col, jnp.int32)
         return (_part1by1_32(r) << 1) | _part1by1_32(c)
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "morton int64 codes need x64 (jax.config.update"
+            "('jax_enable_x64', True)); without it the request would "
+            "silently downgrade to int32 and fail on the 64-bit masks"
+        )
     r = jnp.asarray(row, jnp.int64)
     c = jnp.asarray(col, jnp.int64)
     return (_part1by1_64(r) << 1) | _part1by1_64(c)
