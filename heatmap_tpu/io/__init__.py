@@ -1,0 +1,29 @@
+"""heatmap_tpu.io — host-side ingest sources and egress sinks.
+
+Replaces the reference's Spark-connector storage boundary
+(``get_rows`` / ``write_heatmap_dataframes``, reference
+heatmap.py:131-150) with columnar batch readers and upsert-by-id blob
+writers; PNG tile rendering is new surface (BASELINE.md config 3).
+"""
+
+from heatmap_tpu.io.sources import (  # noqa: F401
+    COLUMNS,
+    CassandraConfig,
+    CassandraSource,
+    CSVSource,
+    JSONLSource,
+    ParquetSource,
+    Source,
+    SyntheticSource,
+    open_source,
+)
+from heatmap_tpu.io.sinks import (  # noqa: F401
+    BlobSink,
+    CassandraBlobSink,
+    DirectoryBlobSink,
+    JSONLBlobSink,
+    MemorySink,
+    PNGTileSink,
+    open_sink,
+)
+from heatmap_tpu.io.png import colorize, png_bytes, raster_to_png  # noqa: F401

@@ -1,0 +1,214 @@
+"""Egress sinks: heatmap blob writers.
+
+TPU-native replacement for the reference's Cassandra egress
+(``write_heatmap_dataframes``, reference heatmap.py:149-150): records
+are ``(id, heatmap)`` pairs where ``id`` is the composite
+``user|timespan|coarseTileId`` key and ``heatmap`` is the JSON dict of
+detail-tile counts (reference heatmap.py:156-157). The reference's
+Cassandra ``append`` mode upserts by primary key (SURVEY.md §8.12);
+every sink here has the same last-write-wins-per-id semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Iterable
+
+import numpy as np
+
+from heatmap_tpu.io.png import raster_to_png
+
+
+class BlobSink:
+    """Base: consumes (id, heatmap-dict-or-json) records."""
+
+    def write(self, records: Iterable[tuple]) -> int:
+        n = 0
+        for blob_id, heatmap in records:
+            self.write_one(blob_id, heatmap)
+            n += 1
+        return n
+
+    def write_one(self, blob_id: str, heatmap) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _as_json(heatmap) -> str:
+    return heatmap if isinstance(heatmap, str) else json.dumps(heatmap)
+
+
+class MemorySink(BlobSink):
+    """Dict-backed sink (tests, small jobs). Upsert-by-id."""
+
+    def __init__(self):
+        self.blobs: dict[str, str] = {}
+
+    def write_one(self, blob_id, heatmap):
+        self.blobs[blob_id] = _as_json(heatmap)
+
+
+@dataclasses.dataclass
+class JSONLBlobSink(BlobSink):
+    """One ``{"id": ..., "heatmap": ...}`` JSON object per line.
+
+    Append-oriented like the reference's write mode; ``load`` applies
+    last-write-wins per id, reproducing Cassandra upsert semantics
+    (reference heatmap.py:150, SURVEY.md §8.12)."""
+
+    path: str
+    _f: object = dataclasses.field(default=None, repr=False)
+
+    def write_one(self, blob_id, heatmap):
+        if self._f is None:
+            os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            self._f = open(self.path, "a")
+        self._f.write(
+            json.dumps({"id": blob_id, "heatmap": _as_json(heatmap)}) + "\n"
+        )
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+    @staticmethod
+    def load(path) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    out[rec["id"]] = json.loads(rec["heatmap"])
+        return out
+
+
+@dataclasses.dataclass
+class DirectoryBlobSink(BlobSink):
+    """One file per blob id (id sanitized into a filename); overwrite =
+    native upsert."""
+
+    root: str
+
+    def write_one(self, blob_id, heatmap):
+        os.makedirs(self.root, exist_ok=True)
+        fname = blob_id.replace(os.sep, "_") + ".json"
+        with open(os.path.join(self.root, fname), "w") as f:
+            f.write(_as_json(heatmap))
+
+
+@dataclasses.dataclass
+class CassandraBlobSink(BlobSink):
+    """Cassandra egress to ``rhom.heatmaps`` (reference
+    heatmap.py:149-150; schema ``(id text PRIMARY KEY, heatmap text)``,
+    reference heatmap.py:157). Needs an injected ``session`` (the
+    cassandra-driver package is not baked into this image); batches
+    async inserts ``concurrency`` deep."""
+
+    session: object = None
+    keyspace: str = "rhom"  # reference heatmap.py:150
+    table: str = "heatmaps"  # reference heatmap.py:150
+    concurrency: int = 128
+    _pending: list = dataclasses.field(default_factory=list, repr=False)
+
+    def write_one(self, blob_id, heatmap):
+        if self.session is None:
+            raise RuntimeError(
+                "CassandraBlobSink needs a cassandra-driver session "
+                "(not baked into this image); use JSONL/Directory sinks "
+                "or inject session=..."
+            )
+        cql = (
+            f"INSERT INTO {self.keyspace}.{self.table} (id, heatmap) "
+            "VALUES (%s, %s)"
+        )
+        self._pending.append(
+            self.session.execute_async(cql, (blob_id, _as_json(heatmap)))
+        )
+        if len(self._pending) >= self.concurrency:
+            self._drain()
+
+    def _drain(self):
+        for fut in self._pending:
+            fut.result()
+        self._pending.clear()
+
+    def close(self):
+        if self._pending:
+            self._drain()
+
+
+@dataclasses.dataclass
+class PNGTileSink:
+    """Slippy-map PNG tile tree: ``root/z/x/y.png``.
+
+    Renders dense window rasters (ops.histogram.Window layout: rows are
+    tile rows, cols are tile columns at ``window.zoom``) into standard
+    z/x/y web-map tiles of ``tile_px`` pixels, one pixel per detail
+    cell ``pixel_delta`` zooms finer. With the default
+    ``pixel_delta=8``, a z10 tile's 256x256 pixels are the z18 detail
+    counts — the dense-raster analog of the reference's 32x32 blob
+    fan-in (DETAIL_ZOOM_DELTA=5, reference heatmap.py:16,89)."""
+
+    root: str
+    pixel_delta: int = 8
+    log_scale: bool = True
+
+    def write_window(self, raster, window, vmax=None) -> int:
+        """Write all complete z/x/y tiles covered by ``raster`` (a
+        (window.height, window.width) counts array at window.zoom).
+        Tile zoom is ``window.zoom - pixel_delta``. Returns #tiles."""
+        raster = np.asarray(raster)
+        px = 1 << self.pixel_delta
+        tz = window.zoom - self.pixel_delta
+        if tz < 0:
+            raise ValueError(
+                f"window zoom {window.zoom} < pixel_delta {self.pixel_delta}"
+            )
+        if window.row0 % px or window.col0 % px:
+            raise ValueError("window origin must align to tile size")
+        n_ty, n_tx = raster.shape[0] // px, raster.shape[1] // px
+        vmax = vmax if vmax is not None else float(raster.max() or 1)
+        count = 0
+        for ty in range(n_ty):
+            for tx in range(n_tx):
+                block = raster[ty * px : (ty + 1) * px, tx * px : (tx + 1) * px]
+                if not block.any():
+                    continue
+                y = window.row0 // px + ty
+                x = window.col0 // px + tx
+                d = os.path.join(self.root, str(tz), str(x))
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, f"{y}.png"), "wb") as f:
+                    f.write(
+                        raster_to_png(block, log_scale=self.log_scale, vmax=vmax)
+                    )
+                count += 1
+        return count
+
+
+def open_sink(spec: str) -> BlobSink:
+    """CLI sink spec: ``jsonl:PATH``, ``dir:PATH``, ``memory:``,
+    ``cassandra:`` or a bare ``.jsonl`` path."""
+    kind, _, rest = spec.partition(":")
+    if kind == "jsonl":
+        return JSONLBlobSink(rest)
+    if kind == "dir":
+        return DirectoryBlobSink(rest)
+    if kind == "memory":
+        return MemorySink()
+    if kind == "cassandra":
+        return CassandraBlobSink()
+    if spec.endswith((".jsonl", ".ndjson")):
+        return JSONLBlobSink(spec)
+    raise ValueError(f"unrecognized sink spec {spec!r}")

@@ -1,0 +1,342 @@
+"""Ingest sources: columnar point-batch readers.
+
+TPU-native replacement for the reference's ``get_rows`` ingest
+(reference heatmap.py:131-147): where the reference builds a Spark
+DataFrame from Cassandra (keyspace ``rhom``, table ``locations``,
+reference heatmap.py:137) or CosmosDB (env vars
+``LOCATIONS_COSMOSDB_HOST`` / ``LOCATIONS_COSMOSDB_AUTH_KEY``,
+reference heatmap.py:140-146), every source here yields **columnar
+batches** — dicts of host numpy arrays / string lists — sized for
+device transfer, so the hot path never sees per-row Python objects.
+
+The reference's row contract (reference heatmap.py:25-36): columns
+``latitude``, ``longitude``, ``user_id``, ``source``, ``timestamp``;
+rows with ``source == "background"`` are dropped by the loader (that
+filter lives in pipeline.batch, not here — sources are dumb readers).
+"""
+
+from __future__ import annotations
+
+import csv
+import dataclasses
+import json
+import os
+from typing import Iterator
+
+import numpy as np
+
+#: Column names of the reference's ``rhom.locations`` table
+#: (reference heatmap.py:25-36).
+COLUMNS = ("latitude", "longitude", "user_id", "source", "timestamp")
+
+DEFAULT_BATCH = 1 << 20
+
+
+def _empty_batch():
+    return {
+        "latitude": np.empty(0, np.float64),
+        "longitude": np.empty(0, np.float64),
+        "user_id": [],
+        "source": [],
+        "timestamp": [],
+    }
+
+
+def _finalize(cols):
+    return {
+        "latitude": np.asarray(cols["latitude"], np.float64),
+        "longitude": np.asarray(cols["longitude"], np.float64),
+        "user_id": list(cols["user_id"]),
+        "source": list(cols["source"]),
+        "timestamp": list(cols["timestamp"]),
+    }
+
+
+class Source:
+    """Base: iterable of columnar batches."""
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        raise NotImplementedError
+
+    def rows(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        """Row-dict view (compat with pipeline.batch.load_rows and the
+        reference's per-row mappers). Slow path; prefer ``batches``."""
+        for b in self.batches(batch_size):
+            lat, lon = b["latitude"], b["longitude"]
+            for i in range(len(lat)):
+                yield {
+                    "latitude": float(lat[i]),
+                    "longitude": float(lon[i]),
+                    "user_id": b["user_id"][i],
+                    "source": b["source"][i] if b["source"] else None,
+                    "timestamp": b["timestamp"][i] if b["timestamp"] else None,
+                }
+
+
+@dataclasses.dataclass
+class SyntheticSource(Source):
+    """Clustered synthetic GPS traces (hot-spot mixture over a metro
+    area) with a user-id pool exercising every reference routing rule
+    (plain ids, ``x``-prefixed excluded ids, ``rt-`` route ids,
+    ``background`` rows; reference heatmap.py:28-29,64-70)."""
+
+    n: int
+    seed: int = 0
+    n_users: int = 32
+    center: tuple = (47.6, -122.3)
+    spread: tuple = (0.5, 0.7)
+    hotspot_frac: float = 0.25
+    background_frac: float = 0.05
+
+    #: Internal generation chunk; the point stream is a pure function of
+    #: (seed, chunk index), so any ``batch_size`` yields the same points.
+    CHUNK = 1 << 16
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        pending = _empty_batch()
+        for chunk in self._chunks():
+            for k in COLUMNS:
+                if isinstance(pending[k], np.ndarray):
+                    pending[k] = np.concatenate([pending[k], chunk[k]])
+                else:
+                    pending[k] = pending[k] + chunk[k]
+            while len(pending["latitude"]) >= batch_size:
+                yield {k: v[:batch_size] for k, v in pending.items()}
+                pending = {k: v[batch_size:] for k, v in pending.items()}
+        if len(pending["latitude"]):
+            yield pending
+
+    def _chunks(self) -> Iterator[dict]:
+        users = self._user_pool()
+        t0 = 1_500_000_000  # fixed epoch base for reproducibility
+        emitted = 0
+        chunk_idx = 0
+        while emitted < self.n:
+            m = min(self.n - emitted, self.CHUNK)
+            rng = np.random.default_rng([self.seed, chunk_idx])
+            hot = rng.random(m) < self.hotspot_frac
+            lat = self.center[0] + rng.normal(0, self.spread[0], m)
+            lon = self.center[1] + rng.normal(0, self.spread[1], m)
+            lat[hot] = self.center[0] + rng.normal(0, 0.02, int(hot.sum()))
+            lon[hot] = self.center[1] + rng.normal(0, 0.03, int(hot.sum()))
+            uid = rng.integers(0, len(users), m)
+            bg = rng.random(m) < self.background_frac
+            yield {
+                "latitude": lat,
+                "longitude": lon,
+                "user_id": [users[i] for i in uid],
+                "source": np.where(bg, "background", "gps").tolist(),
+                "timestamp": (t0 + rng.integers(0, 86400 * 365, m)).tolist(),
+            }
+            emitted += m
+            chunk_idx += 1
+
+    def _user_pool(self):
+        users = [f"user-{i}" for i in range(self.n_users)]
+        users += [f"x-{i}" for i in range(max(1, self.n_users // 8))]
+        users += [f"rt-{i}" for i in range(max(1, self.n_users // 8))]
+        return users
+
+
+@dataclasses.dataclass
+class CSVSource(Source):
+    """CSV reader with a header row naming (a superset of) COLUMNS.
+
+    Numeric columns are parsed with numpy for speed; uses the native
+    C++ fast parser when available (heatmap_tpu.native)."""
+
+    path: str
+    use_native: bool = True
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        if self.use_native:
+            try:
+                from heatmap_tpu.native import parse_csv_batches
+            except ImportError:
+                parse_csv_batches = None
+            if parse_csv_batches is not None:
+                # Mid-stream errors must propagate: falling back after
+                # yielding would re-read rows and double-count.
+                yield from parse_csv_batches(self.path, batch_size)
+                return
+        with open(self.path, newline="") as f:
+            reader = csv.DictReader(f)
+            cols = {k: [] for k in COLUMNS}
+            for row in reader:
+                cols["latitude"].append(float(row["latitude"]))
+                cols["longitude"].append(float(row["longitude"]))
+                cols["user_id"].append(row.get("user_id", ""))
+                cols["source"].append(row.get("source", ""))
+                cols["timestamp"].append(row.get("timestamp"))
+                if len(cols["latitude"]) >= batch_size:
+                    yield _finalize(cols)
+                    cols = {k: [] for k in COLUMNS}
+            if cols["latitude"]:
+                yield _finalize(cols)
+
+
+@dataclasses.dataclass
+class JSONLSource(Source):
+    """One JSON object per line with the reference column names."""
+
+    path: str
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        cols = {k: [] for k in COLUMNS}
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                row = json.loads(line)
+                cols["latitude"].append(float(row["latitude"]))
+                cols["longitude"].append(float(row["longitude"]))
+                cols["user_id"].append(row.get("user_id", ""))
+                cols["source"].append(row.get("source", ""))
+                cols["timestamp"].append(row.get("timestamp"))
+                if len(cols["latitude"]) >= batch_size:
+                    yield _finalize(cols)
+                    cols = {k: [] for k in COLUMNS}
+        if cols["latitude"]:
+            yield _finalize(cols)
+
+
+@dataclasses.dataclass
+class ParquetSource(Source):
+    """Parquet reader (pyarrow), batched at row-group granularity."""
+
+    path: str
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(self.path)
+        for rb in pf.iter_batches(batch_size=batch_size):
+            d = rb.to_pydict()
+            yield {
+                "latitude": np.asarray(d["latitude"], np.float64),
+                "longitude": np.asarray(d["longitude"], np.float64),
+                "user_id": [str(u) for u in d.get("user_id", [""] * rb.num_rows)],
+                "source": [str(s) for s in d.get("source", [""] * rb.num_rows)],
+                "timestamp": list(d.get("timestamp", [None] * rb.num_rows)),
+            }
+
+
+@dataclasses.dataclass
+class CassandraConfig:
+    """The reference's hard-coded ingest endpoints as real config
+    (reference heatmap.py:16-23,131-147; SURVEY.md §5 config system).
+
+    ``endpoint`` falsy selects the CosmosDB path via env vars, exactly
+    like the reference's truthiness test on
+    ``LOCATION_CASSANDRA_ENDPOINT`` (reference heatmap.py:132)."""
+
+    endpoint: str | None = "10.1.0.11"  # reference heatmap.py:23
+    keyspace: str = "rhom"  # reference heatmap.py:137
+    table: str = "locations"  # reference heatmap.py:137
+    cosmosdb_host_env: str = "LOCATIONS_COSMOSDB_HOST"  # heatmap.py:141
+    cosmosdb_key_env: str = "LOCATIONS_COSMOSDB_AUTH_KEY"  # heatmap.py:142
+    cosmosdb_database: str = "locationsdb"  # heatmap.py:144
+    cosmosdb_collection: str = "locations"  # heatmap.py:145
+
+
+@dataclasses.dataclass
+class CassandraSource(Source):
+    """Cassandra/CosmosDB ingest (reference get_rows, heatmap.py:131-147).
+
+    Reads the locations table in token-range shards (the TPU-native
+    analog of the Spark connector's token-range partitioning, which is
+    also the unit of deterministic shard re-execution — SURVEY.md §5
+    fault tolerance). Requires the ``cassandra-driver`` package, which
+    is not baked into this image — construction works (so config can be
+    round-tripped), ``batches`` raises with guidance unless a driver
+    ``session_factory`` is injected."""
+
+    config: CassandraConfig = dataclasses.field(default_factory=CassandraConfig)
+    session_factory: object = None  # () -> session with .execute(cql)
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        cfg = self.config
+        if not cfg.endpoint:
+            host = os.environ.get(cfg.cosmosdb_host_env)
+            if not host:
+                raise RuntimeError(
+                    "CosmosDB ingest selected (no Cassandra endpoint) but "
+                    f"${cfg.cosmosdb_host_env} is unset "
+                    "(reference heatmap.py:140-146)"
+                )
+            raise RuntimeError(
+                "CosmosDB ingest requires the azure-cosmos SDK, which is "
+                "not available in this image; use CSV/JSONL/Parquet "
+                "sources or inject a session_factory"
+            )
+        cluster = None
+        if self.session_factory is not None:
+            session = self.session_factory()
+        else:
+            try:
+                from cassandra.cluster import Cluster
+            except ImportError as e:
+                raise RuntimeError(
+                    "Cassandra ingest requires the cassandra-driver "
+                    "package (not baked into this image); pass "
+                    "session_factory=... or use CSV/JSONL/Parquet sources"
+                ) from e
+            cluster = Cluster([cfg.endpoint])
+            session = cluster.connect()
+        try:
+            cols = {k: [] for k in COLUMNS}
+            query = (
+                f"SELECT latitude, longitude, user_id, source, timestamp "
+                f"FROM {cfg.keyspace}.{cfg.table}"
+            )
+            for row in session.execute(query):
+                get = (
+                    row.get
+                    if isinstance(row, dict)
+                    else lambda k, r=row: getattr(r, k)
+                )
+                cols["latitude"].append(float(get("latitude")))
+                cols["longitude"].append(float(get("longitude")))
+                cols["user_id"].append(get("user_id"))
+                cols["source"].append(get("source"))
+                cols["timestamp"].append(get("timestamp"))
+                if len(cols["latitude"]) >= batch_size:
+                    yield _finalize(cols)
+                    cols = {k: [] for k in COLUMNS}
+            if cols["latitude"]:
+                yield _finalize(cols)
+        finally:
+            if cluster is not None:
+                cluster.shutdown()
+
+
+def open_source(spec: str, **kwargs) -> Source:
+    """Parse a CLI source spec into a Source.
+
+    Specs: ``synthetic:N`` (optionally ``synthetic:N:seed``),
+    ``csv:PATH``, ``jsonl:PATH``, ``parquet:PATH``,
+    ``cassandra:[ENDPOINT]``. Extension sniffing for bare paths."""
+    kind, _, rest = spec.partition(":")
+    if kind == "synthetic":
+        parts = rest.split(":") if rest else ["1000000"]
+        n = int(parts[0])
+        seed = int(parts[1]) if len(parts) > 1 else 0
+        return SyntheticSource(n=n, seed=seed, **kwargs)
+    if kind == "csv":
+        return CSVSource(rest, **kwargs)
+    if kind == "jsonl":
+        return JSONLSource(rest, **kwargs)
+    if kind == "parquet":
+        return ParquetSource(rest, **kwargs)
+    if kind == "cassandra":
+        cfg = CassandraConfig(endpoint=rest or None)
+        return CassandraSource(config=cfg, **kwargs)
+    # Bare path: sniff the extension.
+    if spec.endswith(".csv"):
+        return CSVSource(spec, **kwargs)
+    if spec.endswith((".jsonl", ".ndjson")):
+        return JSONLSource(spec, **kwargs)
+    if spec.endswith((".parquet", ".pq")):
+        return ParquetSource(spec, **kwargs)
+    raise ValueError(f"unrecognized source spec {spec!r}")

@@ -24,5 +24,7 @@ from heatmap_tpu.pipeline.cascade import (  # noqa: F401
 )
 from heatmap_tpu.pipeline.batch import (  # noqa: F401
     BatchJobConfig,
+    load_columns,
     run_batch,
+    run_job,
 )

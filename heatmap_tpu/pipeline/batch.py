@@ -119,6 +119,65 @@ def build_emissions(codes, valid, group_ids, timestamps, config: BatchJobConfig)
     )
 
 
+def load_columns(batch):
+    """Vectorized ingest filter over a columnar source batch
+    (heatmap_tpu.io.sources layout): drops ``source == "background"``
+    rows (reference heatmap.py:28-29) without touching per-row Python.
+    """
+    src = batch.get("source")
+    lat = np.asarray(batch["latitude"], np.float64)
+    lon = np.asarray(batch["longitude"], np.float64)
+    users = batch["user_id"]
+    stamps = batch.get("timestamp")
+    if stamps is None or len(stamps) == 0:
+        stamps = [None] * len(lat)
+    if src is not None and len(src):
+        keep = np.asarray(src, object) != BACKGROUND_SOURCE
+        if not keep.all():
+            idx = np.flatnonzero(keep)
+            lat, lon = lat[idx], lon[idx]
+            users = [users[i] for i in idx]
+            stamps = [stamps[i] for i in idx]
+    return {
+        "latitude": lat,
+        "longitude": lon,
+        "user_id": list(users),
+        "timestamp": list(stamps),
+    }
+
+
+def run_job(source, sink=None, config: BatchJobConfig | None = None,
+            batch_size: int = 1 << 20):
+    """Source-to-sink job over columnar batches (the production entry;
+    reference batchMain shape with get_rows/write_heatmap_dataframes
+    replaced by heatmap_tpu.io sources/sinks, heatmap.py:152-158).
+
+    Accumulates host columns across source batches, runs the cascade
+    once on device, writes blobs to ``sink`` (upsert-by-id). Returns
+    the blob dict; if ``sink`` is given also writes into it.
+    """
+    config = config or BatchJobConfig()
+    lats, lons, users, stamps = [], [], [], []
+    for batch in source.batches(batch_size):
+        cols = load_columns(batch)
+        lats.append(cols["latitude"])
+        lons.append(cols["longitude"])
+        users.extend(cols["user_id"])
+        stamps.extend(cols["timestamp"])
+    if not lats or sum(len(a) for a in lats) == 0:
+        return {}
+    data = {
+        "latitude": np.concatenate(lats),
+        "longitude": np.concatenate(lons),
+        "user_id": users,
+        "timestamp": stamps,
+    }
+    blobs = _run_loaded(data, config, as_json=True)
+    if sink is not None:
+        sink.write(blobs.items())
+    return blobs
+
+
 def run_batch(rows, config: BatchJobConfig | None = None, as_json: bool = False):
     """The full job: rows in, heatmap blobs out (reference batchMain).
 
@@ -131,7 +190,10 @@ def run_batch(rows, config: BatchJobConfig | None = None, as_json: bool = False)
     data = load_rows(rows)
     if len(data["latitude"]) == 0:
         return {}
+    return _run_loaded(data, config, as_json=as_json)
 
+
+def _run_loaded(data, config: BatchJobConfig, as_json: bool):
     vocab = UserVocab()
     group_ids = vocab.group_ids(data["user_id"])
     codes, valid = project_detail_codes(

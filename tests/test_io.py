@@ -1,0 +1,189 @@
+"""IO layer tests: sources, sinks, PNG encoding, source->sink job.
+
+Covers the reference's storage boundary semantics (SURVEY.md C11/C12):
+column contract, background filtering downstream, upsert-by-id egress.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.io import (
+    CSVSource,
+    DirectoryBlobSink,
+    JSONLBlobSink,
+    JSONLSource,
+    MemorySink,
+    ParquetSource,
+    PNGTileSink,
+    SyntheticSource,
+    colorize,
+    open_sink,
+    open_source,
+    png_bytes,
+)
+from heatmap_tpu.io.sources import CassandraSource
+from heatmap_tpu.ops import Window
+from heatmap_tpu.pipeline import BatchJobConfig, run_batch, run_job
+
+
+def _write_csv(path, rows):
+    cols = ["latitude", "longitude", "user_id", "source", "timestamp"]
+    with open(path, "w") as f:
+        f.write(",".join(cols) + "\n")
+        for r in rows:
+            f.write(",".join(str(r[c]) for c in cols) + "\n")
+
+
+ROWS = [
+    {"latitude": 47.6, "longitude": -122.3, "user_id": "alice", "source": "gps", "timestamp": 1},
+    {"latitude": 47.61, "longitude": -122.31, "user_id": "bob", "source": "gps", "timestamp": 2},
+    {"latitude": 47.62, "longitude": -122.32, "user_id": "x-9", "source": "gps", "timestamp": 3},
+    {"latitude": 47.63, "longitude": -122.33, "user_id": "rt-1", "source": "background", "timestamp": 4},
+]
+
+
+class TestSources:
+    def test_synthetic_deterministic_and_batched(self):
+        src = SyntheticSource(n=1000, seed=7)
+        b1 = list(src.batches(300))
+        b2 = list(SyntheticSource(n=1000, seed=7).batches(300))
+        assert [len(b["latitude"]) for b in b1] == [300, 300, 300, 100]
+        np.testing.assert_array_equal(b1[0]["latitude"], b2[0]["latitude"])
+        assert any(u.startswith("x-") for b in b1 for u in b["user_id"])
+        assert any(u.startswith("rt-") for b in b1 for u in b["user_id"])
+        assert any(s == "background" for b in b1 for s in b["source"])
+
+    def test_csv_roundtrip(self, tmp_path):
+        p = tmp_path / "pts.csv"
+        _write_csv(p, ROWS)
+        batches = list(CSVSource(str(p), use_native=False).batches(3))
+        assert sum(len(b["latitude"]) for b in batches) == 4
+        assert batches[0]["user_id"][0] == "alice"
+        np.testing.assert_allclose(batches[0]["latitude"][0], 47.6)
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        p = tmp_path / "pts.jsonl"
+        with open(p, "w") as f:
+            for r in ROWS:
+                f.write(json.dumps(r) + "\n")
+        (b,) = list(JSONLSource(str(p)).batches())
+        assert b["user_id"] == ["alice", "bob", "x-9", "rt-1"]
+
+    def test_parquet_roundtrip(self, tmp_path):
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        p = tmp_path / "pts.parquet"
+        tbl = pa.table({k: [r[k] for r in ROWS] for k in ROWS[0]})
+        pq.write_table(tbl, p)
+        (b,) = list(ParquetSource(str(p)).batches())
+        assert b["user_id"] == ["alice", "bob", "x-9", "rt-1"]
+        assert b["latitude"].dtype == np.float64
+
+    def test_rows_view_matches_batches(self, tmp_path):
+        p = tmp_path / "pts.csv"
+        _write_csv(p, ROWS)
+        rows = list(CSVSource(str(p), use_native=False).rows())
+        assert [r["user_id"] for r in rows] == ["alice", "bob", "x-9", "rt-1"]
+
+    def test_open_source_specs(self, tmp_path):
+        assert isinstance(open_source("synthetic:100"), SyntheticSource)
+        assert open_source("synthetic:100:3").seed == 3
+        assert isinstance(open_source("csv:/x.csv"), CSVSource)
+        assert isinstance(open_source(str(tmp_path / "a.jsonl")), JSONLSource)
+        cs = open_source("cassandra:10.0.0.5")
+        assert isinstance(cs, CassandraSource)
+        assert cs.config.endpoint == "10.0.0.5"
+        with pytest.raises(ValueError):
+            open_source("nope")
+
+    def test_cassandra_without_driver_raises_helpfully(self):
+        src = CassandraSource()
+        with pytest.raises(RuntimeError, match="cassandra-driver"):
+            next(src.batches())
+
+    def test_cassandra_with_injected_session(self):
+        class FakeSession:
+            def execute(self, q):
+                assert "rhom.locations" in q  # reference heatmap.py:137
+                return iter([dict(r, count=None) for r in ROWS])
+
+        src = CassandraSource(session_factory=FakeSession)
+        (b,) = list(src.batches())
+        assert b["user_id"] == ["alice", "bob", "x-9", "rt-1"]
+
+
+class TestSinks:
+    def test_jsonl_sink_upsert_semantics(self, tmp_path):
+        p = tmp_path / "out.jsonl"
+        with JSONLBlobSink(str(p)) as sink:
+            sink.write([("a|alltime|5_1_2", {"6_2_4": 1.0})])
+            sink.write([("a|alltime|5_1_2", {"6_2_4": 3.0})])
+        loaded = JSONLBlobSink.load(str(p))
+        assert loaded == {"a|alltime|5_1_2": {"6_2_4": 3.0}}
+
+    def test_directory_sink(self, tmp_path):
+        sink = DirectoryBlobSink(str(tmp_path / "blobs"))
+        sink.write([("u|alltime|3_1_1", {"8_32_32": 2.0})])
+        files = list((tmp_path / "blobs").iterdir())
+        assert len(files) == 1
+        assert json.loads(files[0].read_text()) == {"8_32_32": 2.0}
+
+    def test_open_sink_specs(self, tmp_path):
+        assert isinstance(open_sink("memory:"), MemorySink)
+        assert isinstance(open_sink(f"jsonl:{tmp_path}/o.jsonl"), JSONLBlobSink)
+        assert isinstance(open_sink(str(tmp_path / "o.jsonl")), JSONLBlobSink)
+        assert isinstance(open_sink(f"dir:{tmp_path}/d"), DirectoryBlobSink)
+
+
+class TestPNG:
+    def test_png_decodes_via_pil(self):
+        PIL = pytest.importorskip("PIL.Image")
+        import io as _io
+
+        raster = np.zeros((16, 16), np.int32)
+        raster[3, 4] = 10
+        raster[8, 8] = 100
+        data = png_bytes(colorize(raster))
+        img = PIL.open(_io.BytesIO(data))
+        arr = np.asarray(img)
+        assert arr.shape == (16, 16, 4)
+        assert arr[3, 4, 3] == 255  # occupied -> opaque
+        assert arr[0, 0, 3] == 0  # empty -> transparent
+        # hotter cell is brighter
+        assert int(arr[8, 8, :3].sum()) > int(arr[3, 4, :3].sum())
+
+    def test_png_grayscale_and_rgb_shapes(self):
+        assert png_bytes(np.zeros((4, 4), np.uint8))[:4] == b"\x89PNG"
+        assert png_bytes(np.zeros((4, 4, 3), np.uint8))[:4] == b"\x89PNG"
+        with pytest.raises(ValueError):
+            png_bytes(np.zeros((4, 4), np.float32))
+
+    def test_tile_sink_writes_zxy_tree(self, tmp_path):
+        window = Window(zoom=10, row0=256, col0=512, height=8, width=8)
+        raster = np.zeros((8, 8), np.int32)
+        raster[1, 2] = 5
+        sink = PNGTileSink(str(tmp_path / "tiles"), pixel_delta=2)  # 4px tiles
+        n = sink.write_window(raster, window)
+        assert n == 1
+        # tile zoom 8; x = col0/4 = 128, y = row0/4 + 0 = 64
+        assert (tmp_path / "tiles" / "8" / "128" / "64.png").exists()
+
+
+class TestRunJob:
+    def test_run_job_matches_run_batch(self, tmp_path):
+        src = SyntheticSource(n=500, seed=3)
+        sink = MemorySink()
+        cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=5)
+        blobs = run_job(src, sink, cfg, batch_size=128)
+        rows = list(SyntheticSource(n=500, seed=3).rows())
+        expected = run_batch(rows, cfg, as_json=True)
+        assert blobs == expected
+        assert sink.blobs == expected
+        assert len(blobs) > 0
+
+    def test_run_job_filters_background(self):
+        src = SyntheticSource(n=300, seed=1, background_frac=1.0)
+        assert run_job(src, None, BatchJobConfig(detail_zoom=10)) == {}
