@@ -1,0 +1,17 @@
+# Driver image for the TPU-native heatmap job — the analog of the
+# reference's Spark driver image (reference Dockerfile:1-7, which
+# copies heatmap.py/tile.py + the Cassandra connector JAR into a
+# kubespark base). Here the base is a JAX TPU image and the payload is
+# the heatmap_tpu package; no connector JAR (storage IO is host-side
+# Python in heatmap_tpu.io).
+FROM python:3.11-slim
+
+# JAX with TPU support; pinned by the deployment, not the framework.
+RUN pip install --no-cache-dir "jax[tpu]" -f \
+    https://storage.googleapis.com/jax-releases/libtpu_releases.html
+
+WORKDIR /opt/heatmap
+COPY heatmap_tpu ./heatmap_tpu
+COPY submit-heatmap bench.py ./
+ENV PYTHONPATH=/opt/heatmap
+ENTRYPOINT ["./submit-heatmap"]

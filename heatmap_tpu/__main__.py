@@ -1,0 +1,8 @@
+"""``python -m heatmap_tpu`` — CLI entry (see heatmap_tpu.cli)."""
+
+import sys
+
+from heatmap_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,228 @@
+"""Command-line entry: the TPU-native ``submit-heatmap``.
+
+A real flag system replacing the reference's three config mechanisms —
+hard-coded module constants (reference heatmap.py:16-23), env vars
+(reference heatmap.py:141-142), and spark-submit ``--conf`` flags
+(reference submit-heatmap:7-14). ``--backend`` selects the device
+platform (the BASELINE.json ``--backend=tpu`` switch); source/sink
+specs replace the Cassandra/CosmosDB constants.
+
+Subcommands:
+
+- ``run``   — the batch job (reference batchMain, heatmap.py:152-158):
+              source -> cascade -> blob sink.
+- ``tiles`` — dense-window binning -> z/x/y PNG tile tree (new egress
+              surface, BASELINE.md config 3).
+- ``info``  — print resolved config + device inventory as JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_backend_flags(p):
+    p.add_argument(
+        "--backend",
+        choices=("tpu", "cpu"),
+        default="tpu",
+        help="device platform; tpu = whatever accelerator JAX finds "
+        "(default), cpu = force host platform",
+    )
+    p.add_argument(
+        "--no-x64",
+        action="store_true",
+        help="keep JAX in 32-bit mode (the composite-key cascade needs "
+        "x64; only the dense tiles path works without it)",
+    )
+
+
+def _init_backend(args):
+    import jax
+
+    if args.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    if not args.no_x64:
+        jax.config.update("jax_enable_x64", True)
+    return jax
+
+
+def _add_run_flags(p):
+    p.add_argument("--input", required=True,
+                   help="source spec: synthetic:N[:seed] | csv:P | jsonl:P "
+                   "| parquet:P | cassandra:[ENDPOINT]")
+    p.add_argument("--output", default="jsonl:heatmaps.jsonl",
+                   help="sink spec: jsonl:P | dir:P | memory:")
+    p.add_argument("--detail-zoom", type=int, default=21,
+                   help="finest binning zoom (reference MAX_ZOOM_LEVEL + "
+                   "DETAIL_ZOOM_DELTA = 21, heatmap.py:16-17,27)")
+    p.add_argument("--min-detail-zoom", type=int, default=5,
+                   help="cascade floor; detail levels run down to this+1 "
+                   "(reference range(21, 5, -1), heatmap.py:109)")
+    p.add_argument("--result-delta", type=int, default=5,
+                   help="blob tiles are this many zooms coarser than "
+                   "detail (reference DETAIL_ZOOM_DELTA, heatmap.py:16)")
+    p.add_argument("--timespans", default="alltime",
+                   help="comma list of alltime,year,month,day (reference "
+                   "supports these but ships alltime-only, heatmap.py:62)")
+    p.add_argument("--batch-size", type=int, default=1 << 20)
+    p.add_argument("--capacity", type=int, default=None,
+                   help="unique-key capacity for the device cascade "
+                   "(default: #emissions)")
+    p.add_argument("--amplify-all", action="store_true",
+                   help="reproduce the reference's 'all'-amplification "
+                   "cascade quirk (SURVEY.md §8.1) for bit-parity")
+    p.add_argument("--first-timespan-only", action="store_true",
+                   help="reproduce the reference's early-return timespan "
+                   "quirk (SURVEY.md §8.2)")
+
+
+def cmd_run(args) -> int:
+    from heatmap_tpu.pipeline.timespan import VALID_TYPES
+
+    requested = tuple(t.strip() for t in args.timespans.split(",") if t.strip())
+    bad = [t for t in requested if t not in VALID_TYPES]
+    if bad:
+        raise SystemExit(
+            f"--timespans: unknown type(s) {bad}; valid: {', '.join(VALID_TYPES)}"
+        )
+    _init_backend(args)
+    from heatmap_tpu.io import open_sink, open_source
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    config = BatchJobConfig(
+        detail_zoom=args.detail_zoom,
+        min_detail_zoom=args.min_detail_zoom,
+        result_delta=args.result_delta,
+        timespans=requested,
+        amplify_all=args.amplify_all,
+        first_timespan_only=args.first_timespan_only,
+        capacity=args.capacity,
+    )
+    source = open_source(args.input)
+    t0 = time.perf_counter()
+    with open_sink(args.output) as sink:
+        blobs = run_job(source, sink, config, batch_size=args.batch_size)
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {"blobs": len(blobs), "seconds": round(dt, 3), "output": args.output}
+        )
+    )
+    return 0
+
+
+def cmd_tiles(args) -> int:
+    if args.zoom < args.pixel_delta:
+        raise SystemExit(
+            f"--zoom {args.zoom} must be >= --pixel-delta {args.pixel_delta} "
+            "(tile zoom = zoom - pixel_delta)"
+        )
+    _init_backend(args)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from heatmap_tpu.io import PNGTileSink, open_source
+    from heatmap_tpu.ops import bin_points_window, window_from_bounds
+    from heatmap_tpu.pipeline import load_columns
+
+    proj_dtype = jnp.float32 if args.no_x64 else jnp.float64
+    window = window_from_bounds(
+        (args.lat_min, args.lat_max),
+        (args.lon_min, args.lon_max),
+        zoom=args.zoom,
+        align_levels=min(args.pixel_delta, args.zoom),
+        pad_multiple=1 << args.pixel_delta,
+    )
+    source = open_source(args.input)
+    raster = None
+    t0 = time.perf_counter()
+    for batch in source.batches(args.batch_size):
+        cols = load_columns(batch)
+        part = bin_points_window(
+            jnp.asarray(cols["latitude"]),
+            jnp.asarray(cols["longitude"]),
+            window,
+            proj_dtype=proj_dtype,
+        )
+        raster = part if raster is None else raster + part
+    if raster is None:
+        print(json.dumps({"tiles": 0, "output": args.output}))
+        return 0
+    sink = PNGTileSink(args.output, pixel_delta=args.pixel_delta)
+    n = sink.write_window(np.asarray(raster), window)
+    dt = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "tiles": n,
+                "tile_zoom": args.zoom - args.pixel_delta,
+                "seconds": round(dt, 3),
+                "output": args.output,
+            }
+        )
+    )
+    return 0
+
+
+def cmd_info(args) -> int:
+    jax = _init_backend(args)
+    devs = jax.devices()
+    print(
+        json.dumps(
+            {
+                "backend": args.backend,
+                "platform": devs[0].platform,
+                "n_devices": len(devs),
+                "x64": bool(jax.config.jax_enable_x64),
+                "version": __import__("heatmap_tpu").__version__,
+            }
+        )
+    )
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="heatmap-tpu",
+        description="TPU-native heatmap aggregation (reference parity: "
+        "timfpark/heatmap batch job)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p_run = sub.add_parser("run", help="batch job: points -> heatmap blobs")
+    _add_backend_flags(p_run)
+    _add_run_flags(p_run)
+    p_run.set_defaults(fn=cmd_run)
+
+    p_tiles = sub.add_parser("tiles", help="points -> z/x/y PNG tile tree")
+    _add_backend_flags(p_tiles)
+    p_tiles.add_argument("--input", required=True)
+    p_tiles.add_argument("--output", default="tiles")
+    p_tiles.add_argument("--zoom", type=int, default=16,
+                         help="detail (pixel) zoom")
+    p_tiles.add_argument("--pixel-delta", type=int, default=8,
+                         help="tile zoom = zoom - pixel_delta; 8 -> 256px tiles")
+    p_tiles.add_argument("--lat-min", type=float, default=45.0)
+    p_tiles.add_argument("--lat-max", type=float, default=50.0)
+    p_tiles.add_argument("--lon-min", type=float, default=-125.0)
+    p_tiles.add_argument("--lon-max", type=float, default=-119.0)
+    p_tiles.add_argument("--batch-size", type=int, default=1 << 20)
+    p_tiles.set_defaults(fn=cmd_tiles)
+
+    p_info = sub.add_parser("info", help="resolved config + devices")
+    _add_backend_flags(p_info)
+    p_info.set_defaults(fn=cmd_info)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,12 @@ def timespan_label(timespan_type: str, local_date) -> str:
 
 
 def _to_date(ts):
+    if ts is None:
+        raise ValueError(
+            "dated timespans (year/month/day) need a timestamp column; "
+            "got a row with timestamp=None — use --timespans alltime for "
+            "timestamp-less sources"
+        )
     if isinstance(ts, _dt.datetime):
         return ts.date()
     if isinstance(ts, _dt.date):

@@ -1,0 +1,110 @@
+"""CLI tests: flag parsing in-process, end-to-end runs via subprocess.
+
+The subprocess runs use ``--backend cpu`` (the CLI's own platform
+switch — the flag system under test) rather than the conftest's
+config, since they are fresh interpreters.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from heatmap_tpu.cli import build_parser
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_cli(*argv, timeout=240):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "heatmap_tpu", *argv],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+        env=env,
+    )
+
+
+class TestParser:
+    def test_run_defaults_match_reference_constants(self):
+        args = build_parser().parse_args(["run", "--input", "synthetic:10"])
+        # reference heatmap.py:16-17: DETAIL_ZOOM_DELTA=5, MAX_ZOOM_LEVEL=16
+        assert args.detail_zoom == 21
+        assert args.min_detail_zoom == 5
+        assert args.result_delta == 5
+        assert args.timespans == "alltime"
+        assert args.backend == "tpu"
+
+    def test_backend_choices(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["run", "--input", "x", "--backend", "gpu"])
+
+    def test_subcommand_required(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args([])
+
+    def test_bad_timespan_rejected_before_ingest(self):
+        from heatmap_tpu.cli import cmd_run
+
+        args = build_parser().parse_args(
+            ["run", "--input", "synthetic:10", "--timespans", "dayly"]
+        )
+        with pytest.raises(SystemExit, match="dayly"):
+            cmd_run(args)
+
+    def test_tiles_zoom_below_pixel_delta_rejected(self):
+        from heatmap_tpu.cli import cmd_tiles
+
+        args = build_parser().parse_args(
+            ["tiles", "--input", "synthetic:10", "--zoom", "6"]
+        )
+        with pytest.raises(SystemExit, match="pixel-delta"):
+            cmd_tiles(args)
+
+
+class TestEndToEnd:
+    def test_run_synthetic_to_jsonl(self, tmp_path):
+        out = tmp_path / "blobs.jsonl"
+        r = _run_cli(
+            "run",
+            "--backend", "cpu",
+            "--input", "synthetic:2000:3",
+            "--output", f"jsonl:{out}",
+            "--detail-zoom", "12",
+        )
+        assert r.returncode == 0, r.stderr
+        stats = json.loads(r.stdout.strip().splitlines()[-1])
+        assert stats["blobs"] > 0
+        from heatmap_tpu.io import JSONLBlobSink
+
+        loaded = JSONLBlobSink.load(str(out))
+        assert len(loaded) == stats["blobs"]
+        assert any(k.startswith("all|alltime|") for k in loaded)
+
+    def test_tiles_synthetic_to_png_tree(self, tmp_path):
+        out = tmp_path / "tiles"
+        r = _run_cli(
+            "tiles",
+            "--backend", "cpu",
+            "--input", "synthetic:5000:1",
+            "--output", str(out),
+            "--zoom", "12",
+            "--pixel-delta", "6",
+        )
+        assert r.returncode == 0, r.stderr
+        stats = json.loads(r.stdout.strip().splitlines()[-1])
+        assert stats["tiles"] >= 1
+        assert stats["tile_zoom"] == 6
+        pngs = [f for _, _, fs in os.walk(out) for f in fs]
+        assert len(pngs) == stats["tiles"]
+
+    def test_info_reports_platform(self):
+        r = _run_cli("info", "--backend", "cpu")
+        assert r.returncode == 0, r.stderr
+        info = json.loads(r.stdout.strip())
+        assert info["platform"] == "cpu"
+        assert info["x64"] is True
