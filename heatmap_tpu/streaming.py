@@ -1,0 +1,236 @@
+"""Streaming micro-batches: incremental tile rasters with time decay.
+
+BASELINE.md config 4 — the Spark-Streaming-shaped workload the
+reference job never grew (its batch path recomputes everything from
+Cassandra each run, reference heatmap.py:152-158). TPU-native design:
+
+- The live heatmap is a dense window raster **resident in HBM**; each
+  micro-batch is one jitted step ``raster' = decay^dt * raster +
+  bin(batch)`` with the raster buffer **donated**, so the update is
+  in-place and the only host traffic is the incoming points.
+- Decay is exponential with a configurable half-life, applied by
+  elapsed stream time between batches (per-batch scalar, so the decay
+  multiply fuses into the scatter-add's epilogue under XLA).
+- Multi-chip: the same step over a row-sharded raster via the
+  ``parallel`` layer — points go data-parallel, partial rasters merge
+  with a psum_scatter, and the decay multiply is purely local (the
+  spatial/sequence-parallel axis: each chip owns a latitude band).
+
+Float policy: f32 accumulation is exact for counts < 2^24 per cell and
+decayed streams are bounded by ``incoming_rate * half_life / ln 2``;
+pass ``acc_dtype=jnp.float64`` (with x64) for extreme cell densities.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from heatmap_tpu.ops import Window, bin_points_window
+from heatmap_tpu.parallel.mesh import DATA_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Static stream parameters (compiled into the step)."""
+
+    window: Window
+    half_life_s: float = 3600.0
+    proj_dtype: object = jnp.float32
+    acc_dtype: object = jnp.float32
+    #: Pad every micro-batch to this many points (one compiled step for
+    #: the whole stream; batches longer than this raise). None = compile
+    #: per distinct batch length.
+    pad_to: int | None = None
+
+    @property
+    def decay_rate(self) -> float:
+        """Per-second multiplicative decay exponent: 2^(-dt/half_life)."""
+        return math.log(2.0) / self.half_life_s
+
+
+def make_update_step(config: StreamConfig, mesh=None):
+    """Build the jitted micro-batch step.
+
+    Returns ``step(raster, lat, lon, dt_s, weights?) -> raster`` with
+    the raster argument donated (in-place HBM update). With ``mesh``
+    the raster is row-sharded over the mesh's ``data`` axis and points
+    are consumed data-parallel (see parallel.bin_points_rowsharded).
+    """
+    window = config.window
+    ln2_over_hl = config.decay_rate
+
+    if mesh is None:
+
+        def step(raster, lat, lon, dt_s, weights, valid):
+            decay = jnp.exp(-ln2_over_hl * dt_s.astype(raster.dtype))
+            fresh = bin_points_window(
+                lat, lon, window,
+                weights=weights,
+                valid=valid,
+                proj_dtype=config.proj_dtype,
+                dtype=raster.dtype,
+            )
+            return raster * decay + fresh
+
+        return jax.jit(step, donate_argnums=0)
+
+    from heatmap_tpu.parallel import bin_points_rowsharded
+
+    def step_sharded(raster, lat, lon, dt_s, weights, valid):
+        decay = jnp.exp(-ln2_over_hl * dt_s.astype(raster.dtype))
+        fresh = bin_points_rowsharded(
+            lat, lon, window, mesh,
+            weights=weights,
+            valid=valid,
+            proj_dtype=config.proj_dtype,
+            dtype=raster.dtype,
+        )
+        return raster * decay + fresh
+
+    return jax.jit(step_sharded, donate_argnums=0)
+
+
+class HeatmapStream:
+    """Stateful micro-batch driver around the jitted step.
+
+    Batches carry stream timestamps (seconds, monotone non-decreasing);
+    the state decays by the elapsed time since the previous batch, so
+    replaying the same timestamped batches reproduces the same raster
+    (deterministic resume — checkpoint/restore via ``state_dict`` /
+    ``load_state_dict``).
+    """
+
+    def __init__(self, config: StreamConfig, mesh=None):
+        self.config = config
+        self._step = make_update_step(config, mesh=mesh)
+        self._mesh = mesh
+        h, w = config.window.shape
+        raster = jnp.zeros((h, w), config.acc_dtype)
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            raster = jax.device_put(
+                raster, NamedSharding(mesh, P(DATA_AXIS, None))
+            )
+        self.raster = raster
+        self.t: float | None = None
+        self.n_batches = 0
+        self._ndev = 1 if mesh is None else int(mesh.shape.get(DATA_AXIS, 1))
+        if config.pad_to is not None and config.pad_to % self._ndev:
+            raise ValueError(
+                f"pad_to={config.pad_to} must divide evenly across the "
+                f"mesh's {self._ndev}-way {DATA_AXIS} axis"
+            )
+
+    def update(self, lat, lon, t: float, weights=None):
+        """Consume one micro-batch stamped at stream time ``t``.
+
+        Batches are padded (masked invalid) to ``config.pad_to`` when
+        set, else to a multiple of the mesh's data-axis size — sharded
+        inputs must split evenly across devices.
+        """
+        if self.t is not None and t < self.t:
+            raise ValueError(f"stream time went backwards: {t} < {self.t}")
+        dt = 0.0 if self.t is None else t - self.t
+        lat = np.asarray(lat)
+        lon = np.asarray(lon)
+        n = lat.shape[0]
+        target = self.config.pad_to
+        if target is not None and n > target:
+            raise ValueError(f"batch of {n} points exceeds pad_to={target}")
+        if target is None and self._ndev > 1:
+            target = -(-n // self._ndev) * self._ndev
+        valid = None
+        if target is not None and target != n:
+            pad = target - n
+            lat = np.concatenate([lat, np.zeros(pad, lat.dtype)])
+            lon = np.concatenate([lon, np.zeros(pad, lon.dtype)])
+            valid = np.arange(target) < n
+            if weights is not None:
+                weights = np.concatenate(
+                    [np.asarray(weights), np.zeros(pad, np.asarray(weights).dtype)]
+                )
+        self.raster = self._step(
+            self.raster,
+            jnp.asarray(lat),
+            jnp.asarray(lon),
+            jnp.asarray(dt, self.config.acc_dtype),
+            None if weights is None else jnp.asarray(weights),
+            None if valid is None else jnp.asarray(valid),
+        )
+        self.t = t
+        self.n_batches += 1
+        return self
+
+    def snapshot(self) -> np.ndarray:
+        """Device -> host copy of the current decayed raster."""
+        return np.asarray(self.raster)
+
+    def state_dict(self) -> dict:
+        return {
+            "raster": self.snapshot(),
+            "t": self.t,
+            "n_batches": self.n_batches,
+        }
+
+    def load_state_dict(self, state: dict):
+        raster = jnp.asarray(state["raster"], self.config.acc_dtype)
+        if raster.shape != tuple(self.config.window.shape):
+            raise ValueError(
+                f"checkpoint raster {raster.shape} != window {self.config.window.shape}"
+            )
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            raster = jax.device_put(
+                raster, NamedSharding(self._mesh, P(DATA_AXIS, None))
+            )
+        self.raster = raster
+        self.t = state["t"]
+        self.n_batches = state["n_batches"]
+        return self
+
+
+def run_stream(stream: HeatmapStream, timed_batches, *, on_batch=None):
+    """Drive a stream from an iterable of ``(t_seconds, batch)`` pairs,
+    where ``batch`` is a columnar point batch (heatmap_tpu.io layout;
+    background rows dropped like the batch path, reference
+    heatmap.py:28-29). ``on_batch(stream, t)`` fires after each step
+    (metrics/snapshot hook)."""
+    from heatmap_tpu.pipeline import load_columns
+
+    for t, batch in timed_batches:
+        cols = load_columns(batch)
+        stream.update(cols["latitude"], cols["longitude"], t)
+        if on_batch is not None:
+            on_batch(stream, t)
+    return stream
+
+
+def decayed_oracle(window: Window, timed_points, half_life_s: float):
+    """Pure-numpy reference for tests: same decay-then-add semantics.
+
+    ``timed_points``: iterable of (t, lat_array, lon_array).
+    """
+    raster = np.zeros(window.shape, np.float64)
+    last_t = None
+    n = 1 << window.zoom
+    for t, lat, lon in timed_points:
+        dt = 0.0 if last_t is None else t - last_t
+        raster *= 2.0 ** (-dt / half_life_s)
+        phi = np.asarray(lat, np.float64) * math.pi / 180
+        y = (1 - np.log(np.tan(phi) + 1 / np.cos(phi)) / math.pi) / 2
+        row = np.floor(y * n) - window.row0
+        col = np.floor((np.asarray(lon, np.float64) + 180.0) / 360.0 * n) - window.col0
+        ok = (
+            np.isfinite(row) & (row >= 0) & (row < window.height)
+            & (col >= 0) & (col < window.width)
+        )
+        np.add.at(raster, (row[ok].astype(np.int64), col[ok].astype(np.int64)), 1.0)
+        last_t = t
+    return raster
