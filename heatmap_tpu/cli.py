@@ -121,6 +121,8 @@ def cmd_tiles(args) -> int:
             f"--zoom {args.zoom} must be >= --pixel-delta {args.pixel_delta} "
             "(tile zoom = zoom - pixel_delta)"
         )
+    if args.splat and (args.splat < 0 or args.splat % 2 == 0):
+        raise SystemExit(f"--splat {args.splat}: kernel size must be odd")
     _init_backend(args)
     import jax.numpy as jnp
     import numpy as np
@@ -152,6 +154,12 @@ def cmd_tiles(args) -> int:
     if raster is None:
         print(json.dumps({"tiles": 0, "output": args.output}))
         return 0
+    if args.splat:
+        from heatmap_tpu.ops import gaussian_kernel_1d, splat_raster
+
+        raster = splat_raster(
+            raster, gaussian_kernel_1d(args.splat, args.sigma)
+        )
     sink = PNGTileSink(args.output, pixel_delta=args.pixel_delta)
     n = sink.write_window(np.asarray(raster), window)
     dt = time.perf_counter() - t0
@@ -211,6 +219,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_tiles.add_argument("--lon-min", type=float, default=-125.0)
     p_tiles.add_argument("--lon-max", type=float, default=-119.0)
     p_tiles.add_argument("--batch-size", type=int, default=1 << 20)
+    p_tiles.add_argument("--splat", type=int, default=0, metavar="K",
+                         help="smooth with a KxK Gaussian kernel before "
+                         "rendering (e.g. 9; 0 = off)")
+    p_tiles.add_argument("--sigma", type=float, default=None,
+                         help="Gaussian sigma in cells (default K/4)")
     p_tiles.set_defaults(fn=cmd_tiles)
 
     p_info = sub.add_parser("info", help="resolved config + devices")

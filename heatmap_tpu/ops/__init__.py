@@ -11,6 +11,8 @@ Here the same work is three jit-compiled primitives:
   no data-dependent control flow).
 - ``pyramid``: zoom rollups — 2x2 reshape-sums on rasters, and
   order-preserving Morton-shift re-aggregation on sparse keys.
+- ``splat``: weighted binning + separable Gaussian-kernel smoothing
+  (BASELINE.md config 3), dense MXU convolution work.
 """
 
 from heatmap_tpu.ops.histogram import (  # noqa: F401
@@ -27,4 +29,9 @@ from heatmap_tpu.ops.pyramid import (  # noqa: F401
     coarsen_raster,
     pyramid_from_raster,
     pyramid_sparse_morton,
+)
+from heatmap_tpu.ops.splat import (  # noqa: F401
+    bin_points_splat,
+    gaussian_kernel_1d,
+    splat_raster,
 )

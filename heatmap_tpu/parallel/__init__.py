@@ -28,4 +28,5 @@ from heatmap_tpu.parallel.sharded import (  # noqa: F401
     bin_points_rowsharded,
     pyramid_rowsharded,
     pyramid_sparse_morton_sharded,
+    splat_rowsharded,
 )

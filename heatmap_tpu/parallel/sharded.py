@@ -285,3 +285,68 @@ def pyramid_sparse_morton_sharded(
         check_vma=False,
     )
     return list(fn(codes, w, v))
+
+
+def splat_rowsharded(raster, kernel_1d, mesh: Mesh):
+    """Gaussian splat over a row-sharded raster via halo exchange.
+
+    The stencil analog of the binning path's collectives: each device
+    owns a horizontal band of the raster (as produced by
+    bin_points_rowsharded); the vertical convolution needs
+    ``len(kernel)//2`` rows from each neighbor, exchanged with two
+    ``lax.ppermute`` shifts over ICI (zeros arrive at the global
+    edges, matching SAME zero padding). The horizontal pass is purely
+    local. Compute stays distributed — no device ever holds the full
+    raster.
+    """
+    ndev = _data_size(mesh)
+    k = jnp.asarray(kernel_1d)
+    if k.ndim != 1 or k.shape[0] % 2 == 0:
+        raise ValueError(f"kernel must be 1D with odd length, got shape {k.shape}")
+    half = (k.shape[0] - 1) // 2
+    h, w = raster.shape
+    if h % ndev:
+        raise ValueError(f"raster height {h} not divisible by {ndev} devices")
+    if half and h // ndev < half:
+        raise ValueError(
+            f"shard height {h // ndev} smaller than kernel half-width "
+            f"{half}: halo exchange needs >= one kernel radius per shard"
+        )
+
+    def body(block):
+        out_dtype = (
+            block.dtype
+            if jnp.issubdtype(block.dtype, jnp.floating)
+            else k.dtype
+        )
+        x = block.astype(out_dtype)
+        if half == 0:
+            padded = x
+        else:
+            # Halo exchange: my last rows -> next device's top halo; my
+            # first rows -> previous device's bottom halo. ppermute
+            # yields zeros where no source sends (global edges).
+            down = [(i, i + 1) for i in range(ndev - 1)]
+            up = [(i, i - 1) for i in range(1, ndev)]
+            top_halo = lax.ppermute(x[-half:], DATA_AXIS, down)
+            bot_halo = lax.ppermute(x[:half], DATA_AXIS, up)
+            padded = jnp.concatenate([top_halo, x, bot_halo], axis=0)
+        kd = k.astype(out_dtype)
+        # Vertical pass VALID over the halo-padded block, horizontal
+        # pass SAME — same math as ops.splat.splat_raster globally.
+        y = lax.conv_general_dilated(
+            padded[None, None], kd[None, None, :, None], (1, 1),
+            [(0, 0), (0, 0)],
+        )
+        y = lax.conv_general_dilated(
+            y, kd[None, None, None, :], (1, 1), [(0, 0), (half, half)]
+        )
+        return y[0, 0]
+
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(DATA_AXIS, None),),
+        out_specs=P(DATA_AXIS, None),
+    )
+    return fn(raster)

@@ -1,0 +1,124 @@
+"""Gaussian splat tests (BASELINE.md config 3): kernel properties,
+oracle parity, mass conservation, weighted binning, sharded halo
+exchange vs the single-device path.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from heatmap_tpu.ops import (
+    Window,
+    bin_points_splat,
+    bin_points_window,
+    gaussian_kernel_1d,
+    splat_raster,
+)
+from heatmap_tpu.ops.splat import splat_oracle_np
+from heatmap_tpu.parallel import make_mesh, splat_rowsharded
+
+WINDOW = Window(zoom=10, row0=320, col0=256, height=64, width=64)
+
+
+def _points(n=500, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.uniform(30.0, 52.0, n),
+        rng.uniform(-90.0, -68.0, n),
+        rng.exponential(2.0, n),
+    )
+
+
+class TestKernel:
+    def test_normalized_and_symmetric(self):
+        k = np.asarray(gaussian_kernel_1d(9))
+        assert k.shape == (9,)
+        np.testing.assert_allclose(k.sum(), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(k, k[::-1])
+        assert k[4] == k.max()
+
+    def test_even_or_nonpositive_size_rejected(self):
+        with pytest.raises(ValueError):
+            gaussian_kernel_1d(8)
+        with pytest.raises(ValueError):
+            gaussian_kernel_1d(0)
+
+    def test_size_one_is_identity(self):
+        r = jnp.asarray(np.random.default_rng(0).random((16, 16)))
+        out = splat_raster(r, gaussian_kernel_1d(1))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(r), rtol=1e-6)
+
+
+class TestSplatRaster:
+    def test_matches_direct_2d_oracle(self):
+        rng = np.random.default_rng(1)
+        r = rng.poisson(2.0, (32, 48)).astype(np.float64)
+        out = splat_raster(jnp.asarray(r), gaussian_kernel_1d(9, dtype=jnp.float64))
+        np.testing.assert_allclose(np.asarray(out), splat_oracle_np(r, 9), rtol=1e-10)
+
+    def test_interior_mass_preserved(self):
+        r = np.zeros((32, 32))
+        r[16, 16] = 7.0  # interior point: whole 9x9 stamp stays inside
+        out = splat_raster(jnp.asarray(r), gaussian_kernel_1d(9, dtype=jnp.float64))
+        np.testing.assert_allclose(float(out.sum()), 7.0, rtol=1e-10)
+
+    def test_int_raster_promoted_to_float(self):
+        r = jnp.ones((8, 8), jnp.int32)
+        out = splat_raster(r, gaussian_kernel_1d(3))
+        assert jnp.issubdtype(out.dtype, jnp.floating)
+
+
+class TestBinPointsSplat:
+    def test_weighted_end_to_end_vs_oracle(self):
+        lat, lon, w = _points()
+        base = bin_points_window(
+            jnp.asarray(lat), jnp.asarray(lon), WINDOW,
+            weights=jnp.asarray(w), proj_dtype=jnp.float64, dtype=jnp.float64,
+        )
+        out = bin_points_splat(
+            jnp.asarray(lat), jnp.asarray(lon), WINDOW,
+            weights=jnp.asarray(w), proj_dtype=jnp.float64, dtype=jnp.float64,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), splat_oracle_np(np.asarray(base), 9), rtol=1e-10
+        )
+        assert float(out.sum()) > 0
+
+    def test_unweighted_defaults_to_counts(self):
+        lat, lon, _ = _points(100, seed=3)
+        out = bin_points_splat(
+            jnp.asarray(lat), jnp.asarray(lon), WINDOW,
+            proj_dtype=jnp.float64, dtype=jnp.float64,
+        )
+        base = bin_points_window(
+            jnp.asarray(lat), jnp.asarray(lon), WINDOW,
+            proj_dtype=jnp.float64,
+        )
+        # splat preserves total in-window mass up to edge bleed
+        assert float(out.sum()) <= float(base.sum()) + 1e-9
+
+
+class TestShardedSplat:
+    def test_matches_single_device(self, devices):
+        mesh = make_mesh(data=8, devices=devices)
+        rng = np.random.default_rng(5)
+        r = rng.poisson(1.5, (64, 32)).astype(np.float64)
+        expected = splat_raster(
+            jnp.asarray(r), gaussian_kernel_1d(9, dtype=jnp.float64)
+        )
+        got = splat_rowsharded(
+            jnp.asarray(r), gaussian_kernel_1d(9, dtype=jnp.float64), mesh
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-10)
+
+    def test_shard_too_small_for_halo_rejected(self, devices):
+        mesh = make_mesh(data=8, devices=devices)
+        r = jnp.zeros((16, 16))  # shard height 2 < half 4
+        with pytest.raises(ValueError, match="halo"):
+            splat_rowsharded(r, gaussian_kernel_1d(9), mesh)
+
+    def test_height_not_divisible_rejected(self, devices):
+        mesh = make_mesh(data=8, devices=devices)
+        with pytest.raises(ValueError, match="divisible"):
+            splat_rowsharded(jnp.zeros((30, 16)), gaussian_kernel_1d(3), mesh)
