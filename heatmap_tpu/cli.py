@@ -123,6 +123,8 @@ def cmd_tiles(args) -> int:
         )
     if args.splat and (args.splat < 0 or args.splat % 2 == 0):
         raise SystemExit(f"--splat {args.splat}: kernel size must be odd")
+    if args.sigma is not None and not args.sigma > 0:
+        raise SystemExit(f"--sigma {args.sigma}: must be positive")
     _init_backend(args)
     import jax.numpy as jnp
     import numpy as np

@@ -25,13 +25,15 @@ from heatmap_tpu.ops.histogram import Window, bin_points_window
 
 
 def gaussian_kernel_1d(size: int = 9, sigma: float | None = None, dtype=jnp.float32):
-    """Normalized 1D Gaussian taps. ``sigma`` defaults to size/4 (a 9-tap
-    kernel then spans +-4.5 sigma... i.e. sigma=2.25, the conventional
-    "kernel covers ~2 sigma each side" choice)."""
+    """Normalized 1D Gaussian taps. ``sigma`` defaults to size/4
+    (sigma=2.25 for 9 taps), so the kernel truncates at ~2 sigma each
+    side and renormalizes the ~4% clipped tail mass back in."""
     if size < 1 or size % 2 == 0:
         raise ValueError(f"kernel size must be odd and positive, got {size}")
     if sigma is None:
         sigma = size / 4.0
+    if not sigma > 0:
+        raise ValueError(f"sigma must be positive, got {sigma}")
     x = np.arange(size, dtype=np.float64) - (size - 1) / 2.0
     k = np.exp(-0.5 * (x / sigma) ** 2)
     k /= k.sum()
@@ -82,23 +84,3 @@ def bin_points_splat(
         else jnp.float32
     )
     return splat_raster(raster, gaussian_kernel_1d(kernel_size, sigma, kernel_dtype))
-
-
-def splat_oracle_np(raster, size=9, sigma=None):
-    """Direct (non-separable) numpy 2D convolution for tests."""
-    if sigma is None:
-        sigma = size / 4.0
-    x = np.arange(size, dtype=np.float64) - (size - 1) / 2.0
-    k1 = np.exp(-0.5 * (x / sigma) ** 2)
-    k1 /= k1.sum()
-    k2 = np.outer(k1, k1)
-    r = np.asarray(raster, np.float64)
-    h, w = r.shape
-    half = size // 2
-    padded = np.zeros((h + 2 * half, w + 2 * half))
-    padded[half : half + h, half : half + w] = r
-    out = np.zeros_like(r)
-    for dy in range(size):
-        for dx in range(size):
-            out += k2[dy, dx] * padded[dy : dy + h, dx : dx + w]
-    return out

@@ -141,3 +141,26 @@ def run_job(rows, detail_zoom: int = 21, min_detail_zoom: int = 5, amplify_all: 
     return cascade(
         load_points(rows, detail_zoom), detail_zoom, min_detail_zoom, amplify_all
     )
+
+
+def splat_oracle_np(raster, size=9, sigma=None):
+    """Direct (non-separable) numpy 2D Gaussian convolution — the
+    independent oracle for ops.splat's separable formulation."""
+    import numpy as np
+
+    if sigma is None:
+        sigma = size / 4.0
+    x = np.arange(size, dtype=np.float64) - (size - 1) / 2.0
+    k1 = np.exp(-0.5 * (x / sigma) ** 2)
+    k1 /= k1.sum()
+    k2 = np.outer(k1, k1)
+    r = np.asarray(raster, np.float64)
+    h, w = r.shape
+    half = size // 2
+    padded = np.zeros((h + 2 * half, w + 2 * half))
+    padded[half : half + h, half : half + w] = r
+    out = np.zeros_like(r)
+    for dy in range(size):
+        for dx in range(size):
+            out += k2[dy, dx] * padded[dy : dy + h, dx : dx + w]
+    return out

@@ -1,0 +1,97 @@
+"""Pallas MXU histogram kernel tests (interpret mode on the CPU mesh;
+compiled-path parity and speed were measured on the real chip: PERF_NOTES.md).
+
+Parity oracle: the XLA scatter path (ops.histogram), itself verified
+against the pure-Python reference oracle in test_ops.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from heatmap_tpu.ops import Window, bin_points_window, bin_rowcol_window
+from heatmap_tpu.ops.pallas_kernels import (
+    bin_points_window_pallas,
+    bin_rowcol_window_pallas,
+)
+
+WINDOW = Window(zoom=10, row0=320, col0=256, height=64, width=128)
+
+
+def _points(n=3000, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        rng.uniform(25.0, 55.0, n),  # some out-of-window
+        rng.uniform(-95.0, -60.0, n),
+        rng.exponential(1.5, n),
+    )
+
+
+def test_rowcol_parity_with_xla_scatter():
+    rng = np.random.default_rng(1)
+    row = rng.integers(300, 400, 5000)  # straddles the window rows
+    col = rng.integers(230, 400, 5000)
+    expected = bin_rowcol_window(
+        jnp.asarray(row), jnp.asarray(col), WINDOW, dtype=jnp.float32
+    )
+    got = bin_rowcol_window_pallas(
+        jnp.asarray(row, jnp.int32), jnp.asarray(col, jnp.int32), WINDOW,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+    assert float(got.sum()) > 0
+
+
+def test_weighted_parity():
+    rng = np.random.default_rng(2)
+    row = rng.integers(320, 384, 2000)
+    col = rng.integers(256, 384, 2000)
+    w = rng.exponential(1.0, 2000).astype(np.float32)
+    expected = bin_rowcol_window(
+        jnp.asarray(row), jnp.asarray(col), WINDOW,
+        weights=jnp.asarray(w), dtype=jnp.float32,
+    )
+    got = bin_rowcol_window_pallas(
+        jnp.asarray(row, jnp.int32), jnp.asarray(col, jnp.int32), WINDOW,
+        weights=jnp.asarray(w), interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-6)
+
+
+def test_valid_mask_and_padding():
+    # 700 points (not a chunk multiple) with every other point masked.
+    row = np.full(700, 330, np.int32)
+    col = np.full(700, 300, np.int32)
+    valid = (np.arange(700) % 2) == 0
+    got = bin_rowcol_window_pallas(
+        jnp.asarray(row), jnp.asarray(col), WINDOW,
+        valid=jnp.asarray(valid), chunk=256, interpret=True,
+    )
+    assert float(got[10, 44]) == 350.0  # row 330-320, col 300-256
+    assert float(got.sum()) == 350.0
+
+
+def test_empty_input():
+    got = bin_rowcol_window_pallas(
+        jnp.zeros(0, jnp.int32), jnp.zeros(0, jnp.int32), WINDOW,
+        interpret=True,
+    )
+    assert float(got.sum()) == 0.0
+
+
+def test_fused_projection_parity():
+    lat, lon, w = _points()
+    expected = bin_points_window(
+        jnp.asarray(lat), jnp.asarray(lon), WINDOW,
+        weights=jnp.asarray(w, jnp.float32),
+        proj_dtype=jnp.float64, dtype=jnp.float32,
+    )
+    got = bin_points_window_pallas(
+        jnp.asarray(lat), jnp.asarray(lon), WINDOW,
+        weights=jnp.asarray(w, jnp.float32),
+        proj_dtype=jnp.float64, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(expected), rtol=1e-6
+    )

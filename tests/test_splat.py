@@ -15,7 +15,7 @@ from heatmap_tpu.ops import (
     gaussian_kernel_1d,
     splat_raster,
 )
-from heatmap_tpu.ops.splat import splat_oracle_np
+from oracle import splat_oracle_np
 from heatmap_tpu.parallel import make_mesh, splat_rowsharded
 
 WINDOW = Window(zoom=10, row0=320, col0=256, height=64, width=64)
