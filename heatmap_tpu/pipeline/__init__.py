@@ -27,4 +27,5 @@ from heatmap_tpu.pipeline.batch import (  # noqa: F401
     load_columns,
     run_batch,
     run_job,
+    run_job_fast,
 )

@@ -178,6 +178,80 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     return blobs
 
 
+def run_job_fast(csv_path: str, sink=None, config: BatchJobConfig | None = None,
+                 batch_size: int = 1 << 20):
+    """CSV-to-sink job over the native decoder's integer fast path.
+
+    Same output as ``run_job(CSVSource(path))`` but no per-row Python
+    objects anywhere: the C++ reader thread (native/pointcodec.cpp)
+    parses, routes user ids (reference heatmap.py:64-70) and flags
+    background rows (reference heatmap.py:28-29) natively; this side
+    only maps the reader's small routed-name table into the UserVocab
+    (O(unique users), not O(rows)) and filters with numpy masks.
+
+    Dated timespans need per-row timestamps as Python objects, so this
+    path requires ``timespans == ("alltime",)`` (the reference's only
+    live timespan, SURVEY.md §8.7).
+    """
+    try:
+        from heatmap_tpu.native import parse_csv_batches
+    except ImportError as e:
+        raise RuntimeError(
+            "run_job_fast needs the native decoder (native/ build "
+            "failed or disabled); use run_job(CSVSource(path)) instead"
+        ) from e
+
+    config = config or BatchJobConfig()
+    if tuple(config.timespans) != ("alltime",):
+        raise ValueError(
+            "run_job_fast supports only alltime timespans; use run_job "
+            "for dated timespan buckets"
+        )
+    vocab = UserVocab()
+    names: list = []  # reader-side intern table, extended per batch
+    reader_to_vocab = np.full(1024, -2, np.int32)  # -2 = not yet mapped
+    lats, lons, gids = [], [], []
+    for b in parse_csv_batches(csv_path, batch_size, fast=True):
+        names.extend(b["new_group_names"])
+        if len(names) > len(reader_to_vocab):
+            grown = np.full(max(len(names), 2 * len(reader_to_vocab)), -2,
+                            np.int32)
+            grown[: len(reader_to_vocab)] = reader_to_vocab
+            reader_to_vocab = grown
+        keep = ~b["background"]
+        routed = b["routed"][keep]
+        # Map only reader ids referenced by kept rows, in first-use
+        # order, so vocab ids match the string path's assignment order.
+        ref_ids = routed[routed >= 0]
+        unmapped = reader_to_vocab[ref_ids] == -2
+        if unmapped.any():
+            first_use = ref_ids[unmapped]
+            _, order = np.unique(first_use, return_index=True)
+            for rid in first_use[np.sort(order)]:
+                if reader_to_vocab[rid] == -2:
+                    reader_to_vocab[rid] = vocab.id_for(names[rid])
+        gids.append(np.where(
+            routed >= 0, reader_to_vocab[np.maximum(routed, 0)], EXCLUDED
+        ).astype(np.int32))
+        lats.append(b["latitude"][keep])
+        lons.append(b["longitude"][keep])
+    if not lats or sum(len(a) for a in lats) == 0:
+        return {}
+    lat = np.concatenate(lats)
+    blobs = _run_grouped(
+        lat,
+        np.concatenate(lons),
+        np.concatenate(gids),
+        np.zeros(len(lat)),  # timestamps unused under alltime
+        vocab,
+        config,
+        as_json=True,
+    )
+    if sink is not None:
+        sink.write(blobs.items())
+    return blobs
+
+
 def run_batch(rows, config: BatchJobConfig | None = None, as_json: bool = False):
     """The full job: rows in, heatmap blobs out (reference batchMain).
 
@@ -196,11 +270,17 @@ def run_batch(rows, config: BatchJobConfig | None = None, as_json: bool = False)
 def _run_loaded(data, config: BatchJobConfig, as_json: bool):
     vocab = UserVocab()
     group_ids = vocab.group_ids(data["user_id"])
-    codes, valid = project_detail_codes(
-        data["latitude"], data["longitude"], config.detail_zoom
+    return _run_grouped(
+        data["latitude"], data["longitude"], group_ids,
+        data["timestamp"], vocab, config, as_json,
     )
+
+
+def _run_grouped(lat, lon, group_ids, timestamps, vocab,
+                 config: BatchJobConfig, as_json: bool):
+    codes, valid = project_detail_codes(lat, lon, config.detail_zoom)
     e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
-        codes, valid, group_ids, data["timestamp"], config
+        codes, valid, group_ids, timestamps, config
     )
     n_slots = len(ts_vocab) * n_groups
 

@@ -1,0 +1,102 @@
+// Host staging-buffer pool: fixed set of page-aligned buffers recycled
+// across micro-batches.
+//
+// Role: the memory-management piece of the runtime the reference left to
+// Spark (executor memory + spill to spark.local.dir, reference
+// submit-heatmap:14). Host->device feeds stage point columns here so the
+// ingest pipeline reuses a bounded set of aligned allocations instead of
+// malloc/free per batch — acquire blocks when all buffers are in flight,
+// which back-pressures the decoder thread against device compute.
+//
+// Plain C ABI for ctypes; buffers are page-aligned (4096) so DMA-friendly
+// copies and madvise tricks stay available to the transfer layer.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::vector<void*> bufs;
+  std::vector<int> free_ids;
+  int64_t buf_bytes;
+  std::mutex mu;
+  std::condition_variable cv;
+
+  ~Pool() {
+    for (void* b : bufs) std::free(b);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hm_pool_create(int64_t buf_bytes, int n_bufs) {
+  if (buf_bytes <= 0 || n_bufs <= 0) return nullptr;
+  auto* p = new Pool();
+  p->buf_bytes = buf_bytes;
+  // Round up to the 4096 alignment aligned_alloc requires of the size.
+  int64_t size = (buf_bytes + 4095) / 4096 * 4096;
+  for (int i = 0; i < n_bufs; ++i) {
+    void* b = std::aligned_alloc(4096, size);
+    if (!b) {
+      delete p;
+      return nullptr;
+    }
+    p->bufs.push_back(b);
+    p->free_ids.push_back(i);
+  }
+  return p;
+}
+
+// Block until a buffer is free; returns its id (the caller maps ids to
+// base pointers once via hm_pool_buffer).
+int hm_pool_acquire(void* handle) {
+  auto* p = static_cast<Pool*>(handle);
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv.wait(lk, [&] { return !p->free_ids.empty(); });
+  int id = p->free_ids.back();
+  p->free_ids.pop_back();
+  return id;
+}
+
+// Non-blocking acquire: -1 if every buffer is in flight.
+int hm_pool_try_acquire(void* handle) {
+  auto* p = static_cast<Pool*>(handle);
+  std::lock_guard<std::mutex> lk(p->mu);
+  if (p->free_ids.empty()) return -1;
+  int id = p->free_ids.back();
+  p->free_ids.pop_back();
+  return id;
+}
+
+void hm_pool_release(void* handle, int id) {
+  auto* p = static_cast<Pool*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->free_ids.push_back(id);
+  }
+  p->cv.notify_one();
+}
+
+void* hm_pool_buffer(void* handle, int id) {
+  auto* p = static_cast<Pool*>(handle);
+  if (id < 0 || static_cast<size_t>(id) >= p->bufs.size()) return nullptr;
+  return p->bufs[id];
+}
+
+int64_t hm_pool_buf_bytes(void* handle) {
+  return static_cast<Pool*>(handle)->buf_bytes;
+}
+
+int hm_pool_size(void* handle) {
+  return static_cast<int>(static_cast<Pool*>(handle)->bufs.size());
+}
+
+void hm_pool_destroy(void* handle) { delete static_cast<Pool*>(handle); }
+
+}  // extern "C"
