@@ -78,6 +78,17 @@ def _add_run_flags(p):
     p.add_argument("--first-timespan-only", action="store_true",
                    help="reproduce the reference's early-return timespan "
                    "quirk (SURVEY.md §8.2)")
+    p.add_argument("--fast", action="store_true",
+                   help="integer-only native-decoder path (csv sources, "
+                   "alltime timespans; needs the native/ build)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="checkpoint ingest progress here and resume from "
+                   "the latest checkpoint on rerun")
+    p.add_argument("--checkpoint-every", type=int, default=8,
+                   help="checkpoint every N source batches")
+    p.add_argument("--profile", default=None, metavar="LOGDIR",
+                   help="capture a jax.profiler trace into LOGDIR and "
+                   "print the span/throughput report to stderr")
 
 
 def cmd_run(args) -> int:
@@ -90,8 +101,16 @@ def cmd_run(args) -> int:
             f"--timespans: unknown type(s) {bad}; valid: {', '.join(VALID_TYPES)}"
         )
     _init_backend(args)
+    import contextlib
+
     from heatmap_tpu.io import open_sink, open_source
-    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+    from heatmap_tpu.pipeline import (
+        BatchJobConfig,
+        run_job,
+        run_job_fast,
+        run_job_resumable,
+    )
+    from heatmap_tpu.utils.trace import get_tracer, jax_profile
 
     config = BatchJobConfig(
         detail_zoom=args.detail_zoom,
@@ -102,11 +121,40 @@ def cmd_run(args) -> int:
         first_timespan_only=args.first_timespan_only,
         capacity=args.capacity,
     )
-    source = open_source(args.input)
+    if args.fast and args.checkpoint_dir:
+        raise SystemExit("--fast and --checkpoint-dir are mutually "
+                         "exclusive (the fast path has no resume yet)")
+    fast_path = None
+    if args.fast:
+        # Resolve through open_source so bare .csv paths and csv: specs
+        # behave identically to every other subcommand.
+        from heatmap_tpu.io.sources import CSVSource
+
+        src = open_source(args.input)
+        if not isinstance(src, CSVSource):
+            raise SystemExit(
+                f"--fast needs a csv source, got {args.input!r}"
+            )
+        fast_path = src.path
     t0 = time.perf_counter()
-    with open_sink(args.output) as sink:
-        blobs = run_job(source, sink, config, batch_size=args.batch_size)
+    prof = jax_profile(args.profile) if args.profile else contextlib.nullcontext()
+    with prof:
+        with open_sink(args.output) as sink:
+            if args.fast:
+                blobs = run_job_fast(fast_path, sink, config,
+                                     batch_size=args.batch_size)
+            elif args.checkpoint_dir:
+                blobs = run_job_resumable(
+                    open_source(args.input), args.checkpoint_dir, sink,
+                    config, batch_size=args.batch_size,
+                    checkpoint_every=args.checkpoint_every,
+                )
+            else:
+                blobs = run_job(open_source(args.input), sink, config,
+                                batch_size=args.batch_size)
     dt = time.perf_counter() - t0
+    if args.profile:
+        print(get_tracer().format_report(), file=sys.stderr)
     print(
         json.dumps(
             {"blobs": len(blobs), "seconds": round(dt, 3), "output": args.output}

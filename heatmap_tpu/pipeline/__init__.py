@@ -28,4 +28,5 @@ from heatmap_tpu.pipeline.batch import (  # noqa: F401
     run_batch,
     run_job,
     run_job_fast,
+    run_job_resumable,
 )

@@ -156,14 +156,19 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     once on device, writes blobs to ``sink`` (upsert-by-id). Returns
     the blob dict; if ``sink`` is given also writes into it.
     """
+    from heatmap_tpu.utils.trace import get_tracer
+
     config = config or BatchJobConfig()
+    tracer = get_tracer()
     lats, lons, users, stamps = [], [], [], []
     for batch in source.batches(batch_size):
-        cols = load_columns(batch)
-        lats.append(cols["latitude"])
-        lons.append(cols["longitude"])
-        users.extend(cols["user_id"])
-        stamps.extend(cols["timestamp"])
+        with tracer.span("ingest.batch"):
+            cols = load_columns(batch)
+            lats.append(cols["latitude"])
+            lons.append(cols["longitude"])
+            users.extend(cols["user_id"])
+            stamps.extend(cols["timestamp"])
+        tracer.add_items("ingest.batch", len(cols["latitude"]))
     if not lats or sum(len(a) for a in lats) == 0:
         return {}
     data = {
@@ -172,9 +177,11 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
         "user_id": users,
         "timestamp": stamps,
     }
-    blobs = _run_loaded(data, config, as_json=True)
+    with tracer.span("cascade", items=len(data["latitude"])):
+        blobs = _run_loaded(data, config, as_json=True)
     if sink is not None:
-        sink.write(blobs.items())
+        with tracer.span("egress"):
+            sink.write(blobs.items())
     return blobs
 
 
@@ -210,43 +217,179 @@ def run_job_fast(csv_path: str, sink=None, config: BatchJobConfig | None = None,
     vocab = UserVocab()
     names: list = []  # reader-side intern table, extended per batch
     reader_to_vocab = np.full(1024, -2, np.int32)  # -2 = not yet mapped
+    from heatmap_tpu.utils.trace import get_tracer
+
+    tracer = get_tracer()
     lats, lons, gids = [], [], []
-    for b in parse_csv_batches(csv_path, batch_size, fast=True):
-        names.extend(b["new_group_names"])
-        if len(names) > len(reader_to_vocab):
-            grown = np.full(max(len(names), 2 * len(reader_to_vocab)), -2,
-                            np.int32)
-            grown[: len(reader_to_vocab)] = reader_to_vocab
-            reader_to_vocab = grown
-        keep = ~b["background"]
-        routed = b["routed"][keep]
-        # Map only reader ids referenced by kept rows, in first-use
-        # order, so vocab ids match the string path's assignment order.
-        ref_ids = routed[routed >= 0]
-        unmapped = reader_to_vocab[ref_ids] == -2
-        if unmapped.any():
-            first_use = ref_ids[unmapped]
-            _, order = np.unique(first_use, return_index=True)
-            for rid in first_use[np.sort(order)]:
-                if reader_to_vocab[rid] == -2:
-                    reader_to_vocab[rid] = vocab.id_for(names[rid])
-        gids.append(np.where(
-            routed >= 0, reader_to_vocab[np.maximum(routed, 0)], EXCLUDED
-        ).astype(np.int32))
-        lats.append(b["latitude"][keep])
-        lons.append(b["longitude"][keep])
+    with tracer.span("ingest.fast"):
+        for b in parse_csv_batches(csv_path, batch_size, fast=True):
+            tracer.add_items("ingest.fast", len(b["latitude"]))
+            names.extend(b["new_group_names"])
+            if len(names) > len(reader_to_vocab):
+                grown = np.full(max(len(names), 2 * len(reader_to_vocab)),
+                                -2, np.int32)
+                grown[: len(reader_to_vocab)] = reader_to_vocab
+                reader_to_vocab = grown
+            keep = ~b["background"]
+            routed = b["routed"][keep]
+            # Map only reader ids referenced by kept rows, in first-use
+            # order, so vocab ids match the string path's assignment
+            # order.
+            ref_ids = routed[routed >= 0]
+            unmapped = reader_to_vocab[ref_ids] == -2
+            if unmapped.any():
+                first_use = ref_ids[unmapped]
+                _, order = np.unique(first_use, return_index=True)
+                for rid in first_use[np.sort(order)]:
+                    if reader_to_vocab[rid] == -2:
+                        reader_to_vocab[rid] = vocab.id_for(names[rid])
+            gids.append(np.where(
+                routed >= 0, reader_to_vocab[np.maximum(routed, 0)], EXCLUDED
+            ).astype(np.int32))
+            lats.append(b["latitude"][keep])
+            lons.append(b["longitude"][keep])
     if not lats or sum(len(a) for a in lats) == 0:
         return {}
     lat = np.concatenate(lats)
-    blobs = _run_grouped(
-        lat,
-        np.concatenate(lons),
-        np.concatenate(gids),
-        np.zeros(len(lat)),  # timestamps unused under alltime
-        vocab,
-        config,
-        as_json=True,
-    )
+    with tracer.span("cascade", items=len(lat)):
+        blobs = _run_grouped(
+            lat,
+            np.concatenate(lons),
+            np.concatenate(gids),
+            np.zeros(len(lat)),  # timestamps unused under alltime
+            vocab,
+            config,
+            as_json=True,
+        )
+    if sink is not None:
+        sink.write(blobs.items())
+    return blobs
+
+
+def run_job_resumable(source, checkpoint_dir: str, sink=None,
+                      config: BatchJobConfig | None = None,
+                      batch_size: int = 1 << 20,
+                      checkpoint_every: int = 8,
+                      fault_injector=None):
+    """``run_job`` with checkpoint/resume over source batches.
+
+    The reference recomputes everything from Cassandra on any failure
+    (no checkpointing anywhere, SURVEY.md §5). Here ingest progress is
+    checkpointed every ``checkpoint_every`` batches (atomic npz via
+    utils.checkpoint); a rerun with the same source/batch_size resumes
+    after the last checkpointed batch. The source is still *streamed*
+    from the start on resume — pre-checkpoint batches are read and
+    discarded; what's skipped is the load_columns/vocab/accumulation
+    work and, on the earlier run, everything after the checkpoint.
+    Sources must iterate deterministically for resume to be exact — every
+    built-in source does (files byte-ordered, synthetic seeded).
+
+    ``fault_injector`` (utils.recovery.FaultInjector) fails chosen
+    batch indices for recovery testing.
+    """
+    from heatmap_tpu.utils import CheckpointManager
+    from heatmap_tpu.utils.trace import get_tracer
+
+    config = config or BatchJobConfig()
+    if checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    tracer = get_tracer()
+    mgr = CheckpointManager(checkpoint_dir)
+    vocab = UserVocab()
+    lats, lons, gids, stamps = [], [], [], []
+    done = 0
+    if mgr.latest_step() is not None:
+        arrays, meta = mgr.load()
+        lats, lons = [arrays["latitude"]], [arrays["longitude"]]
+        gids = [arrays["group_ids"]]
+        if "timestamps_ms" in arrays:
+            stamps = [list(arrays["timestamps_ms"])]
+        elif "timestamps_str" in arrays:
+            stamps = [list(arrays["timestamps_str"])]
+        else:
+            stamps = [[None] * len(arrays["latitude"])]
+        for name in meta["group_names"][1:]:  # [0] is always 'all'
+            vocab.id_for(name)
+        done = meta["batches_done"]
+
+    def checkpoint(step):
+        lat = np.concatenate(lats) if lats else np.empty(0)
+        arrays = {
+            "latitude": lat,
+            "longitude": np.concatenate(lons) if lons else np.empty(0),
+            "group_ids": np.concatenate(gids) if gids else np.empty(0, np.int32),
+        }
+        flat_stamps = [s for chunk in stamps for s in chunk]
+        if flat_stamps and all(s is not None for s in flat_stamps):
+            try:
+                arrays["timestamps_ms"] = np.asarray(flat_stamps, np.int64)
+            except (ValueError, TypeError):
+                # datetime/date objects: epoch-ms round-trips through
+                # timespan._to_date (UTC). Anything else keeps its
+                # string form — resumes behave exactly like the
+                # original run would have (float()-able strings work,
+                # junk raises in _to_date either way).
+                import datetime as _dt
+
+                def to_ms(s):
+                    if isinstance(s, _dt.datetime):
+                        if s.tzinfo is None:
+                            s = s.replace(tzinfo=_dt.timezone.utc)
+                        return int(s.timestamp() * 1000)
+                    if isinstance(s, _dt.date):
+                        return int(_dt.datetime(
+                            s.year, s.month, s.day,
+                            tzinfo=_dt.timezone.utc,
+                        ).timestamp() * 1000)
+                    return None
+
+                ms = [to_ms(s) for s in flat_stamps]
+                if all(m is not None for m in ms):
+                    arrays["timestamps_ms"] = np.asarray(ms, np.int64)
+                else:
+                    arrays["timestamps_str"] = np.asarray(
+                        [str(s) for s in flat_stamps]
+                    )
+        mgr.save(step, arrays, {
+            "group_names": list(vocab.names),
+            "batches_done": step,
+        })
+        # Collapse accumulated chunks so later checkpoints don't recopy
+        # a growing list-of-arrays.
+        lats[:] = [arrays["latitude"]]
+        lons[:] = [arrays["longitude"]]
+        gids[:] = [arrays["group_ids"]]
+        stamps[:] = [flat_stamps]
+
+    for i, batch in enumerate(source.batches(batch_size)):
+        if i < done:
+            continue  # already checkpointed on a previous run
+        if fault_injector is not None:
+            fault_injector.check(i)
+        with tracer.span("ingest.batch"):
+            cols = load_columns(batch)
+            lats.append(cols["latitude"])
+            lons.append(cols["longitude"])
+            gids.append(vocab.group_ids(cols["user_id"]))
+            stamps.append(cols["timestamp"])
+        tracer.add_items("ingest.batch", len(cols["latitude"]))
+        done = i + 1
+        if done % checkpoint_every == 0:
+            with tracer.span("checkpoint"):
+                checkpoint(done)
+    if not lats or sum(len(a) for a in lats) == 0:
+        return {}
+    flat_stamps = [s for chunk in stamps for s in chunk]
+    with tracer.span("cascade"):
+        blobs = _run_grouped(
+            np.concatenate(lats),
+            np.concatenate(lons),
+            np.concatenate(gids).astype(np.int32),
+            flat_stamps,
+            vocab,
+            config,
+            as_json=True,
+        )
     if sink is not None:
         sink.write(blobs.items())
     return blobs

@@ -178,6 +178,24 @@ class HeatmapStream:
             "n_batches": self.n_batches,
         }
 
+    def checkpoint(self, manager) -> str:
+        """Atomic checkpoint via utils.checkpoint.CheckpointManager,
+        numbered by batches consumed."""
+        return manager.save(
+            self.n_batches,
+            {"raster": self.snapshot()},
+            {"t": self.t, "n_batches": self.n_batches},
+        )
+
+    def restore(self, manager, step: int | None = None):
+        """Load the latest (or a given) checkpoint into this stream."""
+        arrays, meta = manager.load(step)
+        return self.load_state_dict({
+            "raster": arrays["raster"],
+            "t": meta["t"],
+            "n_batches": meta["n_batches"],
+        })
+
     def load_state_dict(self, state: dict):
         raster = jnp.asarray(state["raster"], self.config.acc_dtype)
         if raster.shape != tuple(self.config.window.shape):

@@ -1,0 +1,28 @@
+"""Auxiliary runtime subsystems: tracing, checkpointing, recovery.
+
+The reference had none of these in-repo — profiling was the Spark UI,
+fault tolerance was Spark lineage recomputation, and there was no
+checkpoint/resume at all (SURVEY.md §5). Here they are first-class:
+
+- ``trace`` — span timers, throughput counters, jax.profiler hooks.
+- ``checkpoint`` — atomic npz checkpoints with a retention manager.
+- ``recovery`` — deterministic shard re-execution with retry budgets
+  and fault injection for tests.
+"""
+
+from heatmap_tpu.utils.trace import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    jax_profile,
+    span,
+)
+from heatmap_tpu.utils.checkpoint import (  # noqa: F401
+    CheckpointManager,
+    load_checkpoint,
+    save_checkpoint,
+)
+from heatmap_tpu.utils.recovery import (  # noqa: F401
+    FaultInjector,
+    ShardFailure,
+    run_shards,
+)

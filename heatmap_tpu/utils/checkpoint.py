@@ -1,0 +1,117 @@
+"""Atomic checkpoints with retention.
+
+The reference has no checkpoint/resume at all: a 16-level unioned Spark
+lineage is recomputed from source on failure, and its Cassandra write
+mode 'append' makes reruns upsert blindly (SURVEY.md §5, reference
+heatmap.py:113-116,150). Here checkpoints are explicit:
+
+- ``save_checkpoint`` writes arrays + JSON-serializable meta as one npz
+  via write-to-temp + atomic rename, so a crash mid-write never leaves
+  a truncated checkpoint behind.
+- ``CheckpointManager`` numbers checkpoints by step, finds the latest,
+  and prunes old ones (keep-N retention).
+
+Rasters and cascade partials are pure sums, so resuming from any saved
+step and re-adding the remaining shards is idempotent-by-construction
+(the recovery model SURVEY.md §5 prescribes for the TPU build).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import tempfile
+
+import numpy as np
+
+_META_KEY = "__meta_json__"
+_STEP_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+
+
+def save_checkpoint(path: str, arrays: dict, meta: dict | None = None):
+    """Atomically write ``arrays`` (+ JSON ``meta``) to ``path`` (.npz)."""
+    for k in arrays:
+        if k == _META_KEY:
+            raise ValueError(f"array name {k!r} is reserved")
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    payload = {k: np.asarray(v) for k, v in arrays.items()}
+    payload[_META_KEY] = np.frombuffer(
+        json.dumps(meta or {}).encode(), dtype=np.uint8
+    )
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_checkpoint(path: str) -> tuple[dict, dict]:
+    """-> (arrays, meta)."""
+    with np.load(path) as z:
+        arrays = {k: z[k] for k in z.files if k != _META_KEY}
+        meta = json.loads(bytes(z[_META_KEY].tobytes()).decode()) \
+            if _META_KEY in z.files else {}
+    return arrays, meta
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints in a directory, keep-N retention."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        if keep < 1:
+            raise ValueError("keep must be >= 1")
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"ckpt-{step}.npz")
+
+    def steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = _STEP_RE.match(name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def save(self, step: int, arrays: dict, meta: dict | None = None) -> str:
+        meta = dict(meta or {})
+        meta["step"] = step
+        path = self._path(step)
+        save_checkpoint(path, arrays, meta)
+        self._prune()
+        return path
+
+    def load(self, step: int | None = None) -> tuple[dict, dict]:
+        """Load ``step`` (default: latest). Raises FileNotFoundError if
+        there is nothing to load."""
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    f"no checkpoints under {self.directory}"
+                )
+        return load_checkpoint(self._path(step))
+
+    def _prune(self):
+        steps = self.steps()
+        for s in steps[: -self.keep]:
+            try:
+                os.unlink(self._path(s))
+            except OSError:
+                pass

@@ -1,0 +1,88 @@
+"""Deterministic shard re-execution with retry budgets.
+
+The reference's fault tolerance is entirely Spark's: lineage
+recomputation of lost RDD partitions plus dynamic executor allocation
+(SURVEY.md §5; reference submit-heatmap:10-13). The TPU-native model is
+simpler and stronger: ingest is split into deterministic shards (file
+byte ranges, Cassandra token ranges, synthetic seed ranges), every
+shard's contribution is a pure sum, and a failed shard is simply re-run
+— re-adding an identical partial is the only way a retry can land, so
+recovery is idempotent by construction.
+
+``FaultInjector`` provides the fault-injection hook the reference never
+had: tests (and chaos runs) fail chosen shards a chosen number of times
+to exercise the retry/recovery path.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class ShardFailure(RuntimeError):
+    """A shard exhausted its retry budget."""
+
+    def __init__(self, shard_index, attempts, last_error):
+        super().__init__(
+            f"shard {shard_index} failed after {attempts} attempts: "
+            f"{last_error!r}"
+        )
+        self.shard_index = shard_index
+        self.attempts = attempts
+        self.last_error = last_error
+
+
+class FaultInjector:
+    """Deterministically fail chosen shards N times (for tests/chaos).
+
+    ``fail_counts``: {shard_index: times_to_fail}. Call ``check(i)``
+    at the top of shard work; it raises until shard i's budget is
+    spent, then lets the shard through — modeling a transient fault.
+    """
+
+    def __init__(self, fail_counts: dict):
+        self._remaining = dict(fail_counts)
+        self.injected = 0
+
+    def check(self, shard_index):
+        left = self._remaining.get(shard_index, 0)
+        if left > 0:
+            self._remaining[shard_index] = left - 1
+            self.injected += 1
+            raise RuntimeError(f"injected fault on shard {shard_index}")
+
+
+def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
+               fault_injector: FaultInjector | None = None,
+               on_retry=None, tracer=None):
+    """Run ``process(shard)`` over every shard with per-shard retries.
+
+    Returns the list of per-shard results in shard order (order is
+    deterministic regardless of failures — the analog of Spark's
+    deterministic partition recompute). ``retries`` is the number of
+    *re*-executions allowed per shard; ``on_retry(i, attempt, err)``
+    is the failure-detection hook (log, mark executor unhealthy, ...).
+    Raises ShardFailure once a shard exhausts its budget.
+    """
+    results = []
+    for i, shard in enumerate(shards):
+        attempt = 0
+        while True:
+            try:
+                if fault_injector is not None:
+                    fault_injector.check(i)
+                if tracer is not None:
+                    with tracer.span("shard"):
+                        results.append(process(shard))
+                else:
+                    results.append(process(shard))
+                break
+            except Exception as e:  # noqa: BLE001 — retry boundary
+                attempt += 1
+                if on_retry is not None:
+                    on_retry(i, attempt, e)
+                if attempt > retries:
+                    raise ShardFailure(i, attempt, e) from e
+                if backoff_s:
+                    time.sleep(backoff_s * attempt)
+    return results

@@ -1,0 +1,139 @@
+"""Tracing and throughput metrics.
+
+The reference has zero instrumentation — no timers, no logging, not one
+print (SURVEY.md §5 "tracing/profiling: none in-repo"; the ``time``
+import at reference heatmap.py:10 is unused). This module provides the
+greenfield replacement:
+
+- ``span(name)`` — wall-clock span timer, nestable, recorded into a
+  process-wide ``Tracer`` (per-name count / total / max).
+- ``Tracer.add_items(name, n)`` — throughput accounting: items
+  processed under a name, so ``report()`` yields points/sec.
+- ``jax_profile(logdir)`` — context manager around ``jax.profiler``'s
+  trace (TensorBoard-viewable XLA timeline), gated so CPU-only test
+  environments without profiler support degrade to a no-op.
+
+Spans measure *host* wall-clock. For device work inside a span, call
+``block_until_ready`` on the result before the span closes, or the
+span records only dispatch time (XLA is async).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+
+class _SpanStats:
+    __slots__ = ("count", "total_s", "max_s", "items")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.items = 0
+
+
+class Tracer:
+    """Per-name span statistics + item throughput, thread-safe."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stats: dict[str, _SpanStats] = {}
+
+    def _stat(self, name: str) -> _SpanStats:
+        s = self._stats.get(name)
+        if s is None:
+            s = self._stats.setdefault(name, _SpanStats())
+        return s
+
+    @contextlib.contextmanager
+    def span(self, name: str, items: int | None = None):
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                s = self._stat(name)
+                s.count += 1
+                s.total_s += dt
+                s.max_s = max(s.max_s, dt)
+                if items:
+                    s.items += int(items)
+
+    def add_items(self, name: str, n: int):
+        """Attribute ``n`` processed items to ``name`` (throughput)."""
+        with self._lock:
+            self._stat(name).items += int(n)
+
+    def report(self) -> dict:
+        """{name: {count, total_s, max_s, mean_s, items, items_per_s}}."""
+        out = {}
+        with self._lock:
+            for name, s in self._stats.items():
+                out[name] = {
+                    "count": s.count,
+                    "total_s": s.total_s,
+                    "max_s": s.max_s,
+                    "mean_s": s.total_s / s.count if s.count else 0.0,
+                    "items": s.items,
+                    "items_per_s": s.items / s.total_s if s.total_s else 0.0,
+                }
+        return out
+
+    def reset(self):
+        with self._lock:
+            self._stats.clear()
+
+    def format_report(self) -> str:
+        lines = []
+        for name, r in sorted(self.report().items()):
+            line = (
+                f"{name:<28} n={r['count']:<6} total={r['total_s']:.3f}s "
+                f"mean={r['mean_s'] * 1e3:.2f}ms max={r['max_s'] * 1e3:.2f}ms"
+            )
+            if r["items"]:
+                line += (
+                    f" items={r['items']} ({r['items_per_s'] / 1e6:.2f} M/s)"
+                )
+            lines.append(line)
+        return "\n".join(lines)
+
+
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer the pipeline instruments into."""
+    return _default
+
+
+def span(name: str, items: int | None = None):
+    """Span on the default tracer: ``with span("binning", items=n): ...``"""
+    return _default.span(name, items=items)
+
+
+@contextlib.contextmanager
+def jax_profile(logdir: str):
+    """Capture a jax.profiler trace (XLA timeline) into ``logdir``.
+
+    No-op (with a warning attribute on the tracer) when the profiler is
+    unavailable on the current backend.
+    """
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+        started = True
+    except Exception:
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

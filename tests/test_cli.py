@@ -85,6 +85,74 @@ class TestEndToEnd:
         assert len(loaded) == stats["blobs"]
         assert any(k.startswith("all|alltime|") for k in loaded)
 
+    def test_run_fast_csv_matches_plain(self, tmp_path):
+        import csv
+        import numpy as np
+
+        from heatmap_tpu import native
+
+        if not native.available():
+            pytest.skip("native library not built")
+        pts = tmp_path / "pts.csv"
+        rng = np.random.default_rng(9)
+        with open(pts, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["latitude", "longitude", "user_id", "source",
+                        "timestamp"])
+            for _ in range(1500):
+                w.writerow([
+                    rng.uniform(40, 50), rng.uniform(-130, -110),
+                    ["alice", "x-2", "rt-4"][rng.integers(0, 3)],
+                    "background" if rng.random() < 0.1 else "gps", 1,
+                ])
+        outs = {}
+        for name, extra in (("plain", []), ("fast", ["--fast"])):
+            out = tmp_path / f"{name}.jsonl"
+            r = _run_cli(
+                "run", "--backend", "cpu",
+                "--input", f"csv:{pts}",
+                "--output", f"jsonl:{out}",
+                "--detail-zoom", "12", "--min-detail-zoom", "9",
+                *extra,
+            )
+            assert r.returncode == 0, r.stderr
+            from heatmap_tpu.io import JSONLBlobSink
+
+            outs[name] = JSONLBlobSink.load(str(out))
+        assert outs["plain"] == outs["fast"]
+
+    def test_run_with_checkpoint_dir_resumes(self, tmp_path):
+        out = tmp_path / "blobs.jsonl"
+        ck = tmp_path / "ck"
+        common = [
+            "run", "--backend", "cpu",
+            "--input", "synthetic:3000:5",
+            "--output", f"jsonl:{out}",
+            "--detail-zoom", "12", "--min-detail-zoom", "9",
+            "--batch-size", "512",
+            "--checkpoint-dir", str(ck), "--checkpoint-every", "2",
+        ]
+        r = _run_cli(*common)
+        assert r.returncode == 0, r.stderr
+        assert any(f.startswith("ckpt-") for f in os.listdir(ck))
+        # Rerun resumes from checkpoints and reproduces the same blobs.
+        from heatmap_tpu.io import JSONLBlobSink
+
+        first = JSONLBlobSink.load(str(out))
+        r2 = _run_cli(*common)
+        assert r2.returncode == 0, r2.stderr
+        assert JSONLBlobSink.load(str(out)) == first
+
+    def test_fast_rejects_non_csv_and_checkpoint_combo(self):
+        r = _run_cli("run", "--backend", "cpu", "--fast",
+                     "--input", "synthetic:10")
+        assert r.returncode != 0
+        assert "csv" in r.stderr
+        r = _run_cli("run", "--backend", "cpu", "--fast",
+                     "--input", "csv:x.csv", "--checkpoint-dir", "/tmp/ck")
+        assert r.returncode != 0
+        assert "mutually" in r.stderr
+
     def test_tiles_synthetic_to_png_tree(self, tmp_path):
         out = tmp_path / "tiles"
         r = _run_cli(

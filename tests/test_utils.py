@@ -1,0 +1,249 @@
+"""Aux subsystems: tracing, checkpoint/resume, shard recovery.
+
+SURVEY.md §5: the reference has none of these in-repo (Spark provided
+fault tolerance; no tracing, no checkpoints). These tests pin down the
+greenfield implementations.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.utils import (
+    CheckpointManager,
+    FaultInjector,
+    ShardFailure,
+    Tracer,
+    load_checkpoint,
+    run_shards,
+    save_checkpoint,
+)
+
+
+# ---------------------------------------------------------------- trace
+
+def test_tracer_spans_and_throughput():
+    tr = Tracer()
+    with tr.span("work", items=100):
+        time.sleep(0.01)
+    with tr.span("work", items=50):
+        pass
+    r = tr.report()["work"]
+    assert r["count"] == 2
+    assert r["items"] == 150
+    assert r["total_s"] >= 0.01
+    assert r["max_s"] >= r["mean_s"]
+    assert r["items_per_s"] > 0
+    assert "work" in tr.format_report()
+    tr.reset()
+    assert tr.report() == {}
+
+
+def test_tracer_nested_spans():
+    tr = Tracer()
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+    assert set(tr.report()) == {"outer", "inner"}
+
+
+# ----------------------------------------------------------- checkpoint
+
+def test_checkpoint_roundtrip(tmp_path):
+    p = str(tmp_path / "c.npz")
+    arrays = {"a": np.arange(5), "b": np.ones((2, 3), np.float32)}
+    save_checkpoint(p, arrays, {"step": 7, "note": "hi"})
+    got, meta = load_checkpoint(p)
+    np.testing.assert_array_equal(got["a"], arrays["a"])
+    np.testing.assert_array_equal(got["b"], arrays["b"])
+    assert meta == {"step": 7, "note": "hi"}
+
+
+def test_checkpoint_atomic_no_partial_on_failure(tmp_path):
+    p = str(tmp_path / "c.npz")
+    save_checkpoint(p, {"a": np.arange(3)}, {"v": 1})
+
+    class Boom:
+        def __array__(self):
+            raise RuntimeError("mid-serialize failure")
+
+    with pytest.raises(Exception):
+        save_checkpoint(p, {"a": Boom()})
+    # Old checkpoint intact, no temp litter.
+    got, meta = load_checkpoint(p)
+    np.testing.assert_array_equal(got["a"], np.arange(3))
+    assert [f for f in os.listdir(tmp_path) if f.endswith(".tmp")] == []
+
+
+def test_checkpoint_manager_latest_and_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=2)
+    for step in (1, 5, 9):
+        mgr.save(step, {"x": np.full(2, step)})
+    assert mgr.steps() == [5, 9]  # pruned to keep=2
+    assert mgr.latest_step() == 9
+    arrays, meta = mgr.load()
+    assert meta["step"] == 9
+    np.testing.assert_array_equal(arrays["x"], [9, 9])
+    arrays5, _ = mgr.load(5)
+    np.testing.assert_array_equal(arrays5["x"], [5, 5])
+
+
+def test_checkpoint_manager_empty(tmp_path):
+    mgr = CheckpointManager(str(tmp_path))
+    assert mgr.latest_step() is None
+    with pytest.raises(FileNotFoundError):
+        mgr.load()
+
+
+# ------------------------------------------------------------- recovery
+
+def test_run_shards_success_order():
+    out = run_shards([3, 1, 4], lambda s: s * 10)
+    assert out == [30, 10, 40]
+
+
+def test_run_shards_retries_transient_fault():
+    inj = FaultInjector({1: 2})  # shard 1 fails twice, then succeeds
+    retries_seen = []
+    out = run_shards(
+        [0, 1, 2], lambda s: s,
+        retries=2, fault_injector=inj,
+        on_retry=lambda i, a, e: retries_seen.append((i, a)),
+    )
+    assert out == [0, 1, 2]
+    assert inj.injected == 2
+    assert retries_seen == [(1, 1), (1, 2)]
+
+
+def test_run_shards_exhausted_budget_raises():
+    inj = FaultInjector({0: 5})
+    with pytest.raises(ShardFailure) as ei:
+        run_shards([0], lambda s: s, retries=2, fault_injector=inj)
+    assert ei.value.shard_index == 0
+    assert ei.value.attempts == 3
+
+
+def test_run_shards_result_identical_with_and_without_faults():
+    """Idempotent re-execution: transient faults never change results."""
+    shards = list(range(6))
+    clean = run_shards(shards, lambda s: s ** 2)
+    faulty = run_shards(
+        shards, lambda s: s ** 2,
+        retries=3, fault_injector=FaultInjector({0: 1, 3: 2, 5: 3}),
+    )
+    assert clean == faulty
+
+
+# -------------------------------------------------- resumable batch job
+
+def _mini_cfg():
+    from heatmap_tpu.pipeline import BatchJobConfig
+
+    return BatchJobConfig(detail_zoom=11, min_detail_zoom=8)
+
+
+def test_run_job_resumable_matches_run_job(tmp_path):
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import run_job, run_job_resumable
+
+    src = SyntheticSource(n=4000, seed=2)
+    plain = run_job(src, config=_mini_cfg(), batch_size=512)
+    resumable = run_job_resumable(
+        src, str(tmp_path / "ck"), config=_mini_cfg(),
+        batch_size=512, checkpoint_every=2,
+    )
+    assert plain == resumable
+
+
+def test_run_job_resumable_resumes_after_crash(tmp_path):
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import run_job, run_job_resumable
+
+    src = SyntheticSource(n=4000, seed=2)
+    ckdir = str(tmp_path / "ck")
+    # Crash on batch index 5 (after the step-4 checkpoint).
+    inj = FaultInjector({5: 1})
+    with pytest.raises(RuntimeError):
+        run_job_resumable(
+            src, ckdir, config=_mini_cfg(), batch_size=512,
+            checkpoint_every=2, fault_injector=inj,
+        )
+    mgr = CheckpointManager(ckdir)
+    assert mgr.latest_step() == 4
+    # Rerun resumes from the checkpoint and completes identically.
+    resumed = run_job_resumable(
+        src, ckdir, config=_mini_cfg(), batch_size=512, checkpoint_every=2,
+    )
+    assert resumed == run_job(src, config=_mini_cfg(), batch_size=512)
+
+
+def test_run_job_resumable_rejects_bad_interval(tmp_path):
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import run_job_resumable
+
+    with pytest.raises(ValueError):
+        run_job_resumable(SyntheticSource(n=10), str(tmp_path / "ck"),
+                          checkpoint_every=0)
+
+
+def test_run_job_resumable_datetime_timestamps_roundtrip(tmp_path):
+    """Dated timespans with datetime timestamps survive checkpoint/resume."""
+    import datetime as dt
+
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_resumable
+
+    class DatetimeSource:
+        def batches(self, batch_size):
+            base = dt.datetime(2020, 3, 1, tzinfo=dt.timezone.utc)
+            for k in range(4):
+                yield {
+                    "latitude": np.full(50, 40.0 + k),
+                    "longitude": np.full(50, -100.0),
+                    "user_id": ["u1"] * 50,
+                    "source": ["gps"] * 50,
+                    "timestamp": [base + dt.timedelta(days=40 * k)] * 50,
+                }
+
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                         timespans=("alltime", "month"))
+    ckdir = str(tmp_path / "ck")
+    inj = FaultInjector({3: 1})
+    with pytest.raises(RuntimeError):
+        run_job_resumable(DatetimeSource(), ckdir, config=cfg,
+                          checkpoint_every=1, fault_injector=inj)
+    resumed = run_job_resumable(DatetimeSource(), ckdir, config=cfg,
+                                checkpoint_every=1)
+    clean = run_job_resumable(DatetimeSource(), str(tmp_path / "ck2"),
+                              config=cfg, checkpoint_every=10)
+    assert resumed == clean
+    assert any("|2020-03|" in k for k in clean)
+
+
+def test_streaming_checkpoint_restore(tmp_path):
+    import jax.numpy as jnp
+
+    from heatmap_tpu.ops import Window
+    from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+
+    rng = np.random.default_rng(0)
+    window = Window(zoom=9, row0=160, col0=128, height=32, width=32)
+    cfg = StreamConfig(window=window, half_life_s=60.0)
+    mgr = CheckpointManager(str(tmp_path / "stream"))
+
+    s1 = HeatmapStream(cfg)
+    for k in range(3):
+        s1.update(rng.uniform(30, 50, 100), rng.uniform(-100, -60, 100),
+                  t=10.0 * k)
+    s1.checkpoint(mgr)
+
+    s2 = HeatmapStream(cfg).restore(mgr)
+    assert s2.t == s1.t and s2.n_batches == 3
+    np.testing.assert_array_equal(s2.snapshot(), s1.snapshot())
+    # Continue both identically.
+    lat = rng.uniform(30, 50, 50)
+    lon = rng.uniform(-100, -60, 50)
+    for s in (s1, s2):
+        s.update(lat, lon, t=35.0)
+    np.testing.assert_allclose(s1.snapshot(), s2.snapshot())
