@@ -30,3 +30,11 @@ from heatmap_tpu.parallel.sharded import (  # noqa: F401
     pyramid_sparse_morton_sharded,
     splat_rowsharded,
 )
+from heatmap_tpu.parallel.multihost import (  # noqa: F401
+    gather_blobs,
+    initialize,
+    make_hybrid_mesh,
+    process_shard_bounds,
+    run_job_multihost,
+    shard_source_rows,
+)

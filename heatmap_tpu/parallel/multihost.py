@@ -1,0 +1,228 @@
+"""Multi-host execution: DCN-aware meshes, ingest sharding, egress merge.
+
+The reference scales out by adding Spark executors behind a k8s external
+shuffle service (reference submit-heatmap:9-13); its "communication
+backend" is the JVM shuffle over the pod network (SURVEY.md §2.3). The
+TPU-native equivalent (BASELINE.md config 5, 10B points on v5e-64):
+
+- every host runs this same program (SPMD) after ``initialize()``
+  (``jax.distributed`` — on TPU pods coordinator/process-id/count
+  auto-detect from the runtime environment);
+- ingest is sharded by process: each host reads only its slice of the
+  source (``process_shard_bounds`` — the Cassandra-token-range analog),
+  then shards its points over its local devices on the mesh's data
+  axis;
+- device collectives (psum / psum_scatter in parallel.sharded) ride
+  ICI within a host and DCN across hosts. ``make_hybrid_mesh`` orders
+  devices so consecutive data-axis neighbors are ICI-local (XLA then
+  hierarchically decomposes cross-host reductions: reduce over ICI
+  first, DCN once per host);
+- final blob egress merges across hosts with ``gather_blobs`` (DCN
+  byte-level allgather via jax.experimental.multihost_utils), the
+  analog of the reference's driver-side collect before the Cassandra
+  write (reference heatmap.py:156-158).
+
+Everything degrades to a no-op on a single process, so the same job
+script runs unchanged from a laptop CPU to a v5e-64 pod.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+
+from heatmap_tpu.parallel.mesh import DATA_AXIS, TILE_AXIS, make_mesh
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None):
+    """Bring up jax.distributed (no-op if already initialized or
+    single-process with no coordinator configured).
+
+    On TPU pods all three arguments auto-detect; on CPU/GPU clusters
+    pass them explicitly (the JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars also work).
+    """
+    if jax.process_count() > 1:
+        return  # already distributed
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (ValueError, RuntimeError):
+        # Single-process environment (no coordinator discoverable) or
+        # already initialized — both fine.
+        pass
+
+
+def make_hybrid_mesh(tile: int = 1, devices=None) -> jax.sharding.Mesh:
+    """A (data, tile) mesh whose data-axis device order is DCN-aware.
+
+    Multi-process: devices are ordered host-major (each host's local
+    devices contiguous), so neighboring data-axis positions are
+    ICI-connected and XLA lowers data-axis reductions hierarchically
+    (ICI ring per host, then one DCN hop per host pair) — the layout
+    "How to Scale Your Model" prescribes for DP over pods. The axis
+    NAME stays ``data``, so every kernel in parallel.sharded works
+    unchanged on a pod.
+
+    Single-process: identical to ``make_mesh``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if jax.process_count() > 1:
+        # jax.devices() is already process-major on TPU pods, but make
+        # it explicit (and stable) rather than relying on enumeration
+        # order: sort by (process_index, local id).
+        devices = sorted(
+            devices, key=lambda d: (d.process_index, d.id)
+        )
+    return make_mesh(tile=tile, devices=devices)
+
+
+def process_shard_bounds(n: int, process_count: int | None = None,
+                         process_index: int | None = None) -> tuple[int, int]:
+    """[start, end) slice of an n-element source this process ingests.
+
+    Balanced like Spark's even token-range split: first ``n % k``
+    shards get one extra element. Deterministic, so failed-host
+    re-execution re-reads exactly the same slice (SURVEY.md §5
+    fault-tolerance model).
+    """
+    k = jax.process_count() if process_count is None else process_count
+    i = jax.process_index() if process_index is None else process_index
+    if not 0 <= i < k:
+        raise ValueError(f"process_index {i} out of range for {k} processes")
+    base, extra = divmod(n, k)
+    start = i * base + min(i, extra)
+    return start, start + base + (1 if i < extra else 0)
+
+
+def shard_source_rows(source_batches, n_total: int, batch_size: int,
+                      process_count: int | None = None,
+                      process_index: int | None = None):
+    """Yield only this process's batches from a deterministic source.
+
+    ``source_batches`` must yield fixed-size batches (``batch_size``
+    rows, last one ragged) in a deterministic order; batch indices are
+    partitioned by ``process_shard_bounds`` over the batch count. The
+    host-level analog of the per-device point sharding inside the mesh.
+    """
+    n_batches = -(-n_total // batch_size) if n_total else 0
+    lo, hi = process_shard_bounds(n_batches, process_count, process_index)
+    for i, batch in enumerate(source_batches):
+        if i >= hi:
+            break
+        if i >= lo:
+            yield batch
+
+
+def gather_blobs(local_blobs: dict, max_bytes: int = 1 << 30) -> dict:
+    """Merge per-process blob dicts across hosts (DCN allgather).
+
+    Values must be JSON-serializable (the pipeline emits JSON strings
+    already). Key collisions across hosts are summed when both sides
+    are numeric dicts, else last-process-wins — with process-sharded
+    ingest and slot-complete cascades, collisions only occur for
+    result tiles whose detail tiles straddle host shards, where the
+    inner dicts are disjoint-or-summable by construction.
+
+    Single-process: returns ``local_blobs`` unchanged.
+    """
+    if jax.process_count() == 1:
+        return local_blobs
+    from jax.experimental import multihost_utils
+
+    payload = json.dumps(local_blobs).encode()
+    if len(payload) > max_bytes:
+        raise ValueError(
+            f"local blob payload {len(payload)}B exceeds max_bytes; "
+            f"raise max_bytes or write per-host sinks instead"
+        )
+    # Fixed-width frame: [length:8][payload][zero pad] so allgather is
+    # a dense u8 array.
+    n = np.asarray([len(payload)], np.int64)
+    max_len = int(multihost_utils.process_allgather(n).max())
+    frame = np.zeros(max_len + 8, np.uint8)
+    frame[:8] = np.frombuffer(np.int64(len(payload)).tobytes(), np.uint8)
+    frame[8 : 8 + len(payload)] = np.frombuffer(payload, np.uint8)
+    frames = multihost_utils.process_allgather(frame)  # (k, max_len+8)
+    merged: dict = {}
+    for row in np.asarray(frames):
+        ln = int(np.frombuffer(row[:8].tobytes(), np.int64)[0])
+        part = json.loads(row[8 : 8 + ln].tobytes().decode())
+        for key, val in part.items():
+            if key in merged:
+                merged[key] = _merge_blob_values(merged[key], val)
+            else:
+                merged[key] = val
+    return merged
+
+
+def _merge_blob_values(a, b):
+    """Sum two blob values that may be JSON strings of {tile: count}."""
+    decode = isinstance(a, str)
+    da = json.loads(a) if decode else a
+    db = json.loads(b) if isinstance(b, str) else b
+    if isinstance(da, dict) and isinstance(db, dict):
+        out = dict(da)
+        for k, v in db.items():
+            out[k] = out.get(k, 0) + v if isinstance(v, (int, float)) else v
+        return json.dumps(out) if decode else out
+    return b
+
+
+def run_job_multihost(source, sink=None, config=None,
+                      batch_size: int = 1 << 20,
+                      n_total: int | None = None):
+    """Process-sharded ``run_job``: each host ingests its slice of the
+    source, aggregates on its local devices, and the blob dicts merge
+    over DCN at the end (only process 0 writes the sink).
+
+    ``n_total`` (total source rows) enables exact batch-count sharding;
+    without it, single-process falls through to run_job and
+    multi-process raises (sources must declare their size to shard —
+    SyntheticSource has ``n``; files can be pre-counted).
+    """
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+    from heatmap_tpu.pipeline.batch import _run_loaded, load_columns
+
+    config = config or BatchJobConfig()
+    if jax.process_count() == 1:
+        return run_job(source, sink, config, batch_size=batch_size)
+    if n_total is None:
+        n_total = getattr(source, "n", None)
+        if n_total is None:
+            raise ValueError(
+                "multi-host sharding needs n_total (source row count)"
+            )
+    lats, lons, users, stamps = [], [], [], []
+    for batch in shard_source_rows(source.batches(batch_size), n_total,
+                                   batch_size):
+        cols = load_columns(batch)
+        lats.append(cols["latitude"])
+        lons.append(cols["longitude"])
+        users.extend(cols["user_id"])
+        stamps.extend(cols["timestamp"])
+    if lats and sum(len(a) for a in lats):
+        local = _run_loaded(
+            {
+                "latitude": np.concatenate(lats),
+                "longitude": np.concatenate(lons),
+                "user_id": users,
+                "timestamp": stamps,
+            },
+            config,
+            as_json=True,
+        )
+    else:
+        local = {}
+    blobs = gather_blobs(local)
+    if sink is not None and jax.process_index() == 0:
+        sink.write(blobs.items())
+    return blobs

@@ -1,0 +1,129 @@
+"""Multi-host layer (parallel.multihost).
+
+True multi-process DCN runs need a pod; these tests pin down the pieces
+that make the pod path correct: deterministic process-shard math, the
+shard+merge algebra (per-host cascade then blob merge must equal the
+global cascade — everything is linear in counts), and the
+single-process degradation contract.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.parallel.multihost import (
+    _merge_blob_values,
+    gather_blobs,
+    make_hybrid_mesh,
+    process_shard_bounds,
+    run_job_multihost,
+    shard_source_rows,
+)
+
+
+def test_process_shard_bounds_partition():
+    for n in (0, 1, 7, 64, 1001):
+        for k in (1, 2, 3, 8):
+            slices = [process_shard_bounds(n, k, i) for i in range(k)]
+            # Contiguous, disjoint, covering, balanced within 1.
+            assert slices[0][0] == 0 and slices[-1][1] == n
+            for (a0, a1), (b0, b1) in zip(slices, slices[1:]):
+                assert a1 == b0
+            sizes = [b - a for a, b in slices]
+            assert max(sizes) - min(sizes) <= 1
+
+
+def test_process_shard_bounds_validates():
+    with pytest.raises(ValueError):
+        process_shard_bounds(10, 4, 4)
+
+
+def test_shard_source_rows_covers_exactly():
+    batches = [np.full(10, i) for i in range(7)]
+    seen = []
+    for i in range(3):
+        seen += [int(b[0]) for b in shard_source_rows(
+            iter(batches), n_total=70, batch_size=10,
+            process_count=3, process_index=i,
+        )]
+    assert seen == list(range(7))
+
+
+def test_make_hybrid_mesh_single_process_matches_make_mesh(devices):
+    from heatmap_tpu.parallel import make_mesh
+
+    mesh = make_hybrid_mesh(devices=devices)
+    ref = make_mesh(devices=devices)
+    assert mesh.shape == ref.shape
+    assert list(mesh.devices.flat) == list(ref.devices.flat)
+
+
+def test_gather_blobs_single_process_identity():
+    blobs = {"all|alltime|3_1_2": json.dumps({"8_40_65": 2.0})}
+    assert gather_blobs(blobs) is blobs
+
+
+def test_merge_blob_values_sums_json_dicts():
+    a = json.dumps({"t1": 1.0, "t2": 2.0})
+    b = json.dumps({"t2": 3.0, "t3": 4.0})
+    assert json.loads(_merge_blob_values(a, b)) == {
+        "t1": 1.0, "t2": 5.0, "t3": 4.0
+    }
+    # Raw-dict form too (non-JSON sinks).
+    assert _merge_blob_values({"t": 1}, {"t": 2}) == {"t": 3}
+
+
+def test_sharded_cascade_merge_equals_global():
+    """Per-host run + blob merge == single global run (linearity)."""
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+    from heatmap_tpu.pipeline.batch import _run_loaded, load_columns
+
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8)
+    src = SyntheticSource(n=3000, seed=4)
+    batch_size = 256
+    global_blobs = run_job(src, config=cfg, batch_size=batch_size)
+
+    k = 3
+    merged: dict = {}
+    for pi in range(k):
+        lats, lons, users, stamps = [], [], [], []
+        for batch in shard_source_rows(src.batches(batch_size),
+                                       n_total=3000, batch_size=batch_size,
+                                       process_count=k, process_index=pi):
+            cols = load_columns(batch)
+            lats.append(cols["latitude"])
+            lons.append(cols["longitude"])
+            users.extend(cols["user_id"])
+            stamps.extend(cols["timestamp"])
+        if not lats or sum(len(a) for a in lats) == 0:
+            continue
+        local = _run_loaded(
+            {
+                "latitude": np.concatenate(lats),
+                "longitude": np.concatenate(lons),
+                "user_id": users,
+                "timestamp": stamps,
+            },
+            cfg,
+            as_json=True,
+        )
+        for key, val in local.items():
+            merged[key] = (
+                _merge_blob_values(merged[key], val) if key in merged else val
+            )
+    assert set(merged) == set(global_blobs)
+    for key in global_blobs:
+        assert json.loads(merged[key]) == pytest.approx(
+            json.loads(global_blobs[key])
+        )
+
+
+def test_run_job_multihost_single_process_falls_through():
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=9)
+    src = SyntheticSource(n=1000, seed=1)
+    assert run_job_multihost(src, config=cfg) == run_job(src, config=cfg)
