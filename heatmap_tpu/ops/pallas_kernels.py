@@ -47,7 +47,8 @@ DEFAULT_CHUNK = 1024
 
 
 def _histogram_kernel(
-    rc_ref, w_ref, out_ref, acc_ref, *, height, width, chunk, precision
+    rc_ref, w_ref, out_ref, acc_ref, *, height, width, chunk, precision,
+    onehot_dtype
 ):
     i = pl.program_id(0)
 
@@ -59,11 +60,17 @@ def _histogram_kernel(
     cols = rc_ref[1, :]
     weights = w_ref[0, :]  # (chunk,) f32
 
+    # bf16 one-hots halve the VPU->MXU operand traffic and stay exact:
+    # 0 and 1 are representable, and accumulation is f32 regardless
+    # (preferred_element_type). Only the *weighted* path needs f32
+    # operands, because arbitrary weights don't survive bf16's 8-bit
+    # mantissa — the caller picks via onehot_dtype.
     r_ids = jax.lax.broadcasted_iota(jnp.int32, (height, chunk), 0)
-    row_onehot = (r_ids == rows[None, :]).astype(jnp.float32)
+    row_onehot = (r_ids == rows[None, :]).astype(onehot_dtype)
     c_ids = jax.lax.broadcasted_iota(jnp.int32, (chunk, width), 1)
-    col_onehot = (c_ids == cols[:, None]).astype(jnp.float32)
-    col_onehot = col_onehot * weights[:, None]
+    col_onehot = (c_ids == cols[:, None]).astype(onehot_dtype)
+    if onehot_dtype == jnp.float32:
+        col_onehot = col_onehot * weights[:, None]
 
     acc_ref[:] += jnp.dot(
         row_onehot,
@@ -78,7 +85,7 @@ def _histogram_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("window", "chunk", "interpret")
+    jax.jit, static_argnames=("window", "chunk", "interpret", "onehot_dtype")
 )
 def bin_rowcol_window_pallas(
     row,
@@ -88,6 +95,7 @@ def bin_rowcol_window_pallas(
     valid=None,
     chunk: int = DEFAULT_CHUNK,
     interpret: bool = False,
+    onehot_dtype=None,
 ):
     """Pallas MXU histogram: pre-projected points -> (H, W) f32 raster.
 
@@ -126,13 +134,23 @@ def bin_rowcol_window_pallas(
 
     # 0/1 one-hots and unit weights are exact in the MXU's default
     # bf16 passes; arbitrary weights need full f32 precision or the
-    # TPU matmul rounds them to 8 mantissa bits.
+    # TPU matmul rounds them to 8 mantissa bits. The count path goes
+    # further and feeds bf16 one-hot *operands* (half the VPU->MXU
+    # traffic, still exact — counts accumulate in f32).
     precision = (
         jax.lax.Precision.DEFAULT if weights is None
         else jax.lax.Precision.HIGHEST
     )
+    if onehot_dtype is None:
+        onehot_dtype = jnp.bfloat16 if weights is None else jnp.float32
+    elif weights is not None and onehot_dtype != jnp.float32:
+        raise ValueError(
+            "weighted binning requires f32 one-hots (bf16 would round "
+            "the weights); leave onehot_dtype unset"
+        )
     kernel = functools.partial(
-        _histogram_kernel, height=h, width=w, chunk=chunk, precision=precision
+        _histogram_kernel, height=h, width=w, chunk=chunk,
+        precision=precision, onehot_dtype=onehot_dtype,
     )
     return pl.pallas_call(
         kernel,

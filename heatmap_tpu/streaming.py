@@ -46,6 +46,9 @@ class StreamConfig:
     #: the whole stream; batches longer than this raise). None = compile
     #: per distinct batch length.
     pad_to: int | None = None
+    #: Binning backend (ops.histogram): "xla", "pallas", or "auto"
+    #: (pallas MXU kernel on TPU for blob-sized windows).
+    backend: str = "auto"
 
     @property
     def decay_rate(self) -> float:
@@ -74,6 +77,7 @@ def make_update_step(config: StreamConfig, mesh=None):
                 valid=valid,
                 proj_dtype=config.proj_dtype,
                 dtype=raster.dtype,
+                backend=config.backend,
             )
             return raster * decay + fresh
 

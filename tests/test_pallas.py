@@ -95,3 +95,43 @@ def test_fused_projection_parity():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(expected), rtol=1e-6
     )
+
+
+def test_bf16_and_f32_onehots_identical():
+    rng = np.random.default_rng(4)
+    row = rng.integers(300, 420, 4000)
+    col = rng.integers(230, 400, 4000)
+    args = (jnp.asarray(row, jnp.int32), jnp.asarray(col, jnp.int32), WINDOW)
+    bf = bin_rowcol_window_pallas(*args, interpret=True,
+                                  onehot_dtype=jnp.bfloat16)
+    f32 = bin_rowcol_window_pallas(*args, interpret=True,
+                                   onehot_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(bf), np.asarray(f32))
+
+
+def test_weighted_rejects_bf16_onehots():
+    import pytest
+
+    with pytest.raises(ValueError):
+        bin_rowcol_window_pallas(
+            jnp.zeros(8, jnp.int32), jnp.zeros(8, jnp.int32), WINDOW,
+            weights=jnp.ones(8, jnp.float32), interpret=True,
+            onehot_dtype=jnp.bfloat16,
+        )
+
+
+def test_backend_selection_in_histogram():
+    """bin_rowcol_window backend plumbing: auto falls back to xla off-TPU;
+    explicit pallas matches (via interpret-free path only on TPU, so here
+    just check auto==xla result on CPU)."""
+    from heatmap_tpu.ops.histogram import _pick_backend
+
+    assert _pick_backend("auto", WINDOW) == "xla"  # CPU test env
+    assert _pick_backend("pallas", WINDOW) == "pallas"
+    assert _pick_backend("xla", WINDOW) == "xla"
+    rng = np.random.default_rng(5)
+    row = jnp.asarray(rng.integers(300, 400, 1000), jnp.int32)
+    col = jnp.asarray(rng.integers(230, 400, 1000), jnp.int32)
+    a = bin_rowcol_window(row, col, WINDOW, backend="auto")
+    b = bin_rowcol_window(row, col, WINDOW, backend="xla")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
