@@ -124,24 +124,28 @@ def cmd_run(args) -> int:
     if args.fast and args.checkpoint_dir:
         raise SystemExit("--fast and --checkpoint-dir are mutually "
                          "exclusive (the fast path has no resume yet)")
-    fast_path = None
+    fast_source = None
     if args.fast:
-        # Resolve through open_source so bare .csv paths and csv: specs
+        # Resolve through open_source so bare paths and prefixed specs
         # behave identically to every other subcommand.
+        from heatmap_tpu.io.hmpb import HMPBSource
         from heatmap_tpu.io.sources import CSVSource
 
         src = open_source(args.input)
-        if not isinstance(src, CSVSource):
+        if isinstance(src, CSVSource):
+            fast_source = src.path
+        elif isinstance(src, HMPBSource):
+            fast_source = src
+        else:
             raise SystemExit(
-                f"--fast needs a csv source, got {args.input!r}"
+                f"--fast needs a csv or hmpb source, got {args.input!r}"
             )
-        fast_path = src.path
     t0 = time.perf_counter()
     prof = jax_profile(args.profile) if args.profile else contextlib.nullcontext()
     with prof:
         with open_sink(args.output) as sink:
             if args.fast:
-                blobs = run_job_fast(fast_path, sink, config,
+                blobs = run_job_fast(fast_source, sink, config,
                                      batch_size=args.batch_size)
             elif args.checkpoint_dir:
                 blobs = run_job_resumable(
@@ -226,6 +230,15 @@ def cmd_tiles(args) -> int:
     return 0
 
 
+def cmd_convert(args) -> int:
+    from heatmap_tpu.io.hmpb import convert_to_hmpb
+
+    stats = convert_to_hmpb(args.input, args.output,
+                            batch_size=args.batch_size)
+    print(json.dumps(stats))
+    return 0
+
+
 def cmd_info(args) -> int:
     jax = _init_backend(args)
     devs = jax.devices()
@@ -275,6 +288,16 @@ def build_parser() -> argparse.ArgumentParser:
     p_tiles.add_argument("--sigma", type=float, default=None,
                          help="Gaussian sigma in cells (default K/4)")
     p_tiles.set_defaults(fn=cmd_tiles)
+
+    p_conv = sub.add_parser(
+        "convert",
+        help="convert a source to the HMPB binary columnar point format "
+        "(mmap ingest for --fast reruns)",
+    )
+    p_conv.add_argument("--input", required=True, help="any source spec")
+    p_conv.add_argument("--output", required=True, help="output .hmpb path")
+    p_conv.add_argument("--batch-size", type=int, default=1 << 20)
+    p_conv.set_defaults(fn=cmd_convert)
 
     p_info = sub.add_parser("info", help="resolved config + devices")
     _add_backend_flags(p_info)

@@ -17,6 +17,11 @@ from heatmap_tpu.io.sources import (  # noqa: F401
     SyntheticSource,
     open_source,
 )
+from heatmap_tpu.io.hmpb import (  # noqa: F401
+    HMPBSource,
+    convert_to_hmpb,
+    write_hmpb,
+)
 from heatmap_tpu.io.sinks import (  # noqa: F401
     BlobSink,
     CassandraBlobSink,

@@ -1,0 +1,287 @@
+"""HMPB: the framework's binary columnar point format (mmap ingest).
+
+CSV decoding tops out at parser speed (native/pointcodec.cpp, ~150
+MB/s/core); production-scale reruns of the same dataset shouldn't pay
+it twice. HMPB stores points in the pipeline's *fast layout* — numeric
+columns plus pre-routed integer group ids (reference heatmap.py:64-70
+routing applied once, at conversion) — so ingest is a memory map and
+per-batch slicing runs at memory bandwidth. The reference's analog is
+the Cassandra SSTable scan behind the connector (reference
+heatmap.py:137), which it re-decodes every run.
+
+Layout (explicitly little-endian, including on big-endian hosts):
+
+    magic   b"HMPB\\x01\\n"
+    u64     header_len (JSON bytes, excluding its pad)
+    bytes   header JSON: {"n": N, "names": [routed group names],
+                          "columns": [...]}  (id order; names[i] is
+                          routed id i, -1 = excluded x-user),
+            NUL-padded so the data section starts 8-byte aligned
+    f64[N]  latitude
+    f64[N]  longitude
+    i64[N]  timestamp (TS_MISSING sentinel = INT64_MIN)
+    i32[N]  routed group id
+    u8[N]   background flag (reference heatmap.py:28-29)
+
+Sections are contiguous, in the order above (widest first, u8 last),
+and every section start is 8-byte aligned, so external readers can mmap
+and cast column pointers directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+MAGIC = b"HMPB\x01\n"
+TS_MISSING = np.iinfo(np.int64).min
+
+_COLUMNS = (
+    ("latitude", "<f8"),
+    ("longitude", "<f8"),
+    ("timestamp", "<i8"),
+    ("routed", "<i4"),
+    ("background", "u1"),
+)
+
+
+def write_hmpb(path: str, latitude, longitude, routed, names,
+               timestamp=None, background=None):
+    """Write one HMPB file from fast-layout columns (atomic rename)."""
+    lat = np.ascontiguousarray(latitude, "<f8")
+    lon = np.ascontiguousarray(longitude, "<f8")
+    n = lat.shape[0]
+    rid = np.ascontiguousarray(routed, "<i4")
+    ts = (
+        np.full(n, TS_MISSING, "<i8")
+        if timestamp is None
+        else np.ascontiguousarray(timestamp, "<i8")
+    )
+    bg = (
+        np.zeros(n, "u1")
+        if background is None
+        else np.ascontiguousarray(background, "u1")
+    )
+    for name, arr in (("longitude", lon), ("timestamp", ts),
+                      ("routed", rid), ("background", bg)):
+        if arr.shape[0] != n:
+            raise ValueError(f"{name} has {arr.shape[0]} rows, expected {n}")
+    if rid.size and int(rid.max(initial=-1)) >= len(names):
+        raise ValueError("routed ids exceed the names table")
+    header = json.dumps({
+        "n": int(n),
+        "names": list(names),
+        "columns": [c for c, _ in _COLUMNS],
+    }).encode()
+    # NUL-pad so the data section (magic + u64 + header + pad) starts
+    # 8-byte aligned: every later section is then aligned too (columns
+    # are ordered widest-first).
+    pad = (-(len(MAGIC) + 8 + len(header))) % 8
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        f.write(np.uint64(len(header)).astype("<u8").tobytes())
+        f.write(header)
+        f.write(b"\x00" * pad)
+        for arr in (lat, lon, ts, rid, bg):
+            arr.tofile(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+class HMPBSource:
+    """Memory-mapped HMPB reader yielding fast-layout batches.
+
+    ``fast_batches`` is the pipeline.run_job_fast input contract
+    (latitude/longitude/timestamp/background/routed arrays +
+    new_group_names); ``batches`` adapts to the string-column Source
+    contract for the generic (slower) pipeline paths.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            if f.read(len(MAGIC)) != MAGIC:
+                raise ValueError(f"{path}: not an HMPB file")
+            (hlen,) = np.frombuffer(f.read(8), "<u8")
+            header = json.loads(f.read(int(hlen)).decode())
+            self._data_off = f.tell() + (-f.tell()) % 8  # header NUL pad
+        self.n = int(header["n"])
+        self.names = list(header["names"])
+        self._maps = {}
+        off = self._data_off
+        for name, dtype in _COLUMNS:
+            itemsize = np.dtype(dtype).itemsize
+            self._maps[name] = (off, dtype)
+            off += self.n * itemsize
+        expected = off
+        actual = os.path.getsize(path)
+        if actual < expected:
+            raise ValueError(
+                f"{path}: truncated ({actual} bytes, need {expected})"
+            )
+
+    def _col(self, name, lo, hi):
+        off, dtype = self._maps[name]
+        itemsize = np.dtype(dtype).itemsize
+        return np.memmap(
+            self.path, dtype=dtype, mode="r",
+            offset=off + lo * itemsize, shape=(hi - lo,),
+        )
+
+    def fast_batches(self, batch_size: int = 1 << 20):
+        sent_names = False
+        for lo in range(0, self.n, batch_size):
+            hi = min(lo + batch_size, self.n)
+            yield {
+                "latitude": np.asarray(self._col("latitude", lo, hi)),
+                "longitude": np.asarray(self._col("longitude", lo, hi)),
+                "timestamp": np.asarray(self._col("timestamp", lo, hi)),
+                "routed": np.asarray(self._col("routed", lo, hi)),
+                "background": np.asarray(
+                    self._col("background", lo, hi)
+                ).astype(bool),
+                "new_group_names": [] if sent_names else list(self.names),
+            }
+            sent_names = True
+
+    def batches(self, batch_size: int = 1 << 20):
+        """String-column Source view (for the generic pipeline paths).
+
+        user_id strings are reconstructed from the routed-name table —
+        excluded x-users come back as the canonical ``"x"`` (the
+        original id wasn't stored; routing is identical since only the
+        prefix matters, reference heatmap.py:65) and route-pooled ids
+        as ``"rt-"``-less ``"route"``... which would re-route to its own
+        group, so they come back as ``"rt-0"`` to preserve routing.
+        """
+        for b in self.fast_batches(batch_size):
+            rid = b["routed"]
+            users = []
+            for r in rid:
+                if r < 0:
+                    users.append("x")
+                else:
+                    name = self.names[r]
+                    users.append("rt-0" if name == "route" else name)
+            ts = b["timestamp"]
+            yield {
+                "latitude": b["latitude"],
+                "longitude": b["longitude"],
+                "user_id": users,
+                "source": [
+                    "background" if bg else "gps" for bg in b["background"]
+                ],
+                "timestamp": [
+                    None if t == TS_MISSING else int(t) for t in ts
+                ],
+            }
+
+
+def _stamp_to_i64(s) -> int:
+    """Timestamp -> stored i64: ints/strings pass through as epoch
+    values; datetime/date become epoch-ms (the shape timespan._to_date
+    consumes — reference heatmap.py:26 carried epoch-ms)."""
+    import datetime as _dt
+
+    if s in (None, ""):
+        return TS_MISSING
+    if isinstance(s, _dt.datetime):
+        if s.tzinfo is None:
+            s = s.replace(tzinfo=_dt.timezone.utc)
+        return int(s.timestamp() * 1000)
+    if isinstance(s, _dt.date):
+        return int(_dt.datetime(
+            s.year, s.month, s.day, tzinfo=_dt.timezone.utc
+        ).timestamp() * 1000)
+    return int(float(s))
+
+
+def convert_to_hmpb(source_spec: str, out_path: str,
+                    batch_size: int = 1 << 20) -> dict:
+    """Convert any source spec to HMPB (columns held in memory once).
+
+    CSV inputs use the native decoder's fast path end-to-end; other
+    sources route user ids host-side. Returns {"n": ..., "groups": ...}.
+    """
+    lats, lons, tss, rids, bgs = [], [], [], [], []
+    names: list = []
+
+    def ingest_fast(batches):
+        for b in batches:
+            names.extend(b["new_group_names"])
+            lats.append(np.asarray(b["latitude"], np.float64))
+            lons.append(np.asarray(b["longitude"], np.float64))
+            tss.append(np.asarray(b["timestamp"], np.int64))
+            rids.append(np.asarray(b["routed"], np.int32))
+            bgs.append(np.asarray(b["background"], np.uint8))
+
+    kind, _, rest = source_spec.partition(":")
+    is_csv = kind == "csv" or (not rest and source_spec.endswith(".csv"))
+    is_hmpb = kind == "hmpb" or (not rest and source_spec.endswith(".hmpb"))
+    native_ok = False
+    if is_csv:
+        try:
+            from heatmap_tpu.native import parse_csv_batches
+
+            native_ok = True
+        except ImportError:
+            pass
+    if native_ok:
+        ingest_fast(parse_csv_batches(
+            rest if kind == "csv" else source_spec, batch_size, fast=True,
+        ))
+    elif is_hmpb:
+        # Already in the fast layout: columnar copy, no per-row work.
+        ingest_fast(HMPBSource(rest or source_spec).fast_batches(batch_size))
+    else:
+        from heatmap_tpu.io.sources import open_source
+        from heatmap_tpu.pipeline.groups import route_user
+
+        src = open_source(source_spec)
+        intern: dict = {}
+        for b in src.batches(batch_size):
+            m = len(b["latitude"])
+            rid = np.empty(m, np.int32)
+            for i, uid in enumerate(b["user_id"]):
+                name = route_user(uid)
+                if name is None:
+                    rid[i] = -1
+                    continue
+                g = intern.get(name)
+                if g is None:
+                    g = len(names)
+                    intern[name] = g
+                    names.append(name)
+                rid[i] = g
+            src_col = b.get("source") or []
+            bg = np.asarray(
+                [s == "background" for s in src_col] if len(src_col) else
+                np.zeros(m, bool)
+            ).astype(np.uint8)
+            stamps = b.get("timestamp")
+            if stamps is None or len(stamps) == 0:
+                ts = np.full(m, TS_MISSING, np.int64)
+            else:
+                ts = np.asarray([_stamp_to_i64(s) for s in stamps], np.int64)
+            lats.append(np.asarray(b["latitude"], np.float64))
+            lons.append(np.asarray(b["longitude"], np.float64))
+            tss.append(ts)
+            rids.append(rid)
+            bgs.append(bg)
+
+    n = sum(len(a) for a in lats)
+    write_hmpb(
+        out_path,
+        np.concatenate(lats) if n else np.empty(0),
+        np.concatenate(lons) if n else np.empty(0),
+        np.concatenate(rids) if n else np.empty(0, np.int32),
+        names,
+        timestamp=np.concatenate(tss) if n else None,
+        background=np.concatenate(bgs) if n else None,
+    )
+    return {"n": n, "groups": len(names), "output": out_path}

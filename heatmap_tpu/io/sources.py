@@ -332,6 +332,10 @@ def open_source(spec: str, **kwargs) -> Source:
     if kind == "cassandra":
         cfg = CassandraConfig(endpoint=rest or None)
         return CassandraSource(config=cfg, **kwargs)
+    if kind == "hmpb":
+        from heatmap_tpu.io.hmpb import HMPBSource
+
+        return HMPBSource(rest, **kwargs)
     # Bare path: sniff the extension.
     if spec.endswith(".csv"):
         return CSVSource(spec, **kwargs)
@@ -339,4 +343,8 @@ def open_source(spec: str, **kwargs) -> Source:
         return JSONLSource(spec, **kwargs)
     if spec.endswith((".parquet", ".pq")):
         return ParquetSource(spec, **kwargs)
+    if spec.endswith(".hmpb"):
+        from heatmap_tpu.io.hmpb import HMPBSource
+
+        return HMPBSource(spec, **kwargs)
     raise ValueError(f"unrecognized source spec {spec!r}")

@@ -185,28 +185,40 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     return blobs
 
 
-def run_job_fast(csv_path: str, sink=None, config: BatchJobConfig | None = None,
+def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
                  batch_size: int = 1 << 20):
-    """CSV-to-sink job over the native decoder's integer fast path.
+    """Integer-fast-path job: no per-row Python objects anywhere.
 
-    Same output as ``run_job(CSVSource(path))`` but no per-row Python
-    objects anywhere: the C++ reader thread (native/pointcodec.cpp)
-    parses, routes user ids (reference heatmap.py:64-70) and flags
-    background rows (reference heatmap.py:28-29) natively; this side
-    only maps the reader's small routed-name table into the UserVocab
-    (O(unique users), not O(rows)) and filters with numpy masks.
+    ``source`` is a CSV path (the native C++ decoder parses, routes
+    user ids per reference heatmap.py:64-70 and flags background rows
+    per heatmap.py:28-29 in its reader threads) or any object with a
+    ``fast_batches(batch_size)`` method (io.hmpb.HMPBSource memory-maps
+    pre-routed columns). This side only maps the small routed-name
+    table into the UserVocab (O(unique users), not O(rows)) and
+    filters with numpy masks. Same blobs as the string path.
 
     Dated timespans need per-row timestamps as Python objects, so this
     path requires ``timespans == ("alltime",)`` (the reference's only
     live timespan, SURVEY.md §8.7).
     """
-    try:
-        from heatmap_tpu.native import parse_csv_batches
-    except ImportError as e:
-        raise RuntimeError(
-            "run_job_fast needs the native decoder (native/ build "
-            "failed or disabled); use run_job(CSVSource(path)) instead"
-        ) from e
+    if isinstance(source, str):
+        try:
+            from heatmap_tpu.native import parse_csv_batches
+        except ImportError as e:
+            raise RuntimeError(
+                "run_job_fast on a CSV path needs the native decoder "
+                "(native/ build failed or disabled); use "
+                "run_job(CSVSource(path)) instead"
+            ) from e
+        batches = parse_csv_batches(source, batch_size, fast=True)
+    elif hasattr(source, "fast_batches"):
+        batches = source.fast_batches(batch_size)
+    else:
+        raise TypeError(
+            f"run_job_fast needs a CSV path or a fast-batch source "
+            f"(got {type(source).__name__}); use run_job for generic "
+            f"sources"
+        )
 
     config = config or BatchJobConfig()
     if tuple(config.timespans) != ("alltime",):
@@ -222,7 +234,7 @@ def run_job_fast(csv_path: str, sink=None, config: BatchJobConfig | None = None,
     tracer = get_tracer()
     lats, lons, gids = [], [], []
     with tracer.span("ingest.fast"):
-        for b in parse_csv_batches(csv_path, batch_size, fast=True):
+        for b in batches:
             tracer.add_items("ingest.fast", len(b["latitude"]))
             names.extend(b["new_group_names"])
             if len(names) > len(reader_to_vocab):

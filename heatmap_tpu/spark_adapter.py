@@ -1,0 +1,117 @@
+"""Spark adapter: run the TPU cascade inside ``rdd.mapPartitions``.
+
+The reference IS a Spark job (reference heatmap.py:152-163); this
+module is the compatibility bridge for shops whose ingest/orchestration
+stays on Spark while the aggregation moves to TPU hosts (SURVEY.md §7
+build-plan step 5, BASELINE.json's ``--backend=tpu`` north star). The
+shape:
+
+    rdd_of_row_dicts
+      .mapPartitions(heatmap_partitions(config))   # TPU work per part.
+      .reduceByKey(merge_heatmaps)                 # tiny blob merge
+      -> (id, heatmap-json) pairs, reference output schema
+         (reference heatmap.py:156-157)
+
+Each partition runs the full projection+cascade on the local
+accelerator and emits per-(user|timespan|coarse-tile) blob partials;
+the shuffle then moves only aggregated blobs (kilobytes), not points —
+the reference shuffles every point record twice per zoom level
+(SURVEY.md §3.3, 32 shuffles).
+
+Correctness rests on linearity: cascade(A ∪ B) == merge(cascade(A),
+cascade(B)) per key, because every stage is a sum over points (tested
+in tests/test_spark_adapter.py without a Spark cluster — the adapter
+body is plain iterators, so pyspark is only needed at ``run_with_spark``
+call time).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+class HeatmapPartitionRunner:
+    """The ``mapPartitions`` body: iterator of reference-shaped row
+    dicts (latitude, longitude, user_id, source, timestamp — reference
+    heatmap.py:25-36) in, ``(id, heatmap_json)`` pairs out.
+
+    A module-level class so plain pickle (not just Spark's cloudpickle)
+    can ship it to executors; configuration is captured as plain data
+    and heatmap_tpu is imported lazily on the executor (which needs the
+    package + jax installed).
+    """
+
+    def __init__(self, cfg_kwargs: dict):
+        self.cfg_kwargs = cfg_kwargs
+
+    def __call__(self, rows):
+        from heatmap_tpu.pipeline import BatchJobConfig, run_batch
+
+        blobs = run_batch(
+            rows, BatchJobConfig(**self.cfg_kwargs), as_json=True
+        )
+        return iter(blobs.items())
+
+
+def heatmap_partitions(config=None):
+    """-> picklable callable for ``rdd.mapPartitions``."""
+    return HeatmapPartitionRunner(_config_kwargs(config))
+
+
+def merge_heatmaps(a: str, b: str) -> str:
+    """reduceByKey merge: sum two heatmap-json blobs per detail tile."""
+    da, db = json.loads(a), json.loads(b)
+    for k, v in db.items():
+        da[k] = da.get(k, 0) + v
+    return json.dumps(da)
+
+
+def run_with_spark(rdd, config=None, output_table=None):
+    """Driver-side orchestration over a live RDD (needs pyspark).
+
+    Returns the blob dict; with ``output_table`` also writes a
+    DataFrame ``(id, heatmap)`` in the reference's Cassandra append
+    shape (reference heatmap.py:149-150,157) via the session bound to
+    the RDD.
+    """
+    pairs = (
+        rdd.mapPartitions(heatmap_partitions(config))
+        .reduceByKey(merge_heatmaps)
+        .collect()
+    )
+    blobs = dict(pairs)
+    if output_table is not None:
+        from pyspark.sql import SparkSession
+
+        spark = SparkSession.builder.getOrCreate()
+        df = spark.createDataFrame(list(blobs.items()), ["id", "heatmap"])
+        (
+            df.write.format("org.apache.spark.sql.cassandra")
+            .mode("append")
+            .options(**output_table)
+            .save()
+        )
+    return blobs
+
+
+def simulate_partitions(partitions, config=None):
+    """Run the exact mapPartitions/reduceByKey dataflow on in-memory
+    lists (no Spark) — the test/validation harness for the adapter."""
+    fn = heatmap_partitions(config)
+    merged: dict = {}
+    for part in partitions:
+        for key, blob in fn(iter(part)):
+            merged[key] = (
+                merge_heatmaps(merged[key], blob) if key in merged else blob
+            )
+    return merged
+
+
+def _config_kwargs(config) -> dict:
+    if config is None:
+        return {}
+    import dataclasses
+
+    if dataclasses.is_dataclass(config):
+        return dataclasses.asdict(config)
+    return dict(config)

@@ -1,0 +1,206 @@
+"""HMPB binary columnar point format (io.hmpb)."""
+
+import csv
+import json
+import subprocess
+import sys
+import os
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.io.hmpb import (
+    TS_MISSING,
+    HMPBSource,
+    convert_to_hmpb,
+    write_hmpb,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_write_read_roundtrip(tmp_path):
+    p = str(tmp_path / "pts.hmpb")
+    rng = np.random.default_rng(0)
+    n = 1000
+    lat = rng.uniform(-85, 85, n)
+    lon = rng.uniform(-180, 180, n)
+    rid = rng.integers(-1, 3, n).astype(np.int32)
+    ts = rng.integers(0, 2**31, n)
+    bg = (rng.random(n) < 0.1).astype(np.uint8)
+    write_hmpb(p, lat, lon, rid, ["all-u", "bob", "route"],
+               timestamp=ts, background=bg)
+    src = HMPBSource(p)
+    assert src.n == n
+    assert src.names == ["all-u", "bob", "route"]
+    got = list(src.fast_batches(256))
+    assert [len(b["latitude"]) for b in got] == [256, 256, 256, 232]
+    assert got[0]["new_group_names"] == src.names
+    assert got[1]["new_group_names"] == []
+    np.testing.assert_array_equal(
+        np.concatenate([b["latitude"] for b in got]), lat)
+    np.testing.assert_array_equal(
+        np.concatenate([b["routed"] for b in got]), rid)
+    np.testing.assert_array_equal(
+        np.concatenate([b["background"] for b in got]), bg.astype(bool))
+
+
+def test_write_validates(tmp_path):
+    p = str(tmp_path / "bad.hmpb")
+    with pytest.raises(ValueError):
+        write_hmpb(p, np.zeros(3), np.zeros(2), np.zeros(3, np.int32), [])
+    with pytest.raises(ValueError):
+        write_hmpb(p, np.zeros(1), np.zeros(1),
+                   np.asarray([5], np.int32), ["only-one"])
+
+
+def test_reader_rejects_non_hmpb(tmp_path):
+    p = tmp_path / "x.hmpb"
+    p.write_bytes(b"not a real file")
+    with pytest.raises(ValueError):
+        HMPBSource(str(p))
+
+
+def test_truncated_file_detected(tmp_path):
+    p = str(tmp_path / "t.hmpb")
+    write_hmpb(p, np.zeros(100), np.zeros(100),
+               np.zeros(100, np.int32), ["u"])
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) - 50)
+    with pytest.raises(ValueError):
+        HMPBSource(p)
+
+
+def _write_csv(path, n, seed=0):
+    rng = np.random.default_rng(seed)
+    users = ["alice", "bob", "x-9", "rt-1", ""]
+    with open(path, "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["latitude", "longitude", "user_id", "source", "timestamp"])
+        for _ in range(n):
+            w.writerow([
+                rng.uniform(40, 50), rng.uniform(-130, -110),
+                users[rng.integers(0, len(users))],
+                "background" if rng.random() < 0.1 else "gps",
+                int(rng.integers(0, 2**31)),
+            ])
+
+
+def test_convert_csv_and_run_job_fast_parity(tmp_path):
+    from heatmap_tpu.io.sources import CSVSource
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_fast
+
+    csv_p = str(tmp_path / "pts.csv")
+    hmpb_p = str(tmp_path / "pts.hmpb")
+    _write_csv(csv_p, 2000, seed=5)
+    stats = convert_to_hmpb(f"csv:{csv_p}", hmpb_p)
+    assert stats["n"] == 2000
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=9)
+    via_hmpb = run_job_fast(HMPBSource(hmpb_p), config=cfg)
+    via_strings = run_job(CSVSource(csv_p, use_native=False), config=cfg)
+    assert via_hmpb == via_strings
+
+
+def test_string_batches_view_routes_identically(tmp_path):
+    """HMPBSource.batches reconstructs user ids that ROUTE identically,
+    so the generic pipeline gives the same blobs as the fast path."""
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_fast
+
+    csv_p = str(tmp_path / "pts.csv")
+    hmpb_p = str(tmp_path / "pts.hmpb")
+    _write_csv(csv_p, 1000, seed=6)
+    convert_to_hmpb(f"csv:{csv_p}", hmpb_p)
+    src = HMPBSource(hmpb_p)
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=9)
+    assert run_job(src, config=cfg) == run_job_fast(src, config=cfg)
+
+
+def test_convert_from_synthetic_source(tmp_path):
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_fast
+    from heatmap_tpu.io.sources import SyntheticSource
+
+    hmpb_p = str(tmp_path / "s.hmpb")
+    stats = convert_to_hmpb("synthetic:3000:2", hmpb_p, batch_size=512)
+    assert stats["n"] == 3000
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=9)
+    via_hmpb = run_job_fast(HMPBSource(hmpb_p), config=cfg)
+    direct = run_job(SyntheticSource(n=3000, seed=2), config=cfg,
+                     batch_size=512)
+    assert via_hmpb == direct
+
+
+def test_cli_convert_then_fast_run(tmp_path):
+    csv_p = tmp_path / "pts.csv"
+    hmpb_p = tmp_path / "pts.hmpb"
+    out = tmp_path / "blobs.jsonl"
+    _write_csv(str(csv_p), 800, seed=7)
+    env = dict(os.environ, PYTHONPATH=REPO)
+
+    def run(*argv):
+        return subprocess.run(
+            [sys.executable, "-m", "heatmap_tpu", *argv],
+            capture_output=True, text=True, timeout=240, cwd=REPO, env=env,
+        )
+
+    r = run("convert", "--input", f"csv:{csv_p}", "--output", str(hmpb_p))
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["n"] == 800
+    r = run("run", "--backend", "cpu", "--fast",
+            "--input", str(hmpb_p), "--output", f"jsonl:{out}",
+            "--detail-zoom", "12", "--min-detail-zoom", "9")
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout.strip().splitlines()[-1])["blobs"] > 0
+
+
+def test_alignment_and_endianness(tmp_path):
+    """Every column section starts 8-byte aligned and data is
+    little-endian regardless of host order (external-reader contract)."""
+    p = str(tmp_path / "a.hmpb")
+    write_hmpb(p, np.asarray([1.5]), np.asarray([2.5]),
+               np.asarray([0], np.int32), ["zz"], timestamp=[7])
+    src = HMPBSource(p)
+    for name in ("latitude", "longitude", "timestamp", "routed",
+                 "background"):
+        off, _ = src._maps[name]
+        assert off % 8 == 0 or name in ("routed", "background")
+        assert src._maps["latitude"][0] % 8 == 0
+    raw = open(p, "rb").read()
+    off = src._maps["latitude"][0]
+    assert raw[off:off + 8] == np.float64(1.5).astype("<f8").tobytes()
+
+
+def test_convert_datetime_timestamps(tmp_path):
+    import datetime as dt
+
+    from heatmap_tpu.io.hmpb import _stamp_to_i64
+
+    d = dt.datetime(2021, 6, 1, 12, tzinfo=dt.timezone.utc)
+    assert _stamp_to_i64(d) == int(d.timestamp() * 1000)
+    assert _stamp_to_i64(dt.date(2021, 6, 1)) == int(
+        dt.datetime(2021, 6, 1, tzinfo=dt.timezone.utc).timestamp() * 1000
+    )
+    assert _stamp_to_i64(None) == TS_MISSING
+    assert _stamp_to_i64("1500") == 1500
+
+
+def test_hmpb_to_hmpb_reconvert(tmp_path):
+    csv_p = str(tmp_path / "pts.csv")
+    h1 = str(tmp_path / "a.hmpb")
+    h2 = str(tmp_path / "b.hmpb")
+    _write_csv(csv_p, 500, seed=9)
+    convert_to_hmpb(f"csv:{csv_p}", h1)
+    convert_to_hmpb(f"hmpb:{h1}", h2)
+    a, b = HMPBSource(h1), HMPBSource(h2)
+    assert a.n == b.n and a.names == b.names
+    (ba,), (bb,) = list(a.fast_batches(1000)), list(b.fast_batches(1000))
+    for k in ("latitude", "longitude", "timestamp", "routed", "background"):
+        np.testing.assert_array_equal(ba[k], bb[k])
+
+
+def test_missing_timestamps_sentinel(tmp_path):
+    p = str(tmp_path / "nt.hmpb")
+    write_hmpb(p, np.zeros(3), np.zeros(3), np.zeros(3, np.int32), ["u"])
+    (b,) = list(HMPBSource(p).fast_batches(10))
+    assert (b["timestamp"] == TS_MISSING).all()
+    (sb,) = list(HMPBSource(p).batches(10))
+    assert sb["timestamp"] == [None, None, None]

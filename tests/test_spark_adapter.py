@@ -1,0 +1,90 @@
+"""Spark adapter algebra: partitioned cascade + merge == global cascade.
+
+No Spark cluster needed — heatmap_partitions returns a plain iterator
+closure, so the exact mapPartitions/reduceByKey dataflow is simulated
+on lists (simulate_partitions). pyspark is only imported by
+run_with_spark, which these tests don't touch.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.pipeline import BatchJobConfig, run_batch
+from heatmap_tpu.spark_adapter import (
+    heatmap_partitions,
+    merge_heatmaps,
+    simulate_partitions,
+)
+
+
+def _rows(n, seed):
+    rng = np.random.default_rng(seed)
+    users = ["alice", "bob", "x-7", "rt-1", "rt-2"]
+    return [
+        {
+            "latitude": float(rng.uniform(40, 50)),
+            "longitude": float(rng.uniform(-130, -110)),
+            "user_id": users[int(rng.integers(0, len(users)))],
+            "source": "background" if rng.random() < 0.1 else "gps",
+            "timestamp": int(rng.integers(0, 2**31)),
+        }
+        for _ in range(n)
+    ]
+
+
+CFG = dict(detail_zoom=12, min_detail_zoom=9)
+
+
+@pytest.mark.parametrize("amplify", [False, True])
+def test_partitioned_equals_global(amplify):
+    rows = _rows(1200, seed=1)
+    cfg = BatchJobConfig(amplify_all=amplify, **CFG)
+    global_blobs = run_batch(rows, cfg, as_json=True)
+    # 4 uneven partitions, one empty.
+    parts = [rows[:100], rows[100:700], [], rows[700:]]
+    merged = simulate_partitions(parts, cfg)
+    assert set(merged) == set(global_blobs)
+    for k in global_blobs:
+        assert json.loads(merged[k]) == pytest.approx(
+            json.loads(global_blobs[k])
+        )
+
+
+def test_merge_heatmaps_sums():
+    a = json.dumps({"12_1_2": 2.0, "12_1_3": 1.0})
+    b = json.dumps({"12_1_3": 4.0, "12_9_9": 1.0})
+    assert json.loads(merge_heatmaps(a, b)) == {
+        "12_1_2": 2.0, "12_1_3": 5.0, "12_9_9": 1.0
+    }
+
+
+def test_partition_closure_is_picklable():
+    """Spark ships the closure to executors via pickle."""
+    import pickle
+
+    fn = heatmap_partitions(BatchJobConfig(**CFG))
+    fn2 = pickle.loads(pickle.dumps(fn))
+    rows = _rows(50, seed=3)
+    assert dict(fn2(iter(rows))) == dict(
+        heatmap_partitions(BatchJobConfig(**CFG))(iter(rows))
+    )
+
+
+def test_output_schema_matches_reference():
+    """(id, heatmap-json) with id = user|timespan|coarseTile and the
+    blob a detailTile->count dict (reference heatmap.py:156-157,
+    §3.5 output record shape)."""
+    blobs = simulate_partitions([_rows(200, seed=4)], BatchJobConfig(**CFG))
+    assert blobs
+    for key, val in blobs.items():
+        user, timespan, tile = key.split("|")
+        assert timespan == "alltime"
+        z, r, c = tile.split("_")
+        inner = json.loads(val)
+        assert isinstance(inner, dict) and inner
+        for dk, dv in inner.items():
+            dz, _, _ = dk.split("_")
+            assert int(dz) == int(z) + 5  # result_delta
+            assert dv > 0
