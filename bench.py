@@ -73,6 +73,10 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--baseline-n", type=int, default=1 << 20)
     ap.add_argument("--cpu", action="store_true", help="run on CPU instead of TPU")
+    ap.add_argument("--bin-backend", default="xla",
+                    choices=("xla", "partitioned"),
+                    help="binning path: xla scatter (default) or the "
+                    "sort-partitioned MXU kernel (ops/partitioned.py)")
     args = ap.parse_args()
 
     import jax
@@ -95,7 +99,10 @@ def main():
 
     @jax.jit
     def step(la, lo):
-        raster = bin_points_window(la, lo, window, proj_dtype=jnp.float32)
+        raster = bin_points_window(
+            la, lo, window, proj_dtype=jnp.float32,
+            backend=args.bin_backend,
+        )
         pyr = pyramid_from_raster_capped(raster)
         # Return the top so the whole pyramid materializes.
         return pyr[-1].sum(), raster

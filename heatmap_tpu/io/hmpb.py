@@ -23,9 +23,19 @@ Layout (explicitly little-endian, including on big-endian hosts):
     i32[N]  routed group id
     u8[N]   background flag (reference heatmap.py:28-29)
 
-Sections are contiguous, in the order above (widest first, u8 last),
-and every section start is 8-byte aligned, so external readers can mmap
-and cast column pointers directly.
+Sections are contiguous, in the order above (widest first, u8 last).
+Every column is *naturally* aligned for its element type — the data
+section starts 8-byte aligned, f64/i64 sections keep that, the i32
+section starts at data+24n (8-aligned) and the u8 section at data+28n
+(4-aligned, which u8 doesn't care about) — so external readers can
+mmap and cast each column pointer directly.
+
+Timestamp units: values pass through from the source unchanged
+(the reference's location feed carried epoch-milliseconds, reference
+heatmap.py:26); datetime/date objects are normalized to epoch-ms.
+The column is self-consistent per file, but HMPB does not convert
+between source unit conventions — mixing epoch-second and epoch-ms
+sources and then using dated timespans is on the operator.
 """
 
 from __future__ import annotations
@@ -112,26 +122,28 @@ class HMPBSource:
             self._data_off = f.tell() + (-f.tell()) % 8  # header NUL pad
         self.n = int(header["n"])
         self.names = list(header["names"])
-        self._maps = {}
+        offsets = {}
         off = self._data_off
         for name, dtype in _COLUMNS:
-            itemsize = np.dtype(dtype).itemsize
-            self._maps[name] = (off, dtype)
-            off += self.n * itemsize
+            offsets[name] = (off, dtype)
+            off += self.n * np.dtype(dtype).itemsize
         expected = off
         actual = os.path.getsize(path)
         if actual < expected:
             raise ValueError(
                 f"{path}: truncated ({actual} bytes, need {expected})"
             )
+        # Map the file once; per-batch reads are plain slices of these
+        # column views (no per-batch open/mmap syscalls).
+        self._mm = np.memmap(path, dtype="u1", mode="r")
+        self._cols = {
+            name: self._mm[o : o + self.n * np.dtype(dt).itemsize].view(dt)
+            for name, (o, dt) in offsets.items()
+        }
+        self._maps = offsets  # column offset table (alignment contract)
 
     def _col(self, name, lo, hi):
-        off, dtype = self._maps[name]
-        itemsize = np.dtype(dtype).itemsize
-        return np.memmap(
-            self.path, dtype=dtype, mode="r",
-            offset=off + lo * itemsize, shape=(hi - lo,),
-        )
+        return self._cols[name][lo:hi]
 
     def fast_batches(self, batch_size: int = 1 << 20):
         sent_names = False

@@ -152,14 +152,27 @@ def bin_rowcol_window(row, col, window: Window, weights=None, valid=None,
     partitioning. Returns an (H, W) raster.
 
     ``backend``: "xla" (scatter-add), "pallas" (MXU one-hot matmul
-    kernel, TPU only), or "auto" (pallas on TPU for windows up to
-    PALLAS_AUTO_MAX_CELLS cells). The pallas path accumulates in f32 —
-    exact for < 2^24 counts per cell per call — and is cast to the
-    requested ``dtype``.
+    kernel, TPU only), "partitioned" (sort + per-block MXU kernel for
+    LARGE windows, count-only; ops/partitioned.py), or "auto" (pallas
+    on TPU for windows up to PALLAS_AUTO_MAX_CELLS cells). The pallas
+    paths accumulate in f32 — exact for < 2^24 counts per cell per
+    call — and are cast to the requested ``dtype``.
     """
     if dtype is None:
         dtype = jnp.int32 if weights is None else jnp.float32
-    if _pick_backend(backend, window) == "pallas":
+    picked = _pick_backend(backend, window)
+    if picked == "partitioned":
+        if weights is not None:
+            raise ValueError(
+                "backend='partitioned' is count-only; use xla/pallas "
+                "for weighted binning"
+            )
+        from heatmap_tpu.ops.partitioned import bin_rowcol_window_partitioned
+
+        return bin_rowcol_window_partitioned(
+            row, col, window, valid=valid, dtype=dtype
+        )
+    if picked == "pallas":
         from heatmap_tpu.ops.pallas_kernels import bin_rowcol_window_pallas
 
         raster = bin_rowcol_window_pallas(

@@ -1,0 +1,235 @@
+"""Sort-partitioned MXU binning for LARGE windows.
+
+The whole-window Pallas histogram (pallas_kernels.py) pays H*W MACs per
+point, so it only wins for blob-sized windows; the XLA scatter path
+pays a flat ~10-30 ns *per update* on v5e (PERF_NOTES.md), which is the
+headline-bench bottleneck for big rasters (a z15 metro window is ~1.3M
+cells). This module restores MXU locality for big windows:
+
+1. project to linear cell indices and **sort** (XLA's comparison sort
+   is the one fast reshuffling primitive on this chip);
+2. cut the sorted stream into fixed chunks; a chunk whose cells all
+   land in one aligned ``block_cells`` region is **good** — after
+   sorting, that's the common case for clustered GPS data;
+3. stable-reorder whole chunks (a contiguous row gather, not a
+   per-element one) so good chunks come first, bad chunks last;
+4. a Pallas kernel walks the good chunks with a scalar-prefetched
+   output-block index per chunk (bases are monotone by construction,
+   so each output block's visits are consecutive): each chunk becomes
+   a 256-ish x 256-ish one-hot matmul into its block — the same
+   MXU formulation as the small-window kernel, but against a 2^16-cell
+   block instead of the whole raster;
+5. the bad-chunk tail (sparse fringes, block-straddlers, padding) goes
+   through the ordinary scatter, but over a bounded suffix (1/8 of the
+   points by default) instead of the full stream;
+6. if an adversarial distribution makes more than that fraction of
+   chunks bad, ``lax.cond`` falls back to the plain full scatter —
+   correctness never depends on the data being friendly.
+
+Counts accumulate in f32 inside the kernel (exact < 2^24 per cell per
+call) and int32 on the scatter tail; the merged raster is returned in
+the requested dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from heatmap_tpu.ops.histogram import Window
+
+DEFAULT_CHUNK = 1024
+#: Cells per aligned output block: 2^16 = a 256x256 one-hot factor pair,
+#: the measured flat-rate regime of the MXU histogram kernel.
+BLOCK_CELLS = 1 << 16
+_BLK_SIDE = 1 << 8  # sqrt(BLOCK_CELLS): rows/cols of the local factor
+
+
+def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
+                      zeros_ref, out_ref, acc_ref, *, chunk):
+    del zeros_ref  # present only to alias-init the output to zero
+    i = pl.program_id(0)
+
+    @pl.when(first_ref[i] == 1)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    local = s_ref[0, :] - base_ref[i] * BLOCK_CELLS
+    ok = (good_ref[i] == 1) & (local >= 0) & (local < BLOCK_CELLS)
+    rloc = jnp.where(ok, local // _BLK_SIDE, -1)
+    cloc = jnp.where(ok, local % _BLK_SIDE, 0)
+
+    r_ids = lax.broadcasted_iota(jnp.int32, (_BLK_SIDE, chunk), 0)
+    row_onehot = (r_ids == rloc[None, :]).astype(jnp.bfloat16)
+    c_ids = lax.broadcasted_iota(jnp.int32, (chunk, _BLK_SIDE), 1)
+    col_onehot = (c_ids == cloc[:, None]).astype(jnp.bfloat16)
+    acc_ref[0] += jnp.dot(
+        row_onehot, col_onehot, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(last_ref[i] == 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
+                      bad_cap_chunks, interpret):
+    """Good chunks -> pallas blocks; bad tail -> bounded scatter.
+
+    ``good`` is the per-chunk mask computed by the caller — the SAME
+    mask that sized the bounded tail via n_bad, so the tail provably
+    covers every chunk this path masks out.
+    """
+    fblk = s[::chunk] // BLOCK_CELLS
+
+    # Stable reorder keeps sorted order within each class, so good-chunk
+    # block bases stay monotone non-decreasing.
+    order = jnp.argsort(~good, stable=True)
+    s2 = jnp.take(s.reshape(n_chunks, chunk), order, axis=0)
+    good2 = good[order]
+    fblk2 = fblk[order]
+
+    # Forward-fill bad/disabled chunks with the last good base (cummax
+    # works because good bases are non-decreasing); leading bads clamp
+    # to block 0, fully masked.
+    base = jnp.maximum(lax.cummax(jnp.where(good2, fblk2, -1)), 0)
+    gi = good2.astype(jnp.int32)
+    first_visit = jnp.concatenate(
+        [jnp.ones(1, jnp.int32),
+         (base[1:] != base[:-1]).astype(jnp.int32)]
+    )
+    last_visit = jnp.concatenate(
+        [(base[1:] != base[:-1]).astype(jnp.int32),
+         jnp.ones(1, jnp.int32)]
+    )
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(n_chunks,),
+        in_specs=[
+            pl.BlockSpec((1, chunk), lambda i, *_: (i, 0)),
+            pl.BlockSpec(
+                (1, _BLK_SIDE, _BLK_SIDE),
+                lambda i, base, *_: (base[i], 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, _BLK_SIDE, _BLK_SIDE), lambda i, base, *_: (base[i], 0, 0)
+        ),
+        scratch_shapes=[pltpu.VMEM((1, _BLK_SIDE, _BLK_SIDE), jnp.float32)],
+    )
+    zeros = jnp.zeros((n_blocks, _BLK_SIDE, _BLK_SIDE), jnp.float32)
+    blocks = pl.pallas_call(
+        functools.partial(_partition_kernel, chunk=chunk),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_blocks, _BLK_SIDE, _BLK_SIDE), jnp.float32
+        ),
+        input_output_aliases={5: 0},  # zeros operand -> output
+        interpret=interpret,
+    )(base, gi, first_visit, last_visit, s2, zeros)
+    dense = blocks.reshape(n_blocks * BLOCK_CELLS)[:hw]
+
+    # Bounded scatter over the bad tail; already-counted good chunks in
+    # the suffix get weight 0, sentinel/out-of-range cells drop.
+    suffix = s2[-bad_cap_chunks:].reshape(-1)
+    w = jnp.repeat(
+        (~good2[-bad_cap_chunks:]).astype(jnp.int32), chunk
+    )
+    tail = (
+        jnp.zeros(hw, jnp.int32).at[suffix].add(w, mode="drop")
+    )
+    return dense.astype(jnp.int32) + tail
+
+
+def bin_rowcol_window_partitioned(
+    row,
+    col,
+    window: Window,
+    valid=None,
+    chunk: int = DEFAULT_CHUNK,
+    bad_frac: int = 8,
+    interpret: bool | None = None,
+    dtype=jnp.int32,
+):
+    """Count-only binning of pre-projected points into a large window.
+
+    Contract matches ops.histogram.bin_rowcol_window(weights=None):
+    out-of-window / invalid points drop. ``bad_frac``: the scatter tail
+    is sized n/bad_frac points; distributions badder than that fall
+    back to the full scatter inside the same jit (lax.cond).
+    ``interpret`` defaults to True on CPU (pallas has no compiled CPU
+    lowering), False on accelerators.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    return _bin_partitioned_jit(
+        row, col, window, valid, chunk=chunk, bad_frac=bad_frac,
+        interpret=interpret, dtype=dtype,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("window", "chunk", "bad_frac", "interpret", "dtype"),
+)
+def _bin_partitioned_jit(
+    row,
+    col,
+    window: Window,
+    valid=None,
+    chunk: int = DEFAULT_CHUNK,
+    bad_frac: int = 8,
+    interpret: bool = False,
+    dtype=jnp.int32,
+):
+    h, w = window.height, window.width
+    hw = h * w
+    if hw >= (1 << 31):
+        raise ValueError(f"window too large for int32 cell ids: {window}")
+    n_blocks = -(-hw // BLOCK_CELLS)
+    sentinel = n_blocks * BLOCK_CELLS  # beyond every block, drops everywhere
+
+    r = jnp.asarray(row, jnp.int32) - window.row0
+    c = jnp.asarray(col, jnp.int32) - window.col0
+    ok = (r >= 0) & (r < h) & (c >= 0) & (c < w)
+    if valid is not None:
+        ok = ok & valid
+    idx = jnp.where(ok, r * w + c, sentinel)
+
+    n = idx.shape[0]
+    n_pad = -(-max(n, 1) // chunk) * chunk
+    if n_pad != n:
+        idx = jnp.concatenate(
+            [idx, jnp.full(n_pad - n, sentinel, jnp.int32)]
+        )
+    n_chunks = n_pad // chunk
+    bad_cap_chunks = max(1, n_chunks // bad_frac)
+
+    s = jnp.sort(idx)
+    # The single source of truth for chunk goodness: fully inside one
+    # aligned block AND free of sentinels. The bounded tail in
+    # _partitioned_path covers exactly the chunks this marks bad, and
+    # the cond below guarantees they fit.
+    first = s[::chunk]
+    last = s[chunk - 1 :: chunk]
+    good = (first // BLOCK_CELLS == last // BLOCK_CELLS) & (last < sentinel)
+    n_bad = (~good).sum()
+
+    raster = lax.cond(
+        n_bad <= bad_cap_chunks,
+        lambda s_, good_: _partitioned_path(
+            s_, good_, n_chunks, n_blocks, hw, chunk, bad_cap_chunks,
+            interpret,
+        ),
+        lambda s_, good_: jnp.zeros(hw, jnp.int32).at[s_].add(1, mode="drop"),
+        s,
+        good,
+    )
+    return raster.reshape(h, w).astype(dtype)

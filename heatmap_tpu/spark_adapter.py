@@ -69,29 +69,26 @@ def merge_heatmaps(a: str, b: str) -> str:
 def run_with_spark(rdd, config=None, output_table=None):
     """Driver-side orchestration over a live RDD (needs pyspark).
 
-    Returns the blob dict; with ``output_table`` also writes a
-    DataFrame ``(id, heatmap)`` in the reference's Cassandra append
-    shape (reference heatmap.py:149-150,157) via the session bound to
-    the RDD.
+    With ``output_table`` the reduced pairs are written straight from
+    the executors as a DataFrame ``(id, heatmap)`` in the reference's
+    Cassandra append shape (reference heatmap.py:149-150,157) — the
+    result set never funnels through the driver — and None is
+    returned. Without it, the blobs are collected and returned as a
+    dict (small-result / interactive use).
     """
-    pairs = (
-        rdd.mapPartitions(heatmap_partitions(config))
-        .reduceByKey(merge_heatmaps)
-        .collect()
+    pairs = rdd.mapPartitions(heatmap_partitions(config)).reduceByKey(
+        merge_heatmaps
     )
-    blobs = dict(pairs)
     if output_table is not None:
-        from pyspark.sql import SparkSession
-
-        spark = SparkSession.builder.getOrCreate()
-        df = spark.createDataFrame(list(blobs.items()), ["id", "heatmap"])
+        df = pairs.toDF(["id", "heatmap"])
         (
             df.write.format("org.apache.spark.sql.cassandra")
             .mode("append")
             .options(**output_table)
             .save()
         )
-    return blobs
+        return None
+    return dict(pairs.collect())
 
 
 def simulate_partitions(partitions, config=None):

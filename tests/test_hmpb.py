@@ -153,19 +153,18 @@ def test_cli_convert_then_fast_run(tmp_path):
 
 
 def test_alignment_and_endianness(tmp_path):
-    """Every column section starts 8-byte aligned and data is
-    little-endian regardless of host order (external-reader contract)."""
+    """Every column starts naturally aligned for its element type and
+    data is little-endian regardless of host order (the external-reader
+    contract in the module docstring). Odd n exercises the worst case."""
     p = str(tmp_path / "a.hmpb")
-    write_hmpb(p, np.asarray([1.5]), np.asarray([2.5]),
-               np.asarray([0], np.int32), ["zz"], timestamp=[7])
+    write_hmpb(p, np.asarray([1.5, 2.0, 3.0]), np.asarray([2.5, 1.0, 0.5]),
+               np.asarray([0, 0, 0], np.int32), ["zz"], timestamp=[7, 8, 9])
     src = HMPBSource(p)
-    for name in ("latitude", "longitude", "timestamp", "routed",
-                 "background"):
-        off, _ = src._maps[name]
-        assert off % 8 == 0 or name in ("routed", "background")
-        assert src._maps["latitude"][0] % 8 == 0
+    for name, (off, dtype) in src._maps.items():
+        assert off % np.dtype(dtype).itemsize == 0, (name, off)
     raw = open(p, "rb").read()
     off = src._maps["latitude"][0]
+    assert off % 8 == 0
     assert raw[off:off + 8] == np.float64(1.5).astype("<f8").tobytes()
 
 
