@@ -230,6 +230,79 @@ def cmd_tiles(args) -> int:
     return 0
 
 
+def cmd_stream(args) -> int:
+    if args.half_life <= 0:
+        raise SystemExit(f"--half-life {args.half_life}: must be positive")
+    if args.zoom < args.pixel_delta:
+        raise SystemExit(
+            f"--zoom {args.zoom} must be >= --pixel-delta {args.pixel_delta} "
+            "(tile zoom = zoom - pixel_delta)"
+        )
+    if args.checkpoint_dir and args.checkpoint_every < 1:
+        raise SystemExit(
+            f"--checkpoint-every {args.checkpoint_every}: must be >= 1"
+        )
+    _init_backend(args)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from heatmap_tpu.io import PNGTileSink, open_source
+    from heatmap_tpu.ops import window_from_bounds
+    from heatmap_tpu.pipeline import load_columns
+    from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+    from heatmap_tpu.utils import CheckpointManager
+
+    window = window_from_bounds(
+        (args.lat_min, args.lat_max),
+        (args.lon_min, args.lon_max),
+        zoom=args.zoom,
+        align_levels=min(args.pixel_delta, args.zoom),
+        pad_multiple=1 << args.pixel_delta,
+    )
+    proj_dtype = jnp.float32 if args.no_x64 else jnp.float64
+    config = StreamConfig(
+        window=window,
+        half_life_s=args.half_life,
+        proj_dtype=proj_dtype,
+        pad_to=args.batch_points,
+    )
+    stream = HeatmapStream(config)
+    mgr = None
+    if args.checkpoint_dir:
+        mgr = CheckpointManager(args.checkpoint_dir)
+        if mgr.latest_step() is not None:
+            stream.restore(mgr)
+    t0 = time.perf_counter()
+    resumed = stream.n_batches
+    t_stream = stream.t or 0.0
+    i = 0
+    for batch in open_source(args.input).batches(args.batch_points):
+        i += 1
+        if i <= resumed:
+            continue  # deterministic source replay up to the checkpoint
+        cols = load_columns(batch)
+        t_stream += args.interval
+        stream.update(cols["latitude"], cols["longitude"], t_stream)
+        if mgr is not None and stream.n_batches % args.checkpoint_every == 0:
+            stream.checkpoint(mgr)
+    if mgr is not None:
+        stream.checkpoint(mgr)
+    snap = stream.snapshot()  # one device->host copy, reused below
+    n_tiles = 0
+    if args.output:
+        sink = PNGTileSink(args.output, pixel_delta=args.pixel_delta)
+        n_tiles = sink.write_window(snap, window)
+    print(json.dumps({
+        "batches": stream.n_batches,
+        "stream_seconds": stream.t,
+        "live_mass": float(np.sum(snap)),
+        "tiles": n_tiles,
+        "seconds": round(time.perf_counter() - t0, 3),
+        "output": args.output,
+    }))
+    return 0
+
+
 def cmd_convert(args) -> int:
     from heatmap_tpu.io.hmpb import convert_to_hmpb
 
@@ -241,6 +314,8 @@ def cmd_convert(args) -> int:
 
 def cmd_info(args) -> int:
     jax = _init_backend(args)
+    from heatmap_tpu import native
+
     devs = jax.devices()
     print(
         json.dumps(
@@ -248,7 +323,9 @@ def cmd_info(args) -> int:
                 "backend": args.backend,
                 "platform": devs[0].platform,
                 "n_devices": len(devs),
+                "n_processes": jax.process_count(),
                 "x64": bool(jax.config.jax_enable_x64),
+                "native": native.available(),
                 "version": __import__("heatmap_tpu").__version__,
             }
         )
@@ -288,6 +365,33 @@ def build_parser() -> argparse.ArgumentParser:
     p_tiles.add_argument("--sigma", type=float, default=None,
                          help="Gaussian sigma in cells (default K/4)")
     p_tiles.set_defaults(fn=cmd_tiles)
+
+    p_stream = sub.add_parser(
+        "stream",
+        help="micro-batch streaming: decayed live raster -> PNG tiles "
+        "(BASELINE.md config 4)",
+    )
+    _add_backend_flags(p_stream)
+    p_stream.add_argument("--input", required=True,
+                          help="source spec, consumed as micro-batches")
+    p_stream.add_argument("--output", default="live_tiles",
+                          help="PNG tile tree dir for the final snapshot "
+                          "('' = none)")
+    p_stream.add_argument("--batch-points", type=int, default=1 << 16,
+                          help="points per micro-batch (one compiled step)")
+    p_stream.add_argument("--interval", type=float, default=60.0,
+                          help="stream seconds advanced per micro-batch")
+    p_stream.add_argument("--half-life", type=float, default=3600.0,
+                          help="decay half-life in stream seconds")
+    p_stream.add_argument("--zoom", type=int, default=12)
+    p_stream.add_argument("--pixel-delta", type=int, default=8)
+    p_stream.add_argument("--lat-min", type=float, default=45.0)
+    p_stream.add_argument("--lat-max", type=float, default=50.0)
+    p_stream.add_argument("--lon-min", type=float, default=-125.0)
+    p_stream.add_argument("--lon-max", type=float, default=-119.0)
+    p_stream.add_argument("--checkpoint-dir", default=None)
+    p_stream.add_argument("--checkpoint-every", type=int, default=16)
+    p_stream.set_defaults(fn=cmd_stream)
 
     p_conv = sub.add_parser(
         "convert",

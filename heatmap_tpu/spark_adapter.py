@@ -80,7 +80,13 @@ def run_with_spark(rdd, config=None, output_table=None):
         merge_heatmaps
     )
     if output_table is not None:
-        df = pairs.toDF(["id", "heatmap"])
+        # createDataFrame over the pairs RDD is a distributed write
+        # plan (no driver collect); getOrCreate also covers legacy
+        # SparkContext-only jobs where RDD.toDF is not yet patched in.
+        from pyspark.sql import SparkSession
+
+        spark = SparkSession.builder.getOrCreate()
+        df = spark.createDataFrame(pairs, ["id", "heatmap"])
         (
             df.write.format("org.apache.spark.sql.cassandra")
             .mode("append")

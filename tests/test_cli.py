@@ -153,6 +153,36 @@ class TestEndToEnd:
         assert r.returncode != 0
         assert "mutually" in r.stderr
 
+    def test_stream_synthetic_decay_and_resume(self, tmp_path):
+        out = tmp_path / "live"
+        ck = tmp_path / "ck"
+        common = [
+            "stream", "--backend", "cpu",
+            "--input", "synthetic:20000:4",
+            "--output", str(out),
+            "--batch-points", "2048",
+            "--interval", "600", "--half-life", "1200",
+            "--zoom", "10", "--pixel-delta", "6",
+            "--lat-min", "46", "--lat-max", "49",
+            "--lon-min", "-124", "--lon-max", "-120",
+            "--checkpoint-dir", str(ck), "--checkpoint-every", "3",
+        ]
+        r = _run_cli(*common)
+        assert r.returncode == 0, r.stderr
+        stats = json.loads(r.stdout.strip().splitlines()[-1])
+        assert stats["batches"] >= 9
+        assert stats["tiles"] > 0
+        # Decay: live mass is well under the raw point count.
+        assert 0 < stats["live_mass"] < 20000
+        assert any(f.startswith("ckpt-") for f in os.listdir(ck))
+        # Rerun: resumes from the final checkpoint, consumes nothing new,
+        # and reproduces the same live mass.
+        r2 = _run_cli(*common)
+        assert r2.returncode == 0, r2.stderr
+        stats2 = json.loads(r2.stdout.strip().splitlines()[-1])
+        assert stats2["batches"] == stats["batches"]
+        assert stats2["live_mass"] == pytest.approx(stats["live_mass"])
+
     def test_tiles_synthetic_to_png_tree(self, tmp_path):
         out = tmp_path / "tiles"
         r = _run_cli(

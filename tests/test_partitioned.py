@@ -87,8 +87,20 @@ def test_block_boundary_straddle():
 
 def test_backend_plumbing_and_weighted_rejection():
     rng = np.random.default_rng(5)
-    row = jnp.asarray(rng.integers(515, 530, 1000), jnp.int32)
-    col = jnp.asarray(rng.integers(300, 330, 1000), jnp.int32)
+    row = jnp.asarray(rng.integers(500, 700, 1000), jnp.int32)
+    col = jnp.asarray(rng.integers(280, 360, 1000), jnp.int32)
+    valid = jnp.asarray(rng.random(1000) < 0.7)
+    # Positive dispatch: backend="partitioned" through the public
+    # entry forwards valid= and dtype= and matches the scatter path.
+    via_backend = bin_rowcol_window(
+        row, col, WINDOW, valid=valid, backend="partitioned",
+        dtype=jnp.float32,
+    )
+    expected = bin_rowcol_window(row, col, WINDOW, valid=valid,
+                                 dtype=jnp.float32)
+    assert via_backend.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(via_backend),
+                                  np.asarray(expected))
     with pytest.raises(ValueError):
         bin_rowcol_window(
             row, col, WINDOW, weights=jnp.ones(1000),
