@@ -50,14 +50,10 @@ _BLK_SIDE = 1 << 8  # sqrt(BLOCK_CELLS): rows/cols of the local factor
 
 
 def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
-                      *rest, chunk, weighted):
-    # rest = (w_ref?, zeros_ref, out_ref, acc_ref); zeros_ref only
-    # alias-inits the output.
-    if weighted:
-        w_ref, _, out_ref, acc_ref = rest
-    else:
-        _, out_ref, acc_ref = rest
-        w_ref = None
+                      zeros_ref, out_ref, acc_ref, *, chunk):
+    # This backend is count-only (histogram.py routes weighted binning
+    # to xla/pallas); zeros_ref only alias-inits the output.
+    del zeros_ref
     i = pl.program_id(0)
 
     @pl.when(first_ref[i] == 1)
@@ -71,23 +67,11 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
 
     r_ids = lax.broadcasted_iota(jnp.int32, (_BLK_SIDE, chunk), 0)
     c_ids = lax.broadcasted_iota(jnp.int32, (chunk, _BLK_SIDE), 1)
-    if weighted:
-        # Arbitrary weights don't survive bf16 one-hot products; full
-        # f32 with HIGHEST precision, like the small-window kernel.
-        row_onehot = (r_ids == rloc[None, :]).astype(jnp.float32)
-        col_onehot = (c_ids == cloc[:, None]).astype(jnp.float32)
-        col_onehot = col_onehot * jnp.where(ok, w_ref[0, :], 0.0)[:, None]
-        acc_ref[0] += jnp.dot(
-            row_onehot, col_onehot,
-            preferred_element_type=jnp.float32,
-            precision=lax.Precision.HIGHEST,
-        )
-    else:
-        row_onehot = (r_ids == rloc[None, :]).astype(jnp.bfloat16)
-        col_onehot = (c_ids == cloc[:, None]).astype(jnp.bfloat16)
-        acc_ref[0] += jnp.dot(
-            row_onehot, col_onehot, preferred_element_type=jnp.float32
-        )
+    row_onehot = (r_ids == rloc[None, :]).astype(jnp.bfloat16)
+    col_onehot = (c_ids == cloc[:, None]).astype(jnp.bfloat16)
+    acc_ref[0] += jnp.dot(
+        row_onehot, col_onehot, preferred_element_type=jnp.float32
+    )
 
     @pl.when(last_ref[i] == 1)
     def _():

@@ -46,8 +46,28 @@ def initialize(coordinator_address: str | None = None,
     pass them explicitly (the JAX_COORDINATOR_ADDRESS /
     JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars also work).
     """
-    if jax.process_count() > 1:
-        return  # already distributed
+    import os
+
+    # Detect a prior distributed init WITHOUT touching the backend:
+    # jax.process_count() would initialize the local backend, after
+    # which distributed.initialize() always raises and the job would
+    # silently run single-process.
+    try:
+        from jax._src import distributed as _dist
+
+        if getattr(_dist.global_state, "client", None) is not None:
+            return  # already distributed
+    except (ImportError, AttributeError):
+        # private API moved/renamed; fall through to initialize
+        pass
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+        or any(os.environ.get(v) for v in (
+            "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+        ))
+    )
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -55,8 +75,11 @@ def initialize(coordinator_address: str | None = None,
             process_id=process_id,
         )
     except (ValueError, RuntimeError):
-        # Single-process environment (no coordinator discoverable) or
-        # already initialized — both fine.
+        if explicit:
+            # The caller configured a cluster; failing to join it is an
+            # error, not a single-process fallback.
+            raise
+        # Single-process environment (no coordinator discoverable) — fine.
         pass
 
 

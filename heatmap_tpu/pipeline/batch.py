@@ -315,9 +315,17 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
         lats, lons = [arrays["latitude"]], [arrays["longitude"]]
         gids = [arrays["group_ids"]]
         if "timestamps_ms" in arrays:
-            stamps = [list(arrays["timestamps_ms"])]
+            from heatmap_tpu.io.hmpb import TS_MISSING
+
+            stamps = [[None if t == TS_MISSING else int(t)
+                       for t in arrays["timestamps_ms"]]]
         elif "timestamps_str" in arrays:
-            stamps = [list(arrays["timestamps_str"])]
+            if "timestamps_valid" in arrays:
+                stamps = [[s if v else None
+                           for s, v in zip(arrays["timestamps_str"],
+                                           arrays["timestamps_valid"])]]
+            else:
+                stamps = [list(arrays["timestamps_str"])]
         else:
             stamps = [[None] * len(arrays["latitude"])]
         for name in meta["group_names"][1:]:  # [0] is always 'all'
@@ -332,9 +340,18 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
             "group_ids": np.concatenate(gids) if gids else np.empty(0, np.int32),
         }
         flat_stamps = [s for chunk in stamps for s in chunk]
-        if flat_stamps and all(s is not None for s in flat_stamps):
+        if flat_stamps and any(s is not None for s in flat_stamps):
+            # Mixed None/real streams must round-trip: None persists as
+            # the TS_MISSING int64 sentinel (or a validity mask on the
+            # string path), never by dropping the whole column — a
+            # resumed run has to bucket dated timespans exactly like an
+            # uninterrupted one.
+            from heatmap_tpu.io.hmpb import TS_MISSING
+
+            valid = np.asarray([s is not None for s in flat_stamps], bool)
+            present = [s for s in flat_stamps if s is not None]
             try:
-                arrays["timestamps_ms"] = np.asarray(flat_stamps, np.int64)
+                ms_present = np.asarray(present, np.int64)
             except (ValueError, TypeError):
                 # datetime/date objects: epoch-ms round-trips through
                 # timespan._to_date (UTC). Anything else keeps its
@@ -355,13 +372,20 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
                         ).timestamp() * 1000)
                     return None
 
-                ms = [to_ms(s) for s in flat_stamps]
-                if all(m is not None for m in ms):
-                    arrays["timestamps_ms"] = np.asarray(ms, np.int64)
-                else:
-                    arrays["timestamps_str"] = np.asarray(
-                        [str(s) for s in flat_stamps]
-                    )
+                ms = [to_ms(s) for s in present]
+                ms_present = (
+                    np.asarray(ms, np.int64)
+                    if all(m is not None for m in ms) else None
+                )
+            if ms_present is not None:
+                full = np.full(len(flat_stamps), TS_MISSING, np.int64)
+                full[valid] = ms_present
+                arrays["timestamps_ms"] = full
+            else:
+                arrays["timestamps_str"] = np.asarray(
+                    ["" if s is None else str(s) for s in flat_stamps]
+                )
+                arrays["timestamps_valid"] = valid
         mgr.save(step, arrays, {
             "group_names": list(vocab.names),
             "batches_done": step,

@@ -265,7 +265,14 @@ struct CsvReader {
       worker_finish("worker open failed");
       return;
     }
-    if (begin > 0 && std::fseek(f, static_cast<long>(begin), SEEK_SET) != 0) {
+    // 64-bit seek regardless of the width of long: fseek(long) would
+    // truncate offsets past 2 GiB on LLP64 platforms and misplace the
+    // worker's shard.
+#if defined(_WIN32)
+    if (begin > 0 && _fseeki64(f, begin, SEEK_SET) != 0) {
+#else
+    if (begin > 0 && fseeko(f, static_cast<off_t>(begin), SEEK_SET) != 0) {
+#endif
       std::fclose(f);
       worker_finish("worker seek failed");
       return;

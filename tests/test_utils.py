@@ -221,6 +221,52 @@ def test_run_job_resumable_datetime_timestamps_roundtrip(tmp_path):
     assert any("|2020-03|" in k for k in clean)
 
 
+def test_run_job_resumable_mixed_none_timestamps_roundtrip(tmp_path):
+    """A mixed None/real timestamp stream must checkpoint the real ones
+    (as TS_MISSING-sentinel int64), not drop the whole column — resumed
+    runs bucket dated timespans exactly like uninterrupted ones."""
+    import datetime as dt
+
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_resumable
+
+    class MixedSource:
+        def batches(self, batch_size):
+            base = dt.datetime(2021, 6, 1, tzinfo=dt.timezone.utc)
+            for k in range(4):
+                n = 50
+                stamps = [
+                    (base + dt.timedelta(days=40 * k)) if i % 2 == 0 else None
+                    for i in range(n)
+                ]
+                yield {
+                    "latitude": np.full(n, 40.0 + k),
+                    "longitude": np.full(n, -100.0),
+                    "user_id": ["u1"] * n,
+                    "source": ["gps"] * n,
+                    "timestamp": stamps,
+                }
+
+    from heatmap_tpu.io.hmpb import TS_MISSING
+    from heatmap_tpu.pipeline import run_job
+
+    # Dated timespans reject None rows loudly (timespan._to_date), so
+    # run alltime; what matters is the checkpoint neither drops the
+    # real stamps nor invents fake ones for the None rows.
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8)
+    ckdir = str(tmp_path / "ck")
+    inj = FaultInjector({3: 1})
+    with pytest.raises(RuntimeError):
+        run_job_resumable(MixedSource(), ckdir, config=cfg,
+                          checkpoint_every=1, fault_injector=inj)
+    arrays, _meta = CheckpointManager(ckdir).load()
+    ts = arrays["timestamps_ms"]
+    assert (ts == TS_MISSING).sum() == len(ts) // 2
+    assert (ts != TS_MISSING).sum() == len(ts) // 2
+    resumed = run_job_resumable(MixedSource(), ckdir, config=cfg,
+                                checkpoint_every=1)
+    assert resumed == run_job(MixedSource(), config=cfg)
+
+
 def test_streaming_checkpoint_restore(tmp_path):
     import jax.numpy as jnp
 
