@@ -69,6 +69,11 @@ def _add_run_flags(p):
                    help="comma list of alltime,year,month,day (reference "
                    "supports these but ships alltime-only, heatmap.py:62)")
     p.add_argument("--batch-size", type=int, default=1 << 20)
+    p.add_argument("--max-points-in-flight", type=int, default=None,
+                   metavar="N",
+                   help="bound peak memory: run the cascade per chunk of "
+                   "at most N points and merge per-level aggregates "
+                   "(exact; for sources larger than host RAM)")
     p.add_argument("--capacity", type=int, default=None,
                    help="unique-key capacity for the device cascade "
                    "(default: #emissions)")
@@ -124,6 +129,9 @@ def cmd_run(args) -> int:
     if args.fast and args.checkpoint_dir:
         raise SystemExit("--fast and --checkpoint-dir are mutually "
                          "exclusive (the fast path has no resume yet)")
+    if args.max_points_in_flight is not None and (args.fast or args.checkpoint_dir):
+        raise SystemExit("--max-points-in-flight applies to the standard "
+                         "run path only (not --fast / --checkpoint-dir)")
     fast_source = None
     if args.fast:
         # Resolve through open_source so bare paths and prefixed specs
@@ -155,7 +163,8 @@ def cmd_run(args) -> int:
                 )
             else:
                 blobs = run_job(open_source(args.input), sink, config,
-                                batch_size=args.batch_size)
+                                batch_size=args.batch_size,
+                                max_points_in_flight=args.max_points_in_flight)
     dt = time.perf_counter() - t0
     if args.profile:
         print(get_tracer().format_report(), file=sys.stderr)

@@ -82,16 +82,18 @@ def project_detail_codes(lat: np.ndarray, lon: np.ndarray, detail_zoom: int):
     return morton.morton_encode_np(row, col), valid
 
 
-def build_emissions(codes, valid, group_ids, timestamps, config: BatchJobConfig):
+def build_emissions(codes, valid, group_ids, timestamps,
+                    config: BatchJobConfig, ts_vocab: TimespanVocab | None = None):
     """Expand points into (code, slot) emissions + slot name table.
 
     Mirrors the reference mapper's group expansion (heatmap.py:64-75):
     each point emits once for 'all' and once for its routed group (if
     not excluded), for each requested timespan. With
     ``first_timespan_only`` (reference early-return quirk, SURVEY.md
-    §8.2) only the first timespan emits.
+    §8.2) only the first timespan emits. Pass a shared ``ts_vocab`` to
+    keep timespan ids consistent across chunked calls.
     """
-    ts_vocab = TimespanVocab()
+    ts_vocab = ts_vocab if ts_vocab is not None else TimespanVocab()
     timespans = (
         config.timespans[:1] if config.first_timespan_only else config.timespans
     )
@@ -147,7 +149,8 @@ def load_columns(batch):
 
 
 def run_job(source, sink=None, config: BatchJobConfig | None = None,
-            batch_size: int = 1 << 20):
+            batch_size: int = 1 << 20,
+            max_points_in_flight: int | None = None):
     """Source-to-sink job over columnar batches (the production entry;
     reference batchMain shape with get_rows/write_heatmap_dataframes
     replaced by heatmap_tpu.io sources/sinks, heatmap.py:152-158).
@@ -155,10 +158,22 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     Accumulates host columns across source batches, runs the cascade
     once on device, writes blobs to ``sink`` (upsert-by-id). Returns
     the blob dict; if ``sink`` is given also writes into it.
+
+    ``max_points_in_flight`` bounds peak memory for sources larger than
+    host RAM (BASELINE.md config 5 shape): the cascade runs per chunk of
+    at most that many points and per-level aggregates merge on the host
+    — exact, because every level is a linear (key, sum) reduction, the
+    same property the Spark adapter's partition merge relies on
+    (spark_adapter.merge_heatmaps). Peak footprint is then
+    O(chunk + unique aggregate keys) instead of O(total points).
     """
     from heatmap_tpu.utils.trace import get_tracer
 
     config = config or BatchJobConfig()
+    if max_points_in_flight is not None:
+        return _run_job_bounded(
+            source, sink, config, batch_size, max_points_in_flight
+        )
     tracer = get_tracer()
     lats, lons, users, stamps = [], [], [], []
     for batch in source.batches(batch_size):
@@ -182,6 +197,179 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     if sink is not None:
         with tracer.span("egress"):
             sink.write(blobs.items())
+    return blobs
+
+
+def _run_job_bounded(source, sink, config: BatchJobConfig,
+                     batch_size: int, max_points: int):
+    """Chunked cascade with host-side per-level aggregate merge.
+
+    Spark streams partitions through executors (reference
+    heatmap.py:111-117); the analog here: chunks of at most
+    ``max_points`` points run the full device cascade, and the decoded
+    per-level (timespan, group, code) -> sum aggregates fold into one
+    running table per level. UserVocab / TimespanVocab are shared
+    across chunks so ids stay consistent; slot packing is re-derived
+    from the FINAL vocab sizes at egress (per-chunk packing uses the
+    chunk-local group count, which decode inverts exactly).
+    """
+    from heatmap_tpu.utils.trace import get_tracer
+
+    if max_points < 1:
+        raise ValueError(f"max_points_in_flight must be >= 1, got {max_points}")
+    tracer = get_tracer()
+    vocab = UserVocab()
+    ts_vocab = TimespanVocab()
+    ccfg = config.cascade_config()
+    n_levels = ccfg.n_levels + 1
+    empty = {
+        "ts": np.empty(0, np.int64), "g": np.empty(0, np.int64),
+        "code": np.empty(0, np.int64), "value": np.empty(0, np.float64),
+    }
+    merged = [dict(empty) for _ in range(n_levels)]
+    lats, lons, gids, stamps = [], [], [], []
+    pending = 0
+
+    def flush():
+        nonlocal pending
+        if pending == 0:
+            return
+        lat = np.concatenate(lats)
+        lon = np.concatenate(lons)
+        group_ids = np.concatenate(gids).astype(np.int32)
+        flat_stamps = [s for chunk in stamps for s in chunk]
+        lats.clear(); lons.clear(); gids.clear(); stamps.clear()
+        pending = 0
+        with tracer.span("cascade.chunk", items=len(lat)):
+            codes, valid = project_detail_codes(lat, lon, config.detail_zoom)
+            e_codes, e_slots, e_valid, _, n_groups = build_emissions(
+                codes, valid, group_ids, flat_stamps, config, ts_vocab=ts_vocab
+            )
+            level_data = cascade_mod.build_cascade(
+                e_codes, e_slots, ccfg,
+                n_slots=len(ts_vocab) * n_groups,
+                valid=e_valid,
+                capacity=min(config.capacity or len(e_codes), len(e_codes)),
+            )
+            levels = cascade_mod.decode_levels(level_data, ccfg)
+        with tracer.span("merge.chunk"):
+            for i, lvl in enumerate(levels):
+                merged[i] = _merge_sorted_level(
+                    merged[i], lvl["slot"] // n_groups, lvl["slot"] % n_groups,
+                    lvl["code"], lvl["value"],
+                )
+
+    for batch in source.batches(min(batch_size, max_points)):
+        with tracer.span("ingest.batch"):
+            cols = load_columns(batch)
+            m = len(cols["latitude"])
+            # Flush BEFORE appending when the batch would overshoot, so
+            # a chunk never exceeds max_points (batches are read at
+            # most max_points long).
+            if pending and pending + m > max_points:
+                flush()
+            lats.append(cols["latitude"])
+            lons.append(cols["longitude"])
+            gids.append(vocab.group_ids(cols["user_id"]))
+            stamps.append(cols["timestamp"])
+            pending += m
+        tracer.add_items("ingest.batch", m)
+        if pending >= max_points:
+            flush()
+    flush()
+    if all(len(m["code"]) == 0 for m in merged):
+        return {}
+
+    # Egress: re-pack slots with the complete vocabs, then the shared
+    # finalize + blob path.
+    n_groups = len(vocab)
+    levels = []
+    for i, m in enumerate(merged):
+        rows, cols_ = morton.morton_decode_np(m["code"])
+        levels.append({
+            "zoom": ccfg.detail_zoom - i,
+            "slot": m["ts"] * n_groups + m["g"],
+            "code": m["code"],
+            "row": rows,
+            "col": cols_,
+            "value": m["value"],
+        })
+    blobs = _finish_blobs(levels, ccfg, _slot_names(vocab, ts_vocab, n_groups),
+                          as_json=True)
+    if sink is not None:
+        with tracer.span("egress"):
+            sink.write(blobs.items())
+    return blobs
+
+
+def _merge_sorted_level(m, ts2, g2, code2, value2):
+    """Fold one chunk's level aggregates into the running table.
+
+    Both sides arrive sorted by (ts, g, code): the running table is the
+    previous merge's output, and decode_levels emits ascending
+    composite-key order, which for slot = ts*G + g (g < G) IS the
+    (ts, g, code) lexicographic order. That makes this a two-sorted-run
+    merge — O(K log K) binary searches, not a full re-sort of the
+    accumulated table per chunk. Equal keys dedupe by summing.
+    """
+    ts = np.concatenate([m["ts"], ts2])
+    g = np.concatenate([m["g"], g2])
+    code = np.concatenate([m["code"], code2])
+    value = np.concatenate([m["value"], value2])
+    if len(code) == 0:
+        return m
+    # Pack (ts, g, code) into one comparable int64 when it fits (the
+    # cascade's own composite keys already prove slot<<code_bits fits;
+    # the global G here can only be larger by the vocab tail, so guard).
+    code_bits = int(code.max(initial=0)).bit_length()
+    gmax = int(g.max(initial=0)) + 1
+    tmax = int(ts.max(initial=0)) + 1
+    if code_bits + (gmax * tmax).bit_length() < 62:
+        def pack(t_, g_, c_):
+            return ((t_ * gmax + g_) << code_bits) | c_
+
+        pa = pack(m["ts"], m["g"], m["code"])
+        pb = pack(ts2, g2, code2)
+        if len(pa) and len(pb):
+            pos_a = np.arange(len(pa)) + np.searchsorted(pb, pa, side="left")
+            pos_b = np.arange(len(pb)) + np.searchsorted(pa, pb, side="right")
+            order = np.empty(len(pa) + len(pb), np.int64)
+            order[pos_a] = np.arange(len(pa))
+            order[pos_b] = len(pa) + np.arange(len(pb))
+        else:
+            order = np.arange(len(code))
+    else:  # pathological widths: correct but slower full sort
+        order = np.lexsort((code, g, ts))
+    ts, g, code, value = ts[order], g[order], code[order], value[order]
+    new = np.concatenate([[True],
+                          (ts[1:] != ts[:-1]) | (g[1:] != g[:-1])
+                          | (code[1:] != code[:-1])])
+    seg = np.cumsum(new) - 1
+    keep = np.flatnonzero(new)
+    return {
+        "ts": ts[keep], "g": g[keep], "code": code[keep],
+        "value": np.bincount(seg, weights=value),
+    }
+
+
+def _slot_names(vocab, ts_vocab, n_groups):
+    """slot id -> (user name, timespan label) table shared by every
+    egress path (slot = timespan*G + group)."""
+    return {
+        t * n_groups + g: (vocab.name_for(g), ts_vocab.label_for(t))
+        for t in range(len(ts_vocab))
+        for g in range(n_groups)
+    }
+
+
+def _finish_blobs(decoded_levels, ccfg, slot_names, as_json):
+    """Shared egress tail: finalize decoded levels and build blobs."""
+    finalized = cascade_mod.finalize_level_arrays(
+        decoded_levels, ccfg, slot_names
+    )
+    blobs = cascade_mod.blobs_from_level_arrays(finalized)
+    if as_json:
+        return {k: json.dumps(v) for k, v in blobs.items()}
     return blobs
 
 
@@ -472,12 +660,9 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
         valid=e_valid,
         capacity=config.capacity or len(e_codes),
     )
-    slot_names = {
-        t * n_groups + g: (vocab.name_for(g), ts_vocab.label_for(t))
-        for t in range(len(ts_vocab))
-        for g in range(n_groups)
-    }
-    blobs = cascade_mod.emit_blobs(levels, ccfg, slot_names)
-    if as_json:
-        return {k: json.dumps(v) for k, v in blobs.items()}
-    return blobs
+    return _finish_blobs(
+        cascade_mod.decode_levels(levels, ccfg),
+        ccfg,
+        _slot_names(vocab, ts_vocab, n_groups),
+        as_json,
+    )

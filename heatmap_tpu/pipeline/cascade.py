@@ -155,7 +155,17 @@ def emit_level_arrays(level_data, config: CascadeConfig, slot_names):
     directly (files/Arrow/Cassandra batches) without any per-element
     Python. Applies the amplify_all compat patch when configured.
     """
-    levels = decode_levels(level_data, config)
+    return finalize_level_arrays(
+        decode_levels(level_data, config), config, slot_names
+    )
+
+
+def finalize_level_arrays(levels, config: CascadeConfig, slot_names):
+    """Second half of :func:`emit_level_arrays`, for callers that build
+    decoded levels themselves (e.g. the bounded-memory chunk merge in
+    pipeline.batch): resolve slot names, add coarse tile coordinates,
+    apply the amplify_all compat patch.
+    """
     if config.amplify_all:
         _patch_amplified(levels, slot_names)
     n_slots = max(slot_names) + 1
@@ -179,9 +189,17 @@ def emit_blobs(level_data, config: CascadeConfig, slot_names):
     the per-blob dict assembly is inherently Python-object bound — use
     :func:`emit_level_arrays` for bulk sinks.
     """
+    return blobs_from_level_arrays(
+        emit_level_arrays(level_data, config, slot_names)
+    )
+
+
+def blobs_from_level_arrays(levels):
+    """Reference-format blobs from finalized level arrays
+    (:func:`finalize_level_arrays` output)."""
     sep = "|"  # reference KEY_SEPERATOR [sic], heatmap.py:18
     blobs: dict[str, dict[str, float]] = {}
-    for lvl in emit_level_arrays(level_data, config, slot_names):
+    for lvl in levels:
         if len(lvl["slot"]) == 0:
             continue
         blob_ids = np.char.add(

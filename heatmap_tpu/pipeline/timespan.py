@@ -79,11 +79,29 @@ class TimespanVocab:
 
         'alltime' ignores timestamps entirely (and tolerates None, like
         the reference whose timestamps are carried but unused,
-        SURVEY.md §8.7).
+        SURVEY.md §8.7). Numeric epoch-ms columns are factorized to
+        unique UTC days first, so Python label formatting runs per
+        distinct day, not per row.
         """
         n = len(timestamps)
         if timespan_type == "alltime":
             return np.zeros(n, np.int32)
+        arr = np.asarray(timestamps)
+        if arr.dtype.kind in "iuf" and n:
+            # Epoch ms -> UTC day ordinal; floor (not truncation)
+            # matches fromtimestamp(ms/1000, UTC).date() for negatives.
+            if arr.dtype.kind == "f":
+                days = np.floor(arr / 86_400_000.0).astype(np.int64)
+            else:
+                days = np.floor_divide(arr.astype(np.int64), 86_400_000)
+            uniq, inv = np.unique(days, return_inverse=True)
+            per_day = np.empty(len(uniq), np.int32)
+            for j, d in enumerate(uniq):
+                date = _dt.datetime.fromtimestamp(
+                    int(d) * 86_400, _dt.timezone.utc
+                ).date()
+                per_day[j] = self.id_for(timespan_label(timespan_type, date))
+            return per_day[inv.reshape(-1)].astype(np.int32)
         out = np.empty(n, np.int32)
         for i, ts in enumerate(timestamps):
             out[i] = self.id_for(timespan_label(timespan_type, _to_date(ts)))

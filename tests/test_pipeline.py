@@ -188,3 +188,73 @@ def test_multi_timespan_emission():
     )
     labels_q = {k.split("|")[1] for k in run_batch(rows, cfg_q)}
     assert labels_q == {"alltime"}
+
+
+# -- bounded-memory chunked cascade ---------------------------------------
+
+
+class _ColSource:
+    """Columnar batches over row dicts, for run_job tests."""
+
+    def __init__(self, rows):
+        self.rows = rows
+
+    def batches(self, batch_size):
+        for i in range(0, len(self.rows), batch_size):
+            chunk = self.rows[i : i + batch_size]
+            yield {
+                "latitude": [r["latitude"] for r in chunk],
+                "longitude": [r["longitude"] for r in chunk],
+                "user_id": [r["user_id"] for r in chunk],
+                "timestamp": [r.get("timestamp") for r in chunk],
+                "source": [r.get("source", "gps") for r in chunk],
+            }
+
+
+@pytest.mark.parametrize("amplify", [False, True])
+def test_run_job_bounded_matches_unbounded(amplify):
+    """max_points_in_flight chunks the cascade; linearity of the
+    per-level (key, sum) reduction makes the result exactly equal."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2000, seed=7)
+    cfg = BatchJobConfig(
+        detail_zoom=12, min_detail_zoom=6,
+        timespans=("alltime", "month"), amplify_all=amplify,
+    )
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=128)
+    bounded = run_job(
+        _ColSource(rows), config=cfg, batch_size=128,
+        max_points_in_flight=150,
+    )
+    assert plain == bounded
+
+
+def test_run_job_bounded_device_arrays_stay_small(monkeypatch):
+    """A source 10x larger than the bound never materializes more than
+    ~one chunk's emissions on device (the config-5 memory shape)."""
+    from heatmap_tpu.pipeline import batch as batch_mod
+    from heatmap_tpu.pipeline import cascade as cascade_mod
+    from heatmap_tpu.pipeline import run_job
+
+    sizes = []
+    real = cascade_mod.build_cascade
+
+    def spy(e_codes, *a, **kw):
+        sizes.append(len(e_codes))
+        return real(e_codes, *a, **kw)
+
+    monkeypatch.setattr(batch_mod.cascade_mod, "build_cascade", spy)
+    rows = _rows(n=3000, seed=9)
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=7)
+    bound = 300
+    bounded = run_job(_ColSource(rows), config=cfg, batch_size=100,
+                      max_points_in_flight=bound)
+    assert len(sizes) >= 8  # actually chunked, not one big pass
+    # <= 2 emissions per point (all + per-user); chunks never overshoot
+    # the bound (flush happens before an overfilling append).
+    assert max(sizes) <= 2 * bound
+    sizes.clear()
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=100)
+    assert sizes and sizes[0] > 2 * bound  # unbounded = one big cascade
+    assert plain == bounded
