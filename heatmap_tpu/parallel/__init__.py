@@ -24,6 +24,7 @@ from heatmap_tpu.parallel.mesh import (  # noqa: F401
 )
 from heatmap_tpu.parallel.sharded import (  # noqa: F401
     aggregate_keys_sharded,
+    bin_points_bandsharded,
     bin_points_replicated,
     bin_points_rowsharded,
     pyramid_rowsharded,

@@ -11,9 +11,13 @@ Communication mapping from the reference (SURVEY.md §2.3):
 | external shuffle service            | — (ICI/DCN, no spill)             |
 
 All kernels are pure and shard_map-traced over the mesh from
-parallel.mesh; wrap in ``jax.jit`` for the compiled path. They require
-the ``tile`` mesh axis to be 1 for now (points use only the data axis;
-the tile axis is reserved for raster/tile-space sharding).
+parallel.mesh; wrap in ``jax.jit`` for the compiled path. On a 2D
+(data, tile) mesh the point-parallel kernels shard points over BOTH
+axes (collectives run over the flattened axes), and
+``bin_points_bandsharded`` uses the tile axis as true tile-space
+parallelism: an ``all_to_all`` regroups points so each device only
+ever materializes its own raster band — the groupByKey analog for
+rasters too big for one device's HBM.
 """
 
 from __future__ import annotations
@@ -27,15 +31,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from heatmap_tpu.ops import histogram, pyramid as pyramid_ops, sparse as sparse_ops
 from heatmap_tpu.parallel.mesh import DATA_AXIS, TILE_AXIS
+from heatmap_tpu.tilemath import mercator
 
 
-def _data_size(mesh: Mesh) -> int:
-    if mesh.shape[TILE_AXIS] != 1:
-        raise NotImplementedError(
-            "sharded kernels currently require a tile axis of size 1 "
-            f"(got {mesh.shape[TILE_AXIS]})"
-        )
-    return mesh.shape[DATA_AXIS]
+def _shard_axes(mesh: Mesh):
+    """(axis names, total shards) the point-parallel kernels span.
+
+    tile == 1 keeps the single ``data`` axis; tile > 1 flattens points
+    over (data, tile) so a 2D mesh still uses every device — the tile
+    axis only becomes *spatial* in bin_points_bandsharded.
+    """
+    if mesh.shape[TILE_AXIS] == 1:
+        return (DATA_AXIS,), mesh.shape[DATA_AXIS]
+    return (DATA_AXIS, TILE_AXIS), mesh.shape[DATA_AXIS] * mesh.shape[TILE_AXIS]
 
 
 def _ones_like_weights(weights, n, dtype):
@@ -56,10 +64,10 @@ def bin_points_replicated(
 
     The direct reduceByKey replacement: every device bins its point
     shard into a full local (H, W) raster, then one ``lax.psum`` over
-    ICI merges them. Point arrays must be divisible by the data axis
-    size (see mesh.pad_to_multiple).
+    ICI merges them. Point arrays must be divisible by the number of
+    point shards (see mesh.pad_to_multiple).
     """
-    _data_size(mesh)
+    axes, _ = _shard_axes(mesh)
     if dtype is None:
         dtype = jnp.int32 if weights is None else jnp.float32
     n = latitude.shape[0]
@@ -70,12 +78,12 @@ def bin_points_replicated(
         raster = histogram.bin_points_window(
             la, lo, window, weights=w, valid=v, proj_dtype=proj_dtype, dtype=dtype
         )
-        return lax.psum(raster, DATA_AXIS)
+        return lax.psum(raster, axes)
 
     fn = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(axes), P(axes), P(axes), P(axes)),
         out_specs=P(),
     )
     return fn(latitude, longitude, w, v)
@@ -97,10 +105,10 @@ def bin_points_rowsharded(
     rasters AND leaves device i owning row block i — each device holds
     its slice of merged tile space, like a Spark reducer holding its key
     range, but the "shuffle" rides ICI as one fused collective. Global
-    result shape (H, W), sharded (H/D, W) per device; window.height must
-    divide by the data axis size.
+    result shape (H, W), sharded (H/shards, W) per device;
+    window.height must divide by the number of point shards.
     """
-    ndev = _data_size(mesh)
+    axes, ndev = _shard_axes(mesh)
     if window.height % ndev:
         raise ValueError(f"window height {window.height} not divisible by {ndev}")
     if dtype is None:
@@ -113,13 +121,13 @@ def bin_points_rowsharded(
         raster = histogram.bin_points_window(
             la, lo, window, weights=w, valid=v, proj_dtype=proj_dtype, dtype=dtype
         )
-        return lax.psum_scatter(raster, DATA_AXIS, scatter_dimension=0, tiled=True)
+        return lax.psum_scatter(raster, axes, scatter_dimension=0, tiled=True)
 
     fn = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
-        out_specs=P(DATA_AXIS),
+        in_specs=(P(axes), P(axes), P(axes), P(axes)),
+        out_specs=P(axes),
     )
     return fn(latitude, longitude, w, v)
 
@@ -133,7 +141,7 @@ def pyramid_rowsharded(raster, levels: int, mesh: Mesh):
     ``local_levels+1`` row-sharded, the rest replicated — callers can
     inspect ``.sharding`` or just use the values.
     """
-    ndev = _data_size(mesh)
+    axes, ndev = _shard_axes(mesh)
     h, w = raster.shape
     block_h = h // ndev
     local_levels = 0
@@ -147,19 +155,19 @@ def pyramid_rowsharded(raster, levels: int, mesh: Mesh):
             block = pyramid_ops.coarsen_raster(block)
             outs.append(block)
         if gather_levels:
-            full = lax.all_gather(block, DATA_AXIS, axis=0, tiled=True)
+            full = lax.all_gather(block, axes, axis=0, tiled=True)
             for _ in range(gather_levels):
                 full = pyramid_ops.coarsen_raster(full)
                 outs.append(full)
         return tuple(outs)
 
     out_specs = tuple(
-        [P(DATA_AXIS)] * (local_levels + 1) + [P()] * gather_levels
+        [P(axes)] * (local_levels + 1) + [P()] * gather_levels
     )
     # Outputs after the all_gather are replicated by construction; VMA
     # can't infer that statically, hence check_vma=False.
     fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(DATA_AXIS),), out_specs=out_specs,
+        body, mesh=mesh, in_specs=(P(axes),), out_specs=out_specs,
         check_vma=False,
     )
     return list(fn(raster))
@@ -175,7 +183,7 @@ def aggregate_keys_sharded(
     all-reduce formulation of reduceByKey for sparse keys. ``capacity``
     bounds BOTH the per-device and the merged unique counts.
     """
-    ndev = _data_size(mesh)
+    axes, ndev = _shard_axes(mesh)
     keys = jnp.asarray(keys)
     n = keys.shape[0]
     capacity = n if capacity is None else capacity
@@ -192,8 +200,8 @@ def aggregate_keys_sharded(
         u, s, local_n = sparse_ops.aggregate_keys(
             k, weights=w, valid=v, capacity=local_capacity, acc_dtype=acc_dtype
         )
-        gu = lax.all_gather(u, DATA_AXIS, axis=0, tiled=True)
-        gs = lax.all_gather(s, DATA_AXIS, axis=0, tiled=True)
+        gu = lax.all_gather(u, axes, axis=0, tiled=True)
+        gs = lax.all_gather(s, axes, axis=0, tiled=True)
         mu, ms, mn = sparse_ops.aggregate_keys(
             gu, weights=gs, valid=gu != sentinel, capacity=capacity,
             acc_dtype=acc_dtype,
@@ -203,7 +211,7 @@ def aggregate_keys_sharded(
         # before the merge and the merged count can look clean — force
         # the returned n_unique past capacity so callers detect it.
         local_overflow = lax.pmax(
-            (local_n > local_capacity).astype(jnp.int32), DATA_AXIS
+            (local_n > local_capacity).astype(jnp.int32), axes
         )
         mn = jnp.where(local_overflow > 0, jnp.maximum(mn, capacity + 1), mn)
         return mu, ms, mn
@@ -212,7 +220,7 @@ def aggregate_keys_sharded(
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(axes), P(axes), P(axes)),
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
@@ -235,7 +243,7 @@ def pyramid_sparse_morton_sharded(
     the merged (already sorted) uniques via Morton shifts — replicated,
     since post-merge work is O(levels * capacity), tiny next to binning.
     """
-    ndev = _data_size(mesh)
+    axes, ndev = _shard_axes(mesh)
     codes = jnp.asarray(codes)
     n = codes.shape[0]
     capacity = n if capacity is None else capacity
@@ -250,8 +258,8 @@ def pyramid_sparse_morton_sharded(
         u, s, local_n = sparse_ops.aggregate_keys(
             k, weights=w, valid=v, capacity=local_capacity, acc_dtype=acc_dtype
         )
-        gu = lax.all_gather(u, DATA_AXIS, axis=0, tiled=True)
-        gs = lax.all_gather(s, DATA_AXIS, axis=0, tiled=True)
+        gu = lax.all_gather(u, axes, axis=0, tiled=True)
+        gs = lax.all_gather(s, axes, axis=0, tiled=True)
         out = pyramid_ops.pyramid_sparse_morton(
             gu,
             weights=gs,
@@ -264,7 +272,7 @@ def pyramid_sparse_morton_sharded(
         # the ops/sparse.py overflow contract holds (see
         # aggregate_keys_sharded).
         local_overflow = lax.pmax(
-            (local_n > local_capacity).astype(jnp.int32), DATA_AXIS
+            (local_n > local_capacity).astype(jnp.int32), axes
         )
         return tuple(
             (
@@ -280,7 +288,7 @@ def pyramid_sparse_morton_sharded(
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
+        in_specs=(P(axes), P(axes), P(axes)),
         out_specs=out_specs,
         check_vma=False,
     )
@@ -299,7 +307,7 @@ def splat_rowsharded(raster, kernel_1d, mesh: Mesh):
     local. Compute stays distributed — no device ever holds the full
     raster.
     """
-    ndev = _data_size(mesh)
+    axes, ndev = _shard_axes(mesh)
     k = jnp.asarray(kernel_1d)
     if k.ndim != 1 or k.shape[0] % 2 == 0:
         raise ValueError(f"kernel must be 1D with odd length, got shape {k.shape}")
@@ -328,8 +336,8 @@ def splat_rowsharded(raster, kernel_1d, mesh: Mesh):
             # yields zeros where no source sends (global edges).
             down = [(i, i + 1) for i in range(ndev - 1)]
             up = [(i, i - 1) for i in range(1, ndev)]
-            top_halo = lax.ppermute(x[-half:], DATA_AXIS, down)
-            bot_halo = lax.ppermute(x[:half], DATA_AXIS, up)
+            top_halo = lax.ppermute(x[-half:], axes, down)
+            bot_halo = lax.ppermute(x[:half], axes, up)
             padded = jnp.concatenate([top_halo, x, bot_halo], axis=0)
         kd = k.astype(out_dtype)
         # Vertical pass VALID over the halo-padded block, horizontal
@@ -346,7 +354,125 @@ def splat_rowsharded(raster, kernel_1d, mesh: Mesh):
     fn = jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(DATA_AXIS, None),),
-        out_specs=P(DATA_AXIS, None),
+        in_specs=(P(axes, None),),
+        out_specs=P(axes, None),
     )
     return fn(raster)
+
+
+def bin_points_bandsharded(
+    latitude,
+    longitude,
+    window: histogram.Window,
+    mesh: Mesh,
+    weights=None,
+    valid=None,
+    proj_dtype=None,
+    dtype=None,
+    send_capacity: int | None = None,
+):
+    """Tile-space-parallel binning: no device materializes the raster.
+
+    The true groupByKey analog (SURVEY.md §2.3 spatial parallelism;
+    reference heatmap.py:112 hash-partitions tile space across
+    reducers): points are sharded over the whole (data, tile) mesh;
+    each device projects its shard, an ``lax.all_to_all`` over the
+    ``tile`` axis regroups points to the device owning their horizontal
+    raster band, and each device bins ONLY its (H/T, W) band —
+    per-device raster memory is H*W/T, unlike
+    bin_points_rowsharded, whose psum_scatter needs the full local
+    (H, W) raster before scattering. Copies across the data axis merge
+    with a psum. Returns the (H, W) raster row-sharded over the tile
+    axis (replicated over data).
+
+    ``send_capacity`` bounds the per-destination all_to_all buffer
+    (default: the per-device point count, which cannot overflow).
+    Smaller values save memory but silently drop points past the
+    capacity — only use when the point distribution over bands is
+    known to be balanced.
+    """
+    T = mesh.shape[TILE_AXIS]
+    D = mesh.shape[DATA_AXIS]
+    if T < 2:
+        raise ValueError(
+            "bin_points_bandsharded needs a tile axis >= 2 "
+            "(use bin_points_replicated/rowsharded on a data-only mesh)"
+        )
+    if window.height % T:
+        raise ValueError(f"window height {window.height} not divisible by tile={T}")
+    band_h = window.height // T
+    if dtype is None:
+        dtype = jnp.int32 if weights is None else jnp.float32
+    n = latitude.shape[0]
+    if n % (D * T):
+        raise ValueError(f"{n} points not divisible by {D * T} devices")
+    n_local = n // (D * T)
+    cap = n_local if send_capacity is None else min(send_capacity, n_local)
+    band_window = histogram.Window(
+        zoom=window.zoom, row0=0, col0=0, height=band_h, width=window.width
+    )
+
+    w = _ones_like_weights(weights, n, dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+
+    def local(la, lo, w, v):
+        row, col, pvalid = mercator.project_points(
+            la, lo, window.zoom, dtype=proj_dtype
+        )
+        r = jnp.asarray(row, jnp.int32) - window.row0
+        c = jnp.asarray(col, jnp.int32) - window.col0
+        ok = (
+            pvalid & v
+            & (r >= 0) & (r < window.height)
+            & (c >= 0) & (c < window.width)
+        )
+        dest = jnp.where(ok, r // band_h, T).astype(jnp.int32)
+        # Sort by destination band so each band's points are contiguous
+        # (invalid points sort last under sentinel T), then scatter
+        # whole runs into fixed (T, cap) send buffers.
+        order = jnp.argsort(dest)
+        sd = dest[order]
+        m = sd.shape[0]
+        starts = jnp.searchsorted(sd, jnp.arange(T, dtype=sd.dtype))
+        slot = jnp.arange(m, dtype=jnp.int32) - starts[jnp.clip(sd, 0, T - 1)]
+        send_r = jnp.full((T, cap), -1, jnp.int32).at[sd, slot].set(
+            r[order], mode="drop"
+        )
+        send_c = jnp.zeros((T, cap), jnp.int32).at[sd, slot].set(
+            c[order], mode="drop"
+        )
+        send_w = jnp.zeros((T, cap), dtype).at[sd, slot].set(
+            w[order], mode="drop"
+        )
+        # The regroup "shuffle": row t of the send buffer goes to tile
+        # position t; row j of the result came from tile position j.
+        recv_r = lax.all_to_all(send_r, TILE_AXIS, 0, 0, tiled=True)
+        recv_c = lax.all_to_all(send_c, TILE_AXIS, 0, 0, tiled=True)
+        recv_w = lax.all_to_all(send_w, TILE_AXIS, 0, 0, tiled=True)
+        t_idx = lax.axis_index(TILE_AXIS)
+        rloc = recv_r.reshape(-1) - t_idx * band_h
+        band = histogram.bin_rowcol_window(
+            rloc,
+            recv_c.reshape(-1),
+            band_window,
+            weights=recv_w.reshape(-1),
+            valid=recv_r.reshape(-1) >= 0,
+            dtype=dtype,
+        )
+        # Different data-axis rows hold disjoint point shards of the
+        # same band: merge, leaving the band replicated over data.
+        return lax.psum(band, DATA_AXIS)
+
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P((DATA_AXIS, TILE_AXIS)),
+            P((DATA_AXIS, TILE_AXIS)),
+            P((DATA_AXIS, TILE_AXIS)),
+            P((DATA_AXIS, TILE_AXIS)),
+        ),
+        out_specs=P(TILE_AXIS, None),
+        check_vma=False,
+    )
+    return fn(latitude, longitude, w, v)

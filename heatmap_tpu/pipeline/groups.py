@@ -79,12 +79,20 @@ class UserVocab:
         n = len(user_ids)
         if n == 0:
             return np.empty(0, np.int32)
+        codes = uniques = None
         try:
             import pandas as pd
 
             codes, uniques = pd.factorize(
                 np.asarray(user_ids, dtype=object), use_na_sentinel=False
             )
+        except (ImportError, TypeError):
+            # No pandas, or pandas < 1.5 (kwarg spelled na_sentinel) —
+            # degrade to the loop rather than fail the whole ingest.
+            # Only the factorize call sits in this try: routing errors
+            # below (e.g. a None user id) must stay loud.
+            pass
+        if codes is not None:
             mapped = np.empty(len(uniques), np.int32)
             for j, uid in enumerate(uniques):
                 # Route the ORIGINAL object: None/int ids must fail as
@@ -93,16 +101,15 @@ class UserVocab:
                 name = route_user(uid)
                 mapped[j] = EXCLUDED if name is None else self.id_for(name)
             return mapped[codes].astype(np.int32)
-        except ImportError:
-            # Dict-cache loop: one hash lookup per row, routing only on
-            # first sight of each id.
-            cache: dict = {}
-            out = np.empty(n, np.int32)
-            for i, uid in enumerate(user_ids):
-                gid = cache.get(uid)
-                if gid is None:
-                    name = route_user(uid)
-                    gid = EXCLUDED if name is None else self.id_for(name)
-                    cache[uid] = gid
-                out[i] = gid
-            return out
+        # Dict-cache loop: one hash lookup per row, routing only on
+        # first sight of each id.
+        cache: dict = {}
+        out = np.empty(n, np.int32)
+        for i, uid in enumerate(user_ids):
+            gid = cache.get(uid)
+            if gid is None:
+                name = route_user(uid)
+                gid = EXCLUDED if name is None else self.id_for(name)
+                cache[uid] = gid
+            out[i] = gid
+        return out

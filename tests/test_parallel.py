@@ -177,3 +177,110 @@ def test_aggregate_keys_sharded_local_overflow_signal(mesh):
     )
     gu, gs, gn = aggregate_keys_sharded(jnp.asarray(keys), mesh, capacity=5)
     assert int(gn) > 5  # overflow signalled (device 0 dropped key 5)
+
+
+# -- 2D (data x tile) meshes ----------------------------------------------
+
+
+@pytest.fixture(scope="module", params=[(4, 2), (2, 4)],
+                ids=["4x2", "2x4"])
+def mesh2d(request):
+    data, tile = request.param
+    return make_mesh(data=data, tile=tile)
+
+
+def test_point_kernels_on_2d_mesh_match_single_device(mesh2d):
+    """Existing point-parallel kernels shard over the flattened
+    (data, tile) axes — tile > 1 uses all devices, same results."""
+    lats, lons = _points(seed=11)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=10, align_levels=3, pad_multiple=8
+    )
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    la, lo, v = jnp.asarray(pla), jnp.asarray(plo), jnp.asarray(valid)
+    want = np.asarray(bin_points_window(lats, lons, win))
+    np.testing.assert_array_equal(
+        np.asarray(bin_points_replicated(la, lo, win, mesh2d, valid=v)), want
+    )
+    sharded = bin_points_rowsharded(la, lo, win, mesh2d, valid=v)
+    np.testing.assert_array_equal(np.asarray(sharded), want)
+    pyr = pyramid_rowsharded(sharded, 3, mesh2d)
+    for got, w in zip(pyr, pyramid_from_raster(jnp.asarray(want), 3)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(w))
+
+
+def test_sparse_kernels_on_2d_mesh_match_local(mesh2d):
+    rng = np.random.default_rng(12)
+    keys = rng.integers(0, 300, 8 * 512).astype(np.int32)
+    gu, gs, gn = aggregate_keys_sharded(jnp.asarray(keys), mesh2d, capacity=512)
+    lu, ls, ln = aggregate_keys(jnp.asarray(keys), capacity=len(keys))
+    n = int(gn)
+    assert n == int(ln)
+    np.testing.assert_array_equal(np.asarray(gu[:n]), np.asarray(lu[:n]))
+    np.testing.assert_array_equal(np.asarray(gs[:n]), np.asarray(ls[:n]))
+
+
+def test_bandsharded_binning_matches_single_device(mesh2d):
+    """The all_to_all tile-space regroup (groupByKey analog): counts
+    match the single-device raster exactly, output sharded by band."""
+    from heatmap_tpu.parallel import bin_points_bandsharded
+
+    lats, lons = _points(seed=13)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=10, align_levels=3, pad_multiple=8
+    )
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    got = bin_points_bandsharded(
+        jnp.asarray(pla), jnp.asarray(plo), win, mesh2d,
+        valid=jnp.asarray(valid),
+    )
+    want = np.asarray(bin_points_window(lats, lons, win))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert got.sharding.spec[0] == "tile"  # rows band-sharded
+
+
+def test_bandsharded_weighted(mesh2d):
+    from heatmap_tpu.parallel import bin_points_bandsharded
+
+    lats, lons = _points(seed=14)
+    w = np.random.default_rng(15).uniform(0.0, 2.0, len(lats)).astype(np.float32)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=9, align_levels=0, pad_multiple=8
+    )
+    (pla, plo, pw), valid = pad_to_multiple([lats, lons, w], 8)
+    got = np.asarray(
+        bin_points_bandsharded(
+            jnp.asarray(pla), jnp.asarray(plo), win, mesh2d,
+            weights=jnp.asarray(pw), valid=jnp.asarray(valid),
+        )
+    )
+    want = np.asarray(bin_points_window(lats, lons, win, weights=w))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_bandsharded_under_jit(mesh2d):
+    from heatmap_tpu.parallel import bin_points_bandsharded
+
+    lats, lons = _points(seed=16, n=8 * 256)
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=8, align_levels=2, pad_multiple=8
+    )
+
+    @jax.jit
+    def step(la, lo):
+        return bin_points_bandsharded(la, lo, win, mesh2d)
+
+    got = np.asarray(step(jnp.asarray(lats), jnp.asarray(lons)))
+    want = np.asarray(bin_points_window(lats, lons, win))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_bandsharded_rejects_tile1():
+    from heatmap_tpu.parallel import bin_points_bandsharded
+
+    win = window_from_bounds((35.0, 55.0), (-5.0, 20.0), zoom=8,
+                             align_levels=2, pad_multiple=8)
+    with pytest.raises(ValueError):
+        bin_points_bandsharded(
+            jnp.zeros(8), jnp.zeros(8), win, make_mesh()
+        )
