@@ -133,14 +133,21 @@ def window_from_bounds(
 PALLAS_AUTO_MAX_CELLS = 256 * 256
 
 
-def _pick_backend(backend: str, window: Window) -> str:
+def _pick_backend(backend: str, window: Window, weighted: bool = False) -> str:
     if backend != "auto":
         return backend
     import jax
 
     on_tpu = jax.devices()[0].platform in ("tpu", "axon")
-    small = window.height * window.width <= PALLAS_AUTO_MAX_CELLS
-    return "pallas" if (on_tpu and small) else "xla"
+    if not on_tpu:
+        return "xla"
+    if window.height * window.width <= PALLAS_AUTO_MAX_CELLS:
+        return "pallas"
+    # Large windows: sort-partitioned MXU binning wins big for counts
+    # (measured 149 M vs 67 M pts/s on the ~1024x1280 z15 headline
+    # window, v5e-1, same session); it is count-only, so weighted
+    # binning stays on the scatter path.
+    return "xla" if weighted else "partitioned"
 
 
 def bin_rowcol_window(row, col, window: Window, weights=None, valid=None,
@@ -160,7 +167,7 @@ def bin_rowcol_window(row, col, window: Window, weights=None, valid=None,
     """
     if dtype is None:
         dtype = jnp.int32 if weights is None else jnp.float32
-    picked = _pick_backend(backend, window)
+    picked = _pick_backend(backend, window, weighted=weights is not None)
     if picked == "partitioned":
         if weights is not None:
             raise ValueError(

@@ -60,7 +60,7 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    local = s_ref[0, :] - base_ref[i] * BLOCK_CELLS
+    local = s_ref[0, 0, :] - base_ref[i] * BLOCK_CELLS
     ok = (good_ref[i] == 1) & (local >= 0) & (local < BLOCK_CELLS)
     rloc = jnp.where(ok, local // _BLK_SIDE, -1)
     cloc = jnp.where(ok, local % _BLK_SIDE, 0)
@@ -115,7 +115,12 @@ def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
         num_scalar_prefetch=4,
         grid=(n_chunks,),
         in_specs=[
-            pl.BlockSpec((1, chunk), lambda i, *_: (i, 0)),
+            # (n_chunks, 1, chunk) so the last-two block dims (1, chunk)
+            # satisfy the TPU tiling rule: sublane block == array dim
+            # (1 == 1), lane block divisible by 128.  A flat
+            # (n_chunks, chunk) array with block (1, chunk) is rejected
+            # by Mosaic (sublane 1 neither 8-divisible nor full).
+            pl.BlockSpec((1, 1, chunk), lambda i, *_: (i, 0, 0)),
             pl.BlockSpec(
                 (1, _BLK_SIDE, _BLK_SIDE),
                 lambda i, base, *_: (base[i], 0, 0),
@@ -135,7 +140,7 @@ def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
         ),
         input_output_aliases={5: 0},  # zeros operand -> output
         interpret=interpret,
-    )(base, gi, first_visit, last_visit, s2, zeros)
+    )(base, gi, first_visit, last_visit, s2.reshape(n_chunks, 1, chunk), zeros)
     dense = blocks.reshape(n_blocks * BLOCK_CELLS)[:hw]
 
     # Bounded scatter over the bad tail; already-counted good chunks in
