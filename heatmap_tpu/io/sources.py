@@ -234,28 +234,74 @@ class CassandraConfig:
     endpoint: str | None = "10.1.0.11"  # reference heatmap.py:23
     keyspace: str = "rhom"  # reference heatmap.py:137
     table: str = "locations"  # reference heatmap.py:137
+    #: Partition-key column(s) for token() predicates. The reference's
+    #: table schema is not in the repo; the Spark connector discovered
+    #: it from table metadata, so it is config here.
+    partition_keys: tuple = ("user_id",)
+    #: Number of token ranges the Murmur3 ring is split into (the
+    #: connector's input-split analog and the deterministic
+    #: re-execution unit).
+    n_ranges: int = 64
     cosmosdb_host_env: str = "LOCATIONS_COSMOSDB_HOST"  # heatmap.py:141
     cosmosdb_key_env: str = "LOCATIONS_COSMOSDB_AUTH_KEY"  # heatmap.py:142
     cosmosdb_database: str = "locationsdb"  # heatmap.py:144
     cosmosdb_collection: str = "locations"  # heatmap.py:145
 
 
+#: Murmur3 partitioner token bounds (the Cassandra default ring).
+TOKEN_MIN = -(1 << 63)
+TOKEN_MAX = (1 << 63) - 1
+
+
+def token_ranges(n_ranges: int) -> list:
+    """Split the Murmur3 ring into ``n_ranges`` contiguous [lo, hi]
+    closed intervals covering [TOKEN_MIN, TOKEN_MAX] exactly.
+
+    Deterministic (pure arithmetic), so a failed range can be re-read
+    by index on any host — the re-execution shard unit the reference
+    got from the Spark connector's token-range input splits
+    (reference heatmap.py:137, SURVEY.md §5 fault tolerance).
+    """
+    if n_ranges < 1:
+        raise ValueError(f"n_ranges must be >= 1, got {n_ranges}")
+    span = 1 << 64
+    bounds = [TOKEN_MIN + (span * i) // n_ranges for i in range(n_ranges)]
+    bounds.append(TOKEN_MAX + 1)
+    return [(bounds[i], bounds[i + 1] - 1) for i in range(n_ranges)]
+
+
 @dataclasses.dataclass
 class CassandraSource(Source):
     """Cassandra/CosmosDB ingest (reference get_rows, heatmap.py:131-147).
 
-    Reads the locations table in token-range shards (the TPU-native
-    analog of the Spark connector's token-range partitioning, which is
-    also the unit of deterministic shard re-execution — SURVEY.md §5
-    fault tolerance). Requires the ``cassandra-driver`` package, which
-    is not baked into this image — construction works (so config can be
-    round-tripped), ``batches`` raises with guidance unless a driver
-    ``session_factory`` is injected."""
+    Reads the locations table as ``config.n_ranges`` deterministic
+    Murmur3 token-range scans — the TPU-native analog of the Spark
+    connector's token-range input splits (reference heatmap.py:137) —
+    each a bounded query ``WHERE token(pk) >= lo AND token(pk) <= hi``.
+    The range index is the unit of (a) multi-host sharding
+    (``shard_index``/``shard_count`` interleave ranges across hosts)
+    and (b) deterministic re-execution: ``range_batches(i)`` re-reads
+    exactly range ``i`` after a failure (SURVEY.md §5 fault
+    tolerance); partial sums are pure adds, so recovery is idempotent
+    re-add of that range's points.
+
+    The ``cassandra-driver`` package is not baked into this image —
+    construction works (so config can be round-tripped), ``batches``
+    raises with guidance unless a driver ``session_factory`` is
+    injected. The session contract is ``session.execute(cql) ->
+    iterable of rows`` (dicts or attribute objects), which real driver
+    sessions satisfy; paging is the driver's job (its default
+    fetch_size streams pages transparently through the iterator)."""
 
     config: CassandraConfig = dataclasses.field(default_factory=CassandraConfig)
     session_factory: object = None  # () -> session with .execute(cql)
+    #: This host's interleaved share of the token ranges: ranges
+    #: shard_index, shard_index + shard_count, ... (process-sharded
+    #: ingest; parallel.multihost assigns these per process).
+    shard_index: int = 0
+    shard_count: int = 1
 
-    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+    def _session(self):
         cfg = self.config
         if not cfg.endpoint:
             host = os.environ.get(cfg.cosmosdb_host_env)
@@ -270,40 +316,74 @@ class CassandraSource(Source):
                 "not available in this image; use CSV/JSONL/Parquet "
                 "sources or inject a session_factory"
             )
-        cluster = None
         if self.session_factory is not None:
-            session = self.session_factory()
-        else:
-            try:
-                from cassandra.cluster import Cluster
-            except ImportError as e:
-                raise RuntimeError(
-                    "Cassandra ingest requires the cassandra-driver "
-                    "package (not baked into this image); pass "
-                    "session_factory=... or use CSV/JSONL/Parquet sources"
-                ) from e
-            cluster = Cluster([cfg.endpoint])
-            session = cluster.connect()
+            return self.session_factory(), None
+        try:
+            from cassandra.cluster import Cluster
+        except ImportError as e:
+            raise RuntimeError(
+                "Cassandra ingest requires the cassandra-driver "
+                "package (not baked into this image); pass "
+                "session_factory=... or use CSV/JSONL/Parquet sources"
+            ) from e
+        cluster = Cluster([self.config.endpoint])
+        return cluster.connect(), cluster
+
+    def _range_query(self, lo: int, hi: int) -> str:
+        cfg = self.config
+        pk = ", ".join(cfg.partition_keys)
+        return (
+            f"SELECT latitude, longitude, user_id, source, timestamp "
+            f"FROM {cfg.keyspace}.{cfg.table} "
+            f"WHERE token({pk}) >= {lo} AND token({pk}) <= {hi}"
+        )
+
+    def my_ranges(self) -> list:
+        """(index, (lo, hi)) pairs owned by this shard."""
+        return [
+            (i, r)
+            for i, r in enumerate(token_ranges(self.config.n_ranges))
+            if i % self.shard_count == self.shard_index
+        ]
+
+    def _scan(self, session, lo, hi, cols, batch_size):
+        for row in session.execute(self._range_query(lo, hi)):
+            get = (
+                row.get
+                if isinstance(row, dict)
+                else lambda k, r=row: getattr(r, k)
+            )
+            cols["latitude"].append(float(get("latitude")))
+            cols["longitude"].append(float(get("longitude")))
+            cols["user_id"].append(get("user_id"))
+            cols["source"].append(get("source"))
+            cols["timestamp"].append(get("timestamp"))
+            if len(cols["latitude"]) >= batch_size:
+                yield _finalize(cols)
+                for v in cols.values():
+                    v.clear()
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        session, cluster = self._session()
         try:
             cols = {k: [] for k in COLUMNS}
-            query = (
-                f"SELECT latitude, longitude, user_id, source, timestamp "
-                f"FROM {cfg.keyspace}.{cfg.table}"
-            )
-            for row in session.execute(query):
-                get = (
-                    row.get
-                    if isinstance(row, dict)
-                    else lambda k, r=row: getattr(r, k)
-                )
-                cols["latitude"].append(float(get("latitude")))
-                cols["longitude"].append(float(get("longitude")))
-                cols["user_id"].append(get("user_id"))
-                cols["source"].append(get("source"))
-                cols["timestamp"].append(get("timestamp"))
-                if len(cols["latitude"]) >= batch_size:
-                    yield _finalize(cols)
-                    cols = {k: [] for k in COLUMNS}
+            for _, (lo, hi) in self.my_ranges():
+                yield from self._scan(session, lo, hi, cols, batch_size)
+            if cols["latitude"]:
+                yield _finalize(cols)
+        finally:
+            if cluster is not None:
+                cluster.shutdown()
+
+    def range_batches(self, index: int,
+                      batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        """Re-read exactly token range ``index`` (deterministic
+        re-execution of one failed shard)."""
+        lo, hi = token_ranges(self.config.n_ranges)[index]
+        session, cluster = self._session()
+        try:
+            cols = {k: [] for k in COLUMNS}
+            yield from self._scan(session, lo, hi, cols, batch_size)
             if cols["latitude"]:
                 yield _finalize(cols)
         finally:
