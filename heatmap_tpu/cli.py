@@ -84,8 +84,9 @@ def _add_run_flags(p):
                    help="reproduce the reference's early-return timespan "
                    "quirk (SURVEY.md §8.2)")
     p.add_argument("--fast", action="store_true",
-                   help="integer-only native-decoder path (csv sources, "
-                   "alltime timespans; needs the native/ build)")
+                   help="integer-only native-decoder path (csv/hmpb "
+                   "sources; dated timespans use the i64 epoch-ms "
+                   "column; needs the native/ build for csv)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="checkpoint ingest progress here and resume from "
                    "the latest checkpoint on rerun")
@@ -126,9 +127,6 @@ def cmd_run(args) -> int:
         first_timespan_only=args.first_timespan_only,
         capacity=args.capacity,
     )
-    if args.fast and args.checkpoint_dir:
-        raise SystemExit("--fast and --checkpoint-dir are mutually "
-                         "exclusive (the fast path has no resume yet)")
     if args.max_points_in_flight is not None and (args.fast or args.checkpoint_dir):
         raise SystemExit("--max-points-in-flight applies to the standard "
                          "run path only (not --fast / --checkpoint-dir)")
@@ -154,7 +152,9 @@ def cmd_run(args) -> int:
         with open_sink(args.output) as sink:
             if args.fast:
                 blobs = run_job_fast(fast_source, sink, config,
-                                     batch_size=args.batch_size)
+                                     batch_size=args.batch_size,
+                                     checkpoint_dir=args.checkpoint_dir,
+                                     checkpoint_every=args.checkpoint_every)
             elif args.checkpoint_dir:
                 blobs = run_job_resumable(
                     open_source(args.input), args.checkpoint_dir, sink,

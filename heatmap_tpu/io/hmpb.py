@@ -46,7 +46,9 @@ import os
 import numpy as np
 
 MAGIC = b"HMPB\x01\n"
-TS_MISSING = np.iinfo(np.int64).min
+# Canonical missing-timestamp sentinel (INT64_MIN); re-exported here
+# because it is part of the on-disk format contract.
+from heatmap_tpu.pipeline.timespan import TS_MISSING  # noqa: E402
 
 _COLUMNS = (
     ("latitude", "<f8"),

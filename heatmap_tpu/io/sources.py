@@ -301,6 +301,15 @@ class CassandraSource(Source):
     shard_index: int = 0
     shard_count: int = 1
 
+    def __post_init__(self):
+        # A bad shard assignment must fail loudly: an out-of-range
+        # shard_index would match no ranges and silently ingest nothing.
+        if self.shard_count < 1 or not (0 <= self.shard_index < self.shard_count):
+            raise ValueError(
+                f"invalid shard assignment: shard_index={self.shard_index} "
+                f"shard_count={self.shard_count} (need 0 <= index < count)"
+            )
+
     def _session(self):
         cfg = self.config
         if not cfg.endpoint:

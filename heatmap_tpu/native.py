@@ -123,6 +123,14 @@ if _lib is not None:
     _lib.hm_pool_destroy.argtypes = [ctypes.c_void_p]
 
     TS_MISSING = int(_lib.hm_ts_missing())
+    # The C sentinel must agree with the canonical Python-side one
+    # (pipeline.timespan.TS_MISSING, INT64_MIN) or fast-path missing
+    # timestamps would silently stop being detected.
+    from heatmap_tpu.pipeline.timespan import TS_MISSING as _PY_TS_MISSING
+
+    assert TS_MISSING == int(_PY_TS_MISSING), (
+        f"native TS_MISSING {TS_MISSING} != canonical {_PY_TS_MISSING}"
+    )
 
     def _arena_to_list(buf: bytes, rows: int) -> list:
         # NUL-separated fields, one per row, each NUL-terminated.

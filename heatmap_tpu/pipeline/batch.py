@@ -374,7 +374,10 @@ def _finish_blobs(decoded_levels, ccfg, slot_names, as_json):
 
 
 def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
-                 batch_size: int = 1 << 20):
+                 batch_size: int = 1 << 20,
+                 checkpoint_dir: str | None = None,
+                 checkpoint_every: int = 8,
+                 fault_injector=None):
     """Integer-fast-path job: no per-row Python objects anywhere.
 
     ``source`` is a CSV path (the native C++ decoder parses, routes
@@ -385,46 +388,115 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
     table into the UserVocab (O(unique users), not O(rows)) and
     filters with numpy masks. Same blobs as the string path.
 
-    Dated timespans need per-row timestamps as Python objects, so this
-    path requires ``timespans == ("alltime",)`` (the reference's only
-    live timespan, SURVEY.md §8.7).
+    Dated timespans work here: fast batches carry an i64 epoch-ms
+    ``timestamp`` column (TS_MISSING sentinel), which the factorized
+    unique-day labeler consumes without per-row Python; a sentinel row
+    under a dated timespan raises exactly like timestamp=None does on
+    the string path.
+
+    ``checkpoint_dir`` enables checkpoint/resume with
+    run_job_resumable's semantics: ingest progress is checkpointed
+    every ``checkpoint_every`` batches, a rerun skips the row-work of
+    already-checkpointed batches (the reader still streams them for its
+    intern table). Resume-by-batch-index requires a deterministic batch
+    order, so checkpointing forces the native CSV reader to a single
+    worker (parallel byte-range parsing reorders batches run to run);
+    HMPB batches are always in file order.
     """
-    if isinstance(source, str):
-        try:
-            from heatmap_tpu.native import parse_csv_batches
-        except ImportError as e:
-            raise RuntimeError(
-                "run_job_fast on a CSV path needs the native decoder "
-                "(native/ build failed or disabled); use "
-                "run_job(CSVSource(path)) instead"
-            ) from e
-        batches = parse_csv_batches(source, batch_size, fast=True)
-    elif hasattr(source, "fast_batches"):
-        batches = source.fast_batches(batch_size)
-    else:
+    config = config or BatchJobConfig()
+    if checkpoint_every < 1:
+        raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
+    from heatmap_tpu.pipeline.timespan import TS_MISSING
+    from heatmap_tpu.utils.trace import get_tracer
+
+    def make_batches():
+        if isinstance(source, str):
+            try:
+                from heatmap_tpu.native import parse_csv_batches
+            except ImportError as e:
+                raise RuntimeError(
+                    "run_job_fast on a CSV path needs the native decoder "
+                    "(native/ build failed or disabled); use "
+                    "run_job(CSVSource(path)) instead"
+                ) from e
+            return parse_csv_batches(
+                source, batch_size, fast=True,
+                n_workers=1 if checkpoint_dir is not None else None,
+            )
+        if hasattr(source, "fast_batches"):
+            return source.fast_batches(batch_size)
         raise TypeError(
             f"run_job_fast needs a CSV path or a fast-batch source "
             f"(got {type(source).__name__}); use run_job for generic "
             f"sources"
         )
 
-    config = config or BatchJobConfig()
-    if tuple(config.timespans) != ("alltime",):
-        raise ValueError(
-            "run_job_fast supports only alltime timespans; use run_job "
-            "for dated timespan buckets"
-        )
     vocab = UserVocab()
     names: list = []  # reader-side intern table, extended per batch
     reader_to_vocab = np.full(1024, -2, np.int32)  # -2 = not yet mapped
-    from heatmap_tpu.utils.trace import get_tracer
-
     tracer = get_tracer()
-    lats, lons, gids = [], [], []
+    lats, lons, gids, tss = [], [], [], []
+    mgr = None
+    done = 0
+    if checkpoint_dir is not None:
+        from heatmap_tpu.utils import CheckpointManager
+
+        mgr = CheckpointManager(checkpoint_dir)
+        if mgr.latest_step() is not None:
+            arrays, meta = mgr.load()
+            # Batch indices only mean the same rows under the reader
+            # that wrote them — refuse checkpoints from the string path
+            # (run_job_resumable) instead of resuming into corruption.
+            kind = meta.get("job_path", "string")
+            if kind != "fast":
+                raise RuntimeError(
+                    f"checkpoint at {checkpoint_dir!r} was written by the "
+                    f"{kind!r} job path; resume it with the same path "
+                    "(run_job_resumable / drop --fast) or point --fast at "
+                    "a fresh checkpoint dir"
+                )
+            lats = [arrays["latitude"]]
+            lons = [arrays["longitude"]]
+            gids = [arrays["group_ids"]]
+            tss = [arrays["timestamps_ms"]]
+            for name in meta["group_names"][1:]:  # [0] is always 'all'
+                vocab.id_for(name)
+            done = meta["batches_done"]
+
+    def checkpoint(step):
+        arrays = {
+            "latitude": np.concatenate(lats) if lats else np.empty(0),
+            "longitude": np.concatenate(lons) if lons else np.empty(0),
+            "group_ids": (
+                np.concatenate(gids) if gids else np.empty(0, np.int32)
+            ),
+            "timestamps_ms": (
+                np.concatenate(tss) if tss else np.empty(0, np.int64)
+            ),
+        }
+        mgr.save(step, arrays, {
+            "group_names": list(vocab.names),
+            "batches_done": step,
+            "job_path": "fast",
+        })
+        # Collapse accumulated chunks so later checkpoints don't recopy
+        # a growing list-of-arrays.
+        lats[:] = [arrays["latitude"]]
+        lons[:] = [arrays["longitude"]]
+        gids[:] = [arrays["group_ids"]]
+        tss[:] = [arrays["timestamps_ms"]]
+
     with tracer.span("ingest.fast"):
-        for b in batches:
-            tracer.add_items("ingest.fast", len(b["latitude"]))
+        for i, b in enumerate(make_batches()):
+            # The intern table must grow even for skipped batches: a
+            # post-resume batch may reference reader ids first named
+            # before the checkpoint.
             names.extend(b["new_group_names"])
+            if i < done:
+                continue  # rows already checkpointed on a previous run
+            if fault_injector is not None:
+                fault_injector.check(i)
+            tracer.add_items("ingest.fast", len(b["latitude"]))
             if len(names) > len(reader_to_vocab):
                 grown = np.full(max(len(names), 2 * len(reader_to_vocab)),
                                 -2, np.int32)
@@ -434,7 +506,8 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
             routed = b["routed"][keep]
             # Map only reader ids referenced by kept rows, in first-use
             # order, so vocab ids match the string path's assignment
-            # order.
+            # order. (id_for is get-or-create, so names restored from a
+            # checkpoint keep their original ids on resume.)
             ref_ids = routed[routed >= 0]
             unmapped = reader_to_vocab[ref_ids] == -2
             if unmapped.any():
@@ -448,6 +521,15 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
             ).astype(np.int32))
             lats.append(b["latitude"][keep])
             lons.append(b["longitude"][keep])
+            ts = b.get("timestamp")
+            tss.append(
+                np.full(int(keep.sum()), TS_MISSING, np.int64)
+                if ts is None else np.asarray(ts, np.int64)[keep]
+            )
+            done = i + 1
+            if mgr is not None and done % checkpoint_every == 0:
+                with tracer.span("checkpoint"):
+                    checkpoint(done)
     if not lats or sum(len(a) for a in lats) == 0:
         return {}
     lat = np.concatenate(lats)
@@ -456,7 +538,7 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
             lat,
             np.concatenate(lons),
             np.concatenate(gids),
-            np.zeros(len(lat)),  # timestamps unused under alltime
+            np.concatenate(tss),
             vocab,
             config,
             as_json=True,
@@ -500,10 +582,17 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
     done = 0
     if mgr.latest_step() is not None:
         arrays, meta = mgr.load()
+        kind = meta.get("job_path", "string")
+        if kind != "string":
+            raise RuntimeError(
+                f"checkpoint at {checkpoint_dir!r} was written by the "
+                f"{kind!r} job path; resume it with run_job_fast "
+                "(--fast) or point this run at a fresh checkpoint dir"
+            )
         lats, lons = [arrays["latitude"]], [arrays["longitude"]]
         gids = [arrays["group_ids"]]
         if "timestamps_ms" in arrays:
-            from heatmap_tpu.io.hmpb import TS_MISSING
+            from heatmap_tpu.pipeline.timespan import TS_MISSING
 
             stamps = [[None if t == TS_MISSING else int(t)
                        for t in arrays["timestamps_ms"]]]
@@ -534,7 +623,7 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
             # string path), never by dropping the whole column — a
             # resumed run has to bucket dated timespans exactly like an
             # uninterrupted one.
-            from heatmap_tpu.io.hmpb import TS_MISSING
+            from heatmap_tpu.pipeline.timespan import TS_MISSING
 
             valid = np.asarray([s is not None for s in flat_stamps], bool)
             present = [s for s in flat_stamps if s is not None]
@@ -577,6 +666,7 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
         mgr.save(step, arrays, {
             "group_names": list(vocab.names),
             "batches_done": step,
+            "job_path": "string",
         })
         # Collapse accumulated chunks so later checkpoints don't recopy
         # a growing list-of-arrays.

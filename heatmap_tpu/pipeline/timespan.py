@@ -18,6 +18,11 @@ import numpy as np
 ALLTIME = "alltime"
 VALID_TYPES = ("alltime", "year", "month", "day")
 
+#: Canonical missing-timestamp sentinel for integer epoch-ms columns.
+#: io.hmpb re-exports this as part of its on-disk format contract, and
+#: the native decoder asserts its C definition matches.
+TS_MISSING = np.iinfo(np.int64).min
+
 
 def timespan_label(timespan_type: str, local_date) -> str:
     """Label for one timespan bucket; formatting per reference
@@ -88,6 +93,13 @@ class TimespanVocab:
             return np.zeros(n, np.int32)
         arr = np.asarray(timestamps)
         if arr.dtype.kind in "iuf" and n:
+            # Missing rows (sentinel / NaN) fail like the object path's
+            # timestamp=None does — a dated bucket can't be invented.
+            missing = (
+                np.isnan(arr) if arr.dtype.kind == "f" else arr == TS_MISSING
+            )
+            if missing.any():
+                _to_date(None)  # raises with the canonical guidance
             # Epoch ms -> UTC day ordinal; floor (not truncation)
             # matches fromtimestamp(ms/1000, UTC).date() for negatives.
             if arr.dtype.kind == "f":

@@ -143,15 +143,38 @@ class TestEndToEnd:
         assert r2.returncode == 0, r2.stderr
         assert JSONLBlobSink.load(str(out)) == first
 
-    def test_fast_rejects_non_csv_and_checkpoint_combo(self):
+    def test_fast_rejects_non_csv_source(self):
         r = _run_cli("run", "--backend", "cpu", "--fast",
                      "--input", "synthetic:10")
         assert r.returncode != 0
         assert "csv" in r.stderr
-        r = _run_cli("run", "--backend", "cpu", "--fast",
-                     "--input", "csv:x.csv", "--checkpoint-dir", "/tmp/ck")
-        assert r.returncode != 0
-        assert "mutually" in r.stderr
+
+    def test_fast_with_checkpoint_dir_matches_fast_alone(self, tmp_path):
+        from heatmap_tpu.io import JSONLBlobSink
+        from heatmap_tpu.io.hmpb import convert_to_hmpb
+
+        hp = tmp_path / "pts.hmpb"
+        convert_to_hmpb("synthetic:2000:3", str(hp))
+        outs = {}
+        for name, extra in (
+            ("plain", []),
+            ("ckpt", ["--checkpoint-dir", str(tmp_path / "ck"),
+                      "--checkpoint-every", "2"]),
+        ):
+            out = tmp_path / f"{name}.jsonl"
+            r = _run_cli(
+                "run", "--backend", "cpu", "--fast",
+                "--input", f"hmpb:{hp}",
+                "--output", f"jsonl:{out}",
+                "--detail-zoom", "11", "--min-detail-zoom", "9",
+                "--batch-size", "512",
+                *extra,
+            )
+            assert r.returncode == 0, r.stderr
+            outs[name] = JSONLBlobSink.load(str(out))
+        assert outs["plain"] == outs["ckpt"]
+        # The checkpoint run actually wrote checkpoints.
+        assert any((tmp_path / "ck").iterdir())
 
     def test_stream_synthetic_decay_and_resume(self, tmp_path):
         out = tmp_path / "live"

@@ -184,6 +184,14 @@ class TestSources:
             "alice", "bob", "rt-1", "x-9",
         ]
 
+    def test_cassandra_invalid_shard_assignment_raises(self):
+        with pytest.raises(ValueError, match="shard"):
+            CassandraSource(session_factory=_FakeTokenSession,
+                            shard_index=3, shard_count=3)
+        with pytest.raises(ValueError, match="shard"):
+            CassandraSource(session_factory=_FakeTokenSession,
+                            shard_index=-1)
+
     def test_cassandra_query_names_partition_key(self):
         from heatmap_tpu.io.sources import CassandraConfig
 

@@ -231,12 +231,34 @@ def test_run_job_fast_matches_run_job(tmp_path):
     )
 
 
-def test_run_job_fast_rejects_dated_timespans(tmp_path):
+def test_run_job_fast_dated_timespans_match_string_path(tmp_path):
+    """Dated timespans on the integer fast path: the i64 epoch-ms
+    column + factorized day labeling must bucket exactly like the
+    string path's per-row labels."""
+    from heatmap_tpu.io.sources import CSVSource
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_fast
+
+    p = tmp_path / "pts.csv"
+    rows = _random_rows(800, seed=3)
+    day_ms = 86_400_000
+    for i, r in enumerate(rows):  # all-present epoch-ms over a few days
+        r["timestamp"] = (i % 5) * day_ms + 12_345
+    _write_csv(p, rows)
+    cfg = BatchJobConfig(
+        detail_zoom=12, min_detail_zoom=9,
+        timespans=("alltime", "day", "month", "year"),
+    )
+    assert run_job_fast(str(p), config=cfg) == run_job(
+        CSVSource(str(p), use_native=False), config=cfg
+    )
+
+
+def test_run_job_fast_dated_raises_on_missing_timestamps(tmp_path):
     from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
 
     p = tmp_path / "pts.csv"
-    _write_csv(p, _random_rows(5))
-    with pytest.raises(ValueError):
+    _write_csv(p, _random_rows(50, seed=4))  # ~10% empty timestamps
+    with pytest.raises(ValueError, match="timestamp"):
         run_job_fast(str(p), config=BatchJobConfig(timespans=("alltime", "day")))
 
 

@@ -179,6 +179,68 @@ def test_run_job_resumable_resumes_after_crash(tmp_path):
     assert resumed == run_job(src, config=_mini_cfg(), batch_size=512)
 
 
+def test_run_job_fast_resumes_after_crash(tmp_path):
+    """Fast-path checkpoint/resume, with dated timespans riding the
+    i64 epoch-ms column through the checkpoint."""
+    from heatmap_tpu.io.hmpb import HMPBSource, convert_to_hmpb
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+
+    hp = str(tmp_path / "pts.hmpb")
+    convert_to_hmpb("synthetic:4000:5", hp)
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8,
+                         timespans=("alltime", "day"))
+    clean = run_job_fast(HMPBSource(hp), config=cfg, batch_size=512)
+    ckdir = str(tmp_path / "ck")
+    inj = FaultInjector({5: 1})  # crash on batch 5, after the step-4 ckpt
+    with pytest.raises(RuntimeError):
+        run_job_fast(HMPBSource(hp), config=cfg, batch_size=512,
+                     checkpoint_dir=ckdir, checkpoint_every=2,
+                     fault_injector=inj)
+    assert CheckpointManager(ckdir).latest_step() == 4
+    resumed = run_job_fast(HMPBSource(hp), config=cfg, batch_size=512,
+                           checkpoint_dir=ckdir, checkpoint_every=2)
+    assert resumed == clean
+
+
+def test_run_job_fast_checkpointing_matches_plain(tmp_path):
+    from heatmap_tpu.io.hmpb import HMPBSource, convert_to_hmpb
+    from heatmap_tpu.pipeline import run_job_fast
+
+    hp = str(tmp_path / "pts.hmpb")
+    convert_to_hmpb("synthetic:3000:7", hp)
+    plain = run_job_fast(HMPBSource(hp), config=_mini_cfg(), batch_size=512)
+    ckpt = run_job_fast(HMPBSource(hp), config=_mini_cfg(), batch_size=512,
+                        checkpoint_dir=str(tmp_path / "ck"),
+                        checkpoint_every=2)
+    assert plain == ckpt
+
+
+def test_checkpoint_job_path_mismatch_refused(tmp_path):
+    """A fast resume must refuse a string-path checkpoint and vice
+    versa — batch indices only mean the same rows under the reader
+    that wrote them."""
+    from heatmap_tpu.io.hmpb import HMPBSource, convert_to_hmpb
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import run_job_fast, run_job_resumable
+
+    ckdir = str(tmp_path / "ck")
+    run_job_resumable(SyntheticSource(n=2000, seed=1), ckdir,
+                      config=_mini_cfg(), batch_size=512,
+                      checkpoint_every=1)
+    hp = str(tmp_path / "pts.hmpb")
+    convert_to_hmpb("synthetic:2000:1", hp)
+    with pytest.raises(RuntimeError, match="job path"):
+        run_job_fast(HMPBSource(hp), config=_mini_cfg(), batch_size=512,
+                     checkpoint_dir=ckdir)
+
+    ck2 = str(tmp_path / "ck2")
+    run_job_fast(HMPBSource(hp), config=_mini_cfg(), batch_size=512,
+                 checkpoint_dir=ck2, checkpoint_every=1)
+    with pytest.raises(RuntimeError, match="job path"):
+        run_job_resumable(SyntheticSource(n=2000, seed=1), ck2,
+                          config=_mini_cfg(), batch_size=512)
+
+
 def test_run_job_resumable_rejects_bad_interval(tmp_path):
     from heatmap_tpu.io.sources import SyntheticSource
     from heatmap_tpu.pipeline import run_job_resumable
