@@ -168,11 +168,13 @@ def cmd_run(args) -> int:
     dt = time.perf_counter() - t0
     if args.profile:
         print(get_tracer().format_report(), file=sys.stderr)
-    print(
-        json.dumps(
-            {"blobs": len(blobs), "seconds": round(dt, 3), "output": args.output}
-        )
-    )
+    summary = {"seconds": round(dt, 3), "output": args.output}
+    if isinstance(blobs, dict) and blobs.get("egress") == "levels":
+        summary["levels"] = blobs["levels"]
+        summary["rows"] = blobs["rows"]
+    else:
+        summary["blobs"] = len(blobs)
+    print(json.dumps(summary))
     return 0
 
 

@@ -27,6 +27,7 @@ from heatmap_tpu.io.sinks import (  # noqa: F401
     CassandraBlobSink,
     DirectoryBlobSink,
     JSONLBlobSink,
+    LevelArraysSink,
     MemorySink,
     PNGTileSink,
     open_sink,

@@ -149,6 +149,75 @@ class CassandraBlobSink(BlobSink):
 
 
 @dataclasses.dataclass
+class LevelArraysSink:
+    """Columnar egress: one compressed ``.npz`` per pyramid level.
+
+    Consumes the finalized level arrays
+    (pipeline.cascade.emit_level_arrays output) directly — the same
+    information as the reference blob format (blob id =
+    user|timespan|coarse tile + detail-tile counts, reference
+    heatmap.py:54-55,79-90) but as columns, with no per-blob Python
+    dict assembly anywhere. This is the bulk-egress surface: a
+    Cassandra/warehouse loader can stream the columns straight into
+    batched writes. Jobs route here automatically when the sink has
+    ``write_levels`` (pipeline.batch._finish_blobs).
+
+    Files are ``level_z{zoom}.npz`` holding row/col/value,
+    user/timespan (unicode), coarse_row/coarse_col and scalar
+    zoom/coarse_zoom; rewrites are atomic (tmp + rename), so reruns
+    upsert whole levels — the columnar analog of upsert-by-id.
+    """
+
+    path: str
+
+    def __post_init__(self):
+        os.makedirs(self.path, exist_ok=True)
+
+    COLUMNS = ("row", "col", "value", "user", "timespan",
+               "coarse_row", "coarse_col")
+
+    def write_levels(self, levels) -> int:
+        rows = 0
+        for lvl in levels:
+            out = {k: np.asarray(lvl[k]) for k in self.COLUMNS}
+            out["zoom"] = np.asarray(lvl["zoom"])
+            out["coarse_zoom"] = np.asarray(lvl["coarse_zoom"])
+            final = os.path.join(self.path, f"level_z{lvl['zoom']:02d}.npz")
+            tmp = final + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez_compressed(f, **out)
+            os.replace(tmp, final)
+            rows += len(out["value"])
+        return rows
+
+    def write(self, records):
+        raise TypeError(
+            "LevelArraysSink is columnar-only (write_levels); use a "
+            "blob sink (jsonl:/dir:/memory:) for per-blob records"
+        )
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    @staticmethod
+    def load(path: str) -> dict:
+        """{zoom: dict-of-columns} for every level file in ``path``."""
+        out = {}
+        for name in sorted(os.listdir(path)):
+            if name.startswith("level_z") and name.endswith(".npz"):
+                with np.load(os.path.join(path, name)) as z:
+                    cols = {k: z[k] for k in z.files}
+                out[int(cols["zoom"])] = cols
+        return out
+
+
+@dataclasses.dataclass
 class PNGTileSink:
     """Slippy-map PNG tile tree: ``root/z/x/y.png``.
 
@@ -199,10 +268,13 @@ class PNGTileSink:
 
 def open_sink(spec: str) -> BlobSink:
     """CLI sink spec: ``jsonl:PATH``, ``dir:PATH``, ``memory:``,
-    ``cassandra:`` or a bare ``.jsonl`` path."""
+    ``cassandra:``, ``arrays:DIR`` (columnar per-level npz) or a bare
+    ``.jsonl`` path."""
     kind, _, rest = spec.partition(":")
     if kind == "jsonl":
         return JSONLBlobSink(rest)
+    if kind == "arrays":
+        return LevelArraysSink(rest)
     if kind == "dir":
         return DirectoryBlobSink(rest)
     if kind == "memory":

@@ -174,22 +174,33 @@ def pyramid_rowsharded(raster, levels: int, mesh: Mesh):
 
 
 def aggregate_keys_sharded(
-    keys, mesh: Mesh, weights=None, valid=None, capacity=None, acc_dtype=None
+    keys, mesh: Mesh, weights=None, valid=None, capacity=None, acc_dtype=None,
+    local_capacity=None,
 ):
     """Global reduce-by-key over sharded keys -> replicated uniques/sums.
 
     Per-device sort+segment-sum (ops/sparse.py), then an ``all_gather``
     of the compact per-device results and a local re-reduce — the
     all-reduce formulation of reduceByKey for sparse keys. ``capacity``
-    bounds BOTH the per-device and the merged unique counts.
+    bounds the merged unique count; ``local_capacity`` the per-device
+    stage (default ``min(capacity, n // ndev)``, clamped to the shard
+    row count — a shard can never hold more distinct keys than rows).
+    Lower it when shards are known to carry few distinct keys: the
+    all_gather moves ndev * local_capacity entries, so a tight bound
+    directly shrinks the collective.
     """
     axes, ndev = _shard_axes(mesh)
     keys = jnp.asarray(keys)
     n = keys.shape[0]
     capacity = n if capacity is None else capacity
-    # Per-device stage: a shard holds at most n//ndev distinct keys, so
-    # sizing it by the global capacity would only inflate the all_gather.
-    local_capacity = min(capacity, n // ndev)
+    # Per-device stage: an evenly-distributed shard holds at most
+    # n//ndev distinct keys, so sizing it by the global capacity would
+    # only inflate the all_gather.
+    if local_capacity is None:
+        local_capacity = min(capacity, n // ndev)
+    # A shard can never hold more distinct keys than its row count, so
+    # anything above n//ndev only pads the all_gather for nothing.
+    local_capacity = max(1, min(local_capacity, n // ndev))
     if acc_dtype is None:
         acc_dtype = jnp.int32 if weights is None else jnp.float32
     w = _ones_like_weights(weights, n, acc_dtype)

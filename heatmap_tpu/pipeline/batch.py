@@ -193,10 +193,7 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
         "timestamp": stamps,
     }
     with tracer.span("cascade", items=len(data["latitude"])):
-        blobs = _run_loaded(data, config, as_json=True)
-    if sink is not None:
-        with tracer.span("egress"):
-            sink.write(blobs.items())
+        blobs = _run_loaded(data, config, as_json=True, sink=sink)
     return blobs
 
 
@@ -294,12 +291,8 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             "col": cols_,
             "value": m["value"],
         })
-    blobs = _finish_blobs(levels, ccfg, _slot_names(vocab, ts_vocab, n_groups),
-                          as_json=True)
-    if sink is not None:
-        with tracer.span("egress"):
-            sink.write(blobs.items())
-    return blobs
+    return _finish_blobs(levels, ccfg, _slot_names(vocab, ts_vocab, n_groups),
+                         as_json=True, sink=sink)
 
 
 def _merge_sorted_level(m, ts2, g2, code2, value2):
@@ -362,14 +355,33 @@ def _slot_names(vocab, ts_vocab, n_groups):
     }
 
 
-def _finish_blobs(decoded_levels, ccfg, slot_names, as_json):
-    """Shared egress tail: finalize decoded levels and build blobs."""
+def _finish_blobs(decoded_levels, ccfg, slot_names, as_json, sink=None):
+    """Shared egress tail: finalize decoded levels, then either stream
+    columns into a columnar sink (anything with ``write_levels``, e.g.
+    io.sinks.LevelArraysSink — no per-blob Python objects at all) or
+    build reference-format blobs and upsert them into ``sink``.
+
+    Returns the blob dict on the blob path; on the columnar path a
+    small stats dict ``{"egress": "levels", "levels": n, "rows": n}``
+    (materializing 100M blob dicts just to return them would defeat
+    the columnar sink's point).
+    """
+    from heatmap_tpu.utils.trace import get_tracer
+
+    tracer = get_tracer()
     finalized = cascade_mod.finalize_level_arrays(
         decoded_levels, ccfg, slot_names
     )
+    if sink is not None and hasattr(sink, "write_levels"):
+        with tracer.span("egress"):
+            rows = sink.write_levels(finalized)
+        return {"egress": "levels", "levels": len(finalized), "rows": rows}
     blobs = cascade_mod.blobs_from_level_arrays(finalized)
     if as_json:
-        return {k: json.dumps(v) for k, v in blobs.items()}
+        blobs = {k: json.dumps(v) for k, v in blobs.items()}
+    if sink is not None:
+        with tracer.span("egress"):
+            sink.write(blobs.items())
     return blobs
 
 
@@ -542,9 +554,8 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
             vocab,
             config,
             as_json=True,
+            sink=sink,
         )
-    if sink is not None:
-        sink.write(blobs.items())
     return blobs
 
 
@@ -703,9 +714,8 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
             vocab,
             config,
             as_json=True,
+            sink=sink,
         )
-    if sink is not None:
-        sink.write(blobs.items())
     return blobs
 
 
@@ -724,17 +734,17 @@ def run_batch(rows, config: BatchJobConfig | None = None, as_json: bool = False)
     return _run_loaded(data, config, as_json=as_json)
 
 
-def _run_loaded(data, config: BatchJobConfig, as_json: bool):
+def _run_loaded(data, config: BatchJobConfig, as_json: bool, sink=None):
     vocab = UserVocab()
     group_ids = vocab.group_ids(data["user_id"])
     return _run_grouped(
         data["latitude"], data["longitude"], group_ids,
-        data["timestamp"], vocab, config, as_json,
+        data["timestamp"], vocab, config, as_json, sink=sink,
     )
 
 
 def _run_grouped(lat, lon, group_ids, timestamps, vocab,
-                 config: BatchJobConfig, as_json: bool):
+                 config: BatchJobConfig, as_json: bool, sink=None):
     codes, valid = project_detail_codes(lat, lon, config.detail_zoom)
     e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
         codes, valid, group_ids, timestamps, config
@@ -755,4 +765,5 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
         ccfg,
         _slot_names(vocab, ts_vocab, n_groups),
         as_json,
+        sink=sink,
     )

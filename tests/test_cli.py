@@ -143,6 +143,22 @@ class TestEndToEnd:
         assert r2.returncode == 0, r2.stderr
         assert JSONLBlobSink.load(str(out)) == first
 
+    def test_run_arrays_output_spec(self, tmp_path):
+        import json as _json
+
+        out = tmp_path / "cols"
+        r = _run_cli(
+            "run", "--backend", "cpu",
+            "--input", "synthetic:500:2",
+            "--output", f"arrays:{out}",
+            "--detail-zoom", "10", "--min-detail-zoom", "8",
+        )
+        assert r.returncode == 0, r.stderr
+        summary = _json.loads(r.stdout.strip().splitlines()[-1])
+        # detail z10 down to min_detail_zoom+1 = z9: two levels.
+        assert summary["rows"] > 0 and summary["levels"] == 2
+        assert any(f.name.endswith(".npz") for f in out.iterdir())
+
     def test_fast_rejects_non_csv_source(self):
         r = _run_cli("run", "--backend", "cpu", "--fast",
                      "--input", "synthetic:10")

@@ -177,6 +177,25 @@ def test_aggregate_keys_sharded_local_overflow_signal(mesh):
     )
     gu, gs, gn = aggregate_keys_sharded(jnp.asarray(keys), mesh, capacity=5)
     assert int(gn) > 5  # overflow signalled (device 0 dropped key 5)
+    # With capacity covering the global uniques the same data is exact.
+    gu, gs, gn = aggregate_keys_sharded(jnp.asarray(keys), mesh, capacity=6)
+    assert int(gn) == 6
+    np.testing.assert_array_equal(np.asarray(gu[:6]), np.arange(6))
+
+
+def test_aggregate_keys_sharded_local_capacity_exact(mesh):
+    # The knob changes padding, never results.
+    rng = np.random.default_rng(8)
+    keys = rng.integers(0, 100, 8 * 256).astype(np.int32)
+    want_u, want_s, want_n = aggregate_keys(jnp.asarray(keys), capacity=2048)
+    for lc in (100, 256, 4096):
+        gu, gs, gn = aggregate_keys_sharded(
+            jnp.asarray(keys), mesh, capacity=256, local_capacity=lc
+        )
+        n = int(want_n)
+        assert int(gn) == n
+        np.testing.assert_array_equal(np.asarray(gu[:n]), np.asarray(want_u[:n]))
+        np.testing.assert_array_equal(np.asarray(gs[:n]), np.asarray(want_s[:n]))
 
 
 # -- 2D (data x tile) meshes ----------------------------------------------
