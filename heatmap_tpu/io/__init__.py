@@ -10,6 +10,7 @@ from heatmap_tpu.io.sources import (  # noqa: F401
     COLUMNS,
     CassandraConfig,
     CassandraSource,
+    CosmosDBSource,
     CSVSource,
     JSONLSource,
     ParquetSource,

@@ -313,17 +313,10 @@ class CassandraSource(Source):
     def _session(self):
         cfg = self.config
         if not cfg.endpoint:
-            host = os.environ.get(cfg.cosmosdb_host_env)
-            if not host:
-                raise RuntimeError(
-                    "CosmosDB ingest selected (no Cassandra endpoint) but "
-                    f"${cfg.cosmosdb_host_env} is unset "
-                    "(reference heatmap.py:140-146)"
-                )
             raise RuntimeError(
-                "CosmosDB ingest requires the azure-cosmos SDK, which is "
-                "not available in this image; use CSV/JSONL/Parquet "
-                "sources or inject a session_factory"
+                "no Cassandra endpoint configured — the reference selects "
+                "CosmosDB in that case (reference heatmap.py:132,140-146); "
+                "use CosmosDBSource (or the cosmosdb: source spec)"
             )
         if self.session_factory is not None:
             return self.session_factory(), None
@@ -400,6 +393,107 @@ class CassandraSource(Source):
                 cluster.shutdown()
 
 
+@dataclasses.dataclass
+class CosmosDBSource(Source):
+    """CosmosDB ingest — the reference's alternative input path
+    (reference heatmap.py:140-146: env-var host/key, database
+    ``locationsdb``, collection ``locations``, selected when the
+    Cassandra endpoint constant is falsy, heatmap.py:132).
+
+    The Spark connector read the collection as one DataFrame; here the
+    collection is scanned per **partition key range** — CosmosDB's
+    physical shard unit and its analog of Cassandra token ranges — so
+    ingest shards across hosts (``shard_index``/``shard_count``
+    interleave ranges) and a failed range re-reads deterministically
+    (``range_batches``).
+
+    The azure-cosmos SDK is not baked into this image, so a
+    ``client_factory`` must be injected: ``client_factory() ->
+    client`` where ``client.partition_key_range_ids() -> [str]`` (may
+    return ``[None]`` for single-range collections) and
+    ``client.query_items(sql, partition_key_range_id=...) -> iterable
+    of row dicts`` with the reference column names. A thin adapter
+    over an ``azure.cosmos.ContainerProxy`` satisfies this: range ids
+    from ``read_partition_key_ranges``, items from ``query_items``
+    (the SDK pages transparently through its iterator).
+    """
+
+    config: CassandraConfig = dataclasses.field(default_factory=CassandraConfig)
+    client_factory: object = None
+    shard_index: int = 0
+    shard_count: int = 1
+
+    #: The reference reads whole documents; project just the point
+    #: columns (SQL API shape).
+    QUERY = ("SELECT c.latitude, c.longitude, c.user_id, c.source, "
+             "c.timestamp FROM c")
+
+    def __post_init__(self):
+        if self.shard_count < 1 or not (0 <= self.shard_index < self.shard_count):
+            raise ValueError(
+                f"invalid shard assignment: shard_index={self.shard_index} "
+                f"shard_count={self.shard_count} (need 0 <= index < count)"
+            )
+
+    def _client(self):
+        cfg = self.config
+        host = os.environ.get(cfg.cosmosdb_host_env)
+        key = os.environ.get(cfg.cosmosdb_key_env)
+        if self.client_factory is not None:
+            return self.client_factory()
+        if not host or not key:
+            raise RuntimeError(
+                f"CosmosDB ingest needs ${cfg.cosmosdb_host_env} and "
+                f"${cfg.cosmosdb_key_env} (reference heatmap.py:141-142) "
+                "or an injected client_factory"
+            )
+        raise RuntimeError(
+            "CosmosDB ingest requires the azure-cosmos SDK, which is not "
+            "available in this image; inject client_factory=... (see the "
+            "class docstring for the adapter contract) or use "
+            "CSV/JSONL/Parquet sources"
+        )
+
+    def _scan_range(self, client, range_id, cols, batch_size):
+        for row in client.query_items(
+            self.QUERY, partition_key_range_id=range_id
+        ):
+            cols["latitude"].append(float(row["latitude"]))
+            cols["longitude"].append(float(row["longitude"]))
+            cols["user_id"].append(row.get("user_id", ""))
+            cols["source"].append(row.get("source", ""))
+            cols["timestamp"].append(row.get("timestamp"))
+            if len(cols["latitude"]) >= batch_size:
+                yield _finalize(cols)
+                for v in cols.values():
+                    v.clear()
+
+    def my_range_ids(self, client) -> list:
+        ids = list(client.partition_key_range_ids())
+        return [
+            r for i, r in enumerate(ids)
+            if i % self.shard_count == self.shard_index
+        ]
+
+    def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        client = self._client()
+        cols = {k: [] for k in COLUMNS}
+        for range_id in self.my_range_ids(client):
+            yield from self._scan_range(client, range_id, cols, batch_size)
+        if cols["latitude"]:
+            yield _finalize(cols)
+
+    def range_batches(self, range_id,
+                      batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
+        """Re-read exactly one partition key range (deterministic
+        re-execution of a failed ingest shard)."""
+        client = self._client()
+        cols = {k: [] for k in COLUMNS}
+        yield from self._scan_range(client, range_id, cols, batch_size)
+        if cols["latitude"]:
+            yield _finalize(cols)
+
+
 def open_source(spec: str, **kwargs) -> Source:
     """Parse a CLI source spec into a Source.
 
@@ -420,7 +514,13 @@ def open_source(spec: str, **kwargs) -> Source:
         return ParquetSource(rest, **kwargs)
     if kind == "cassandra":
         cfg = CassandraConfig(endpoint=rest or None)
+        if not cfg.endpoint:
+            # The reference picks CosmosDB when the endpoint constant is
+            # falsy (reference heatmap.py:132).
+            return CosmosDBSource(config=cfg, **kwargs)
         return CassandraSource(config=cfg, **kwargs)
+    if kind == "cosmosdb":
+        return CosmosDBSource(**kwargs)
     if kind == "hmpb":
         from heatmap_tpu.io.hmpb import HMPBSource
 

@@ -204,6 +204,74 @@ class TestSources:
         assert "token(device_id, day) <= 5" in q
 
 
+class _FakeCosmosClient:
+    """Fake ContainerProxy adapter: two partition key ranges splitting
+    ROWS by row parity."""
+
+    calls: list = []
+
+    def partition_key_range_ids(self):
+        return ["0", "1"]
+
+    def query_items(self, sql, partition_key_range_id=None):
+        assert sql.startswith("SELECT c.latitude")
+        type(self).calls.append(partition_key_range_id)
+        return iter([
+            dict(r) for i, r in enumerate(ROWS)
+            if str(i % 2) == partition_key_range_id
+        ])
+
+
+class TestCosmosDBSource:
+    def test_reads_all_ranges(self):
+        from heatmap_tpu.io.sources import CosmosDBSource
+
+        src = CosmosDBSource(client_factory=_FakeCosmosClient)
+        (b,) = list(src.batches())
+        assert sorted(b["user_id"]) == ["alice", "bob", "rt-1", "x-9"]
+
+    def test_shards_partition_ranges(self):
+        from heatmap_tpu.io.sources import CosmosDBSource
+
+        seen = []
+        for i in range(2):
+            src = CosmosDBSource(client_factory=_FakeCosmosClient,
+                                 shard_index=i, shard_count=2)
+            for b in src.batches():
+                seen.extend(b["user_id"])
+        assert sorted(seen) == ["alice", "bob", "rt-1", "x-9"]
+
+    def test_range_reread_is_deterministic(self):
+        from heatmap_tpu.io.sources import CosmosDBSource
+
+        src = CosmosDBSource(client_factory=_FakeCosmosClient)
+        got = [u for b in src.range_batches("1") for u in b["user_id"]]
+        assert got == [u for b in src.range_batches("1")
+                       for u in b["user_id"]]
+        assert got == [ROWS[1]["user_id"], ROWS[3]["user_id"]]
+
+    def test_missing_env_raises_helpfully(self, monkeypatch):
+        from heatmap_tpu.io.sources import CosmosDBSource
+
+        monkeypatch.delenv("LOCATIONS_COSMOSDB_HOST", raising=False)
+        with pytest.raises(RuntimeError, match="LOCATIONS_COSMOSDB_HOST"):
+            next(CosmosDBSource().batches())
+
+    def test_open_source_specs_route_to_cosmos(self):
+        from heatmap_tpu.io.sources import CosmosDBSource
+
+        # Falsy cassandra endpoint selects CosmosDB, like the
+        # reference's truthiness test (reference heatmap.py:132).
+        assert isinstance(open_source("cassandra:"), CosmosDBSource)
+        assert isinstance(open_source("cosmosdb:"), CosmosDBSource)
+
+    def test_invalid_shard_assignment_raises(self):
+        from heatmap_tpu.io.sources import CosmosDBSource
+
+        with pytest.raises(ValueError, match="shard"):
+            CosmosDBSource(shard_index=2, shard_count=2)
+
+
 class TestLevelArraysSink:
     def test_columnar_egress_matches_blob_path(self, tmp_path):
         """arrays: sink receives the same information as the blob
