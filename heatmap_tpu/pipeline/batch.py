@@ -150,7 +150,8 @@ def load_columns(batch):
 
 def run_job(source, sink=None, config: BatchJobConfig | None = None,
             batch_size: int = 1 << 20,
-            max_points_in_flight: int | None = None):
+            max_points_in_flight: int | None = None,
+            overlap_ingest: bool = True):
     """Source-to-sink job over columnar batches (the production entry;
     reference batchMain shape with get_rows/write_heatmap_dataframes
     replaced by heatmap_tpu.io sources/sinks, heatmap.py:152-158).
@@ -166,13 +167,17 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     same property the Spark adapter's partition merge relies on
     (spark_adapter.merge_heatmaps). Peak footprint is then
     O(chunk + unique aggregate keys) instead of O(total points).
+    ``overlap_ingest`` double-buffers the bounded path: a prefetch
+    thread parses chunk N+1 while the device cascades chunk N (see
+    _run_job_bounded; identical results, up to 3 chunks resident).
     """
     from heatmap_tpu.utils.trace import get_tracer
 
     config = config or BatchJobConfig()
     if max_points_in_flight is not None:
         return _run_job_bounded(
-            source, sink, config, batch_size, max_points_in_flight
+            source, sink, config, batch_size, max_points_in_flight,
+            overlap_ingest=overlap_ingest,
         )
     tracer = get_tracer()
     lats, lons, users, stamps = [], [], [], []
@@ -198,7 +203,8 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
 
 
 def _run_job_bounded(source, sink, config: BatchJobConfig,
-                     batch_size: int, max_points: int):
+                     batch_size: int, max_points: int,
+                     overlap_ingest: bool = True):
     """Chunked cascade with host-side per-level aggregate merge.
 
     Spark streams partitions through executors (reference
@@ -209,7 +215,19 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     across chunks so ids stay consistent; slot packing is re-derived
     from the FINAL vocab sizes at egress (per-chunk packing uses the
     chunk-local group count, which decode inverts exactly).
+
+    ``overlap_ingest`` (the PP analog of SURVEY.md §2.3: the reference
+    ran zoom stages strictly sequentially): a producer thread parses /
+    group-routes the NEXT chunk while the device runs the cascade on
+    the current one, double-buffered through a depth-1 queue. Chunk
+    order — and therefore every vocab id and merge result — is
+    identical to the sequential path; peak footprint grows to at most
+    3 chunks (building + queued + in-cascade). Set False for the
+    strict 1-chunk memory bound.
     """
+    import queue as queue_mod
+    import threading
+
     from heatmap_tpu.utils.trace import get_tracer
 
     if max_points < 1:
@@ -224,19 +242,46 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
         "code": np.empty(0, np.int64), "value": np.empty(0, np.float64),
     }
     merged = [dict(empty) for _ in range(n_levels)]
-    lats, lons, gids, stamps = [], [], [], []
-    pending = 0
 
-    def flush():
-        nonlocal pending
-        if pending == 0:
-            return
-        lat = np.concatenate(lats)
-        lon = np.concatenate(lons)
-        group_ids = np.concatenate(gids).astype(np.int32)
-        flat_stamps = [s for chunk in stamps for s in chunk]
-        lats.clear(); lons.clear(); gids.clear(); stamps.clear()
+    def chunks():
+        """Sequential chunk builder: ingest batches, cut at max_points."""
+        lats, lons, gids, stamps = [], [], [], []
         pending = 0
+
+        def cut():
+            nonlocal pending
+            chunk = (
+                np.concatenate(lats),
+                np.concatenate(lons),
+                np.concatenate(gids).astype(np.int32),
+                [s for b in stamps for s in b],
+            )
+            lats.clear(); lons.clear(); gids.clear(); stamps.clear()
+            pending = 0
+            return chunk
+
+        for batch in source.batches(min(batch_size, max_points)):
+            with tracer.span("ingest.batch"):
+                cols = load_columns(batch)
+                m = len(cols["latitude"])
+                # Cut BEFORE appending when the batch would overshoot,
+                # so a chunk never exceeds max_points (batches are read
+                # at most max_points long).
+                if pending and pending + m > max_points:
+                    yield cut()
+                lats.append(cols["latitude"])
+                lons.append(cols["longitude"])
+                gids.append(vocab.group_ids(cols["user_id"]))
+                stamps.append(cols["timestamp"])
+                pending += m
+            tracer.add_items("ingest.batch", m)
+            if pending >= max_points:
+                yield cut()
+        if pending:
+            yield cut()
+
+    def process(chunk):
+        lat, lon, group_ids, flat_stamps = chunk
         with tracer.span("cascade.chunk", items=len(lat)):
             codes, valid = project_detail_codes(lat, lon, config.detail_zoom)
             e_codes, e_slots, e_valid, _, n_groups = build_emissions(
@@ -256,24 +301,52 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                     lvl["code"], lvl["value"],
                 )
 
-    for batch in source.batches(min(batch_size, max_points)):
-        with tracer.span("ingest.batch"):
-            cols = load_columns(batch)
-            m = len(cols["latitude"])
-            # Flush BEFORE appending when the batch would overshoot, so
-            # a chunk never exceeds max_points (batches are read at
-            # most max_points long).
-            if pending and pending + m > max_points:
-                flush()
-            lats.append(cols["latitude"])
-            lons.append(cols["longitude"])
-            gids.append(vocab.group_ids(cols["user_id"]))
-            stamps.append(cols["timestamp"])
-            pending += m
-        tracer.add_items("ingest.batch", m)
-        if pending >= max_points:
-            flush()
-    flush()
+    if not overlap_ingest:
+        for chunk in chunks():
+            process(chunk)
+    else:
+        # Double-buffer: the producer thread builds chunk N+1 (source
+        # IO, parsing, group routing — pure host work, no JAX) while
+        # this thread runs chunk N's device cascade + merge.
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=1)
+        stop = threading.Event()
+        DONE = object()
+        errors: list = []
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for chunk in chunks():
+                    if not put(chunk):
+                        return
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                errors.append(e)
+            finally:
+                put(DONE)
+
+        t = threading.Thread(target=producer, name="ingest-prefetch",
+                             daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                process(item)
+        finally:
+            stop.set()
+            t.join()
+        if errors:
+            raise errors[0]
+
     if all(len(m["code"]) == 0 for m in merged):
         return {}
 

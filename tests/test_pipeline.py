@@ -228,6 +228,35 @@ def test_run_job_bounded_matches_unbounded(amplify):
         max_points_in_flight=150,
     )
     assert plain == bounded
+    # The sequential (no-prefetch-thread) path is byte-identical too.
+    sequential = run_job(
+        _ColSource(rows), config=cfg, batch_size=128,
+        max_points_in_flight=150, overlap_ingest=False,
+    )
+    assert plain == sequential
+
+
+def test_run_job_bounded_propagates_ingest_errors():
+    """A source failure in the prefetch thread must surface as the
+    job's exception, not a hang or a silent partial result."""
+    from heatmap_tpu.pipeline import run_job
+
+    class ExplodingSource:
+        def batches(self, batch_size):
+            rows = _rows(n=400, seed=3)
+            yield {
+                "latitude": np.asarray([r["latitude"] for r in rows]),
+                "longitude": np.asarray([r["longitude"] for r in rows]),
+                "user_id": [r["user_id"] for r in rows],
+                "timestamp": [r.get("timestamp") for r in rows],
+                "source": [r.get("source", "gps") for r in rows],
+            }
+            raise OSError("disk vanished mid-scan")
+
+    with pytest.raises(OSError, match="disk vanished"):
+        run_job(ExplodingSource(), config=BatchJobConfig(detail_zoom=10,
+                                                         min_detail_zoom=7),
+                batch_size=100, max_points_in_flight=120)
 
 
 def test_run_job_bounded_device_arrays_stay_small(monkeypatch):
