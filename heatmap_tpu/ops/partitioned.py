@@ -16,9 +16,9 @@ cells). This module restores MXU locality for big windows:
 4. a Pallas kernel walks the good chunks with a scalar-prefetched
    output-block index per chunk (bases are monotone by construction,
    so each output block's visits are consecutive): each chunk becomes
-   a 256-ish x 256-ish one-hot matmul into its block — the same
-   MXU formulation as the small-window kernel, but against a 2^16-cell
-   block instead of the whole raster;
+   a side x side one-hot matmul into its block — the same MXU
+   formulation as the small-window kernel, but against one aligned
+   ``block_cells``-cell block instead of the whole raster;
 5. the bad-chunk tail (sparse fringes, block-straddlers, padding) goes
    through the ordinary scatter, but over a bounded suffix (1/8 of the
    points by default) instead of the full stream;
@@ -43,14 +43,18 @@ from jax.experimental import pallas as pl
 from heatmap_tpu.ops.histogram import Window
 
 DEFAULT_CHUNK = 1024
-#: Cells per aligned output block: 2^16 = a 256x256 one-hot factor pair,
-#: the measured flat-rate regime of the MXU histogram kernel.
-BLOCK_CELLS = 1 << 16
-_BLK_SIDE = 1 << 8  # sqrt(BLOCK_CELLS): rows/cols of the local factor
+#: Cells per aligned output block (a side x side one-hot factor pair).
+#: Smaller blocks cut the per-point one-hot construction (VPU, 2*side
+#: compares+casts per point) and the MXU MACs quadratically, at the
+#: price of a lower good-chunk rate on dispersed data (a chunk must
+#: land inside ONE aligned block). 2^16 = 256x256 is the round-1
+#: measured default; sweep block_cells on-chip before changing it.
+DEFAULT_BLOCK_CELLS = 1 << 16
 
 
 def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
-                      zeros_ref, out_ref, acc_ref, *, chunk):
+                      zeros_ref, out_ref, acc_ref, *, chunk, block_cells,
+                      side):
     # This backend is count-only (histogram.py routes weighted binning
     # to xla/pallas); zeros_ref only alias-inits the output.
     del zeros_ref
@@ -60,13 +64,13 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    local = s_ref[0, 0, :] - base_ref[i] * BLOCK_CELLS
-    ok = (good_ref[i] == 1) & (local >= 0) & (local < BLOCK_CELLS)
-    rloc = jnp.where(ok, local // _BLK_SIDE, -1)
-    cloc = jnp.where(ok, local % _BLK_SIDE, 0)
+    local = s_ref[0, 0, :] - base_ref[i] * block_cells
+    ok = (good_ref[i] == 1) & (local >= 0) & (local < block_cells)
+    rloc = jnp.where(ok, local // side, -1)
+    cloc = jnp.where(ok, local % side, 0)
 
-    r_ids = lax.broadcasted_iota(jnp.int32, (_BLK_SIDE, chunk), 0)
-    c_ids = lax.broadcasted_iota(jnp.int32, (chunk, _BLK_SIDE), 1)
+    r_ids = lax.broadcasted_iota(jnp.int32, (side, chunk), 0)
+    c_ids = lax.broadcasted_iota(jnp.int32, (chunk, side), 1)
     row_onehot = (r_ids == rloc[None, :]).astype(jnp.bfloat16)
     col_onehot = (c_ids == cloc[:, None]).astype(jnp.bfloat16)
     acc_ref[0] += jnp.dot(
@@ -79,14 +83,14 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
 
 
 def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
-                      bad_cap_chunks, interpret):
+                      bad_cap_chunks, interpret, block_cells, side):
     """Good chunks -> pallas blocks; bad tail -> bounded scatter.
 
     ``good`` is the per-chunk mask computed by the caller — the SAME
     mask that sized the bounded tail via n_bad, so the tail provably
     covers every chunk this path masks out.
     """
-    fblk = s[::chunk] // BLOCK_CELLS
+    fblk = s[::chunk] // block_cells
 
     # Stable reorder keeps sorted order within each class, so good-chunk
     # block bases stay monotone non-decreasing.
@@ -122,26 +126,27 @@ def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
             # by Mosaic (sublane 1 neither 8-divisible nor full).
             pl.BlockSpec((1, 1, chunk), lambda i, *_: (i, 0, 0)),
             pl.BlockSpec(
-                (1, _BLK_SIDE, _BLK_SIDE),
+                (1, side, side),
                 lambda i, base, *_: (base[i], 0, 0),
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, _BLK_SIDE, _BLK_SIDE), lambda i, base, *_: (base[i], 0, 0)
+            (1, side, side), lambda i, base, *_: (base[i], 0, 0)
         ),
-        scratch_shapes=[pltpu.VMEM((1, _BLK_SIDE, _BLK_SIDE), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((1, side, side), jnp.float32)],
     )
-    zeros = jnp.zeros((n_blocks, _BLK_SIDE, _BLK_SIDE), jnp.float32)
+    zeros = jnp.zeros((n_blocks, side, side), jnp.float32)
     blocks = pl.pallas_call(
-        functools.partial(_partition_kernel, chunk=chunk),
+        functools.partial(_partition_kernel, chunk=chunk,
+                          block_cells=block_cells, side=side),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (n_blocks, _BLK_SIDE, _BLK_SIDE), jnp.float32
+            (n_blocks, side, side), jnp.float32
         ),
         input_output_aliases={5: 0},  # zeros operand -> output
         interpret=interpret,
     )(base, gi, first_visit, last_visit, s2.reshape(n_chunks, 1, chunk), zeros)
-    dense = blocks.reshape(n_blocks * BLOCK_CELLS)[:hw]
+    dense = blocks.reshape(n_blocks * block_cells)[:hw]
 
     # Bounded scatter over the bad tail; already-counted good chunks in
     # the suffix get weight 0, sentinel/out-of-range cells drop.
@@ -164,6 +169,7 @@ def bin_rowcol_window_partitioned(
     bad_frac: int = 8,
     interpret: bool | None = None,
     dtype=jnp.int32,
+    block_cells: int = DEFAULT_BLOCK_CELLS,
 ):
     """Count-only binning of pre-projected points into a large window.
 
@@ -172,19 +178,23 @@ def bin_rowcol_window_partitioned(
     is sized n/bad_frac points; distributions badder than that fall
     back to the full scatter inside the same jit (lax.cond).
     ``interpret`` defaults to True on CPU (pallas has no compiled CPU
-    lowering), False on accelerators.
+    lowering), False on accelerators. ``block_cells`` sets the aligned
+    output-block size (must be an even power of two >= 2^12 so the
+    side is a lane-friendly square; see DEFAULT_BLOCK_CELLS).
     """
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     return _bin_partitioned_jit(
         row, col, window, valid, chunk=chunk, bad_frac=bad_frac,
-        interpret=interpret, dtype=dtype,
+        interpret=interpret, dtype=dtype, block_cells=block_cells,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("window", "chunk", "bad_frac", "interpret", "dtype"),
+    static_argnames=(
+        "window", "chunk", "bad_frac", "interpret", "dtype", "block_cells"
+    ),
 )
 def _bin_partitioned_jit(
     row,
@@ -195,13 +205,20 @@ def _bin_partitioned_jit(
     bad_frac: int = 8,
     interpret: bool = False,
     dtype=jnp.int32,
+    block_cells: int = DEFAULT_BLOCK_CELLS,
 ):
     h, w = window.height, window.width
     hw = h * w
     if hw >= (1 << 31):
         raise ValueError(f"window too large for int32 cell ids: {window}")
-    n_blocks = -(-hw // BLOCK_CELLS)
-    sentinel = n_blocks * BLOCK_CELLS  # beyond every block, drops everywhere
+    side = 1 << (block_cells.bit_length() // 2)
+    if side * side != block_cells or side < 64:
+        raise ValueError(
+            f"block_cells must be an even power of two >= 4096 "
+            f"(a square side of >= 64 lanes), got {block_cells}"
+        )
+    n_blocks = -(-hw // block_cells)
+    sentinel = n_blocks * block_cells  # beyond every block, drops everywhere
 
     r = jnp.asarray(row, jnp.int32) - window.row0
     c = jnp.asarray(col, jnp.int32) - window.col0
@@ -226,14 +243,14 @@ def _bin_partitioned_jit(
     # the cond below guarantees they fit.
     first = s[::chunk]
     last = s[chunk - 1 :: chunk]
-    good = (first // BLOCK_CELLS == last // BLOCK_CELLS) & (last < sentinel)
+    good = (first // block_cells == last // block_cells) & (last < sentinel)
     n_bad = (~good).sum()
 
     raster = lax.cond(
         n_bad <= bad_cap_chunks,
         lambda s_, good_: _partitioned_path(
             s_, good_, n_chunks, n_blocks, hw, chunk, bad_cap_chunks,
-            interpret,
+            interpret, block_cells, side,
         ),
         lambda s_, good_: jnp.zeros(hw, jnp.int32).at[s_].add(1, mode="drop"),
         s,

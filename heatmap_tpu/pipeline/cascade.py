@@ -232,50 +232,69 @@ def _tile_id_strings(zoom, rows, cols):
     )
 
 
+def _sorted_lookup(sorted_keys, sorted_vals, queries):
+    """Value per query from a sorted (keys, vals) table; 0.0 on miss."""
+    if len(sorted_keys) == 0 or len(queries) == 0:
+        return np.zeros(len(queries), np.float64)
+    pos = np.clip(np.searchsorted(sorted_keys, queries), 0,
+                  len(sorted_keys) - 1)
+    return np.where(sorted_keys[pos] == queries, sorted_vals[pos], 0.0)
+
+
 def _patch_amplified(levels, slot_names):
     """In-place 'all' amplification (SURVEY.md §8.1 recurrence):
 
     A_0 = all_0 (correct);  A_L = 2 * rollup(A_{L-1}) + sum_users user_L.
-    Per-user counts untouched, exactly as in the reference.
+    Per-user counts untouched, exactly as in the reference. Fully
+    vectorized: every step works on packed ``(slot << code_bits) |
+    code`` int64 keys (sorted, since level arrays arrive in ascending
+    composite-key order) via unique/bincount folds and searchsorted
+    lookups — no per-aggregate Python.
     """
     is_all_slot = np.array(
         [slot_names.get(s, ("?",))[0] == "all" for s in range(max(slot_names) + 1)]
     )
-    prev: dict = {}
+    prev_s = prev_c = np.empty(0, np.int64)
+    prev_v = np.empty(0, np.float64)
     for level, lvl in enumerate(levels):
-        all_mask = is_all_slot[lvl["slot"]]
-        cur: dict = {}
+        slots = np.asarray(lvl["slot"], np.int64)
+        codes = np.asarray(lvl["code"], np.int64)
+        vals = np.asarray(lvl["value"], np.float64)
+        cb = 2 * lvl["zoom"]  # codes at this level are < 4**zoom
+        all_mask = (
+            is_all_slot[slots] if len(slots) else np.zeros(0, bool)
+        )
+        a_s, a_c = slots[all_mask], codes[all_mask]
         if level == 0:
-            for s, code, v in zip(
-                lvl["slot"][all_mask], lvl["code"][all_mask], lvl["value"][all_mask]
-            ):
-                cur[(int(s), int(code))] = v
+            new_all = vals[all_mask]
         else:
-            rolled: dict = {}
-            for (s, code), v in prev.items():
-                pk = (s, code >> 2)
-                rolled[pk] = rolled.get(pk, 0.0) + v
-            # sum over non-all slots sharing the same timespan: non-all
-            # slots at this level map to the all-slot of their timespan
-            # via slot - group (slot = ts*G + g, all has g = 0).
-            user_total: dict = {}
-            ts_base = _all_slot_of(lvl["slot"], is_all_slot)
+            # rollup(A_{L-1}): parent key folds the 4 children.
+            rk = (prev_s << cb) | (prev_c >> 2)
+            ruk, rinv = np.unique(rk, return_inverse=True)
+            rv = (
+                np.bincount(rinv, weights=prev_v)
+                if len(rk) else np.empty(0, np.float64)
+            )
+            # sum over non-all slots, keyed by the all-slot of their
+            # timespan (slot = ts*G + g with g=0 the all group).
             um = ~all_mask
-            for s, code, v in zip(ts_base[um], lvl["code"][um], lvl["value"][um]):
-                k = (int(s), int(code))
-                user_total[k] = user_total.get(k, 0.0) + v
-            for s, code in zip(lvl["slot"][all_mask], lvl["code"][all_mask]):
-                k = (int(s), int(code))
-                cur[k] = 2.0 * rolled.get(k, 0.0) + user_total.get(k, 0.0)
-        # Patch the level's 'all' values in place.
-        patched = np.array(
-            [
-                cur.get((int(s), int(code)), v)
-                for s, code, v in zip(lvl["slot"], lvl["code"], lvl["value"])
-            ]
-        ) if len(lvl["slot"]) else lvl["value"]
-        lvl["value"] = np.where(all_mask, patched, lvl["value"]) if len(lvl["slot"]) else lvl["value"]
-        prev = cur
+            if um.any():
+                utk = (_all_slot_of(slots[um], is_all_slot) << cb) | codes[um]
+                uuk, uinv = np.unique(utk, return_inverse=True)
+                uv = np.bincount(uinv, weights=vals[um])
+            else:
+                uuk = np.empty(0, np.int64)
+                uv = np.empty(0, np.float64)
+            ak = (a_s << cb) | a_c
+            new_all = (
+                2.0 * _sorted_lookup(ruk, rv, ak)
+                + _sorted_lookup(uuk, uv, ak)
+            )
+        if len(slots):
+            patched = vals.copy()
+            patched[all_mask] = new_all
+            lvl["value"] = patched
+        prev_s, prev_c, prev_v = a_s, a_c, new_all
 
 
 def _all_slot_of(slots, is_all_slot):

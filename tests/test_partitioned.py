@@ -37,6 +37,33 @@ def test_clustered_mostly_good_chunks():
     assert _diff(row, col).sum() > 0
 
 
+@pytest.mark.parametrize("block_cells", [1 << 12, 1 << 14, 1 << 16])
+def test_block_cells_sweep_bit_exact(block_cells):
+    """Every supported block size (64/128/256 side) is bit-exact,
+    including block-boundary straddles at that size's alignment."""
+    rng = np.random.default_rng(6)
+    n = 1 << 14
+    row = np.concatenate([
+        rng.integers(520, 560, n // 2),
+        # dense run straddling this block size's boundary
+        np.full(n // 2, 512 + (block_cells // WINDOW.width)),
+    ])
+    col = rng.integers(300, 500, n)
+    _diff(row, col, block_cells=block_cells)
+
+
+def test_bad_block_cells_rejected():
+    rng = np.random.default_rng(7)
+    row = rng.integers(520, 560, 256)
+    col = rng.integers(300, 340, 256)
+    for bad in (1 << 13, 100, 1 << 10):
+        with pytest.raises(ValueError, match="block_cells"):
+            bin_rowcol_window_partitioned(
+                jnp.asarray(row, jnp.int32), jnp.asarray(col, jnp.int32),
+                WINDOW, interpret=True, block_cells=bad,
+            )
+
+
 def test_uniform_triggers_fallback():
     """Uniform over the window makes most chunks straddle blocks; the
     cond fallback must still be bit-exact."""
