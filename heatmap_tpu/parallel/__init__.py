@@ -37,5 +37,6 @@ from heatmap_tpu.parallel.multihost import (  # noqa: F401
     make_hybrid_mesh,
     process_shard_bounds,
     run_job_multihost,
+    shard_source,
     shard_source_rows,
 )

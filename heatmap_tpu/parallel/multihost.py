@@ -200,6 +200,34 @@ def _merge_blob_values(a, b):
     return b
 
 
+def shard_source(source, process_count: int | None = None,
+                 process_index: int | None = None):
+    """This process's view of a range-shardable source.
+
+    Sources that expose ``shard_index``/``shard_count`` dataclass
+    fields (CassandraSource token ranges, CosmosDBSource partition key
+    ranges) re-instantiate with this process's interleaved assignment —
+    the real connector-style input-split sharding, no row counting
+    needed. Returns None for sources without native sharding (callers
+    fall back to row slicing).
+    """
+    import dataclasses
+
+    if not (dataclasses.is_dataclass(source)
+            and hasattr(source, "shard_index")
+            and hasattr(source, "shard_count")):
+        return None
+    k = jax.process_count() if process_count is None else process_count
+    i = jax.process_index() if process_index is None else process_index
+    if source.shard_count != 1:
+        raise ValueError(
+            "source already carries a shard assignment "
+            f"(shard {source.shard_index}/{source.shard_count}); pass an "
+            "unsharded source to run_job_multihost"
+        )
+    return dataclasses.replace(source, shard_index=i, shard_count=k)
+
+
 def run_job_multihost(source, sink=None, config=None,
                       batch_size: int = 1 << 20,
                       n_total: int | None = None):
@@ -207,10 +235,13 @@ def run_job_multihost(source, sink=None, config=None,
     source, aggregates on its local devices, and the blob dicts merge
     over DCN at the end (only process 0 writes the sink).
 
-    ``n_total`` (total source rows) enables exact batch-count sharding;
-    without it, single-process falls through to run_job and
-    multi-process raises (sources must declare their size to shard —
-    SyntheticSource has ``n``; files can be pre-counted).
+    Range-shardable sources (``shard_index``/``shard_count`` fields —
+    Cassandra token ranges, CosmosDB partition key ranges) shard by
+    range assignment via :func:`shard_source`. Otherwise ``n_total``
+    (total source rows) enables exact batch-count sharding; without
+    it, single-process falls through to run_job and multi-process
+    raises (sources must declare their size to shard — SyntheticSource
+    has ``n``; files can be pre-counted).
     """
     from heatmap_tpu.pipeline import BatchJobConfig, run_job
     from heatmap_tpu.pipeline.batch import _run_loaded, load_columns
@@ -218,15 +249,21 @@ def run_job_multihost(source, sink=None, config=None,
     config = config or BatchJobConfig()
     if jax.process_count() == 1:
         return run_job(source, sink, config, batch_size=batch_size)
-    if n_total is None:
-        n_total = getattr(source, "n", None)
+    sharded = shard_source(source)
+    if sharded is not None:
+        batches = sharded.batches(batch_size)
+    else:
         if n_total is None:
-            raise ValueError(
-                "multi-host sharding needs n_total (source row count)"
-            )
+            n_total = getattr(source, "n", None)
+            if n_total is None:
+                raise ValueError(
+                    "multi-host sharding needs n_total (source row count) "
+                    "or a range-shardable source"
+                )
+        batches = shard_source_rows(source.batches(batch_size), n_total,
+                                    batch_size)
     lats, lons, users, stamps = [], [], [], []
-    for batch in shard_source_rows(source.batches(batch_size), n_total,
-                                   batch_size):
+    for batch in batches:
         cols = load_columns(batch)
         lats.append(cols["latitude"])
         lons.append(cols["longitude"])

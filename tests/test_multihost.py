@@ -39,6 +39,30 @@ def test_process_shard_bounds_validates():
         process_shard_bounds(10, 4, 4)
 
 
+def test_shard_source_range_shardable():
+    """Range-shardable sources (Cassandra/Cosmos) get this process's
+    interleaved assignment instead of row slicing."""
+    from heatmap_tpu.io.sources import CassandraSource
+    from heatmap_tpu.parallel.multihost import shard_source
+
+    src = CassandraSource()
+    mine = shard_source(src, process_count=4, process_index=2)
+    assert (mine.shard_index, mine.shard_count) == (2, 4)
+    assert (src.shard_index, src.shard_count) == (0, 1)  # untouched
+    owned = [i for i, _ in mine.my_ranges()]
+    assert owned == list(range(2, src.config.n_ranges, 4))
+    # Pre-sharded sources are a configuration error, not silent data loss.
+    with pytest.raises(ValueError, match="already carries"):
+        shard_source(mine, process_count=4, process_index=0)
+
+
+def test_shard_source_returns_none_for_plain_sources():
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.parallel.multihost import shard_source
+
+    assert shard_source(SyntheticSource(n=10), 2, 0) is None
+
+
 def test_shard_source_rows_covers_exactly():
     batches = [np.full(10, i) for i in range(7)]
     seen = []
