@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""On-chip sweep of the sort-partitioned binning kernel's tunables.
+
+Sweeps block_cells (output-block size: VPU/MXU cost vs good-chunk rate)
+and chunk (points per grid step) on the headline bench workload, plus
+the XLA scatter reference. One JSON line per configuration. Run on the
+real chip; see PERF_NOTES.md for recorded results.
+
+    python tools/sweep_partitioned.py [--n 25] [--steps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=25, help="log2 point count")
+    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--zoom", type=int, default=15)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from heatmap_tpu.ops import window_from_bounds
+    from heatmap_tpu.ops.histogram import bin_rowcol_window
+    from heatmap_tpu.ops.partitioned import bin_rowcol_window_partitioned
+    from heatmap_tpu.tilemath import mercator
+
+    win = window_from_bounds((44.0, 51.0), (-127.0, -117.0), zoom=args.zoom,
+                             align_levels=min(12, args.zoom),
+                             pad_multiple=256)
+    n = 1 << args.n
+    rng = np.random.default_rng(0)
+    n_hot = n // 4
+    lat = np.concatenate([47.6 + rng.normal(0, 0.5, n - n_hot),
+                          47.6 + rng.normal(0, 0.02, n_hot)]).astype(np.float32)
+    lon = np.concatenate([-122.3 + rng.normal(0, 0.7, n - n_hot),
+                          -122.3 + rng.normal(0, 0.03, n_hot)]).astype(np.float32)
+    dla, dlo = jax.device_put(jnp.asarray(lat)), jax.device_put(jnp.asarray(lon))
+
+    def timed(f):
+        out = f(dla, dlo)
+        int(out.ravel()[0])  # scalar sync through the relay
+        t0 = time.perf_counter()
+        for _ in range(args.steps):
+            out = f(dla, dlo)
+            int(out.ravel()[0])
+        return (time.perf_counter() - t0) / args.steps
+
+    def report(name, dt, **extra):
+        print(json.dumps({
+            "config": name, "ms": round(dt * 1e3, 1),
+            "mpts_per_s": round(n / dt / 1e6, 1), **extra,
+        }), flush=True)
+
+    @jax.jit
+    def xla(la, lo):
+        r, c, v = mercator.project_points(la, lo, win.zoom, dtype=jnp.float32)
+        return bin_rowcol_window(r, c, win, valid=v)
+
+    report("xla-scatter", timed(xla))
+
+    combos = [
+        # (block_cells, chunk, bad_frac): block size sweep at the
+        # defaults, chunk sweep at the best-guess block, tail-cap sweep
+        # (the n/bad_frac scatter tail costs ~8-30 ns/update).
+        (1 << 16, 1024, 8),
+        (1 << 14, 1024, 8),
+        (1 << 12, 1024, 8),
+        (1 << 14, 512, 8),
+        (1 << 14, 2048, 8),
+        (1 << 16, 1024, 32),
+        (1 << 14, 1024, 32),
+        (1 << 14, 1024, 128),
+    ]
+    for block_cells, chunk, bad_frac in combos:
+
+        @jax.jit
+        def part(la, lo, bc=block_cells, ck=chunk, bf=bad_frac):
+            r, c, v = mercator.project_points(la, lo, win.zoom,
+                                              dtype=jnp.float32)
+            return bin_rowcol_window_partitioned(
+                r, c, win, valid=v, block_cells=bc, chunk=ck, bad_frac=bf,
+            )
+
+        name = f"partitioned bc={block_cells} chunk={chunk} bf={bad_frac}"
+        try:
+            report(name, timed(part), block_cells=block_cells,
+                   chunk=chunk, bad_frac=bad_frac)
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            print(json.dumps({
+                "config": name,
+                "error": f"{type(e).__name__}: {e}"[:200],
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
