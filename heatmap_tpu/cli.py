@@ -95,6 +95,11 @@ def _add_run_flags(p):
     p.add_argument("--profile", default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace into LOGDIR and "
                    "print the span/throughput report to stderr")
+    p.add_argument("--multihost", action="store_true",
+                   help="SPMD multi-host job: jax.distributed init, "
+                   "per-process ingest shard (connector ranges or batch "
+                   "slices), DCN blob merge, process 0 writes the sink; "
+                   "single-process falls through to the plain job")
 
 
 def cmd_run(args) -> int:
@@ -130,6 +135,11 @@ def cmd_run(args) -> int:
     if args.max_points_in_flight is not None and (args.fast or args.checkpoint_dir):
         raise SystemExit("--max-points-in-flight applies to the standard "
                          "run path only (not --fast / --checkpoint-dir)")
+    if args.multihost and (args.fast or args.checkpoint_dir
+                           or args.max_points_in_flight is not None):
+        raise SystemExit("--multihost runs the standard job path only "
+                         "(not --fast / --checkpoint-dir / "
+                         "--max-points-in-flight)")
     fast_source = None
     if args.fast:
         # Resolve through open_source so bare paths and prefixed specs
@@ -161,6 +171,13 @@ def cmd_run(args) -> int:
                     config, batch_size=args.batch_size,
                     checkpoint_every=args.checkpoint_every,
                 )
+            elif args.multihost:
+                from heatmap_tpu.parallel import initialize, run_job_multihost
+
+                initialize()
+                blobs = run_job_multihost(open_source(args.input), sink,
+                                          config,
+                                          batch_size=args.batch_size)
             else:
                 blobs = run_job(open_source(args.input), sink, config,
                                 batch_size=args.batch_size,

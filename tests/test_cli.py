@@ -159,6 +159,22 @@ class TestEndToEnd:
         assert summary["rows"] > 0 and summary["levels"] == 2
         assert any(f.name.endswith(".npz") for f in out.iterdir())
 
+    def test_multihost_single_process_falls_through(self, tmp_path):
+        import json as _json
+
+        out = tmp_path / "mh.jsonl"
+        r = _run_cli(
+            "run", "--backend", "cpu", "--multihost",
+            "--input", "synthetic:500:2",
+            "--output", f"jsonl:{out}",
+            "--detail-zoom", "10", "--min-detail-zoom", "8",
+        )
+        assert r.returncode == 0, r.stderr
+        assert _json.loads(r.stdout.strip().splitlines()[-1])["blobs"] > 0
+        r = _run_cli("run", "--backend", "cpu", "--multihost", "--fast",
+                     "--input", "csv:x.csv")
+        assert r.returncode != 0 and "standard job path" in r.stderr
+
     def test_fast_rejects_non_csv_source(self):
         r = _run_cli("run", "--backend", "cpu", "--fast",
                      "--input", "synthetic:10")
