@@ -236,7 +236,9 @@ def _bin_partitioned_jit(
     n_chunks = n_pad // chunk
     bad_cap_chunks = max(1, n_chunks // bad_frac)
 
-    s = jnp.sort(idx)
+    # Unstable sort: cell ids are the only payload, so equal keys are
+    # indistinguishable and stability would only cost time.
+    s = jnp.sort(idx, stable=False)
     # The single source of truth for chunk goodness: fully inside one
     # aligned block AND free of sentinels. The bounded tail in
     # _partitioned_path covers exactly the chunks this marks bad, and

@@ -67,6 +67,21 @@ def main():
 
     report("xla-scatter", timed(xla))
 
+    # Sort cost in isolation, stable vs unstable (the idx sort needs no
+    # stability: duplicate cell ids are indistinguishable).
+    from jax import lax
+
+    for stable in (True, False):
+
+        @jax.jit
+        def sort_only(la, lo, st=stable):
+            r, c, v = mercator.project_points(la, lo, win.zoom,
+                                              dtype=jnp.float32)
+            idx = jnp.where(v, r * win.width + c, win.height * win.width)
+            return lax.sort(idx, is_stable=st)
+
+        report(f"sort-only stable={stable}", timed(sort_only))
+
     combos = [
         # (block_cells, chunk, bad_frac): block size sweep at the
         # defaults, chunk sweep at the best-guess block, tail-cap sweep
