@@ -63,7 +63,10 @@ def aggregate_keys(keys, weights=None, valid=None, capacity=None, acc_dtype=None
         keys = jnp.where(valid, keys, sentinel)
         w = jnp.where(valid, w, 0)
 
-    order = jnp.argsort(keys)
+    # Counts (uniform weights) are exact under any summation order, so
+    # the sort can be unstable; float weights keep the stable order so
+    # results are reproducible against host-order oracles bit-for-bit.
+    order = jnp.argsort(keys, stable=weights is not None)
     return aggregate_sorted_keys(
         keys[order], w[order], capacity, sentinel=sentinel
     )
