@@ -11,17 +11,17 @@ cells). This module restores MXU locality for big windows:
 2. cut the sorted stream into fixed chunks; a chunk whose cells all
    land in one aligned ``block_cells`` region is **good** — after
    sorting, that's the common case for clustered GPS data;
-3. stable-reorder whole chunks (a contiguous row gather, not a
-   per-element one) so good chunks come first, bad chunks last;
+3. chunk block ids are non-decreasing in place (the stream is sorted),
+   so no reorder pass is needed — bad chunks are simply masked;
 4. a Pallas kernel walks the good chunks with a scalar-prefetched
    output-block index per chunk (bases are monotone by construction,
    so each output block's visits are consecutive): each chunk becomes
    a side x side one-hot matmul into its block — the same MXU
    formulation as the small-window kernel, but against one aligned
    ``block_cells``-cell block instead of the whole raster;
-5. the bad-chunk tail (sparse fringes, block-straddlers, padding) goes
-   through the ordinary scatter, but over a bounded suffix (1/8 of the
-   points by default) instead of the full stream;
+5. the bad chunks (sparse fringes, block-straddlers, padding) are
+   gathered by row and go through the ordinary scatter, bounded to
+   1/``bad_frac`` of the points instead of the full stream;
 6. if an adversarial distribution makes more than that fraction of
    chunks bad, ``lax.cond`` falls back to the plain full scatter —
    correctness never depends on the data being friendly.
@@ -92,18 +92,14 @@ def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
     """
     fblk = s[::chunk] // block_cells
 
-    # Stable reorder keeps sorted order within each class, so good-chunk
-    # block bases stay monotone non-decreasing.
-    order = jnp.argsort(~good, stable=True)
-    s2 = jnp.take(s.reshape(n_chunks, chunk), order, axis=0)
-    good2 = good[order]
-    fblk2 = fblk[order]
-
-    # Forward-fill bad/disabled chunks with the last good base (cummax
-    # works because good bases are non-decreasing); leading bads clamp
-    # to block 0, fully masked.
-    base = jnp.maximum(lax.cummax(jnp.where(good2, fblk2, -1)), 0)
-    gi = good2.astype(jnp.int32)
+    # The stream is globally sorted, so chunk block ids are ALREADY
+    # non-decreasing in original order — no reorder pass over the 33M
+    # stream is needed. Forward-fill bad chunks with the last good base
+    # (cummax works because good bases are non-decreasing); leading
+    # bads clamp to block 0, fully masked; a bad chunk between two
+    # blocks joins the previous block's visit run and writes nothing.
+    base = jnp.maximum(lax.cummax(jnp.where(good, fblk, -1)), 0)
+    gi = good.astype(jnp.int32)
     first_visit = jnp.concatenate(
         [jnp.ones(1, jnp.int32),
          (base[1:] != base[:-1]).astype(jnp.int32)]
@@ -145,17 +141,24 @@ def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
         ),
         input_output_aliases={5: 0},  # zeros operand -> output
         interpret=interpret,
-    )(base, gi, first_visit, last_visit, s2.reshape(n_chunks, 1, chunk), zeros)
+    )(base, gi, first_visit, last_visit, s.reshape(n_chunks, 1, chunk), zeros)
     dense = blocks.reshape(n_blocks * block_cells)[:hw]
 
-    # Bounded scatter over the bad tail; already-counted good chunks in
-    # the suffix get weight 0, sentinel/out-of-range cells drop.
-    suffix = s2[-bad_cap_chunks:].reshape(-1)
-    w = jnp.repeat(
-        (~good2[-bad_cap_chunks:]).astype(jnp.int32), chunk
+    # Bounded scatter over the bad chunks only: gather exactly their
+    # rows (the cond guarantees there are at most bad_cap_chunks of
+    # them, so the fixed-size nonzero captures ALL of them); the
+    # fill rows read as sentinel, and sentinel/out-of-range cells drop
+    # in the scatter, so no weight masking is needed.
+    bad_idx = jnp.nonzero(~good, size=bad_cap_chunks,
+                          fill_value=n_chunks)[0]
+    bad_rows = jnp.take(
+        s.reshape(n_chunks, chunk), bad_idx, axis=0,
+        mode="fill", fill_value=hw,
     )
     tail = (
-        jnp.zeros(hw, jnp.int32).at[suffix].add(w, mode="drop")
+        jnp.zeros(hw, jnp.int32)
+        .at[bad_rows.reshape(-1)]
+        .add(1, mode="drop")
     )
     return dense.astype(jnp.int32) + tail
 
