@@ -92,6 +92,11 @@ class TimespanVocab:
         if timespan_type == "alltime":
             return np.zeros(n, np.int32)
         arr = np.asarray(timestamps)
+        if arr.dtype.kind == "M" and n:
+            # datetime64 columns (Parquet/Arrow): epoch ms. NaT casts
+            # to INT64_MIN == TS_MISSING, so missing values flow into
+            # the sentinel check below for free.
+            arr = arr.astype("datetime64[ms]").astype(np.int64)
         if arr.dtype.kind in "iuf" and n:
             # Missing rows (sentinel / NaN) fail like the object path's
             # timestamp=None does — a dated bucket can't be invented.

@@ -72,6 +72,33 @@ def test_timespan_labels():
         timespan_label("week", d)
 
 
+def test_label_ids_datetime64_column():
+    import numpy as np
+
+    from heatmap_tpu.pipeline.timespan import TimespanVocab
+
+    vocab = TimespanVocab()
+    col = np.asarray(
+        ["2017-03-07T12:30", "2017-03-08T01:00", "2017-03-07T23:59"],
+        dtype="datetime64[m]",
+    )
+    ids = vocab.label_ids("day", col)
+    assert [vocab.label_for(i) for i in ids] == [
+        "2017-03-07", "2017-03-08", "2017-03-07",
+    ]
+    # Matches the per-object path on equivalent epoch-ms ints.
+    ms = col.astype("datetime64[ms]").astype(np.int64)
+    vocab2 = TimespanVocab()
+    ids2 = vocab2.label_ids("day", [int(m) for m in ms])
+    assert [vocab2.label_for(i) for i in ids2] == [
+        vocab.label_for(i) for i in ids
+    ]
+    # NaT == TS_MISSING: missing values raise like timestamp=None.
+    nat = np.asarray(["2017-03-07", "NaT"], dtype="datetime64[s]")
+    with pytest.raises(ValueError, match="timestamp"):
+        TimespanVocab().label_ids("day", nat)
+
+
 # -- golden end-to-end -----------------------------------------------------
 
 
