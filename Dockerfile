@@ -6,11 +6,18 @@
 # Python in heatmap_tpu.io).
 FROM python:3.11-slim
 
+# Toolchain for the native runtime (C++ point codec + staging pool).
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ make \
+    && rm -rf /var/lib/apt/lists/*
+
 # JAX with TPU support; pinned by the deployment, not the framework.
 RUN pip install --no-cache-dir "jax[tpu]" -f \
     https://storage.googleapis.com/jax-releases/libtpu_releases.html
 
 WORKDIR /opt/heatmap
+COPY native ./native
+RUN make -C native
 COPY heatmap_tpu ./heatmap_tpu
 COPY submit-heatmap bench.py ./
 ENV PYTHONPATH=/opt/heatmap
