@@ -53,9 +53,10 @@ def _init_backend(args):
 def _add_run_flags(p):
     p.add_argument("--input", required=True,
                    help="source spec: synthetic:N[:seed] | csv:P | jsonl:P "
-                   "| parquet:P | cassandra:[ENDPOINT]")
+                   "| parquet:P | hmpb:P | cassandra:[ENDPOINT] | cosmosdb:")
     p.add_argument("--output", default="jsonl:heatmaps.jsonl",
-                   help="sink spec: jsonl:P | dir:P | memory:")
+                   help="sink spec: jsonl:P | dir:P | memory: | "
+                   "cassandra: | arrays:DIR (columnar per-level npz)")
     p.add_argument("--detail-zoom", type=int, default=21,
                    help="finest binning zoom (reference MAX_ZOOM_LEVEL + "
                    "DETAIL_ZOOM_DELTA = 21, heatmap.py:16-17,27)")
