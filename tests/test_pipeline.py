@@ -99,6 +99,31 @@ def test_label_ids_datetime64_column():
         TimespanVocab().label_ids("day", nat)
 
 
+def test_project_detail_codes_device_matches_host():
+    """The on-device f64 projection+interleave must agree bit-for-bit
+    with the host numpy path (same IEEE-double op order) at z21,
+    including validity at poles/antimeridian edges."""
+    import numpy as np
+
+    from heatmap_tpu.pipeline.batch import project_detail_codes
+
+    rng = np.random.default_rng(11)
+    lat = np.concatenate([
+        np.clip(rng.normal(40, 30, 20000), -89.9, 89.9),
+        [90.0, -90.0, 85.06, -85.06, 0.0],
+    ])
+    lon = np.concatenate([
+        rng.uniform(-180.0, 180.0, 20000), [180.0, -180.0, 0.0, 1e-9, -1e-9],
+    ])
+    dev_codes, dev_valid = project_detail_codes(lat, lon, 21)
+    host_codes, host_valid = project_detail_codes(
+        lat, lon, 21, prefer_device=False
+    )
+    np.testing.assert_array_equal(dev_valid, host_valid)
+    np.testing.assert_array_equal(dev_codes[dev_valid],
+                                  host_codes[host_valid])
+
+
 # -- golden end-to-end -----------------------------------------------------
 
 
