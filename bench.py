@@ -172,11 +172,17 @@ def main():
     total, _ = step(d_lat, d_lon)
     int(total)
 
-    t0 = time.perf_counter()
+    # Median over per-step times: the axon relay's per-call sync cost
+    # spikes unpredictably (PERF_NOTES.md), and one stalled step must
+    # not halve the round's recorded number.
+    times = []
     for _ in range(args.steps):
+        t0 = time.perf_counter()
         total, raster = step(d_lat, d_lon)
         int(total)
-    dt = (time.perf_counter() - t0) / args.steps
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    dt = times[len(times) // 2]
     pts_per_sec = args.n / dt
 
     # CPU baseline on a smaller sample, scaled linearly.
