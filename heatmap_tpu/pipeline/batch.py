@@ -112,6 +112,21 @@ def _project_codes_jit(lat, lon, zoom):
     return morton.morton_encode(row, col, dtype=jnp.int64, zoom=zoom), valid
 
 
+def _cascade_codes(lat, lon, detail_zoom):
+    """Codes + validity feeding the cascade: DEVICE-RESIDENT under x64
+    (projection through emission assembly to the cascade sort never
+    round-trips the big code column through the host), host numpy
+    otherwise."""
+    if jax.config.jax_enable_x64:
+        import jax.numpy as jnp
+
+        return _project_codes_jit(
+            jnp.asarray(lat, jnp.float64), jnp.asarray(lon, jnp.float64),
+            detail_zoom,
+        )
+    return project_detail_codes(lat, lon, detail_zoom, prefer_device=False)
+
+
 def build_emissions(codes, valid, group_ids, timestamps,
                     config: BatchJobConfig, ts_vocab: TimespanVocab | None = None):
     """Expand points into (code, slot) emissions + slot name table.
@@ -122,6 +137,12 @@ def build_emissions(codes, valid, group_ids, timestamps,
     ``first_timespan_only`` (reference early-return quirk, SURVEY.md
     §8.2) only the first timespan emits. Pass a shared ``ts_vocab`` to
     keep timespan ids consistent across chunked calls.
+
+    ``codes``/``valid`` may be device arrays (the x64 ingest path keeps
+    them device-resident from projection to cascade — no host
+    round-trip of the big code column); slot ids are always built
+    host-side (they come from host vocabs) and upload once with the
+    cascade. ``group_ids`` must be numpy.
     """
     ts_vocab = ts_vocab if ts_vocab is not None else TimespanVocab()
     timespans = (
@@ -129,6 +150,12 @@ def build_emissions(codes, valid, group_ids, timestamps,
     )
     per_ts_ids = [ts_vocab.label_ids(t, timestamps) for t in timespans]
     n_groups = int(group_ids.max(initial=ALL_GROUP)) + 1
+    on_device = not isinstance(codes, np.ndarray)
+    if on_device:
+        import jax.numpy as jnp
+    xp = jnp if on_device else np
+    keep = group_ids != EXCLUDED
+    keep_x = xp.asarray(keep)
     emit_codes, emit_slots, emit_valid = [], [], []
     for ts_ids in per_ts_ids:
         # 'all' emission for every point.
@@ -136,16 +163,15 @@ def build_emissions(codes, valid, group_ids, timestamps,
         emit_slots.append(ts_ids.astype(np.int64) * n_groups + ALL_GROUP)
         emit_valid.append(valid)
         # per-user emission for non-excluded points.
-        keep = group_ids != EXCLUDED
         emit_codes.append(codes)
         emit_slots.append(
             ts_ids.astype(np.int64) * n_groups + np.where(keep, group_ids, 0)
         )
-        emit_valid.append(valid & keep)
+        emit_valid.append(valid & keep_x)
     return (
-        np.concatenate(emit_codes),
+        xp.concatenate(emit_codes),
         np.concatenate(emit_slots),
-        np.concatenate(emit_valid),
+        xp.concatenate(emit_valid),
         ts_vocab,
         n_groups,
     )
@@ -313,7 +339,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     def process(chunk):
         lat, lon, group_ids, flat_stamps = chunk
         with tracer.span("cascade.chunk", items=len(lat)):
-            codes, valid = project_detail_codes(lat, lon, config.detail_zoom)
+            codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
             e_codes, e_slots, e_valid, _, n_groups = build_emissions(
                 codes, valid, group_ids, flat_stamps, config, ts_vocab=ts_vocab
             )
@@ -848,7 +874,7 @@ def _run_loaded(data, config: BatchJobConfig, as_json: bool, sink=None):
 
 def _run_grouped(lat, lon, group_ids, timestamps, vocab,
                  config: BatchJobConfig, as_json: bool, sink=None):
-    codes, valid = project_detail_codes(lat, lon, config.detail_zoom)
+    codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
     e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
         codes, valid, group_ids, timestamps, config
     )
