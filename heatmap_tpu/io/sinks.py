@@ -162,15 +162,22 @@ class LevelArraysSink:
     batched writes. Jobs route here automatically when the sink has
     ``write_levels`` (pipeline.batch._finish_blobs).
 
-    Files are ``level_z{zoom}.npz`` holding row/col/value,
-    user/timespan (unicode), coarse_row/coarse_col and scalar
-    zoom/coarse_zoom; rewrites are atomic (tmp + rename), so reruns
-    upsert whole levels — the columnar analog of upsert-by-id.
+    Files are ``level_z{zoom}.npz`` (or ``.parquet`` with
+    ``format="parquet"`` — pyarrow, one row group, ready for warehouse
+    bulk loads) holding row/col/value, user/timespan (unicode),
+    coarse_row/coarse_col and zoom/coarse_zoom; rewrites are atomic
+    (tmp + rename), so reruns upsert whole levels — the columnar
+    analog of upsert-by-id.
     """
 
     path: str
+    format: str = "npz"
 
     def __post_init__(self):
+        if self.format not in ("npz", "parquet"):
+            raise ValueError(
+                f"format must be 'npz' or 'parquet', got {self.format!r}"
+            )
         os.makedirs(self.path, exist_ok=True)
 
     COLUMNS = ("row", "col", "value", "user", "timespan",
@@ -182,10 +189,23 @@ class LevelArraysSink:
             out = {k: np.asarray(lvl[k]) for k in self.COLUMNS}
             out["zoom"] = np.asarray(lvl["zoom"])
             out["coarse_zoom"] = np.asarray(lvl["coarse_zoom"])
-            final = os.path.join(self.path, f"level_z{lvl['zoom']:02d}.npz")
+            final = os.path.join(
+                self.path, f"level_z{lvl['zoom']:02d}.{self.format}"
+            )
             tmp = final + ".tmp"
-            with open(tmp, "wb") as f:
-                np.savez_compressed(f, **out)
+            if self.format == "parquet":
+                import pyarrow as pa
+                import pyarrow.parquet as pq
+
+                n = len(out["value"])
+                table = pa.table({
+                    k: (np.full(n, v) if v.ndim == 0 else v)
+                    for k, v in out.items()
+                })
+                pq.write_table(table, tmp)
+            else:
+                with open(tmp, "wb") as f:
+                    np.savez_compressed(f, **out)
             os.replace(tmp, final)
             rows += len(out["value"])
         return rows
@@ -210,9 +230,22 @@ class LevelArraysSink:
         """{zoom: dict-of-columns} for every level file in ``path``."""
         out = {}
         for name in sorted(os.listdir(path)):
-            if name.startswith("level_z") and name.endswith(".npz"):
-                with np.load(os.path.join(path, name)) as z:
+            full = os.path.join(path, name)
+            if not name.startswith("level_z"):
+                continue
+            if name.endswith(".npz"):
+                with np.load(full) as z:
                     cols = {k: z[k] for k in z.files}
+                out[int(cols["zoom"])] = cols
+            elif name.endswith(".parquet"):
+                import pyarrow.parquet as pq
+
+                t = pq.read_table(full)
+                cols = {k: np.asarray(t[k]) for k in t.column_names}
+                # Normalize the per-row zoom columns back to scalars so
+                # both formats load identically.
+                for k in ("zoom", "coarse_zoom"):
+                    cols[k] = np.asarray(cols[k][0]) if len(cols[k]) else cols[k]
                 out[int(cols["zoom"])] = cols
         return out
 
@@ -275,6 +308,8 @@ def open_sink(spec: str) -> BlobSink:
         return JSONLBlobSink(rest)
     if kind == "arrays":
         return LevelArraysSink(rest)
+    if kind == "arrays-parquet":
+        return LevelArraysSink(rest, format="parquet")
     if kind == "dir":
         return DirectoryBlobSink(rest)
     if kind == "memory":

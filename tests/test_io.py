@@ -297,6 +297,29 @@ class TestLevelArraysSink:
                 got.setdefault(bid, {})[did] = float(cols["value"][i])
         assert got == {k: json.loads(v) for k, v in want.items()}
 
+    def test_parquet_format_roundtrips_identically(self, tmp_path):
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        src = SyntheticSource(n=1500, seed=6)
+        cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8)
+        run_job(src, LevelArraysSink(str(tmp_path / "npz")), config=cfg)
+        run_job(src, LevelArraysSink(str(tmp_path / "pq"), format="parquet"),
+                config=cfg)
+        a = LevelArraysSink.load(str(tmp_path / "npz"))
+        b = LevelArraysSink.load(str(tmp_path / "pq"))
+        assert a.keys() == b.keys()
+        for z in a:
+            for k in a[z]:
+                np.testing.assert_array_equal(a[z][k], b[z][k])
+
+    def test_open_sink_parquet_spec_and_bad_format(self, tmp_path):
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        s = open_sink(f"arrays-parquet:{tmp_path / 'c'}")
+        assert isinstance(s, LevelArraysSink) and s.format == "parquet"
+        with pytest.raises(ValueError, match="format"):
+            LevelArraysSink(str(tmp_path / "x"), format="csv")
+
     def test_columnar_sink_rejects_blob_records(self, tmp_path):
         from heatmap_tpu.io.sinks import LevelArraysSink
 
