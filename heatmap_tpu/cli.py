@@ -157,6 +157,13 @@ def cmd_run(args) -> int:
             raise SystemExit(
                 f"--fast needs a csv or hmpb source, got {args.input!r}"
             )
+    if args.multihost:
+        # Must run BEFORE anything that initializes the local backend —
+        # the profiler's start_trace does — or jax.distributed.initialize
+        # fails and every host silently runs the whole job alone.
+        from heatmap_tpu.parallel import initialize
+
+        initialize()
     t0 = time.perf_counter()
     prof = jax_profile(args.profile) if args.profile else contextlib.nullcontext()
     with prof:
@@ -173,9 +180,8 @@ def cmd_run(args) -> int:
                     checkpoint_every=args.checkpoint_every,
                 )
             elif args.multihost:
-                from heatmap_tpu.parallel import initialize, run_job_multihost
+                from heatmap_tpu.parallel import run_job_multihost
 
-                initialize()
                 blobs = run_job_multihost(open_source(args.input), sink,
                                           config,
                                           batch_size=args.batch_size)

@@ -247,6 +247,16 @@ def run_job_multihost(source, sink=None, config=None,
     from heatmap_tpu.pipeline.batch import _run_loaded, load_columns
 
     config = config or BatchJobConfig()
+    if sink is not None and hasattr(sink, "write_levels"):
+        # The multi-process egress merges reference-format blob dicts
+        # over DCN; a columnar sink would crash at the final write.
+        # Refuse at submit time instead (the single-process fallthrough
+        # WOULD work, which makes the pod-only crash extra surprising).
+        raise ValueError(
+            "run_job_multihost egress is blob-based; columnar sinks "
+            "(arrays:/LevelArraysSink) are not supported here — use a "
+            "blob sink, or run per-host jobs with columnar output"
+        )
     if jax.process_count() == 1:
         return run_job(source, sink, config, batch_size=batch_size)
     sharded = shard_source(source)

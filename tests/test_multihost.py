@@ -56,6 +56,16 @@ def test_shard_source_range_shardable():
         shard_source(mine, process_count=4, process_index=0)
 
 
+def test_run_job_multihost_rejects_columnar_sinks(tmp_path):
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.parallel.multihost import run_job_multihost
+
+    with pytest.raises(ValueError, match="blob"):
+        run_job_multihost(SyntheticSource(n=10),
+                          LevelArraysSink(str(tmp_path / "c")))
+
+
 def test_shard_source_returns_none_for_plain_sources():
     from heatmap_tpu.io.sources import SyntheticSource
     from heatmap_tpu.parallel.multihost import shard_source
