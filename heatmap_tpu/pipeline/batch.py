@@ -140,9 +140,10 @@ def build_emissions(codes, valid, group_ids, timestamps,
 
     ``codes``/``valid`` may be device arrays (the x64 ingest path keeps
     them device-resident from projection to cascade — no host
-    round-trip of the big code column); slot ids are always built
-    host-side (they come from host vocabs) and upload once with the
-    cascade. ``group_ids`` must be numpy.
+    round-trip of the big code column). In that case the slot ids are
+    assembled on device as well, from int32 uploads of the host-vocab
+    id columns (half the transfer of pre-built int64 slots, no host
+    concatenation). ``group_ids`` must be numpy.
     """
     ts_vocab = ts_vocab if ts_vocab is not None else TimespanVocab()
     timespans = (
@@ -156,21 +157,23 @@ def build_emissions(codes, valid, group_ids, timestamps,
     xp = jnp if on_device else np
     keep = group_ids != EXCLUDED
     keep_x = xp.asarray(keep)
+    routed = np.where(keep, group_ids, 0).astype(np.int32)
+    routed_x = xp.asarray(routed)
     emit_codes, emit_slots, emit_valid = [], [], []
     for ts_ids in per_ts_ids:
+        ts_x = xp.asarray(ts_ids.astype(np.int32))
+        ts64 = ts_x.astype(xp.int64)
         # 'all' emission for every point.
         emit_codes.append(codes)
-        emit_slots.append(ts_ids.astype(np.int64) * n_groups + ALL_GROUP)
+        emit_slots.append(ts64 * n_groups + ALL_GROUP)
         emit_valid.append(valid)
         # per-user emission for non-excluded points.
         emit_codes.append(codes)
-        emit_slots.append(
-            ts_ids.astype(np.int64) * n_groups + np.where(keep, group_ids, 0)
-        )
+        emit_slots.append(ts64 * n_groups + routed_x)
         emit_valid.append(valid & keep_x)
     return (
         xp.concatenate(emit_codes),
-        np.concatenate(emit_slots),
+        xp.concatenate(emit_slots),
         xp.concatenate(emit_valid),
         ts_vocab,
         n_groups,
