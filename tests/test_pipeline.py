@@ -99,6 +99,49 @@ def test_label_ids_datetime64_column():
         TimespanVocab().label_ids("day", nat)
 
 
+def test_json_blobs_match_dict_path_exactly():
+    """The vectorized direct-to-JSON egress must produce byte-identical
+    strings to json.dumps over the dict path, including float
+    formatting and key order."""
+    import json as _json
+
+    import numpy as np
+
+    from heatmap_tpu.pipeline import cascade as cascade_mod
+    from heatmap_tpu.pipeline.batch import (
+        BatchJobConfig, _cascade_codes, _slot_names, build_emissions,
+    )
+    from heatmap_tpu.pipeline.groups import UserVocab
+
+    rng = np.random.default_rng(5)
+    n = 30000
+    lat = np.clip(rng.normal(47, 3, n), -85, 85)
+    lon = np.clip(rng.normal(-122, 4, n), -179, 179)
+    users = [f"user-{i}" for i in rng.integers(0, 9, n)]
+    vocab = UserVocab()
+    gids = vocab.group_ids(users)
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=7)
+    codes, valid = _cascade_codes(lat, lon, cfg.detail_zoom)
+    e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
+        codes, valid, gids, [None] * n, cfg
+    )
+    ccfg = cfg.cascade_config()
+    lvl = cascade_mod.build_cascade(
+        e_codes, e_slots, ccfg, n_slots=len(ts_vocab) * n_groups,
+        valid=e_valid, capacity=len(e_codes),
+    )
+    fin = cascade_mod.finalize_level_arrays(
+        cascade_mod.decode_levels(lvl, ccfg), ccfg,
+        _slot_names(vocab, ts_vocab, n_groups),
+    )
+    want = {
+        k: _json.dumps(v)
+        for k, v in cascade_mod.blobs_from_level_arrays(fin).items()
+    }
+    got = cascade_mod.json_blobs_from_level_arrays(fin)
+    assert got == want
+
+
 def test_project_detail_codes_device_matches_host():
     """The on-device f64 projection+interleave must agree bit-for-bit
     with the host numpy path (same IEEE-double op order) at z21,
