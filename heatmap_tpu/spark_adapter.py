@@ -58,6 +58,70 @@ def heatmap_partitions(config=None):
     return HeatmapPartitionRunner(_config_kwargs(config))
 
 
+class HeatmapArrowRunner:
+    """The ``DataFrame.mapInArrow`` body: iterator of
+    ``pyarrow.RecordBatch`` with the reference columns in, RecordBatches
+    of ``(id: string, heatmap: string)`` out.
+
+    The Arrow boundary is the zero-copy Spark handoff (SURVEY.md §7
+    "hard parts": don't drown the accelerator in per-row Python at the
+    partition boundary): numeric columns cross as numpy views, only
+    the user/source string columns materialize as Python lists, once
+    per partition. The whole partition aggregates in ONE cascade call.
+    """
+
+    def __init__(self, cfg_kwargs: dict):
+        self.cfg_kwargs = cfg_kwargs
+
+    def __call__(self, batches):
+        import numpy as np
+        import pyarrow as pa
+
+        from heatmap_tpu.pipeline import BatchJobConfig
+        from heatmap_tpu.pipeline.batch import _run_loaded, load_columns
+
+        lats, lons, users, stamps = [], [], [], []
+        for rb in batches:
+            d = {name: rb.column(name) for name in rb.schema.names}
+            cols = load_columns({
+                "latitude": d["latitude"].to_numpy(zero_copy_only=False),
+                "longitude": d["longitude"].to_numpy(zero_copy_only=False),
+                "user_id": d["user_id"].to_pylist() if "user_id" in d
+                else [""] * rb.num_rows,
+                "source": d["source"].to_pylist() if "source" in d else [],
+                "timestamp": d["timestamp"].to_pylist()
+                if "timestamp" in d else None,
+            })
+            lats.append(cols["latitude"])
+            lons.append(cols["longitude"])
+            users.extend(cols["user_id"])
+            stamps.extend(cols["timestamp"])
+        if not lats or sum(len(a) for a in lats) == 0:
+            return
+        blobs = _run_loaded(
+            {
+                "latitude": np.concatenate(lats),
+                "longitude": np.concatenate(lons),
+                "user_id": users,
+                "timestamp": stamps,
+            },
+            BatchJobConfig(**self.cfg_kwargs),
+            as_json=True,
+        )
+        yield pa.RecordBatch.from_pydict({
+            "id": list(blobs.keys()),
+            "heatmap": list(blobs.values()),
+        })
+
+
+def heatmap_arrow_partitions(config=None):
+    """-> picklable callable for ``DataFrame.mapInArrow(fn,
+    'id string, heatmap string')``; partials still need the
+    ``reduceByKey(merge_heatmaps)`` (or groupBy + UDF) merge since a
+    blob's detail tiles can straddle partitions."""
+    return HeatmapArrowRunner(_config_kwargs(config))
+
+
 def merge_heatmaps(a: str, b: str) -> str:
     """reduceByKey merge: sum two heatmap-json blobs per detail tile."""
     da, db = json.loads(a), json.loads(b)

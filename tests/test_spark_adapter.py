@@ -52,6 +52,49 @@ def test_partitioned_equals_global(amplify):
         )
 
 
+def test_arrow_partitions_equal_row_partitions():
+    """The mapInArrow body gives the same partials as the row-dict
+    mapPartitions body, merged to the same global blobs."""
+    import pyarrow as pa
+
+    from heatmap_tpu.spark_adapter import heatmap_arrow_partitions
+
+    rows = _rows(3000, seed=8)
+    parts = [rows[:1300], rows[1300:]]
+    want = simulate_partitions(parts, config=CFG)
+
+    fn = heatmap_arrow_partitions(config=CFG)
+    merged: dict = {}
+    for part in parts:
+        rb = pa.RecordBatch.from_pydict({
+            k: [r[k] for r in part]
+            for k in ("latitude", "longitude", "user_id", "source",
+                      "timestamp")
+        })
+        # Two record batches per partition exercises the accumulate
+        # path inside the runner.
+        half = rb.num_rows // 2
+        for out in fn(iter([rb.slice(0, half), rb.slice(half)])):
+            for key, blob in zip(out.column("id").to_pylist(),
+                                 out.column("heatmap").to_pylist()):
+                merged[key] = (
+                    merge_heatmaps(merged[key], blob)
+                    if key in merged else blob
+                )
+    assert {k: json.loads(v) for k, v in merged.items()} == {
+        k: json.loads(v) for k, v in want.items()
+    }
+
+
+def test_arrow_runner_is_picklable():
+    import pickle
+
+    from heatmap_tpu.spark_adapter import heatmap_arrow_partitions
+
+    fn = heatmap_arrow_partitions(config=CFG)
+    assert pickle.loads(pickle.dumps(fn)).cfg_kwargs == fn.cfg_kwargs
+
+
 def test_merge_heatmaps_sums():
     a = json.dumps({"12_1_2": 2.0, "12_1_3": 1.0})
     b = json.dumps({"12_1_3": 4.0, "12_9_9": 1.0})
