@@ -41,31 +41,43 @@ class FaultInjector:
     """
 
     def __init__(self, fail_counts: dict):
+        import threading
+
         self._remaining = dict(fail_counts)
+        self._lock = threading.Lock()  # run_shards may be threaded
         self.injected = 0
 
     def check(self, shard_index):
-        left = self._remaining.get(shard_index, 0)
-        if left > 0:
+        with self._lock:
+            left = self._remaining.get(shard_index, 0)
+            if left <= 0:
+                return
             self._remaining[shard_index] = left - 1
             self.injected += 1
-            raise RuntimeError(f"injected fault on shard {shard_index}")
+        raise RuntimeError(f"injected fault on shard {shard_index}")
 
 
 def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
                fault_injector: FaultInjector | None = None,
-               on_retry=None, tracer=None):
+               on_retry=None, tracer=None, max_workers: int = 1):
     """Run ``process(shard)`` over every shard with per-shard retries.
 
     Returns the list of per-shard results in shard order (order is
-    deterministic regardless of failures — the analog of Spark's
-    deterministic partition recompute). ``retries`` is the number of
-    *re*-executions allowed per shard; ``on_retry(i, attempt, err)``
-    is the failure-detection hook (log, mark executor unhealthy, ...).
-    Raises ShardFailure once a shard exhausts its budget.
+    deterministic regardless of failures or concurrency — the analog
+    of Spark's deterministic partition recompute). ``retries`` is the
+    number of *re*-executions allowed per shard; ``on_retry(i,
+    attempt, err)`` is the failure-detection hook (log, mark executor
+    unhealthy, ...). Raises ShardFailure once a shard exhausts its
+    budget.
+
+    ``max_workers > 1`` runs shards on a thread pool — the right shape
+    for IO-bound shards like Cassandra token-range or CosmosDB
+    partition-range scans, which spend their time off-GIL in sockets.
+    Retry bookkeeping is per shard and thread-local; ``on_retry`` may
+    be called concurrently and must be thread-safe.
     """
-    results = []
-    for i, shard in enumerate(shards):
+
+    def run_one(i, shard):
         attempt = 0
         while True:
             try:
@@ -73,10 +85,8 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
                     fault_injector.check(i)
                 if tracer is not None:
                     with tracer.span("shard"):
-                        results.append(process(shard))
-                else:
-                    results.append(process(shard))
-                break
+                        return process(shard)
+                return process(shard)
             except Exception as e:  # noqa: BLE001 — retry boundary
                 attempt += 1
                 if on_retry is not None:
@@ -85,4 +95,15 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
                     raise ShardFailure(i, attempt, e) from e
                 if backoff_s:
                     time.sleep(backoff_s * attempt)
-    return results
+
+    shards = list(shards)
+    if max_workers <= 1:
+        return [run_one(i, s) for i, s in enumerate(shards)]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=max_workers) as ex:
+        futures = [ex.submit(run_one, i, s) for i, s in enumerate(shards)]
+        # In-order collection keeps results deterministic; the first
+        # exhausted shard raises (others complete or are abandoned with
+        # the pool).
+        return [f.result() for f in futures]

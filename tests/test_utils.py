@@ -125,6 +125,20 @@ def test_run_shards_exhausted_budget_raises():
     assert ei.value.attempts == 3
 
 
+def test_run_shards_threaded_matches_sequential():
+    """max_workers > 1 keeps shard-order results and retry semantics."""
+    inj = FaultInjector({0: 1, 2: 2, 5: 1})
+    seq = run_shards(list(range(8)), lambda s: s * 3, retries=2,
+                     fault_injector=FaultInjector({0: 1, 2: 2, 5: 1}))
+    par = run_shards(list(range(8)), lambda s: s * 3, retries=2,
+                     fault_injector=inj, max_workers=4)
+    assert par == seq == [i * 3 for i in range(8)]
+    assert inj.injected == 4
+    with pytest.raises(ShardFailure):
+        run_shards([0, 1], lambda s: s, retries=1,
+                   fault_injector=FaultInjector({1: 5}), max_workers=2)
+
+
 def test_run_shards_result_identical_with_and_without_faults():
     """Idempotent re-execution: transient faults never change results."""
     shards = list(range(6))
