@@ -32,8 +32,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from heatmap_tpu.ops import pyramid as pyramid_ops
-from heatmap_tpu.pipeline.groups import ALL_GROUP
-from heatmap_tpu.tilemath import keys as keys_mod
 from heatmap_tpu.tilemath.morton import morton_decode_np
 
 
