@@ -1,0 +1,42 @@
+"""bench.py is a driver contract: ONE JSON line with the headline
+metric. Pin its shape (including the CPU fallback fields) so refactors
+can't silently break the round artifact."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(*extra):
+    return subprocess.run(
+        [sys.executable, "bench.py", "--cpu", "--n", "262144",
+         "--steps", "2", "--baseline-n", "65536", *extra],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env=dict(os.environ, PYTHONPATH=REPO),
+    )
+
+
+def test_bench_emits_one_json_line():
+    r = _run_bench()
+    assert r.returncode == 0, r.stderr
+    line = r.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["unit"] == "points/sec"
+    assert out["value"] > 0
+    assert out["vs_baseline"] > 0
+    assert out["device"] == "cpu"
+    assert out["bin_backend_resolved"] == "xla"  # auto on CPU
+
+
+def test_bench_backend_failure_falls_back():
+    # pallas has no compiled CPU lowering; the bench must degrade to
+    # the scatter path and say so, never emit value=0.
+    r = _run_bench("--bin-backend", "pallas")
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["value"] > 0
+    assert out["bin_backend_resolved"] == "xla"
+    assert "fallback" in out["note_backend"]
