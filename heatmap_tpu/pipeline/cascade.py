@@ -230,10 +230,13 @@ def blobs_from_level_arrays(levels):
 def json_blobs_from_level_arrays(levels):
     """{blob_id: json_string} egress without per-aggregate Python.
 
-    Produces exactly ``{k: json.dumps(v) for k, v in
-    blobs_from_level_arrays(levels).items()}`` (same key order, same
-    float formatting — numpy's shortest-roundtrip repr matches
-    json.dumps for doubles): per level, the JSON fragments are
+    Produces a dict EQUAL to ``{k: json.dumps(v) for k, v in
+    blobs_from_level_arrays(levels).items()}`` — same keys, and each
+    value byte-identical (numpy's shortest-roundtrip repr matches
+    json.dumps for doubles; within-blob entry order is preserved).
+    Key INSERTION order differs: composite-key order here vs
+    string-sorted there, so sequential sink output is not diffable
+    byte-for-byte against the old path. Per level, the JSON fragments are
     assembled with vectorized string ops, concatenated into ONE Python
     string with NUL markers at blob starts, and split back into
     per-blob documents — the only O(blobs) Python work left is the

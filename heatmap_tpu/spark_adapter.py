@@ -108,10 +108,20 @@ class HeatmapArrowRunner:
             BatchJobConfig(**self.cfg_kwargs),
             as_json=True,
         )
-        yield pa.RecordBatch.from_pydict({
-            "id": list(blobs.keys()),
-            "heatmap": list(blobs.values()),
-        })
+        # Explicit schema: an all-invalid partition yields zero blobs,
+        # and from_pydict would otherwise infer null-typed columns that
+        # Spark's schema check rejects. Emission is chunked because
+        # string columns carry int32 offsets (2 GiB cap per column) —
+        # a partition's concatenated JSON can exceed that.
+        schema = pa.schema([("id", pa.string()), ("heatmap", pa.string())])
+        ids = list(blobs.keys())
+        vals = list(blobs.values())
+        step = 1 << 18
+        for lo in range(0, len(ids), step):
+            yield pa.RecordBatch.from_pydict(
+                {"id": ids[lo:lo + step], "heatmap": vals[lo:lo + step]},
+                schema=schema,
+            )
 
 
 def heatmap_arrow_partitions(config=None):

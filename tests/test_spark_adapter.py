@@ -86,6 +86,26 @@ def test_arrow_partitions_equal_row_partitions():
     }
 
 
+def test_arrow_runner_empty_result_keeps_string_schema():
+    """An all-invalid partition must emit string-typed (or no) batches,
+    never null-typed columns that Spark's schema check rejects."""
+    import pyarrow as pa
+
+    from heatmap_tpu.spark_adapter import heatmap_arrow_partitions
+
+    fn = heatmap_arrow_partitions(config=CFG)
+    rb = pa.RecordBatch.from_pydict({
+        "latitude": [89.9, 89.95],  # beyond the Mercator limit
+        "longitude": [0.0, 1.0],
+        "user_id": ["a", "b"],
+        "source": ["gps", "gps"],
+        "timestamp": [1, 2],
+    })
+    for out in fn(iter([rb])):
+        assert out.schema.field("id").type == pa.string()
+        assert out.schema.field("heatmap").type == pa.string()
+
+
 def test_arrow_runner_is_picklable():
     import pickle
 
