@@ -343,6 +343,62 @@ if _lib is not None:
             self.close()
 
 
+if _lib is not None:
+    _lib.hm_format_blob_bodies.restype = ctypes.c_int64
+    _lib.hm_format_blob_bodies.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    _lib.hm_blobfmt_free.restype = None
+    _lib.hm_blobfmt_free.argtypes = [ctypes.c_char_p]
+
+    def format_blob_bodies(rows, cols, values, is_start, zoom: int,
+                           n_threads: int | None = None) -> list:
+        """NUL-separated '{...}' JSON documents for one sorted level.
+
+        Contract of the numpy join/split path in
+        pipeline.cascade.json_blobs_from_level_arrays: one document per
+        blob start, aggregate order preserved. ``values`` MUST be
+        integral doubles with |v| < 1e15 (the caller checks; cascade
+        counts always satisfy it — "%lld.0" is then exactly
+        repr(float)).
+        """
+        import numpy as np
+
+        n = len(rows)
+        if n == 0:
+            return []
+        rows = np.ascontiguousarray(rows, np.int64)
+        cols = np.ascontiguousarray(cols, np.int64)
+        values = np.ascontiguousarray(values, np.float64)
+        starts = np.ascontiguousarray(is_start, np.uint8)
+        if n_threads is None:
+            n_threads = min(8, os.cpu_count() or 1)
+        out = ctypes.c_char_p()
+        length = _lib.hm_format_blob_bodies(
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            cols.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n, zoom, n_threads, ctypes.byref(out),
+        )
+        if length < 0:
+            raise MemoryError("native blob formatter allocation failed")
+        try:
+            buf = ctypes.string_at(out, length)
+        finally:
+            _lib.hm_blobfmt_free(out)
+        return buf.decode("ascii").split("\x00")
+else:
+    format_blob_bodies = None
+
+
 def available() -> bool:
     """True when the native library loaded (accelerated paths active)."""
     return _lib is not None

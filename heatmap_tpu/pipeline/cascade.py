@@ -271,25 +271,46 @@ def json_blobs_from_level_arrays(levels):
             _tile_id_strings(lvl["coarse_zoom"], lvl["coarse_row"][sidx],
                              lvl["coarse_col"][sidx]),
         )
-        # '"<detail>": <value>' fragments, json.dumps separators.
-        frag = np.char.add(
-            np.char.add(
-                np.char.add(
-                    '"',
-                    _tile_id_strings(lvl["zoom"], lvl["row"], lvl["col"]),
-                ),
-                '": ',
-            ),
-            lvl["value"].astype(str),
-        )
-        # Run-start fragments open a new document ('}\x00{' closes the
-        # previous one); the rest continue with ', '. One join, one
-        # split, zero per-blob concatenation.
-        parts = np.char.add(np.where(is_start, "}\x00{", ", "), frag)
-        big = "".join(parts.tolist()) + "}"
-        bodies = big.split("\x00")[1:]  # [0] is the artifact '}' head
-        out.update(zip(blob_ids.tolist(), bodies))
+        out.update(zip(blob_ids.tolist(), _blob_bodies(lvl, is_start)))
     return out
+
+
+def _blob_bodies(lvl, is_start):
+    """Per-blob '{...}' JSON documents for one level, in order.
+
+    The multithreaded native formatter handles the common case —
+    integral count values, which is everything blob egress ever sees
+    from the cascade (weights never reach it) — at C speed; the numpy
+    join/split path is the fallback and the formatting oracle (tested
+    equal byte-for-byte).
+    """
+    values = lvl["value"]
+    # Lazy import: native asserts against pipeline.timespan at load, so
+    # a module-level import here would be circular.
+    from heatmap_tpu import native as _native
+
+    if _native.format_blob_bodies is not None and bool(
+        np.all((values == np.floor(values)) & (np.abs(values) < 1e15))
+    ):
+        return _native.format_blob_bodies(
+            lvl["row"], lvl["col"], values, is_start, int(lvl["zoom"])
+        )
+    # '"<detail>": <value>' fragments, json.dumps separators.
+    frag = np.char.add(
+        np.char.add(
+            np.char.add(
+                '"', _tile_id_strings(lvl["zoom"], lvl["row"], lvl["col"])
+            ),
+            '": ',
+        ),
+        values.astype(str),
+    )
+    # Run-start fragments open a new document ('}\x00{' closes the
+    # previous one); the rest continue with ', '. One join, one split,
+    # zero per-blob concatenation.
+    parts = np.char.add(np.where(is_start, "}\x00{", ", "), frag)
+    big = "".join(parts.tolist()) + "}"
+    return big.split("\x00")[1:]  # [0] is the artifact '}' head
 
 
 def _tile_id_strings(zoom, rows, cols):

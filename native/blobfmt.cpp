@@ -1,0 +1,164 @@
+// Native JSON blob-body formatter: the egress hot loop.
+//
+// The reference-format egress must turn ~tens of millions of
+// (row, col, value) aggregates into per-blob JSON documents
+// '{"z_r_c": v, ...}'. numpy's per-aggregate number->string formatting
+// is the measured floor of that path (~0.5 M aggregates/s,
+// PERF_NOTES.md round 2, GIL-bound so threads don't help Python).
+// This formatter does the same work in C with integer formatting and
+// OS threads: the Python side passes the (already sorted) level
+// columns plus the blob-start mask, and receives ONE buffer of
+// NUL-separated '{...}' documents in order — the exact contract of the
+// numpy join/split trick it replaces (pipeline/cascade.py
+// json_blobs_from_level_arrays).
+//
+// Scope: values must be integral doubles with |v| < 1e15 (cascade
+// counts always are — weights never reach blob egress). The Python
+// caller verifies that precondition and falls back to the numpy path
+// otherwise, so float-repr parity questions never arise here:
+// "%lld.0" is exactly repr(float(k)) == json.dumps(float(k)) for
+// integral doubles below 1e16.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Max chars one aggregate can contribute:
+//   sep (3: '}\0{' or ', ') + '"' + zoom(2) + '_' + row(12) + '_' +
+//   col(12) + '": ' + digits(16) + '.0'  => < 56. Use 64.
+constexpr int64_t kMaxPer = 64;
+
+inline char* put_i64(char* p, long long v) {
+  if (v < 0) {  // not produced by tile math, but stay correct
+    *p++ = '-';
+    v = -v;
+  }
+  char tmp[24];
+  int n = 0;
+  do {
+    tmp[n++] = static_cast<char>('0' + (v % 10));
+    v /= 10;
+  } while (v);
+  while (n) *p++ = tmp[--n];
+  return p;
+}
+
+struct Slice {
+  int64_t lo, hi;  // aggregate range, lo aligned to a blob start
+  char* buf = nullptr;
+  int64_t len = 0;
+};
+
+void format_slice(const int64_t* rows, const int64_t* cols,
+                  const double* vals, const uint8_t* is_start,
+                  int32_t zoom, bool first_slice, Slice* s) {
+  const int64_t n = s->hi - s->lo;
+  s->buf = static_cast<char*>(std::malloc(static_cast<size_t>(n) * kMaxPer));
+  if (s->buf == nullptr) {
+    s->len = -1;
+    return;
+  }
+  char* p = s->buf;
+  char zbuf[8];
+  char* zend = put_i64(zbuf, zoom);
+  const int zlen = static_cast<int>(zend - zbuf);
+  for (int64_t i = s->lo; i < s->hi; ++i) {
+    if (is_start[i]) {
+      if (i == s->lo && first_slice) {
+        *p++ = '{';
+      } else {
+        *p++ = '}';
+        *p++ = '\0';
+        *p++ = '{';
+      }
+    } else {
+      *p++ = ',';
+      *p++ = ' ';
+    }
+    *p++ = '"';
+    std::memcpy(p, zbuf, zlen);
+    p += zlen;
+    *p++ = '_';
+    p = put_i64(p, rows[i]);
+    *p++ = '_';
+    p = put_i64(p, cols[i]);
+    *p++ = '"';
+    *p++ = ':';
+    *p++ = ' ';
+    p = put_i64(p, static_cast<long long>(vals[i]));
+    *p++ = '.';
+    *p++ = '0';
+  }
+  s->len = p - s->buf;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Format NUL-separated '{...}' blob documents for one (sorted) level.
+// rows/cols: int64[n]; vals: double[n] (integral, |v| < 1e15 —
+// caller-checked); is_start: uint8[n] with is_start[0] == 1.
+// On success returns the byte length and stores a malloc'd buffer in
+// *out (free with hm_blobfmt_free); returns -1 on allocation failure,
+// 0 with *out = nullptr for n == 0.
+int64_t hm_format_blob_bodies(const int64_t* rows, const int64_t* cols,
+                              const double* vals, const uint8_t* is_start,
+                              int64_t n, int32_t zoom, int32_t n_threads,
+                              char** out) {
+  *out = nullptr;
+  if (n <= 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 16) n_threads = 16;
+
+  // Slice boundaries aligned to blob starts so every document is
+  // formatted by exactly one thread.
+  std::vector<Slice> slices;
+  int64_t lo = 0;
+  for (int t = 1; t < n_threads && lo < n; ++t) {
+    int64_t target = (n * t) / n_threads;
+    while (target < n && !is_start[target]) ++target;
+    if (target > lo && target < n) {
+      slices.push_back({lo, target});
+      lo = target;
+    }
+  }
+  slices.push_back({lo, n});
+
+  std::vector<std::thread> workers;
+  for (size_t k = 0; k < slices.size(); ++k) {
+    workers.emplace_back(format_slice, rows, cols, vals, is_start, zoom,
+                         k == 0, &slices[k]);
+  }
+  for (auto& w : workers) w.join();
+
+  int64_t total = 1;  // trailing '}'
+  bool failed = false;
+  for (auto& s : slices) {
+    if (s.len < 0) failed = true;
+    total += s.len;
+  }
+  char* merged = failed ? nullptr
+                        : static_cast<char*>(std::malloc(total));
+  int64_t off = 0;
+  for (auto& s : slices) {
+    if (merged != nullptr && s.len > 0) {
+      std::memcpy(merged + off, s.buf, s.len);
+      off += s.len;
+    }
+    std::free(s.buf);
+  }
+  if (merged == nullptr) return -1;
+  merged[off++] = '}';
+  *out = merged;
+  return off;
+}
+
+void hm_blobfmt_free(char* buf) { std::free(buf); }
+
+}  // extern "C"

@@ -262,6 +262,60 @@ def test_run_job_fast_dated_raises_on_missing_timestamps(tmp_path):
         run_job_fast(str(p), config=BatchJobConfig(timespans=("alltime", "day")))
 
 
+def test_format_blob_bodies_matches_numpy_oracle():
+    """The C formatter must be byte-identical to the numpy join/split
+    path for integral values, across thread-slice boundaries."""
+    if native.format_blob_bodies is None:
+        pytest.skip("native library not built")
+    rng = np.random.default_rng(12)
+    n = 100_000
+    lvl = {
+        "zoom": 15,
+        "row": np.sort(rng.integers(0, 1 << 15, n)).astype(np.int64),
+        "col": rng.integers(0, 1 << 15, n).astype(np.int64),
+        "value": rng.integers(1, 10_000_000, n).astype(np.float64),
+        "slot": np.zeros(n, np.int64),
+    }
+    is_start = rng.random(n) < 0.3
+    is_start[0] = True
+    from heatmap_tpu.pipeline.cascade import _blob_bodies
+
+    got = native.format_blob_bodies(lvl["row"], lvl["col"], lvl["value"],
+                                    is_start, 15)
+    # Force the numpy path by making one value non-integral, then
+    # restore: simpler — call the fragment construction directly.
+    frag = np.char.add(
+        np.char.add(
+            np.char.add('"', np.char.add(np.char.add(np.char.add(
+                "15_", lvl["row"].astype(str)), "_"),
+                lvl["col"].astype(str))),
+            '": ',
+        ),
+        lvl["value"].astype(str),
+    )
+    parts = np.char.add(np.where(is_start, "}\x00{", ", "), frag)
+    want = ("".join(parts.tolist()) + "}").split("\x00")[1:]
+    assert got == want
+    # The dispatcher picks the native path for integral values and the
+    # numpy path otherwise; both must parse to the same content.
+    via_dispatch = _blob_bodies(lvl, is_start)
+    assert via_dispatch == want
+
+
+def test_format_blob_bodies_single_blob_and_empty():
+    if native.format_blob_bodies is None:
+        pytest.skip("native library not built")
+    assert native.format_blob_bodies(
+        np.empty(0, np.int64), np.empty(0, np.int64),
+        np.empty(0), np.empty(0, bool), 10,
+    ) == []
+    got = native.format_blob_bodies(
+        np.asarray([3], np.int64), np.asarray([7], np.int64),
+        np.asarray([2.0]), np.asarray([True]), 4,
+    )
+    assert got == ['{"4_3_7": 2.0}']
+
+
 def test_staging_pool_roundtrip_and_backpressure():
     with native.StagingPool(1 << 12, 2) as pool:
         a = pool.acquire((512,), np.float64)
