@@ -36,6 +36,12 @@ int hm_pool_acquire(void* handle);
 void hm_pool_release(void* handle, int id);
 void* hm_pool_buffer(void* handle, int id);
 void hm_pool_destroy(void* handle);
+
+int64_t hm_format_blob_bodies(const int64_t* rows, const int64_t* cols,
+                              const double* vals, const uint8_t* is_start,
+                              int64_t n, int32_t zoom, int32_t n_threads,
+                              char** out);
+void hm_blobfmt_free(char* buf);
 }
 
 namespace {
@@ -127,8 +133,36 @@ int main() {
   }
   drain(path, 4, true);  // early close: destructor races
   pool_hammer();
+  // Threaded blob formatter: 1-thread and 8-thread outputs must be
+  // byte-identical (slice boundaries are the racy part).
+  {
+    constexpr int64_t n = 50000;
+    std::vector<int64_t> rows(n), cols(n);
+    std::vector<double> vals(n);
+    std::vector<uint8_t> starts(n);
+    for (int64_t i = 0; i < n; ++i) {
+      rows[i] = (i * 7919) % 32768;
+      cols[i] = (i * 104729) % 32768;
+      vals[i] = static_cast<double>((i % 1000) + 1);
+      starts[i] = (i == 0 || i % 5 == 0) ? 1 : 0;
+    }
+    char* one = nullptr;
+    char* eight = nullptr;
+    int64_t l1 = hm_format_blob_bodies(rows.data(), cols.data(), vals.data(),
+                                       starts.data(), n, 15, 1, &one);
+    int64_t l8 = hm_format_blob_bodies(rows.data(), cols.data(), vals.data(),
+                                       starts.data(), n, 15, 8, &eight);
+    if (l1 != l8 || l1 < 0 || std::memcmp(one, eight, l1) != 0) {
+      std::fprintf(stderr, "blobfmt thread mismatch: %lld vs %lld\n",
+                   static_cast<long long>(l1), static_cast<long long>(l8));
+      return 1;
+    }
+    hm_blobfmt_free(one);
+    hm_blobfmt_free(eight);
+  }
   std::remove(path.c_str());
-  std::printf("tsan selftest ok: %lld rows x2, early-close, pool hammer\n",
-              static_cast<long long>(a));
+  std::printf(
+      "tsan selftest ok: %lld rows x2, early-close, pool hammer, blobfmt\n",
+      static_cast<long long>(a));
   return 0;
 }
