@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""End-to-end batch-job benchmark: HMPB ingest -> cascade -> egress.
+
+Generates an HMPB file of synthetic GPS points (hot cluster + fringe,
+multiple users incl. rt-/x- routing), runs run_job_fast end to end on
+the default backend, and prints the tracer's stage balance plus a
+points/sec headline. Unlike bench.py (the isolated projection+binning
+kernel), this measures the full production job: mmap ingest, group
+routing, the z21 composite-key cascade, decode/finalize, and egress.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/bench_job.py [--n 20000000]
+        [--egress arrays|json|none] [--runs 1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+
+def synth_hmpb(path: str, n: int, seed: int = 0) -> str:
+    from heatmap_tpu.io.hmpb import write_hmpb
+
+    rng = np.random.default_rng(seed)
+    n_hot = n // 4
+    lat = np.concatenate([47.6 + rng.normal(0, 0.5, n - n_hot),
+                          47.6 + rng.normal(0, 0.02, n_hot)])
+    lon = np.concatenate([-122.3 + rng.normal(0, 0.7, n - n_hot),
+                          -122.3 + rng.normal(0, 0.03, n_hot)])
+    # Routed ids against a names table shaped like production: a few
+    # hundred users, one pooled "route" group, x-excluded rows (-1).
+    names = ["all"] + [f"user{i}" for i in range(200)] + ["route"]
+    routed = rng.integers(1, len(names), n, dtype=np.int32)
+    routed[rng.random(n) < 0.05] = -1  # x- excluded
+    ts = rng.integers(1_500_000_000_000, 1_700_000_000_000, n, dtype=np.int64)
+    background = (rng.random(n) < 0.02).astype(np.uint8)
+    return write_hmpb(path, lat, lon, routed, names,
+                      timestamp=ts, background=background)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=20_000_000)
+    ap.add_argument("--egress", choices=("arrays", "json", "none"),
+                    default="arrays")
+    ap.add_argument("--runs", type=int, default=1)
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the generated HMPB file")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the axon sitecustomize "
+                    "overrides JAX_PLATFORMS, so the env var is not enough)")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)  # int64 composite keys + exact z21
+
+    from heatmap_tpu.io.hmpb import HMPBSource
+    from heatmap_tpu.io.sinks import LevelArraysSink, MemorySink
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+    from heatmap_tpu.utils.trace import get_tracer
+
+    tmpdir = tempfile.mkdtemp(prefix="benchjob-")
+    try:
+        hmpb = os.path.join(tmpdir, "points.hmpb")
+        t0 = time.perf_counter()
+        synth_hmpb(hmpb, args.n)
+        gen_s = time.perf_counter() - t0
+        print(json.dumps({"stage": "synth+write_hmpb", "s": round(gen_s, 2),
+                          "path": hmpb,
+                          "bytes": os.path.getsize(hmpb)}), flush=True)
+
+        config = BatchJobConfig()
+        tracer = get_tracer()
+        for run in range(args.runs):
+            tracer.reset()
+            if args.egress == "arrays":
+                sink = LevelArraysSink(os.path.join(tmpdir, f"levels{run}"))
+            elif args.egress == "json":
+                sink = MemorySink()
+            else:
+                sink = None
+            t0 = time.perf_counter()
+            out = run_job_fast(HMPBSource(hmpb), sink=sink, config=config)
+            dt = time.perf_counter() - t0
+            stages = {
+                name: round(r["total_s"], 3)
+                for name, r in sorted(tracer.report().items())
+            }
+            print(json.dumps({
+                "run": run,
+                "device": jax.devices()[0].platform,
+                "n_points": args.n,
+                "egress": args.egress,
+                "total_s": round(dt, 2),
+                "pts_per_s": round(args.n / dt),
+                "stages": stages,
+                "out": (len(out) if hasattr(out, "__len__")
+                        else str(out)[:80]),
+            }), flush=True)
+    finally:
+        if args.keep:
+            print(json.dumps({"kept": tmpdir}), flush=True)
+        else:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""On-chip bit-exactness check for the sort-partitioned binning kernel.
+
+The tests in tests/test_partitioned.py run the kernel in interpret mode
+(CPU); Mosaic lowering on the real chip differs (layouts, bf16 matmul
+accumulation order), so after any kernel change this script must pass on
+the TPU before the change counts as verified. Compares the partitioned
+raster bit-for-bit against the XLA scatter contract at the headline
+window for clustered, adversarial-uniform, and boundary-straddling
+inputs, across the swept tunable space.
+
+    PYTHONPATH=. python tools/verify_partitioned_onchip.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    # On CPU the kernel silently runs in interpret mode — the exact
+    # path the interpret-mode tests already cover. Verifying Mosaic
+    # lowering requires the real chip; anything else must fail loudly.
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        print(json.dumps({"error": "refusing to verify on CPU "
+                          "(interpret mode is not Mosaic)",
+                          "device": platform}))
+        return 2
+
+    from heatmap_tpu.ops import window_from_bounds
+    from heatmap_tpu.ops.histogram import bin_rowcol_window
+    from heatmap_tpu.ops.partitioned import bin_rowcol_window_partitioned
+    from heatmap_tpu.tilemath import mercator
+
+    win = window_from_bounds((44.0, 51.0), (-127.0, -117.0), zoom=15,
+                             align_levels=12, pad_multiple=256)
+    rng = np.random.default_rng(0)
+    n = 1 << 22
+
+    def project(lat, lon):
+        r, c, v = mercator.project_points(jnp.asarray(lat), jnp.asarray(lon),
+                                          win.zoom, dtype=jnp.float32)
+        return r, c, v
+
+    cases = {}
+    # Clustered: hot core + sparse fringe (the good-chunk fast path).
+    lat = np.concatenate([47.6 + rng.normal(0, 0.02, n // 2),
+                          47.6 + rng.normal(0, 0.8, n // 2)]).astype(np.float32)
+    lon = np.concatenate([-122.3 + rng.normal(0, 0.03, n // 2),
+                          -122.3 + rng.normal(0, 1.2, n // 2)]).astype(np.float32)
+    cases["clustered"] = (lat, lon)
+    # Adversarial uniform over the whole window: every chunk straddles
+    # many blocks -> exercises the lax.cond full-scatter fallback.
+    cases["uniform"] = (
+        rng.uniform(44.0, 51.0, n).astype(np.float32),
+        rng.uniform(-127.0, -117.0, n).astype(np.float32),
+    )
+    # Out-of-window + single-cell pileup (tail & overflow paths).
+    lat = np.full(n, 47.6, np.float32)
+    lon = np.full(n, -122.3, np.float32)
+    lat[: n // 8] = rng.uniform(-60.0, 85.0, n // 8)
+    lon[: n // 8] = rng.uniform(-180.0, 179.9, n // 8)
+    cases["pileup"] = (lat, lon)
+
+    combos = [
+        {},  # defaults
+        {"block_cells": 1 << 12},
+        {"block_cells": 1 << 14},
+        {"chunk": 512},
+        {"chunk": 2048},
+        {"bad_frac": 32},
+    ]
+    failures = 0
+    for name, (lat, lon) in cases.items():
+        r, c, v = project(lat, lon)
+        expected = np.asarray(bin_rowcol_window(r, c, win, valid=v))
+        for kw in combos:
+            got = np.asarray(bin_rowcol_window_partitioned(
+                r, c, win, valid=v, interpret=False, **kw))
+            ok = bool((got == expected).all())
+            print(json.dumps({"case": name, "kw": kw, "bit_exact": ok,
+                              "total": int(expected.sum())}), flush=True)
+            if not ok:
+                failures += 1
+                bad = np.argwhere(got != expected)
+                print(f"  first diffs at {bad[:5].tolist()}", flush=True)
+    print(json.dumps({
+        "device": jax.devices()[0].platform,
+        "failures": failures,
+        "verdict": "BIT-EXACT" if failures == 0 else "MISMATCH",
+    }), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
