@@ -174,13 +174,19 @@ class LevelArraysSink:
     format: str = "npz"
 
     def __post_init__(self):
-        if self.format not in ("npz", "parquet"):
+        if self.format not in ("npz", "npz-compressed", "parquet"):
             raise ValueError(
-                f"format must be 'npz' or 'parquet', got {self.format!r}"
+                f"format must be 'npz', 'npz-compressed' or 'parquet', "
+                f"got {self.format!r}"
             )
         os.makedirs(self.path, exist_ok=True)
 
-    COLUMNS = ("row", "col", "value", "user", "timespan",
+    #: Per-row columns; user/timespan are stored dictionary-encoded
+    #: (``user_idx``/``timespan_idx`` int32 + the small ``user_names``/
+    #: ``timespan_names`` tables in npz; native DictionaryArray columns
+    #: named ``user``/``timespan`` in parquet). ``load`` materializes
+    #: plain ``user``/``timespan`` string columns either way.
+    COLUMNS = ("row", "col", "value", "user_idx", "timespan_idx",
                "coarse_row", "coarse_col")
 
     def write_levels(self, levels) -> int:
@@ -189,8 +195,9 @@ class LevelArraysSink:
             out = {k: np.asarray(lvl[k]) for k in self.COLUMNS}
             out["zoom"] = np.asarray(lvl["zoom"])
             out["coarse_zoom"] = np.asarray(lvl["coarse_zoom"])
+            ext = "npz" if self.format.startswith("npz") else self.format
             final = os.path.join(
-                self.path, f"level_z{lvl['zoom']:02d}.{self.format}"
+                self.path, f"level_z{lvl['zoom']:02d}.{ext}"
             )
             tmp = final + ".tmp"
             if self.format == "parquet":
@@ -198,14 +205,29 @@ class LevelArraysSink:
                 import pyarrow.parquet as pq
 
                 n = len(out["value"])
-                table = pa.table({
-                    k: (np.full(n, v) if v.ndim == 0 else v)
-                    for k, v in out.items()
-                })
-                pq.write_table(table, tmp)
+                cols = {}
+                for k, v in out.items():
+                    if k == "user_idx":
+                        cols["user"] = pa.DictionaryArray.from_arrays(
+                            pa.array(v), pa.array(lvl["user_names"])
+                        )
+                    elif k == "timespan_idx":
+                        cols["timespan"] = pa.DictionaryArray.from_arrays(
+                            pa.array(v), pa.array(lvl["timespan_names"])
+                        )
+                    else:
+                        cols[k] = np.full(n, v) if v.ndim == 0 else v
+                pq.write_table(pa.table(cols), tmp)
             else:
+                out["user_names"] = np.asarray(lvl["user_names"])
+                out["timespan_names"] = np.asarray(lvl["timespan_names"])
+                # Plain savez by default: zlib cost dominated egress
+                # (~17s of a 40s 2M-point job); columns are already
+                # compact (int32 + dictionary encoding).
+                save = (np.savez_compressed
+                        if self.format == "npz-compressed" else np.savez)
                 with open(tmp, "wb") as f:
-                    np.savez_compressed(f, **out)
+                    save(f, **out)
             os.replace(tmp, final)
             rows += len(out["value"])
         return rows
@@ -227,7 +249,12 @@ class LevelArraysSink:
 
     @staticmethod
     def load(path: str) -> dict:
-        """{zoom: dict-of-columns} for every level file in ``path``."""
+        """{zoom: dict-of-columns} for every level file in ``path``.
+
+        ``user``/``timespan`` come back as materialized string columns
+        regardless of the on-disk dictionary encoding, so consumers are
+        format-agnostic.
+        """
         out = {}
         for name in sorted(os.listdir(path)):
             full = os.path.join(path, name)
@@ -236,12 +263,29 @@ class LevelArraysSink:
             if name.endswith(".npz"):
                 with np.load(full) as z:
                     cols = {k: z[k] for k in z.files}
+                for col, names in (("user", "user_names"),
+                                   ("timespan", "timespan_names")):
+                    if names in cols:
+                        cols[col] = cols[names][cols.pop(f"{col}_idx")]
+                        del cols[names]
+                    # else: pre-dictionary-encoding file, plain columns
                 out[int(cols["zoom"])] = cols
             elif name.endswith(".parquet"):
+                import pyarrow as pa
                 import pyarrow.parquet as pq
 
                 t = pq.read_table(full)
-                cols = {k: np.asarray(t[k]) for k in t.column_names}
+                cols = {}
+                for k in t.column_names:
+                    c = t[k].combine_chunks()
+                    if pa.types.is_dictionary(c.type):
+                        c = c.dictionary_decode()
+                        cols[k] = np.asarray(c).astype(str)
+                    elif pa.types.is_string(c.type):
+                        # pre-dictionary-encoding file
+                        cols[k] = np.asarray(c).astype(str)
+                    else:
+                        cols[k] = np.asarray(c)
                 # Normalize the per-row zoom columns back to scalars so
                 # both formats load identically.
                 for k in ("zoom", "coarse_zoom"):

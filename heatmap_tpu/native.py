@@ -399,6 +399,57 @@ else:
     format_blob_bodies = None
 
 
+if _lib is not None:
+    _lib.hm_decode_keys.restype = ctypes.c_int32
+    _lib.hm_decode_keys.argtypes = [
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int32,
+    ]
+
+    def decode_keys(keys, code_bits: int, n_threads: int | None = None):
+        """Split composite cascade keys -> (slot, code, row, col).
+
+        One fused multithreaded pass replacing the numpy
+        shift/mask/Morton-compact chain in pipeline.cascade
+        (decode_level_keys + tilemath.morton.morton_decode_np); with
+        ``code_bits=0`` it is a plain threaded Morton decode
+        (slot == key is then meaningless — callers ignore it).
+        """
+        import numpy as np
+
+        keys = np.ascontiguousarray(keys, np.int64)
+        n = len(keys)
+        slot = np.empty(n, np.int32)
+        code = np.empty(n, np.int64)
+        row = np.empty(n, np.int32)
+        col = np.empty(n, np.int32)
+        if n:
+            if n_threads is None:
+                n_threads = min(8, os.cpu_count() or 1)
+            rc = _lib.hm_decode_keys(
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                n, code_bits,
+                slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                code.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                col.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                n_threads,
+            )
+            if rc != 0:
+                raise ValueError(
+                    f"hm_decode_keys rejected code_bits={code_bits}"
+                )
+        return slot, code, row, col
+else:
+    decode_keys = None
+
+
 def available() -> bool:
     """True when the native library loaded (accelerated paths active)."""
     return _lib is not None

@@ -500,20 +500,22 @@ def _finish_blobs(decoded_levels, ccfg, slot_names, as_json, sink=None):
     from heatmap_tpu.utils.trace import get_tracer
 
     tracer = get_tracer()
-    finalized = cascade_mod.finalize_level_arrays(
-        decoded_levels, ccfg, slot_names
-    )
+    with tracer.span("egress.finalize"):
+        finalized = cascade_mod.finalize_level_arrays(
+            decoded_levels, ccfg, slot_names
+        )
     if sink is not None and hasattr(sink, "write_levels"):
         with tracer.span("egress"):
             rows = sink.write_levels(finalized)
         return {"egress": "levels", "levels": len(finalized), "rows": rows}
-    if as_json:
-        # Vectorized direct-to-JSON egress: no per-aggregate dicts and
-        # no per-blob json.dumps (the dict assembly dominated large
-        # jobs ~10:1 over the device cascade).
-        blobs = cascade_mod.json_blobs_from_level_arrays(finalized)
-    else:
-        blobs = cascade_mod.blobs_from_level_arrays(finalized)
+    with tracer.span("egress.blobs"):
+        if as_json:
+            # Vectorized direct-to-JSON egress: no per-aggregate dicts
+            # and no per-blob json.dumps (the dict assembly dominated
+            # large jobs ~10:1 over the device cascade).
+            blobs = cascade_mod.json_blobs_from_level_arrays(finalized)
+        else:
+            blobs = cascade_mod.blobs_from_level_arrays(finalized)
     if sink is not None:
         with tracer.span("egress"):
             sink.write(blobs.items())
@@ -880,23 +882,31 @@ def _run_loaded(data, config: BatchJobConfig, as_json: bool, sink=None):
 
 def _run_grouped(lat, lon, group_ids, timestamps, vocab,
                  config: BatchJobConfig, as_json: bool, sink=None):
-    codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
-    e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
-        codes, valid, group_ids, timestamps, config
-    )
+    from heatmap_tpu.utils.trace import get_tracer
+
+    tracer = get_tracer()
+    with tracer.span("cascade.project", items=len(lat)):
+        codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
+    with tracer.span("cascade.emissions"):
+        e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
+            codes, valid, group_ids, timestamps, config
+        )
     n_slots = len(ts_vocab) * n_groups
 
     ccfg = config.cascade_config()
-    levels = cascade_mod.build_cascade(
-        e_codes,
-        e_slots,
-        ccfg,
-        n_slots=n_slots,
-        valid=e_valid,
-        capacity=config.capacity or len(e_codes),
-    )
+    with tracer.span("cascade.device"):
+        levels = cascade_mod.build_cascade(
+            e_codes,
+            e_slots,
+            ccfg,
+            n_slots=n_slots,
+            valid=e_valid,
+            capacity=config.capacity or len(e_codes),
+        )
+    with tracer.span("cascade.decode"):
+        decoded = cascade_mod.decode_levels(levels, ccfg)
     return _finish_blobs(
-        cascade_mod.decode_levels(levels, ccfg),
+        decoded,
         ccfg,
         _slot_names(vocab, ts_vocab, n_groups),
         as_json,

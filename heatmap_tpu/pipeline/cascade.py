@@ -113,6 +113,14 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     )
 
 
+def _on_accelerator(x) -> bool:
+    """True when ``x`` is a jax array living on a non-CPU device."""
+    try:
+        return any(d.platform != "cpu" for d in x.devices())
+    except AttributeError:
+        return False  # plain numpy
+
+
 def decode_levels(level_data, config: CascadeConfig):
     """One decode pass shared by all egress consumers.
 
@@ -122,16 +130,49 @@ def decode_levels(level_data, config: CascadeConfig):
     """
     out = []
     for level in range(config.n_levels + 1):
-        keys_arr, sums, n = (np.asarray(x) for x in level_data[level])
+        keys_dev, sums_dev, n = level_data[level]
         n = int(n)
-        if n > keys_arr.shape[0]:
+        if n > keys_dev.shape[0]:
             raise ValueError(
                 f"cascade level {level} overflowed capacity "
-                f"({n} uniques > {keys_arr.shape[0]}); raise `capacity`"
+                f"({n} uniques > {keys_dev.shape[0]}); raise `capacity`"
             )
-        keys_arr, sums = keys_arr[:n], sums[:n]
-        slot_ids, codes = decode_level_keys(keys_arr, config.detail_zoom, level)
-        rows, cols = morton_decode_np(codes)
+        # On accelerators, truncate BEFORE np.asarray: the device
+        # arrays are padded to full capacity, and transferring the
+        # padding dominated decode (16 levels x capacity x 16B of
+        # mostly-pad through the device->host link; only `n` rows are
+        # real). On CPU the transfer is free and the device slice would
+        # only add a copy, so slice host-side there.
+        if _on_accelerator(keys_dev):
+            keys_arr = np.asarray(keys_dev[:n])
+            sums = np.asarray(sums_dev[:n])
+        else:
+            keys_arr = np.asarray(keys_dev)[:n]
+            sums = np.asarray(sums_dev)[:n]
+        # Lazy import (native asserts against pipeline.timespan at
+        # load; module-level would be circular). One threaded C pass
+        # replaces the ~8 single-threaded numpy passes when available.
+        from heatmap_tpu import native as _native
+
+        code_bits = 2 * (config.detail_zoom - level)
+        # The native decoder returns int32 slots. Slot ids are bounded
+        # by key >> code_bits; with code_bits >= 33 they fit int32 by
+        # construction, below that check the actual max (one cheap
+        # pass) and fall back to the int64 numpy path if they don't.
+        native_ok = _native.decode_keys is not None and (
+            code_bits >= 33
+            or keys_arr.size == 0
+            or int(keys_arr.max()) >> code_bits < 2**31
+        )
+        if native_ok:
+            slot_ids, codes, rows, cols = _native.decode_keys(
+                keys_arr, code_bits
+            )
+        else:
+            slot_ids, codes = decode_level_keys(
+                keys_arr, config.detail_zoom, level
+            )
+            rows, cols = morton_decode_np(codes)
         out.append(
             {
                 "zoom": config.detail_zoom - level,
@@ -163,19 +204,44 @@ def finalize_level_arrays(levels, config: CascadeConfig, slot_names):
     decoded levels themselves (e.g. the bounded-memory chunk merge in
     pipeline.batch): resolve slot names, add coarse tile coordinates,
     apply the amplify_all compat patch.
+
+    User/timespan columns are DICTIONARY-ENCODED: per-row int32
+    ``user_idx``/``timespan_idx`` into the small ``user_names``/
+    ``timespan_names`` tables. Materializing per-row unicode columns
+    (the previous contract) cost more host wall-clock than the entire
+    device cascade at 25M aggregates — and every consumer either wants
+    columns (sinks: dictionary encoding is smaller and faster) or only
+    touches blob-run starts (JSON egress). Use :func:`level_strings`
+    where full string columns are genuinely needed.
     """
     if config.amplify_all:
         _patch_amplified(levels, slot_names)
     n_slots = max(slot_names) + 1
     users = np.array([slot_names.get(s, ("?", "?"))[0] for s in range(n_slots)])
     tss = np.array([slot_names.get(s, ("?", "?"))[1] for s in range(n_slots)])
+    # Unique name tables + per-slot index maps (tiny: O(n_slots)).
+    user_names, slot_to_uidx = np.unique(users, return_inverse=True)
+    ts_names, slot_to_tidx = np.unique(tss, return_inverse=True)
+    slot_to_uidx = slot_to_uidx.astype(np.int32)
+    slot_to_tidx = slot_to_tidx.astype(np.int32)
     for lvl in levels:
-        lvl["user"] = users[lvl["slot"]]
-        lvl["timespan"] = tss[lvl["slot"]]
+        lvl["user_idx"] = slot_to_uidx[lvl["slot"]]
+        lvl["timespan_idx"] = slot_to_tidx[lvl["slot"]]
+        lvl["user_names"] = user_names
+        lvl["timespan_names"] = ts_names
         lvl["coarse_zoom"] = lvl["zoom"] - config.result_delta
         lvl["coarse_row"] = lvl["row"] >> config.result_delta
         lvl["coarse_col"] = lvl["col"] >> config.result_delta
     return levels
+
+
+def level_strings(lvl, sel=None):
+    """(user, timespan) string arrays for a finalized level — full
+    columns, or only rows ``sel`` (any numpy index)."""
+    ui, ti = lvl["user_idx"], lvl["timespan_idx"]
+    if sel is not None:
+        ui, ti = ui[sel], ti[sel]
+    return lvl["user_names"][ui], lvl["timespan_names"][ti]
 
 
 def emit_blobs(level_data, config: CascadeConfig, slot_names):
@@ -195,8 +261,9 @@ def emit_blobs(level_data, config: CascadeConfig, slot_names):
 def _level_blob_columns(lvl):
     """(blob_ids, detail_ids, values) string/float columns for a level."""
     sep = "|"  # reference KEY_SEPERATOR [sic], heatmap.py:18
+    users, tss = level_strings(lvl)
     blob_ids = np.char.add(
-        np.char.add(lvl["user"], sep + lvl["timespan"] + sep),
+        np.char.add(users, sep + tss + sep),
         _tile_id_strings(lvl["coarse_zoom"], lvl["coarse_row"], lvl["coarse_col"]),
     )
     detail_ids = _tile_id_strings(lvl["zoom"], lvl["row"], lvl["col"])
@@ -266,8 +333,9 @@ def json_blobs_from_level_arrays(levels):
             | (lvl["coarse_col"][1:] != lvl["coarse_col"][:-1])
         )])
         sidx = np.flatnonzero(is_start)
+        users, tss = level_strings(lvl, sidx)
         blob_ids = np.char.add(
-            np.char.add(lvl["user"][sidx], sep + lvl["timespan"][sidx] + sep),
+            np.char.add(users, sep + tss + sep),
             _tile_id_strings(lvl["coarse_zoom"], lvl["coarse_row"][sidx],
                              lvl["coarse_col"][sidx]),
         )

@@ -138,7 +138,14 @@ def morton_encode_np(row, col) -> np.ndarray:
 
 
 def morton_decode_np(code) -> tuple[np.ndarray, np.ndarray]:
-    """Numpy 64-bit Morton decode -> (row, col) int64."""
+    """Numpy 64-bit Morton decode -> (row, col) int32.
+
+    int32 is always sufficient: a 64-bit Morton code interleaves at
+    most 31 bits per axis (2*zoom <= 62), so row/col < 2^31. Halving
+    the row/col width matters at egress scale (tens of millions of
+    aggregates per job flow through these columns and their coarse
+    shifted copies).
+    """
     code = np.asarray(code, np.uint64)
 
     def compact(x):
@@ -151,6 +158,6 @@ def morton_decode_np(code) -> tuple[np.ndarray, np.ndarray]:
         return x
 
     return (
-        compact(code >> np.uint64(1)).astype(np.int64),
-        compact(code).astype(np.int64),
+        compact(code >> np.uint64(1)).astype(np.int32),
+        compact(code).astype(np.int32),
     )

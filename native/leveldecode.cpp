@@ -1,0 +1,83 @@
+// Native cascade-level key decoder: the egress decode hot loop.
+//
+// After the device cascade, every pyramid level hands back up to tens
+// of millions of composite int64 keys ((slot << code_bits) | morton)
+// that must be split into slot ids and (row, col) tile coordinates
+// before egress (pipeline/cascade.py decode_levels; the reference did
+// this per record in Python string parsing, heatmap.py:80-83). The
+// numpy path is ~8 full-array passes (shift, mask, 6 Morton compact
+// steps x 2 axes) of GIL-bound single-thread work; this does one fused
+// pass per element across OS threads into caller-allocated buffers.
+//
+// code_bits == 0 degrades to a plain Morton decode (slot = key), which
+// is how the Python side exposes a threaded morton_decode as well.
+
+#include <cstdint>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// Compact the even bits of x into the low half (standard Morton
+// de-interleave); row = compact(code >> 1), col = compact(code).
+inline uint64_t compact_even(uint64_t x) {
+  x &= 0x5555555555555555ULL;
+  x = (x | (x >> 1)) & 0x3333333333333333ULL;
+  x = (x | (x >> 2)) & 0x0F0F0F0F0F0F0F0FULL;
+  x = (x | (x >> 4)) & 0x00FF00FF00FF00FFULL;
+  x = (x | (x >> 8)) & 0x0000FFFF0000FFFFULL;
+  x = (x | (x >> 16)) & 0x00000000FFFFFFFFULL;
+  return x;
+}
+
+void decode_range(const int64_t* keys, int64_t lo, int64_t hi,
+                  int32_t code_bits, int32_t* slot, int64_t* code,
+                  int32_t* row, int32_t* col) {
+  const uint64_t mask =
+      code_bits >= 64 ? ~0ULL : ((1ULL << code_bits) - 1ULL);
+  for (int64_t i = lo; i < hi; ++i) {
+    const uint64_t k = static_cast<uint64_t>(keys[i]);
+    const uint64_t c = code_bits ? (k & mask) : k;
+    slot[i] = static_cast<int32_t>(k >> code_bits);
+    code[i] = static_cast<int64_t>(c);
+    row[i] = static_cast<int32_t>(compact_even(c >> 1));
+    col[i] = static_cast<int32_t>(compact_even(c));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Split composite keys into slot/code/row/col columns. All output
+// buffers are caller-allocated with n elements. Returns 0, or -1 on
+// invalid arguments. Threads write disjoint index ranges (no shared
+// mutable state; covered by the TSAN selftest).
+int hm_decode_keys(const int64_t* keys, int64_t n, int32_t code_bits,
+                   int32_t* slot, int64_t* code, int32_t* row,
+                   int32_t* col, int32_t n_threads) {
+  if (n < 0 || code_bits < 0 || code_bits > 63) return -1;
+  if (n == 0) return 0;
+  if (n_threads < 1) n_threads = 1;
+  const int64_t kMinPerThread = 1 << 16;
+  int64_t want = (n + kMinPerThread - 1) / kMinPerThread;
+  if (want < n_threads) n_threads = static_cast<int32_t>(want);
+  if (n_threads <= 1) {
+    decode_range(keys, 0, n, code_bits, slot, code, row, col);
+    return 0;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  const int64_t per = (n + n_threads - 1) / n_threads;
+  for (int32_t t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = lo + per < n ? lo + per : n;
+    if (lo >= hi) break;
+    threads.emplace_back(decode_range, keys, lo, hi, code_bits, slot,
+                         code, row, col);
+  }
+  for (auto& th : threads) th.join();
+  return 0;
+}
+
+}  // extern "C"

@@ -42,6 +42,10 @@ int64_t hm_format_blob_bodies(const int64_t* rows, const int64_t* cols,
                               int64_t n, int32_t zoom, int32_t n_threads,
                               char** out);
 void hm_blobfmt_free(char* buf);
+
+int hm_decode_keys(const int64_t* keys, int64_t n, int32_t code_bits,
+                   int32_t* slot, int64_t* code, int32_t* row, int32_t* col,
+                   int32_t n_threads);
 }
 
 namespace {
@@ -160,9 +164,35 @@ int main() {
     hm_blobfmt_free(one);
     hm_blobfmt_free(eight);
   }
+  // Threaded key decoder: 1-thread and 8-thread outputs must match
+  // exactly (threads write disjoint ranges of shared output buffers;
+  // the minimum-per-thread floor is the subtle part, so use an n
+  // large enough to actually fan out).
+  {
+    // >= 8 * the 2^16 per-thread floor, so n_threads=8 really fans out
+    // to 8 threads rather than being silently capped.
+    constexpr int64_t n = 1 << 19;
+    std::vector<int64_t> keys(n);
+    for (int64_t i = 0; i < n; ++i)
+      keys[i] = ((i % 37) << 42) | ((i * 2654435761LL) & ((1LL << 42) - 1));
+    std::vector<int32_t> s1(n), s8(n), r1(n), r8(n), c1(n), c8(n);
+    std::vector<int64_t> k1(n), k8(n);
+    if (hm_decode_keys(keys.data(), n, 42, s1.data(), k1.data(), r1.data(),
+                       c1.data(), 1) != 0 ||
+        hm_decode_keys(keys.data(), n, 42, s8.data(), k8.data(), r8.data(),
+                       c8.data(), 8) != 0 ||
+        std::memcmp(s1.data(), s8.data(), n * 4) != 0 ||
+        std::memcmp(k1.data(), k8.data(), n * 8) != 0 ||
+        std::memcmp(r1.data(), r8.data(), n * 4) != 0 ||
+        std::memcmp(c1.data(), c8.data(), n * 4) != 0) {
+      std::fprintf(stderr, "decode_keys thread mismatch\n");
+      return 1;
+    }
+  }
   std::remove(path.c_str());
   std::printf(
-      "tsan selftest ok: %lld rows x2, early-close, pool hammer, blobfmt\n",
+      "tsan selftest ok: %lld rows x2, early-close, pool hammer, blobfmt, "
+      "decode_keys\n",
       static_cast<long long>(a));
   return 0;
 }

@@ -362,3 +362,47 @@ def test_tsan_race_detection():
         pytest.skip("tsan runtime unsupported here")
     assert run.returncode == 0, f"TSAN reported races:\n{run.stderr[-2000:]}"
     assert "ok" in run.stdout
+
+
+def test_decode_keys_matches_numpy_oracle():
+    """The threaded C key decoder must agree exactly with the numpy
+    decode_level_keys + morton_decode_np chain, across code widths and
+    thread counts (including the edge keys at each width)."""
+    if native.decode_keys is None:
+        pytest.skip("native library not built")
+    from heatmap_tpu.pipeline.cascade import decode_level_keys
+    from heatmap_tpu.tilemath.morton import morton_decode_np
+
+    rng = np.random.default_rng(5)
+    for detail_zoom, level in ((21, 0), (21, 10), (12, 3), (21, 15)):
+        code_bits = 2 * (detail_zoom - level)
+        n_slots = 37
+        # >= 8 * the decoder's 2^16 per-thread floor, so the
+        # n_threads=8 case below genuinely runs 8 threads.
+        n = 600_001
+        codes = rng.integers(0, 1 << code_bits, n, dtype=np.int64)
+        slots = rng.integers(0, n_slots, n, dtype=np.int64)
+        keys = (slots << code_bits) | codes
+        # Edge keys: zero, max code, max slot.
+        keys[0] = 0
+        keys[1] = (1 << code_bits) - 1
+        keys[2] = ((n_slots - 1) << code_bits) | ((1 << code_bits) - 1)
+        want_slot, want_code = decode_level_keys(keys, detail_zoom, level)
+        want_row, want_col = morton_decode_np(want_code)
+        for n_threads in (1, 8):
+            got_slot, got_code, got_row, got_col = native.decode_keys(
+                keys, code_bits, n_threads=n_threads
+            )
+            np.testing.assert_array_equal(got_slot, want_slot)
+            np.testing.assert_array_equal(got_code, want_code)
+            np.testing.assert_array_equal(got_row, want_row)
+            np.testing.assert_array_equal(got_col, want_col)
+
+
+def test_decode_keys_empty_and_bad_width():
+    if native.decode_keys is None:
+        pytest.skip("native library not built")
+    s, c, r, col = native.decode_keys(np.empty(0, np.int64), 42)
+    assert len(s) == len(c) == len(r) == len(col) == 0
+    with pytest.raises(ValueError, match="code_bits"):
+        native.decode_keys(np.arange(4, dtype=np.int64), 64)
