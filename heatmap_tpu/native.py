@@ -400,6 +400,81 @@ else:
 
 
 if _lib is not None:
+    _lib.hm_format_blob_ids.restype = ctypes.c_int64
+    _lib.hm_format_blob_ids.argtypes = [
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+
+    def _name_table(names):
+        """UTF-8 concat buffer + int64 offsets for a small name array."""
+        import numpy as np
+
+        encoded = [str(s).encode("utf-8") for s in names]
+        offs = np.zeros(len(encoded) + 1, np.int64)
+        np.cumsum([len(b) for b in encoded], out=offs[1:])
+        return b"".join(encoded), offs
+
+    def format_blob_ids(user_idx, ts_idx, coarse_row, coarse_col,
+                        coarse_zoom: int, user_names, ts_names,
+                        n_threads: int | None = None) -> list:
+        """'user|timespan|z_r_c' blob id strings, dictionary-decoded
+        and formatted in one threaded C pass (the numpy np.char chain
+        this replaces was the dominant cost of reference-format JSON
+        egress; reference key codec heatmap.py:54-55)."""
+        import numpy as np
+
+        n = len(user_idx)
+        if n == 0:
+            return []
+        user_idx = np.ascontiguousarray(user_idx, np.int32)
+        ts_idx = np.ascontiguousarray(ts_idx, np.int32)
+        coarse_row = np.ascontiguousarray(coarse_row, np.int32)
+        coarse_col = np.ascontiguousarray(coarse_col, np.int32)
+        ubuf, uoffs = _name_table(user_names)
+        tbuf, toffs = _name_table(ts_names)
+        if n_threads is None:
+            n_threads = min(8, os.cpu_count() or 1)
+        out = ctypes.c_char_p()
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        length = _lib.hm_format_blob_ids(
+            user_idx.ctypes.data_as(i32p),
+            ts_idx.ctypes.data_as(i32p),
+            coarse_row.ctypes.data_as(i32p),
+            coarse_col.ctypes.data_as(i32p),
+            n, coarse_zoom,
+            ubuf, uoffs.ctypes.data_as(i64p), len(user_names),
+            tbuf, toffs.ctypes.data_as(i64p), len(ts_names),
+            n_threads, ctypes.byref(out),
+        )
+        if length < 0:
+            raise ValueError(
+                "native blob-id formatter failed (allocation or "
+                "out-of-range dictionary index)"
+            )
+        try:
+            buf = ctypes.string_at(out, length)
+        finally:
+            _lib.hm_blobfmt_free(out)
+        return buf.decode("utf-8").split("\x00")[:-1]
+else:
+    format_blob_ids = None
+
+
+if _lib is not None:
     _lib.hm_decode_keys.restype = ctypes.c_int32
     _lib.hm_decode_keys.argtypes = [
         ctypes.POINTER(ctypes.c_int64),
@@ -412,33 +487,39 @@ if _lib is not None:
         ctypes.c_int32,
     ]
 
-    def decode_keys(keys, code_bits: int, n_threads: int | None = None):
+    def decode_keys(keys, code_bits: int, n_threads: int | None = None,
+                    morton_only: bool = False):
         """Split composite cascade keys -> (slot, code, row, col).
 
         One fused multithreaded pass replacing the numpy
         shift/mask/Morton-compact chain in pipeline.cascade
-        (decode_level_keys + tilemath.morton.morton_decode_np); with
-        ``code_bits=0`` it is a plain threaded Morton decode
-        (slot == key is then meaningless — callers ignore it).
+        (decode_level_keys + tilemath.morton.morton_decode_np). With
+        ``morton_only=True`` the slot/code columns are neither
+        allocated nor written (returned as None) — the Morton-decode
+        fast path for tilemath.morton.morton_decode_np.
         """
         import numpy as np
 
         keys = np.ascontiguousarray(keys, np.int64)
+        if keys.ndim != 1:
+            raise ValueError(f"keys must be 1-D, got shape {keys.shape}")
         n = len(keys)
-        slot = np.empty(n, np.int32)
-        code = np.empty(n, np.int64)
+        slot = None if morton_only else np.empty(n, np.int32)
+        code = None if morton_only else np.empty(n, np.int64)
         row = np.empty(n, np.int32)
         col = np.empty(n, np.int32)
         if n:
             if n_threads is None:
                 n_threads = min(8, os.cpu_count() or 1)
+            i32p = ctypes.POINTER(ctypes.c_int32)
             rc = _lib.hm_decode_keys(
                 keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
                 n, code_bits,
-                slot.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                code.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-                row.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-                col.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                None if slot is None else slot.ctypes.data_as(i32p),
+                None if code is None else code.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_int64)),
+                row.ctypes.data_as(i32p),
+                col.ctypes.data_as(i32p),
                 n_threads,
             )
             if rc != 0:

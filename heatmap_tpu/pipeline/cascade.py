@@ -333,13 +333,24 @@ def json_blobs_from_level_arrays(levels):
             | (lvl["coarse_col"][1:] != lvl["coarse_col"][:-1])
         )])
         sidx = np.flatnonzero(is_start)
-        users, tss = level_strings(lvl, sidx)
-        blob_ids = np.char.add(
-            np.char.add(users, sep + tss + sep),
-            _tile_id_strings(lvl["coarse_zoom"], lvl["coarse_row"][sidx],
-                             lvl["coarse_col"][sidx]),
-        )
-        out.update(zip(blob_ids.tolist(), _blob_bodies(lvl, is_start)))
+        from heatmap_tpu import native as _native
+
+        if _native.format_blob_ids is not None:
+            ids = _native.format_blob_ids(
+                lvl["user_idx"][sidx], lvl["timespan_idx"][sidx],
+                lvl["coarse_row"][sidx], lvl["coarse_col"][sidx],
+                int(lvl["coarse_zoom"]),
+                lvl["user_names"], lvl["timespan_names"],
+            )
+        else:
+            users, tss = level_strings(lvl, sidx)
+            ids = np.char.add(
+                np.char.add(users, sep + tss + sep),
+                _tile_id_strings(lvl["coarse_zoom"],
+                                 lvl["coarse_row"][sidx],
+                                 lvl["coarse_col"][sidx]),
+            ).tolist()
+        out.update(zip(ids, _blob_bodies(lvl, is_start)))
     return out
 
 

@@ -147,6 +147,23 @@ def morton_decode_np(code) -> tuple[np.ndarray, np.ndarray]:
     shifted copies).
     """
     code = np.asarray(code, np.uint64)
+    if code.ndim == 1 and code.size > 100_000:
+        # Threaded C decode for bulk egress arrays (code_bits=0 makes
+        # hm_decode_keys a plain Morton de-interleave). Lazy import:
+        # native -> pipeline -> tilemath would cycle at module level.
+        from heatmap_tpu import native as _native
+
+        if _native.decode_keys is not None:
+            _, _, row, col = _native.decode_keys(
+                code.astype(np.int64, copy=False), 0, morton_only=True
+            )
+            return row, col
+    return _morton_decode_np_pure(code)
+
+
+def _morton_decode_np_pure(code) -> tuple[np.ndarray, np.ndarray]:
+    """The numpy-only decode: fallback and oracle for the native path."""
+    code = np.asarray(code, np.uint64)
 
     def compact(x):
         x &= np.uint64(0x5555555555555555)

@@ -54,6 +54,32 @@ struct Slice {
   int64_t len = 0;
 };
 
+// Concatenate per-slice buffers (freeing them) into one malloc'd
+// result, with `tail` extra bytes reserved past the payload. Returns
+// the payload length written so far, or -1 if any slice failed or the
+// final allocation did (slices are always freed either way).
+int64_t merge_slices(std::vector<Slice>& slices, int64_t tail, char** out) {
+  int64_t total = tail;
+  bool failed = false;
+  for (auto& s : slices) {
+    if (s.len < 0) failed = true;
+    total += s.len;
+  }
+  char* merged =
+      failed ? nullptr : static_cast<char*>(std::malloc(total ? total : 1));
+  int64_t off = 0;
+  for (auto& s : slices) {
+    if (merged != nullptr && s.len > 0) {
+      std::memcpy(merged + off, s.buf, s.len);
+      off += s.len;
+    }
+    std::free(s.buf);
+  }
+  if (merged == nullptr) return -1;
+  *out = merged;
+  return off;
+}
+
 void format_slice(const int64_t* rows, const int64_t* cols,
                   const double* vals, const uint8_t* is_start,
                   int32_t zoom, bool first_slice, Slice* s) {
@@ -137,26 +163,100 @@ int64_t hm_format_blob_bodies(const int64_t* rows, const int64_t* cols,
   }
   for (auto& w : workers) w.join();
 
-  int64_t total = 1;  // trailing '}'
-  bool failed = false;
-  for (auto& s : slices) {
-    if (s.len < 0) failed = true;
-    total += s.len;
-  }
-  char* merged = failed ? nullptr
-                        : static_cast<char*>(std::malloc(total));
-  int64_t off = 0;
-  for (auto& s : slices) {
-    if (merged != nullptr && s.len > 0) {
-      std::memcpy(merged + off, s.buf, s.len);
-      off += s.len;
-    }
-    std::free(s.buf);
-  }
-  if (merged == nullptr) return -1;
-  merged[off++] = '}';
-  *out = merged;
+  int64_t off = merge_slices(slices, /*tail=*/1, out);
+  if (off < 0) return -1;
+  (*out)[off++] = '}';  // trailing close of the last document
   return off;
+}
+
+// Format NUL-separated blob id strings "user|timespan|z_r_c" for one
+// level's blob-run starts. user_idx/ts_idx: int32[n] dictionary
+// indices; coarse_row/coarse_col: int32[n]; the name tables arrive as
+// one UTF-8 buffer each with n_* offsets[i]..offsets[i+1] spans
+// (offsets arrays have n_*+1 entries). Returns the byte length with a
+// malloc'd buffer in *out (free with hm_blobfmt_free), -1 on
+// allocation failure or an out-of-range index, 0 for n == 0.
+int64_t hm_format_blob_ids(const int32_t* user_idx, const int32_t* ts_idx,
+                           const int32_t* coarse_row,
+                           const int32_t* coarse_col, int64_t n,
+                           int32_t coarse_zoom, const char* user_buf,
+                           const int64_t* user_offs, int32_t n_users,
+                           const char* ts_buf, const int64_t* ts_offs,
+                           int32_t n_ts, int32_t n_threads, char** out) {
+  *out = nullptr;
+  if (n <= 0) return 0;
+  // Tile zooms are tiny non-negatives (<= 31 in practice); the 3-digit
+  // budget in `per` and the zbuf below depend on this bound.
+  if (coarse_zoom < 0 || coarse_zoom > 999) return -1;
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > 16) n_threads = 16;
+
+  int64_t max_user = 0, max_ts = 0;
+  for (int32_t i = 0; i < n_users; ++i) {
+    const int64_t l = user_offs[i + 1] - user_offs[i];
+    if (l > max_user) max_user = l;
+  }
+  for (int32_t i = 0; i < n_ts; ++i) {
+    const int64_t l = ts_offs[i + 1] - ts_offs[i];
+    if (l > max_ts) max_ts = l;
+  }
+  // user + '|' + timespan + '|' + zoom(3) + '_' + row(12) + '_' +
+  // col(12) + NUL, padded.
+  const int64_t per = max_user + max_ts + 34;
+
+  const int64_t kMinPerThread = 1 << 15;
+  int64_t want = (n + kMinPerThread - 1) / kMinPerThread;
+  if (want < n_threads) n_threads = static_cast<int32_t>(want);
+  std::vector<Slice> slices;
+  const int64_t chunk = (n + n_threads - 1) / n_threads;
+  for (int64_t lo = 0; lo < n; lo += chunk)
+    slices.push_back({lo, lo + chunk < n ? lo + chunk : n});
+
+  char zbuf[8];
+  char* zend = put_i64(zbuf, coarse_zoom);
+  const int zlen = static_cast<int>(zend - zbuf);
+
+  std::vector<std::thread> workers;
+  for (auto& s : slices) {
+    workers.emplace_back([&, sp = &s] {
+      const int64_t m = sp->hi - sp->lo;
+      sp->buf = static_cast<char*>(
+          std::malloc(static_cast<size_t>(m) * per));
+      if (sp->buf == nullptr) {
+        sp->len = -1;
+        return;
+      }
+      char* p = sp->buf;
+      for (int64_t i = sp->lo; i < sp->hi; ++i) {
+        const int32_t u = user_idx[i], t = ts_idx[i];
+        if (u < 0 || u >= n_users || t < 0 || t >= n_ts) {
+          sp->len = -1;
+          std::free(sp->buf);
+          sp->buf = nullptr;
+          return;
+        }
+        const int64_t ul = user_offs[u + 1] - user_offs[u];
+        std::memcpy(p, user_buf + user_offs[u], ul);
+        p += ul;
+        *p++ = '|';
+        const int64_t tl = ts_offs[t + 1] - ts_offs[t];
+        std::memcpy(p, ts_buf + ts_offs[t], tl);
+        p += tl;
+        *p++ = '|';
+        std::memcpy(p, zbuf, zlen);
+        p += zlen;
+        *p++ = '_';
+        p = put_i64(p, coarse_row[i]);
+        *p++ = '_';
+        p = put_i64(p, coarse_col[i]);
+        *p++ = '\0';
+      }
+      sp->len = p - sp->buf;
+    });
+  }
+  for (auto& w : workers) w.join();
+
+  return merge_slices(slices, /*tail=*/0, out);
 }
 
 void hm_blobfmt_free(char* buf) { std::free(buf); }

@@ -371,7 +371,7 @@ def test_decode_keys_matches_numpy_oracle():
     if native.decode_keys is None:
         pytest.skip("native library not built")
     from heatmap_tpu.pipeline.cascade import decode_level_keys
-    from heatmap_tpu.tilemath.morton import morton_decode_np
+    from heatmap_tpu.tilemath.morton import _morton_decode_np_pure
 
     rng = np.random.default_rng(5)
     for detail_zoom, level in ((21, 0), (21, 10), (12, 3), (21, 15)):
@@ -388,7 +388,7 @@ def test_decode_keys_matches_numpy_oracle():
         keys[1] = (1 << code_bits) - 1
         keys[2] = ((n_slots - 1) << code_bits) | ((1 << code_bits) - 1)
         want_slot, want_code = decode_level_keys(keys, detail_zoom, level)
-        want_row, want_col = morton_decode_np(want_code)
+        want_row, want_col = _morton_decode_np_pure(want_code)
         for n_threads in (1, 8):
             got_slot, got_code, got_row, got_col = native.decode_keys(
                 keys, code_bits, n_threads=n_threads
@@ -406,3 +406,64 @@ def test_decode_keys_empty_and_bad_width():
     assert len(s) == len(c) == len(r) == len(col) == 0
     with pytest.raises(ValueError, match="code_bits"):
         native.decode_keys(np.arange(4, dtype=np.int64), 64)
+
+
+def test_format_blob_ids_matches_numpy_oracle():
+    """The C blob-id formatter must produce exactly the np.char chain's
+    strings, including multibyte UTF-8 user names and the reference '|'
+    separator (KEY_SEPERATOR [sic], reference heatmap.py:18)."""
+    if native.format_blob_ids is None:
+        pytest.skip("native library not built")
+    rng = np.random.default_rng(9)
+    n = 70_001
+    user_names = np.array(["all", "route", "u-Ä", "东京", "plain", "x|y"])
+    ts_names = np.array(["alltime", "2017_02_03"])
+    uidx = rng.integers(0, len(user_names), n).astype(np.int32)
+    tidx = rng.integers(0, len(ts_names), n).astype(np.int32)
+    crow = rng.integers(0, 1 << 16, n).astype(np.int32)
+    ccol = rng.integers(0, 1 << 16, n).astype(np.int32)
+    zoom = 11
+    want = [
+        f"{user_names[u]}|{ts_names[t]}|{zoom}_{r}_{c}"
+        for u, t, r, c in zip(uidx, tidx, crow, ccol)
+    ]
+    for n_threads in (1, 8):
+        got = native.format_blob_ids(uidx, tidx, crow, ccol, zoom,
+                                     user_names, ts_names,
+                                     n_threads=n_threads)
+        assert got == want
+
+
+def test_format_blob_ids_rejects_bad_index():
+    if native.format_blob_ids is None:
+        pytest.skip("native library not built")
+    with pytest.raises(ValueError, match="out-of-range|failed"):
+        native.format_blob_ids(
+            np.array([5], np.int32), np.array([0], np.int32),
+            np.array([1], np.int32), np.array([1], np.int32),
+            10, np.array(["only"]), np.array(["alltime"]),
+        )
+
+
+def test_decode_keys_morton_only_and_2d_rejected():
+    if native.decode_keys is None:
+        pytest.skip("native library not built")
+    keys = np.arange(200_000, dtype=np.int64)
+    s, c, r, col = native.decode_keys(keys, 0, morton_only=True)
+    assert s is None and c is None
+    _, _, wr, wc = native.decode_keys(keys, 0)
+    np.testing.assert_array_equal(r, wr)
+    np.testing.assert_array_equal(col, wc)
+    with pytest.raises(ValueError, match="1-D"):
+        native.decode_keys(keys.reshape(-1, 2), 0)
+
+
+def test_format_blob_ids_rejects_absurd_zoom():
+    if native.format_blob_ids is None:
+        pytest.skip("native library not built")
+    with pytest.raises(ValueError, match="failed|out-of-range"):
+        native.format_blob_ids(
+            np.array([0], np.int32), np.array([0], np.int32),
+            np.array([1], np.int32), np.array([1], np.int32),
+            2**30, np.array(["u"]), np.array(["alltime"]),
+        )
