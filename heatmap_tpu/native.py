@@ -437,6 +437,11 @@ if _lib is not None:
         import numpy as np
 
         n = len(user_idx)
+        if not (len(ts_idx) == len(coarse_row) == len(coarse_col) == n):
+            raise ValueError(
+                f"column length mismatch: user_idx={n} ts_idx={len(ts_idx)} "
+                f"coarse_row={len(coarse_row)} coarse_col={len(coarse_col)}"
+            )
         if n == 0:
             return []
         user_idx = np.ascontiguousarray(user_idx, np.int32)
@@ -460,11 +465,14 @@ if _lib is not None:
             tbuf, toffs.ctypes.data_as(i64p), len(ts_names),
             n_threads, ctypes.byref(out),
         )
-        if length < 0:
+        if length == -1:
+            raise MemoryError("native blob-id formatter allocation failed")
+        if length == -2:
             raise ValueError(
-                "native blob-id formatter failed (allocation or "
-                "out-of-range dictionary index)"
+                "blob-id dictionary index out of range for its name table"
             )
+        if length < 0:
+            raise ValueError(f"coarse_zoom out of range: {coarse_zoom}")
         try:
             buf = ctypes.string_at(out, length)
         finally:

@@ -56,14 +56,15 @@ struct Slice {
 
 // Concatenate per-slice buffers (freeing them) into one malloc'd
 // result, with `tail` extra bytes reserved past the payload. Returns
-// the payload length written so far, or -1 if any slice failed or the
-// final allocation did (slices are always freed either way).
+// the payload length written so far; a failed slice's negative len is
+// propagated as-is, and -1 is returned if the final allocation fails
+// (slices are always freed either way).
 int64_t merge_slices(std::vector<Slice>& slices, int64_t tail, char** out) {
   int64_t total = tail;
-  bool failed = false;
+  int64_t failed = 0;
   for (auto& s : slices) {
-    if (s.len < 0) failed = true;
-    total += s.len;
+    if (s.len < 0 && failed == 0) failed = s.len;
+    if (s.len > 0) total += s.len;
   }
   char* merged =
       failed ? nullptr : static_cast<char*>(std::malloc(total ? total : 1));
@@ -75,6 +76,7 @@ int64_t merge_slices(std::vector<Slice>& slices, int64_t tail, char** out) {
     }
     std::free(s.buf);
   }
+  if (failed) return failed;
   if (merged == nullptr) return -1;
   *out = merged;
   return off;
@@ -174,8 +176,9 @@ int64_t hm_format_blob_bodies(const int64_t* rows, const int64_t* cols,
 // indices; coarse_row/coarse_col: int32[n]; the name tables arrive as
 // one UTF-8 buffer each with n_* offsets[i]..offsets[i+1] spans
 // (offsets arrays have n_*+1 entries). Returns the byte length with a
-// malloc'd buffer in *out (free with hm_blobfmt_free), -1 on
-// allocation failure or an out-of-range index, 0 for n == 0.
+// malloc'd buffer in *out (free with hm_blobfmt_free), 0 for n == 0,
+// or a distinct negative code: -1 allocation failure, -2 dictionary
+// index out of range, -3 coarse_zoom out of [0, 999].
 int64_t hm_format_blob_ids(const int32_t* user_idx, const int32_t* ts_idx,
                            const int32_t* coarse_row,
                            const int32_t* coarse_col, int64_t n,
@@ -187,7 +190,7 @@ int64_t hm_format_blob_ids(const int32_t* user_idx, const int32_t* ts_idx,
   if (n <= 0) return 0;
   // Tile zooms are tiny non-negatives (<= 31 in practice); the 3-digit
   // budget in `per` and the zbuf below depend on this bound.
-  if (coarse_zoom < 0 || coarse_zoom > 999) return -1;
+  if (coarse_zoom < 0 || coarse_zoom > 999) return -3;
   if (n_threads < 1) n_threads = 1;
   if (n_threads > 16) n_threads = 16;
 
@@ -230,7 +233,7 @@ int64_t hm_format_blob_ids(const int32_t* user_idx, const int32_t* ts_idx,
       for (int64_t i = sp->lo; i < sp->hi; ++i) {
         const int32_t u = user_idx[i], t = ts_idx[i];
         if (u < 0 || u >= n_users || t < 0 || t >= n_ts) {
-          sp->len = -1;
+          sp->len = -2;
           std::free(sp->buf);
           sp->buf = nullptr;
           return;

@@ -53,8 +53,8 @@ extern "C" {
 // are caller-allocated with n elements; slot and/or code may be null
 // to skip those columns (Morton-only decode avoids 12 bytes/element
 // of dead stores). Returns 0, or -1 on invalid arguments. Threads
-// write disjoint index ranges (no shared mutable state; covered by
-// the TSAN selftest).
+// write disjoint index ranges (no shared mutable state); both the
+// full and null-column forms run under the TSAN selftest.
 int hm_decode_keys(const int64_t* keys, int64_t n, int32_t code_bits,
                    int32_t* slot, int64_t* code, int32_t* row,
                    int32_t* col, int32_t n_threads) {

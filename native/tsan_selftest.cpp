@@ -46,6 +46,14 @@ void hm_blobfmt_free(char* buf);
 int hm_decode_keys(const int64_t* keys, int64_t n, int32_t code_bits,
                    int32_t* slot, int64_t* code, int32_t* row, int32_t* col,
                    int32_t n_threads);
+
+int64_t hm_format_blob_ids(const int32_t* user_idx, const int32_t* ts_idx,
+                           const int32_t* coarse_row,
+                           const int32_t* coarse_col, int64_t n,
+                           int32_t coarse_zoom, const char* user_buf,
+                           const int64_t* user_offs, int32_t n_users,
+                           const char* ts_buf, const int64_t* ts_offs,
+                           int32_t n_ts, int32_t n_threads, char** out);
 }
 
 namespace {
@@ -188,11 +196,49 @@ int main() {
       std::fprintf(stderr, "decode_keys thread mismatch\n");
       return 1;
     }
+    // Morton-only form: null slot/code outputs, threaded.
+    std::vector<int32_t> rm(n), cm(n);
+    if (hm_decode_keys(keys.data(), n, 0, nullptr, nullptr, rm.data(),
+                       cm.data(), 8) != 0) {
+      std::fprintf(stderr, "decode_keys null-column form failed\n");
+      return 1;
+    }
+  }
+  // Threaded blob-id formatter: 1-thread and 8-thread outputs must be
+  // byte-identical across slice boundaries.
+  {
+    constexpr int64_t n = 1 << 19;
+    const char unames[] = "all\0route\0user-7";
+    const int64_t uoffs[] = {0, 3, 9, 16};
+    const char tnames[] = "alltime\0""2017_02_03";
+    const int64_t toffs[] = {0, 7, 17};
+    std::vector<int32_t> ui(n), ti(n), cr(n), cc(n);
+    for (int64_t i = 0; i < n; ++i) {
+      ui[i] = static_cast<int32_t>(i % 3);
+      ti[i] = static_cast<int32_t>(i % 2);
+      cr[i] = static_cast<int32_t>((i * 31) % 65536);
+      cc[i] = static_cast<int32_t>((i * 17) % 65536);
+    }
+    char* one = nullptr;
+    char* eight = nullptr;
+    int64_t l1 = hm_format_blob_ids(ui.data(), ti.data(), cr.data(),
+                                    cc.data(), n, 11, unames, uoffs, 3,
+                                    tnames, toffs, 2, 1, &one);
+    int64_t l8 = hm_format_blob_ids(ui.data(), ti.data(), cr.data(),
+                                    cc.data(), n, 11, unames, uoffs, 3,
+                                    tnames, toffs, 2, 8, &eight);
+    if (l1 != l8 || l1 < 0 || std::memcmp(one, eight, l1) != 0) {
+      std::fprintf(stderr, "blob-id thread mismatch: %lld vs %lld\n",
+                   static_cast<long long>(l1), static_cast<long long>(l8));
+      return 1;
+    }
+    hm_blobfmt_free(one);
+    hm_blobfmt_free(eight);
   }
   std::remove(path.c_str());
   std::printf(
       "tsan selftest ok: %lld rows x2, early-close, pool hammer, blobfmt, "
-      "decode_keys\n",
+      "blob-ids, decode_keys\n",
       static_cast<long long>(a));
   return 0;
 }

@@ -418,3 +418,71 @@ class TestRunJob:
     def test_run_job_filters_background(self):
         src = SyntheticSource(n=300, seed=1, background_frac=1.0)
         assert run_job(src, None, BatchJobConfig(detail_zoom=10)) == {}
+
+
+class TestLevelArraysSinkCompat:
+    def test_load_reads_pre_dictionary_npz(self, tmp_path):
+        """Files written before dictionary encoding (plain user/timespan
+        string columns, no *_names tables) must still load."""
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        d = tmp_path / "old"
+        d.mkdir()
+        cols = {
+            "row": np.array([1, 2], np.int64),
+            "col": np.array([3, 4], np.int64),
+            "value": np.array([1.0, 2.0]),
+            "user": np.array(["alice", "all"]),
+            "timespan": np.array(["alltime", "alltime"]),
+            "coarse_row": np.array([0, 0], np.int64),
+            "coarse_col": np.array([0, 0], np.int64),
+            "zoom": np.asarray(9),
+            "coarse_zoom": np.asarray(4),
+        }
+        with open(d / "level_z09.npz", "wb") as f:
+            np.savez(f, **cols)
+        out = LevelArraysSink.load(str(d))
+        assert list(out) == [9]
+        np.testing.assert_array_equal(out[9]["user"], cols["user"])
+        np.testing.assert_array_equal(out[9]["timespan"], cols["timespan"])
+
+    def test_load_reads_pre_dictionary_parquet(self, tmp_path):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        d = tmp_path / "oldpq"
+        d.mkdir()
+        t = pa.table({
+            "row": np.array([1], np.int64),
+            "col": np.array([2], np.int64),
+            "value": np.array([3.0]),
+            "user": ["alice"],          # plain string, not dictionary
+            "timespan": ["alltime"],
+            "coarse_row": np.array([0], np.int64),
+            "coarse_col": np.array([0], np.int64),
+            "zoom": np.array([7], np.int64),
+            "coarse_zoom": np.array([2], np.int64),
+        })
+        pq.write_table(t, str(d / "level_z07.parquet"))
+        out = LevelArraysSink.load(str(d))
+        assert out[7]["user"][0] == "alice"
+        assert out[7]["timespan"][0] == "alltime"
+        assert int(out[7]["coarse_zoom"]) == 2
+
+    def test_npz_compressed_format_roundtrips(self, tmp_path):
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        src = SyntheticSource(n=800, seed=3)
+        cfg = BatchJobConfig(detail_zoom=9, min_detail_zoom=7)
+        run_job(src, LevelArraysSink(str(tmp_path / "a")), config=cfg)
+        run_job(src, LevelArraysSink(str(tmp_path / "b"),
+                                     format="npz-compressed"), config=cfg)
+        a = LevelArraysSink.load(str(tmp_path / "a"))
+        b = LevelArraysSink.load(str(tmp_path / "b"))
+        assert a.keys() == b.keys()
+        for z in a:
+            assert a[z].keys() == b[z].keys()
+            for k in a[z]:
+                np.testing.assert_array_equal(a[z][k], b[z][k])

@@ -82,6 +82,22 @@ def main():
 
         report(f"sort-only stable={stable}", timed(sort_only))
 
+    # Sort-shape probe: k independent row sorts of n/k elements (vmapped
+    # along axis -1). If this beats the flat sort meaningfully, a
+    # k-stream variant of the partitioned kernel (accumulating output
+    # blocks across per-stream visit runs) buys the difference.
+    for k in (8, 32, 128):
+
+        @jax.jit
+        def sort_rows(la, lo, kk=k):
+            r, c, v = mercator.project_points(la, lo, win.zoom,
+                                              dtype=jnp.float32)
+            idx = jnp.where(v, r * win.width + c, win.height * win.width)
+            return lax.sort(idx.reshape(kk, -1), dimension=1,
+                            is_stable=False)
+
+        report(f"sort-rows k={k}", timed(sort_rows))
+
     combos = [
         # (block_cells, chunk, bad_frac): block size sweep at the
         # defaults, chunk sweep at the best-guess block, tail-cap sweep
