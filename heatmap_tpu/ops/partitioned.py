@@ -54,7 +54,7 @@ DEFAULT_BLOCK_CELLS = 1 << 16
 
 def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
                       zeros_ref, out_ref, acc_ref, *, chunk, block_cells,
-                      side):
+                      side, n_blocks):
     # This backend is count-only (histogram.py routes weighted binning
     # to xla/pallas); zeros_ref only alias-inits the output.
     del zeros_ref
@@ -64,7 +64,9 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    local = s_ref[0, 0, :] - base_ref[i] * block_cells
+    # base_ref holds FLAT output-slab ids stream*n_blocks + block; the
+    # cell offset inside the window depends only on the block part.
+    local = s_ref[0, 0, :] - (base_ref[i] % n_blocks) * block_cells
     ok = (good_ref[i] == 1) & (local >= 0) & (local < block_cells)
     rloc = jnp.where(ok, local // side, -1)
     cloc = jnp.where(ok, local % side, 0)
@@ -82,30 +84,48 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
         out_ref[:] = acc_ref[:]
 
 
-def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
+def _partitioned_path(s2, good2, n_blocks, hw, chunk,
                       bad_cap_chunks, interpret, block_cells, side):
     """Good chunks -> pallas blocks; bad tail -> bounded scatter.
 
-    ``good`` is the per-chunk mask computed by the caller — the SAME
-    mask that sized the bounded tail via n_bad, so the tail provably
-    covers every chunk this path masks out.
+    ``s2`` is (streams, L): each row independently sorted (one flat
+    sort is the streams=1 case). ``good2`` is the per-(stream, chunk)
+    mask computed by the caller — the SAME mask that sized the bounded
+    tail via n_bad, so the tail provably covers every chunk this path
+    masks out. Each stream accumulates into its own slab of output
+    blocks; the slabs sum at the end (counts are linear), which keeps
+    every output block's visits consecutive WITHIN the flattened grid
+    without any cross-stream ordering requirement.
     """
-    fblk = s[::chunk] // block_cells
+    streams, L = s2.shape
+    nck = L // chunk
+    n_chunks = streams * nck
+    first = s2[:, ::chunk]
+    fblk = first // block_cells
 
-    # The stream is globally sorted, so chunk block ids are ALREADY
-    # non-decreasing in original order — no reorder pass over the 33M
-    # stream is needed. Forward-fill bad chunks with the last good base
+    # Each row is sorted, so chunk block ids are non-decreasing within
+    # a stream — no reorder pass over the point stream is needed.
+    # Forward-fill bad chunks with the last good base per stream
     # (cummax works because good bases are non-decreasing); leading
     # bads clamp to block 0, fully masked; a bad chunk between two
     # blocks joins the previous block's visit run and writes nothing.
-    base = jnp.maximum(lax.cummax(jnp.where(good, fblk, -1)), 0)
+    base2 = jnp.maximum(
+        lax.cummax(jnp.where(good2, fblk, -1), axis=1), 0
+    )
+    # Flat output-slab id: stream*n_blocks + block. Monotone within a
+    # stream and strictly increasing across stream boundaries' slabs,
+    # so visit runs stay consecutive over the flattened grid.
+    ob = (
+        jnp.arange(streams, dtype=base2.dtype)[:, None] * n_blocks + base2
+    ).reshape(-1)
+    good = good2.reshape(-1)
     gi = good.astype(jnp.int32)
     first_visit = jnp.concatenate(
         [jnp.ones(1, jnp.int32),
-         (base[1:] != base[:-1]).astype(jnp.int32)]
+         (ob[1:] != ob[:-1]).astype(jnp.int32)]
     )
     last_visit = jnp.concatenate(
-        [(base[1:] != base[:-1]).astype(jnp.int32),
+        [(ob[1:] != ob[:-1]).astype(jnp.int32),
          jnp.ones(1, jnp.int32)]
     )
 
@@ -131,18 +151,24 @@ def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
         ),
         scratch_shapes=[pltpu.VMEM((1, side, side), jnp.float32)],
     )
-    zeros = jnp.zeros((n_blocks, side, side), jnp.float32)
+    zeros = jnp.zeros((streams * n_blocks, side, side), jnp.float32)
     blocks = pl.pallas_call(
         functools.partial(_partition_kernel, chunk=chunk,
-                          block_cells=block_cells, side=side),
+                          block_cells=block_cells, side=side,
+                          n_blocks=n_blocks),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (n_blocks, side, side), jnp.float32
+            (streams * n_blocks, side, side), jnp.float32
         ),
         input_output_aliases={5: 0},  # zeros operand -> output
         interpret=interpret,
-    )(base, gi, first_visit, last_visit, s.reshape(n_chunks, 1, chunk), zeros)
-    dense = blocks.reshape(n_blocks * block_cells)[:hw]
+    )(ob, gi, first_visit, last_visit,
+      s2.reshape(n_chunks, 1, chunk), zeros)
+    dense = (
+        blocks.reshape(streams, n_blocks * block_cells).sum(axis=0)[:hw]
+        if streams > 1
+        else blocks.reshape(n_blocks * block_cells)[:hw]
+    )
 
     # Bounded scatter over the bad chunks only: gather exactly their
     # rows (the cond guarantees there are at most bad_cap_chunks of
@@ -152,7 +178,7 @@ def _partitioned_path(s, good, n_chunks, n_blocks, hw, chunk,
     bad_idx = jnp.nonzero(~good, size=bad_cap_chunks,
                           fill_value=n_chunks)[0]
     bad_rows = jnp.take(
-        s.reshape(n_chunks, chunk), bad_idx, axis=0,
+        s2.reshape(n_chunks, chunk), bad_idx, axis=0,
         mode="fill", fill_value=hw,
     )
     tail = (
@@ -173,6 +199,7 @@ def bin_rowcol_window_partitioned(
     interpret: bool | None = None,
     dtype=jnp.int32,
     block_cells: int = DEFAULT_BLOCK_CELLS,
+    streams: int = 1,
 ):
     """Count-only binning of pre-projected points into a large window.
 
@@ -184,19 +211,26 @@ def bin_rowcol_window_partitioned(
     lowering), False on accelerators. ``block_cells`` sets the aligned
     output-block size (must be an even power of two >= 2^12 so the
     side is a lane-friendly square; see DEFAULT_BLOCK_CELLS).
+    ``streams`` splits the cell-id stream into that many independently
+    sorted rows (one batched row sort instead of one flat sort; each
+    row can be VMEM-resident), each accumulating its own output-block
+    slab, summed at the end — same raster bit-for-bit, different
+    sort-cost/memory tradeoff. streams=1 is the flat-sort baseline.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     return _bin_partitioned_jit(
         row, col, window, valid, chunk=chunk, bad_frac=bad_frac,
         interpret=interpret, dtype=dtype, block_cells=block_cells,
+        streams=streams,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "window", "chunk", "bad_frac", "interpret", "dtype", "block_cells"
+        "window", "chunk", "bad_frac", "interpret", "dtype", "block_cells",
+        "streams",
     ),
 )
 def _bin_partitioned_jit(
@@ -209,6 +243,7 @@ def _bin_partitioned_jit(
     interpret: bool = False,
     dtype=jnp.int32,
     block_cells: int = DEFAULT_BLOCK_CELLS,
+    streams: int = 1,
 ):
     h, w = window.height, window.width
     hw = h * w
@@ -220,6 +255,8 @@ def _bin_partitioned_jit(
             f"block_cells must be an even power of two >= 4096 "
             f"(a square side of >= 64 lanes), got {block_cells}"
         )
+    if streams < 1:
+        raise ValueError(f"streams must be >= 1, got {streams}")
     n_blocks = -(-hw // block_cells)
     sentinel = n_blocks * block_cells  # beyond every block, drops everywhere
 
@@ -231,34 +268,42 @@ def _bin_partitioned_jit(
     idx = jnp.where(ok, r * w + c, sentinel)
 
     n = idx.shape[0]
-    n_pad = -(-max(n, 1) // chunk) * chunk
+    # Pad so each of the `streams` rows is a whole number of chunks.
+    per_stream = -(-max(n, 1) // (streams * chunk)) * chunk
+    n_pad = streams * per_stream
     if n_pad != n:
         idx = jnp.concatenate(
             [idx, jnp.full(n_pad - n, sentinel, jnp.int32)]
         )
     n_chunks = n_pad // chunk
-    bad_cap_chunks = max(1, n_chunks // bad_frac)
+    # Padding sentinels land in the trailing rows and sort to each
+    # row's end, so they can mark up to ~streams extra chunks bad on
+    # top of the data-dependent ones.
+    bad_cap_chunks = max(streams + 1, n_chunks // bad_frac)
 
     # Unstable sort: cell ids are the only payload, so equal keys are
-    # indistinguishable and stability would only cost time.
-    s = jnp.sort(idx, stable=False)
+    # indistinguishable and stability would only cost time. With
+    # streams > 1 this is one batched row sort (axis -1).
+    s2 = jnp.sort(idx.reshape(streams, per_stream), axis=-1, stable=False)
     # The single source of truth for chunk goodness: fully inside one
     # aligned block AND free of sentinels. The bounded tail in
     # _partitioned_path covers exactly the chunks this marks bad, and
     # the cond below guarantees they fit.
-    first = s[::chunk]
-    last = s[chunk - 1 :: chunk]
-    good = (first // block_cells == last // block_cells) & (last < sentinel)
-    n_bad = (~good).sum()
+    first = s2[:, ::chunk]
+    last = s2[:, chunk - 1 :: chunk]
+    good2 = (first // block_cells == last // block_cells) & (last < sentinel)
+    n_bad = (~good2).sum()
 
     raster = lax.cond(
         n_bad <= bad_cap_chunks,
         lambda s_, good_: _partitioned_path(
-            s_, good_, n_chunks, n_blocks, hw, chunk, bad_cap_chunks,
+            s_, good_, n_blocks, hw, chunk, bad_cap_chunks,
             interpret, block_cells, side,
         ),
-        lambda s_, good_: jnp.zeros(hw, jnp.int32).at[s_].add(1, mode="drop"),
-        s,
-        good,
+        lambda s_, good_: (
+            jnp.zeros(hw, jnp.int32).at[s_.reshape(-1)].add(1, mode="drop")
+        ),
+        s2,
+        good2,
     )
     return raster.reshape(h, w).astype(dtype)

@@ -208,9 +208,10 @@ int main() {
   // byte-identical across slice boundaries.
   {
     constexpr int64_t n = 1 << 19;
-    const char unames[] = "all\0route\0user-7";
-    const int64_t uoffs[] = {0, 3, 9, 16};
-    const char tnames[] = "alltime\0""2017_02_03";
+    // Packed like the Python _name_table: concatenated, NO separators.
+    const char unames[] = "allrouteuser-7";
+    const int64_t uoffs[] = {0, 3, 8, 14};
+    const char tnames[] = "alltime2017_02_03";
     const int64_t toffs[] = {0, 7, 17};
     std::vector<int32_t> ui(n), ti(n), cr(n), cc(n);
     for (int64_t i = 0; i < n; ++i) {

@@ -133,3 +133,43 @@ def test_backend_plumbing_and_weighted_rejection():
             row, col, WINDOW, weights=jnp.ones(1000),
             backend="partitioned",
         )
+
+
+@pytest.mark.parametrize("streams", [2, 4, 8])
+def test_streams_bit_exact_clustered(streams):
+    """k-stream variant (batched row sorts, per-stream output slabs
+    summed) must match the scatter contract exactly, including padding
+    chunks landing in the trailing streams."""
+    rng = np.random.default_rng(11)
+    n = (1 << 15) + 777  # deliberately not a multiple of streams*chunk
+    row = rng.integers(520, 620, n)
+    col = rng.integers(300, 500, n)
+    row[:500] = rng.integers(0, 4096, 500)
+    col[:500] = rng.integers(0, 4096, 500)
+    assert _diff(row, col, streams=streams).sum() > 0
+
+
+def test_streams_uniform_fallback_and_pileup():
+    rng = np.random.default_rng(12)
+    n = 1 << 14
+    # Uniform over the whole window: mostly bad chunks -> in-jit
+    # full-scatter fallback must reshape the stream matrix correctly.
+    row = rng.integers(0, 4096, n)
+    col = rng.integers(0, 4096, n)
+    _diff(row, col, streams=4)
+    # Single-cell pileup + out-of-window fringe.
+    row2 = np.full(n, 600)
+    col2 = np.full(n, 400)
+    row2[: n // 8] = rng.integers(-100, 5000, n // 8)
+    col2[: n // 8] = rng.integers(-100, 5000, n // 8)
+    _diff(row2, col2, streams=4)
+
+
+def test_streams_one_equals_flat_path():
+    rng = np.random.default_rng(13)
+    n = 1 << 14
+    row = rng.integers(520, 620, n)
+    col = rng.integers(300, 500, n)
+    a = _diff(row, col, streams=1)
+    b = _diff(row, col, streams=8)
+    np.testing.assert_array_equal(a, b)

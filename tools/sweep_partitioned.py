@@ -99,32 +99,38 @@ def main():
         report(f"sort-rows k={k}", timed(sort_rows))
 
     combos = [
-        # (block_cells, chunk, bad_frac): block size sweep at the
-        # defaults, chunk sweep at the best-guess block, tail-cap sweep
-        # (the n/bad_frac scatter tail costs ~8-30 ns/update).
-        (1 << 16, 1024, 8),
-        (1 << 14, 1024, 8),
-        (1 << 12, 1024, 8),
-        (1 << 14, 512, 8),
-        (1 << 14, 2048, 8),
-        (1 << 16, 1024, 32),
-        (1 << 14, 1024, 32),
-        (1 << 14, 1024, 128),
+        # (block_cells, chunk, bad_frac, streams): block size sweep at
+        # the defaults, chunk sweep at the best-guess block, tail-cap
+        # sweep (the n/bad_frac scatter tail costs ~8-30 ns/update),
+        # then the k-stream batched-row-sort variant.
+        (1 << 16, 1024, 8, 1),
+        (1 << 14, 1024, 8, 1),
+        (1 << 12, 1024, 8, 1),
+        (1 << 14, 512, 8, 1),
+        (1 << 14, 2048, 8, 1),
+        (1 << 16, 1024, 32, 1),
+        (1 << 14, 1024, 32, 1),
+        (1 << 14, 1024, 128, 1),
+        (1 << 16, 1024, 8, 8),
+        (1 << 16, 1024, 8, 32),
+        (1 << 16, 1024, 8, 128),
     ]
-    for block_cells, chunk, bad_frac in combos:
+    for block_cells, chunk, bad_frac, streams in combos:
 
         @jax.jit
-        def part(la, lo, bc=block_cells, ck=chunk, bf=bad_frac):
+        def part(la, lo, bc=block_cells, ck=chunk, bf=bad_frac, st=streams):
             r, c, v = mercator.project_points(la, lo, win.zoom,
                                               dtype=jnp.float32)
             return bin_rowcol_window_partitioned(
                 r, c, win, valid=v, block_cells=bc, chunk=ck, bad_frac=bf,
+                streams=st,
             )
 
-        name = f"partitioned bc={block_cells} chunk={chunk} bf={bad_frac}"
+        name = (f"partitioned bc={block_cells} chunk={chunk} "
+                f"bf={bad_frac} k={streams}")
         try:
             report(name, timed(part), block_cells=block_cells,
-                   chunk=chunk, bad_frac=bad_frac)
+                   chunk=chunk, bad_frac=bad_frac, streams=streams)
         except Exception as e:  # noqa: BLE001 — keep sweeping
             print(json.dumps({
                 "config": name,

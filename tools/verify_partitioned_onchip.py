@@ -76,6 +76,9 @@ def main() -> int:
         {"chunk": 512},
         {"chunk": 2048},
         {"bad_frac": 32},
+        {"streams": 8},
+        {"streams": 32},
+        {"streams": 8, "block_cells": 1 << 14},
     ]
     failures = 0
     for name, (lat, lon) in cases.items():
