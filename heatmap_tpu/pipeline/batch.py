@@ -450,7 +450,11 @@ def _merge_sorted_level(m, ts2, g2, code2, value2):
     tmax = int(ts.max(initial=0)) + 1
     if code_bits + (gmax * tmax).bit_length() < 62:
         def pack(t_, g_, c_):
-            return ((t_ * gmax + g_) << code_bits) | c_
+            # int64 up front: ts/g arrive int32 off the native key
+            # decoder, and << code_bits (up to 42 at z21) would
+            # silently wrap in int32 — unsorted pack keys then corrupt
+            # the positional merge below.
+            return ((t_.astype(np.int64) * gmax + g_) << code_bits) | c_
 
         pa = pack(m["ts"], m["g"], m["code"])
         pb = pack(ts2, g2, code2)

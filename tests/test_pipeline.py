@@ -382,3 +382,42 @@ def test_run_job_bounded_device_arrays_stay_small(monkeypatch):
     plain = run_job(_ColSource(rows), config=cfg, batch_size=100)
     assert sizes and sizes[0] > 2 * bound  # unbounded = one big cascade
     assert plain == bounded
+
+
+def test_run_job_bounded_default_zoom_regression():
+    """z21 regression: the chunk merge packs (ts, g, code) with
+    code_bits = 42, which silently wrapped when the slot columns
+    arrived int32 off the native key decoder (int32 << 42). Must match
+    the unbounded job exactly at the DEFAULT detail zoom."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=1500, seed=21)
+    cfg = BatchJobConfig()  # detail_zoom=21: the reference's real shape
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=128)
+    bounded = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                      max_points_in_flight=200)
+    assert plain == bounded
+
+
+def test_merge_sorted_level_int32_slots_wide_codes():
+    """Direct pin of the int32-shift wrap: _merge_sorted_level must pack
+    int32 ts/g columns with 42-bit codes without wrapping, regardless
+    of whether the native decoder (the int32 provenance) is built."""
+    from heatmap_tpu.pipeline.batch import _merge_sorted_level
+
+    empty = {"ts": np.empty(0, np.int64), "g": np.empty(0, np.int64),
+             "code": np.empty(0, np.int64),
+             "value": np.empty(0, np.float64)}
+    code = np.array([1, (1 << 42) - 5], np.int64)
+    a = _merge_sorted_level(
+        empty, np.zeros(2, np.int32), np.array([3, 200], np.int32),
+        code, np.array([1.0, 2.0]),
+    )
+    m = _merge_sorted_level(
+        a, np.zeros(2, np.int32), np.array([3, 299], np.int32),
+        code, np.array([5.0, 7.0]),
+    )
+    # (g=3, code=1)+=5, new (g=200, big) and (g=299, big) stay distinct.
+    assert m["g"].tolist() == [3, 200, 299]
+    assert m["code"].tolist() == [1, (1 << 42) - 5, (1 << 42) - 5]
+    assert m["value"].tolist() == [6.0, 2.0, 7.0]
