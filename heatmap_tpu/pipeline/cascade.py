@@ -128,27 +128,49 @@ def decode_levels(level_data, config: CascadeConfig):
     {slot, code, row, col, zoom, value} — values float64 (reference
     emits float counts, SURVEY.md §8.8). Raises on capacity overflow.
     """
-    out = []
-    for level in range(config.n_levels + 1):
-        keys_dev, sums_dev, n = level_data[level]
-        n = int(n)
-        if n > keys_dev.shape[0]:
+    # Device->host in one batched device_get: on accelerators the
+    # arrays are first truncated to their real row counts ON DEVICE
+    # (they are padded to full capacity — 16 levels x capacity x 16B
+    # of mostly-pad otherwise crosses the link), and the single
+    # device_get moves every level in one round trip instead of 32+
+    # serial np.asarray transfers (the relay adds per-call latency).
+    # On CPU the transfer is free and a device slice would only add a
+    # copy, so slice host-side there.
+    n_lvls = config.n_levels + 1
+    if any(_on_accelerator(level_data[lvl][2]) for lvl in range(n_lvls)):
+        import jax
+
+        # Batch the count scalars too: int() per level would block on
+        # one relay round trip each before the main transfer.
+        counts = [int(c) for c in jax.device_get(
+            [level_data[lvl][2] for lvl in range(n_lvls)]
+        )]
+    else:
+        counts = [int(level_data[lvl][2]) for lvl in range(n_lvls)]
+    for level, n in enumerate(counts):
+        if n > level_data[level][0].shape[0]:
             raise ValueError(
                 f"cascade level {level} overflowed capacity "
-                f"({n} uniques > {keys_dev.shape[0]}); raise `capacity`"
+                f"({n} uniques > {level_data[level][0].shape[0]}); "
+                f"raise `capacity`"
             )
-        # On accelerators, truncate BEFORE np.asarray: the device
-        # arrays are padded to full capacity, and transferring the
-        # padding dominated decode (16 levels x capacity x 16B of
-        # mostly-pad through the device->host link; only `n` rows are
-        # real). On CPU the transfer is free and the device slice would
-        # only add a copy, so slice host-side there.
-        if _on_accelerator(keys_dev):
-            keys_arr = np.asarray(keys_dev[:n])
-            sums = np.asarray(sums_dev[:n])
-        else:
-            keys_arr = np.asarray(keys_dev)[:n]
-            sums = np.asarray(sums_dev)[:n]
+    if any(_on_accelerator(level_data[lvl][0])
+           for lvl in range(config.n_levels + 1)):
+        import jax
+
+        host = jax.device_get(
+            [(level_data[lvl][0][:n], level_data[lvl][1][:n])
+             for lvl, n in enumerate(counts)]
+        )
+    else:
+        host = [
+            (np.asarray(level_data[lvl][0])[:n],
+             np.asarray(level_data[lvl][1])[:n])
+            for lvl, n in enumerate(counts)
+        ]
+
+    out = []
+    for level, (keys_arr, sums) in enumerate(host):
         # Lazy import (native asserts against pipeline.timespan at
         # load; module-level would be circular). One threaded C pass
         # replaces the ~8 single-threaded numpy passes when available.
