@@ -130,10 +130,11 @@ def main():
     #: outage at bench time doesn't erase the measured evidence.
     #: Clearly labeled — the "value" field is always what ran NOW.
     LAST_TPU_MEASUREMENT = {
-        "value": 149348004,
+        "value": 171373869,
         "unit": "points/sec",
         "bin_backend_resolved": "partitioned",
-        "measured": "2026-07-29 v5e-1 (same-session xla scatter: 67.4M)",
+        "measured": "2026-07-30 v5e-1 (prior slow-relay session: 149.3M "
+                    "partitioned vs 67.4M xla scatter)",
     }
 
     import jax
