@@ -1,0 +1,217 @@
+#!/usr/bin/env python
+"""Scale soak checks: equivalences the unit suite can't afford.
+
+The unit tests (tests/) run small shapes; overflow/capacity bugs can
+hide above them (the int32 chunk-merge wrap only fired at ~300 users x
+z21 x multiple chunks). This tool runs minutes-long cross-path
+equivalence checks at configurable scale on the current backend:
+
+  fast-vs-bounded   run_job_fast vs chunked run_job: byte-identical
+                    level arrays (the strongest whole-chain check)
+  mesh              sharded reduce-by-key over the device mesh vs the
+                    single-device kernel, on skewed keys
+  resume            crash (fault injection) + resume == uninterrupted
+  streaming         sharded decayed raster: deterministic replay
+
+    PYTHONPATH=.:$PYTHONPATH XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/soak.py [--n 2000000] [--checks fast-vs-bounded,...]
+
+Exits non-zero on the first inequality. CPU by default (--tpu to let
+the default backend through); the mesh checks need the 8-device
+XLA_FLAGS above.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+CHECKS = ("fast-vs-bounded", "mesh", "resume", "streaming")
+
+
+def _synth_hmpb(path, n, n_users=300, seed=1, dated=False):
+    from heatmap_tpu.io.hmpb import write_hmpb
+
+    rng = np.random.default_rng(seed)
+    names = ["all"] + [f"user{i}" for i in range(n_users)] + ["route"]
+    return write_hmpb(
+        path,
+        47.6 + rng.normal(0, 0.5, n),
+        -122.3 + rng.normal(0, 0.7, n),
+        rng.integers(1, len(names), n, dtype=np.int32),
+        names,
+        timestamp=rng.integers(1_500_000_000_000, 1_600_000_000_000, n)
+        if dated else None,
+        background=(rng.random(n) < 0.02).astype(np.uint8),
+    )
+
+
+def check_fast_vs_bounded(n, tmp):
+    from heatmap_tpu.io.hmpb import HMPBSource
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_fast
+
+    hmpb = _synth_hmpb(os.path.join(tmp, "p.hmpb"), n)
+    cfg = BatchJobConfig()
+    a = os.path.join(tmp, "a")
+    b = os.path.join(tmp, "b")
+    run_job_fast(HMPBSource(hmpb), LevelArraysSink(a), config=cfg)
+    run_job(HMPBSource(hmpb), LevelArraysSink(b), config=cfg,
+            max_points_in_flight=max(n // 4, 1000))
+    la, lb = LevelArraysSink.load(a), LevelArraysSink.load(b)
+    assert la.keys() == lb.keys(), (sorted(la), sorted(lb))
+    rows = 0
+    for z in la:
+        for k in ("row", "col", "value", "user", "timespan",
+                  "coarse_row", "coarse_col"):
+            np.testing.assert_array_equal(la[z][k], lb[z][k])
+        rows += len(la[z]["value"])
+    return {"levels": len(la), "rows": rows}
+
+
+def check_mesh(n, tmp):
+    """Device-mesh sharded aggregation vs single-device, at scale.
+
+    (run_job_multihost falls through to run_job in a single process,
+    so comparing those two here would be vacuous — the mesh coverage
+    must drive the sharded kernels directly.)
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from heatmap_tpu.ops.sparse import aggregate_keys
+    from heatmap_tpu.parallel import make_mesh
+    from heatmap_tpu.parallel.sharded import aggregate_keys_sharded
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs a multi-device mesh (set XLA_FLAGS)"}
+    mesh = make_mesh()
+    ndev = len(jax.devices())
+    n = max(n - n % ndev, ndev)  # shardable length
+    rng = np.random.default_rng(4)
+    # Skewed keys: hot head + long tail, the shape that trips local
+    # capacity/overflow logic.
+    keys = jnp.asarray(np.concatenate([
+        rng.integers(0, 500, n // 2),
+        rng.integers(0, n, n - n // 2),
+    ]).astype(np.int64))
+    want_k, want_s, want_n = aggregate_keys(keys, capacity=n)
+    got_k, got_s, got_n = aggregate_keys_sharded(
+        keys, capacity=n, mesh=mesh
+    )
+    wn, gn = int(want_n), int(got_n)
+    assert wn == gn, f"unique counts diverged: {wn} vs {gn}"
+    np.testing.assert_array_equal(np.asarray(want_k)[:wn],
+                                  np.asarray(got_k)[:gn])
+    np.testing.assert_array_equal(np.asarray(want_s)[:wn],
+                                  np.asarray(got_s)[:gn])
+    return {"uniques": wn, "devices": len(jax.devices()),
+            "mesh": dict(mesh.shape)}
+
+
+def check_resume(n, tmp):
+    from heatmap_tpu.io.hmpb import HMPBSource
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+    from heatmap_tpu.utils.recovery import FaultInjector
+
+    hmpb = _synth_hmpb(os.path.join(tmp, "r.hmpb"), n, dated=True)
+    cfg = BatchJobConfig(timespans=("alltime", "day"))
+    bs = max(n // 8, 1)  # always >= 8 batches, so the mid fault fires
+    n_batches = -(-n // bs)
+    fail_at = n_batches // 2
+    want = run_job_fast(HMPBSource(hmpb), config=cfg, batch_size=bs)
+    ck = os.path.join(tmp, "ck")
+    try:
+        run_job_fast(HMPBSource(hmpb), config=cfg, batch_size=bs,
+                     checkpoint_dir=ck, checkpoint_every=2,
+                     fault_injector=FaultInjector({fail_at: 1}))
+        raise AssertionError("expected the injected fault to fire")
+    except RuntimeError:
+        pass
+    got = run_job_fast(HMPBSource(hmpb), config=cfg, batch_size=bs,
+                       checkpoint_dir=ck, checkpoint_every=2)
+    assert want == got, (
+        f"resume diverged: {len(want)} vs {len(got)} blobs"
+    )
+    return {"blobs": len(want)}
+
+
+def check_streaming(n, tmp):
+    import jax
+
+    from heatmap_tpu.ops import window_from_bounds
+    from heatmap_tpu.parallel import make_mesh
+    from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+
+    win = window_from_bounds((44.0, 51.0), (-127.0, -117.0), zoom=12,
+                             align_levels=10, pad_multiple=256)
+    mesh = make_mesh() if len(jax.devices()) > 1 else None
+    batch = max(n // 50, 1000)
+
+    def run():
+        s = HeatmapStream(
+            StreamConfig(window=win, half_life_s=30.0, pad_to=batch),
+            mesh=mesh,
+        )
+        rng = np.random.default_rng(0)
+        t = 0.0
+        for _ in range(50):
+            t += 5.0
+            s.update(47.6 + rng.normal(0, 0.5, batch),
+                     -122.3 + rng.normal(0, 0.7, batch), t)
+        return np.asarray(s.raster)
+
+    r1, r2 = run(), run()
+    np.testing.assert_array_equal(r1, r2)
+    return {"batches": 50, "batch": batch, "mass": float(r1.sum()),
+            "sharded": mesh is not None}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=2_000_000)
+    ap.add_argument("--checks", default=",".join(CHECKS))
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on the default backend instead of forcing CPU")
+    args = ap.parse_args()
+
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    fns = {"fast-vs-bounded": check_fast_vs_bounded,
+           "mesh": check_mesh,
+           "resume": check_resume,
+           "streaming": check_streaming}
+    failed = 0
+    for name in args.checks.split(","):
+        name = name.strip()
+        if name not in fns:
+            raise SystemExit(f"unknown check {name!r}; valid: {CHECKS}")
+        tmp = tempfile.mkdtemp(prefix=f"soak-{name}-")
+        t0 = time.perf_counter()
+        try:
+            extra = fns[name](args.n, tmp)
+            print(json.dumps({"check": name, "ok": True,
+                              "s": round(time.perf_counter() - t0, 1),
+                              **extra}), flush=True)
+        except AssertionError as e:
+            failed += 1
+            print(json.dumps({"check": name, "ok": False,
+                              "error": str(e)[:300]}), flush=True)
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
