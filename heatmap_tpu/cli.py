@@ -338,6 +338,119 @@ def cmd_stream(args) -> int:
     return 0
 
 
+def cmd_render(args) -> int:
+    """Stored heatmaps -> z/x/y PNG tile tree.
+
+    Closes the loop the reference left to an external web app (its
+    blobs went to Cassandra for some other service to draw, reference
+    heatmap.py:149-150): reads a columnar levels directory
+    (``arrays:DIR`` / ``arrays-parquet:DIR``) or a blob JSONL
+    (``jsonl:PATH``), selects one (user, timespan, zoom) slice, and
+    renders PNG tiles from the stored counts — no re-aggregation.
+    """
+    import numpy as np
+
+    from heatmap_tpu.io import PNGTileSink
+    from heatmap_tpu.io.sinks import JSONLBlobSink, LevelArraysSink
+
+    kind, _, rest = args.input.partition(":")
+    if kind in ("arrays", "arrays-parquet"):
+        levels = LevelArraysSink.load(rest)
+        if not levels:
+            raise SystemExit(f"no level files under {rest!r}")
+        zoom = args.zoom if args.zoom is not None else max(levels)
+        if zoom not in levels:
+            raise SystemExit(
+                f"zoom {zoom} not stored; available: {sorted(levels)}"
+            )
+        lvl = levels[zoom]
+        keep = ((lvl["user"] == args.user)
+                & (lvl["timespan"] == args.timespan))
+        rows = lvl["row"][keep].astype(np.int64)
+        cols = lvl["col"][keep].astype(np.int64)
+        vals = lvl["value"][keep]
+    elif kind == "jsonl" or args.input.endswith((".jsonl", ".ndjson")):
+        from heatmap_tpu.tilemath.keys import parse_tile_id
+
+        path = rest if kind == "jsonl" else args.input
+        blobs = JSONLBlobSink.load(path)
+        # One pass: collect every matching (z, r, c, v); pick/filter
+        # the zoom afterwards. Malformed ids drop, mirroring the
+        # reference parser (tilemath.keys.parse_tile_id).
+        entries = []
+        for blob_id, heat in blobs.items():
+            user, ts, _coarse = blob_id.split("|", 2)
+            if user != args.user or ts != args.timespan:
+                continue
+            for tile_id, v in heat.items():
+                parsed = parse_tile_id(tile_id)
+                if parsed is not None:
+                    entries.append((*parsed, float(v)))
+        zooms_seen = {e[0] for e in entries}
+        zoom = args.zoom if args.zoom is not None else (
+            max(zooms_seen) if zooms_seen else None
+        )
+        if zoom is None or zoom not in zooms_seen:
+            raise SystemExit(
+                f"zoom {zoom} not stored for "
+                f"{args.user!r}/{args.timespan!r}; "
+                f"available: {sorted(zooms_seen)}"
+            )
+        sel = [e for e in entries if e[0] == zoom]
+        rows = np.asarray([e[1] for e in sel], np.int64)
+        cols = np.asarray([e[2] for e in sel], np.int64)
+        vals = np.asarray([e[3] for e in sel], np.float64)
+    else:
+        raise SystemExit(
+            f"render input must be arrays:DIR, arrays-parquet:DIR or "
+            f"jsonl:PATH, got {args.input!r}"
+        )
+
+    if len(rows) == 0:
+        print(json.dumps({"tiles": 0, "output": args.output,
+                          "user": args.user, "timespan": args.timespan}))
+        return 0
+    pixel_delta = min(args.pixel_delta, zoom)
+    px = 1 << pixel_delta
+    # Rasterize PER OCCUPIED OUTPUT TILE, not over one bounding box: a
+    # spread dataset (two cities in the 'all' slice) would make the
+    # dense bounding raster at detail zoom gigabytes; per-tile blocks
+    # bound memory at px*px regardless of extent. One shared vmax so
+    # the colormap is consistent across tiles.
+    from heatmap_tpu.ops import Window
+
+    t0 = time.perf_counter()
+    tile_key = (rows // px) * (1 << 40) + (cols // px)
+    order = np.argsort(tile_key, kind="stable")
+    sorted_keys = tile_key[order]
+    starts = np.flatnonzero(
+        np.concatenate([[True], sorted_keys[1:] != sorted_keys[:-1]])
+    )
+    bounds = np.append(starts, len(sorted_keys))
+    sink = PNGTileSink(args.output, pixel_delta=pixel_delta)
+    vmax = float(vals.max())
+    n = 0
+    for k, s in enumerate(starts):
+        sel = order[s:bounds[k + 1]]
+        ty = int(rows[sel[0]]) // px
+        tx = int(cols[sel[0]]) // px
+        block = np.zeros(px * px, np.float64)
+        np.add.at(block, (rows[sel] - ty * px) * px + (cols[sel] - tx * px),
+                  vals[sel])
+        window = Window(zoom=zoom, row0=ty * px, col0=tx * px,
+                        height=px, width=px)
+        n += sink.write_window(block.reshape(px, px), window, vmax=vmax)
+    print(json.dumps({
+        "tiles": n,
+        "tile_zoom": zoom - pixel_delta,
+        "zoom": zoom,
+        "aggregates": int(len(rows)),
+        "seconds": round(time.perf_counter() - t0, 3),
+        "output": args.output,
+    }))
+    return 0
+
+
 def cmd_convert(args) -> int:
     from heatmap_tpu.io.hmpb import convert_to_hmpb
 
@@ -427,6 +540,22 @@ def build_parser() -> argparse.ArgumentParser:
     p_stream.add_argument("--checkpoint-dir", default=None)
     p_stream.add_argument("--checkpoint-every", type=int, default=16)
     p_stream.set_defaults(fn=cmd_stream)
+
+    p_render = sub.add_parser(
+        "render",
+        help="stored heatmaps (arrays:DIR / jsonl:PATH) -> PNG tile tree",
+    )
+    p_render.add_argument("--input", required=True,
+                          help="arrays:DIR, arrays-parquet:DIR or jsonl:PATH")
+    p_render.add_argument("--output", default="rendered_tiles")
+    p_render.add_argument("--user", default="all",
+                          help="user slice to render (default 'all')")
+    p_render.add_argument("--timespan", default="alltime")
+    p_render.add_argument("--zoom", type=int, default=None,
+                          help="stored detail zoom to render "
+                          "(default: finest available)")
+    p_render.add_argument("--pixel-delta", type=int, default=8)
+    p_render.set_defaults(fn=cmd_render)
 
     p_conv = sub.add_parser(
         "convert",

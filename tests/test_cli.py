@@ -261,3 +261,64 @@ class TestEndToEnd:
         info = json.loads(r.stdout.strip())
         assert info["platform"] == "cpu"
         assert info["x64"] is True
+
+
+class TestRender:
+    def test_render_from_arrays_and_jsonl(self, tmp_path):
+        """Stored heatmaps -> PNG tiles from both storage kinds; the
+        arrays and jsonl inputs must paint the same tile set for the
+        same job."""
+        import glob
+        import json as _json
+
+        lv = tmp_path / "lv"
+        bl = tmp_path / "b.jsonl"
+        for out in (f"arrays:{lv}", f"jsonl:{bl}"):
+            r = _run_cli(
+                "run", "--backend", "cpu",
+                "--input", "synthetic:3000:5",
+                "--output", out,
+                "--detail-zoom", "12", "--min-detail-zoom", "8",
+            )
+            assert r.returncode == 0, r.stderr
+        outs = {}
+        for name, spec in (("arrays", f"arrays:{lv}"), ("jsonl", f"jsonl:{bl}")):
+            td = tmp_path / f"tiles-{name}"
+            r = _run_cli(
+                "render", "--input", spec, "--zoom", "10",
+                "--pixel-delta", "6", "--output", str(td),
+            )
+            assert r.returncode == 0, r.stderr
+            stats = _json.loads(r.stdout.strip().splitlines()[-1])
+            assert stats["tiles"] >= 1 and stats["zoom"] == 10
+            outs[name] = sorted(
+                p.relative_to(td).as_posix()
+                for p in td.rglob("*.png")
+            )
+        assert outs["arrays"] == outs["jsonl"]
+
+    def test_render_missing_zoom_fails_loudly(self, tmp_path):
+        lv = tmp_path / "lv"
+        r = _run_cli(
+            "run", "--backend", "cpu", "--input", "synthetic:500:1",
+            "--output", f"arrays:{lv}",
+            "--detail-zoom", "10", "--min-detail-zoom", "8",
+        )
+        assert r.returncode == 0, r.stderr
+        r = _run_cli("render", "--input", f"arrays:{lv}", "--zoom", "3",
+                     "--output", str(tmp_path / "t"))
+        assert r.returncode != 0
+        assert "available" in r.stderr
+
+    def test_render_jsonl_missing_zoom_fails_loudly(self, tmp_path):
+        bl = tmp_path / "b.jsonl"
+        r = _run_cli(
+            "run", "--backend", "cpu", "--input", "synthetic:500:1",
+            "--output", f"jsonl:{bl}",
+            "--detail-zoom", "10", "--min-detail-zoom", "8",
+        )
+        assert r.returncode == 0, r.stderr
+        r = _run_cli("render", "--input", f"jsonl:{bl}", "--zoom", "3",
+                     "--output", str(tmp_path / "t"))
+        assert r.returncode != 0
+        assert "available" in r.stderr

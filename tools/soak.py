@@ -16,9 +16,9 @@ equivalence checks at configurable scale on the current backend:
     PYTHONPATH=.:$PYTHONPATH XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/soak.py [--n 2000000] [--checks fast-vs-bounded,...]
 
-Exits non-zero on the first inequality. CPU by default (--tpu to let
-the default backend through); the mesh checks need the 8-device
-XLA_FLAGS above.
+Every check runs and reports one JSON line; the exit code is non-zero
+if any failed. CPU by default (--tpu to let the default backend
+through); the mesh checks need the 8-device XLA_FLAGS above.
 """
 
 from __future__ import annotations
@@ -204,10 +204,11 @@ def main():
             print(json.dumps({"check": name, "ok": True,
                               "s": round(time.perf_counter() - t0, 1),
                               **extra}), flush=True)
-        except AssertionError as e:
+        except Exception as e:  # noqa: BLE001 — run all, report each
             failed += 1
             print(json.dumps({"check": name, "ok": False,
-                              "error": str(e)[:300]}), flush=True)
+                              "error": f"{type(e).__name__}: {e}"[:300]}),
+                  flush=True)
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
     return 1 if failed else 0
