@@ -145,13 +145,13 @@ def cmd_run(args) -> int:
     if args.fast:
         # Resolve through open_source so bare paths and prefixed specs
         # behave identically to every other subcommand.
-        from heatmap_tpu.io.hmpb import HMPBSource
+        from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
         from heatmap_tpu.io.sources import CSVSource
 
         src = open_source(args.input)
         if isinstance(src, CSVSource):
             fast_source = src.path
-        elif isinstance(src, HMPBSource):
+        elif isinstance(src, (HMPBSource, HMPBDirSource)):
             fast_source = src
         else:
             raise SystemExit(
@@ -455,7 +455,8 @@ def cmd_convert(args) -> int:
     from heatmap_tpu.io.hmpb import convert_to_hmpb
 
     stats = convert_to_hmpb(args.input, args.output,
-                            batch_size=args.batch_size)
+                            batch_size=args.batch_size,
+                            shard_rows=args.shard_rows)
     print(json.dumps(stats))
     return 0
 
@@ -563,8 +564,14 @@ def build_parser() -> argparse.ArgumentParser:
         "(mmap ingest for --fast reruns)",
     )
     p_conv.add_argument("--input", required=True, help="any source spec")
-    p_conv.add_argument("--output", required=True, help="output .hmpb path")
+    p_conv.add_argument("--output", required=True,
+                        help="output .hmpb path (a directory of part "
+                        "files with --shard-rows)")
     p_conv.add_argument("--batch-size", type=int, default=1 << 20)
+    p_conv.add_argument("--shard-rows", type=int, default=None,
+                        help="split the output into part-NNNNN.hmpb "
+                        "files of at most this many rows (the "
+                        "range-shardable multihost ingest layout)")
     p_conv.set_defaults(fn=cmd_convert)
 
     p_info = sub.add_parser("info", help="resolved config + devices")

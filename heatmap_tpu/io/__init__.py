@@ -19,6 +19,7 @@ from heatmap_tpu.io.sources import (  # noqa: F401
     open_source,
 )
 from heatmap_tpu.io.hmpb import (  # noqa: F401
+    HMPBDirSource,
     HMPBSource,
     convert_to_hmpb,
     write_hmpb,

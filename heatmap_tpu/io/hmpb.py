@@ -40,6 +40,7 @@ sources and then using dated timespans is on the operator.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 
@@ -196,6 +197,106 @@ class HMPBSource:
             }
 
 
+@dataclasses.dataclass
+class HMPBDirSource:
+    """A directory of ``*.hmpb`` shard files as one source.
+
+    The multi-file analog of Cassandra token ranges for binary point
+    data: each file is a deterministic range unit, so the source is
+    range-shardable (``shard_index``/``shard_count`` interleave files
+    across hosts — parallel.multihost.shard_source re-instantiates with
+    the process assignment) and a failed shard re-reads exactly via
+    ``range_batches(i)``. Per-file name tables are remapped into one
+    global intern as files stream, so ``fast_batches`` keeps the
+    run_job_fast contract (routed ids index the cumulative
+    ``new_group_names`` stream; ids stay stable across files).
+    """
+
+    path: str
+    shard_index: int = 0
+    shard_count: int = 1
+
+    def __post_init__(self):
+        if self.shard_count < 1 or not (
+            0 <= self.shard_index < self.shard_count
+        ):
+            raise ValueError(
+                f"invalid shard assignment: shard_index={self.shard_index} "
+                f"shard_count={self.shard_count} (need 0 <= index < count)"
+            )
+        self.files = sorted(
+            os.path.join(self.path, f)
+            for f in os.listdir(self.path)
+            if f.endswith(".hmpb")
+        )
+        if not self.files:
+            raise ValueError(f"no .hmpb files under {self.path!r}")
+
+    @property
+    def n_ranges(self) -> int:
+        return len(self.files)
+
+    def my_files(self):
+        """This shard's interleaved (global_index, path) assignment."""
+        return [
+            (i, f) for i, f in enumerate(self.files)
+            if i % self.shard_count == self.shard_index
+        ]
+
+    def fast_batches(self, batch_size: int = 1 << 20):
+        intern: dict = {}
+        names: list = []
+        emitted = 0
+        for _, path in self.my_files():
+            src = HMPBSource(path)
+            # file-local id -> global id (global intern grows in
+            # first-seen order, matching the reader contract).
+            local_to_global = np.empty(max(len(src.names), 1), np.int32)
+            for li, name in enumerate(src.names):
+                gi = intern.get(name)
+                if gi is None:
+                    gi = len(names)
+                    intern[name] = gi
+                    names.append(name)
+                local_to_global[li] = gi
+            # convert_to_hmpb writes every part with the SAME names
+            # table, so the remap is usually the identity — skip the
+            # per-batch copies then (mmap ingest stays copy-free).
+            identity = (
+                len(src.names) <= len(names)
+                and bool(
+                    (local_to_global[: len(src.names)]
+                     == np.arange(len(src.names))).all()
+                )
+            )
+            for b in src.fast_batches(batch_size):
+                routed = np.asarray(b["routed"], np.int32)
+                if not identity:
+                    routed = np.where(
+                        routed >= 0,
+                        local_to_global[np.maximum(routed, 0)], -1,
+                    ).astype(np.int32)
+                yield {
+                    "latitude": b["latitude"],
+                    "longitude": b["longitude"],
+                    "timestamp": b["timestamp"],
+                    "routed": routed,
+                    "background": b["background"],
+                    "new_group_names": names[emitted:],
+                }
+                emitted = len(names)
+
+    def range_batches(self, index: int, batch_size: int = 1 << 20):
+        """String-column batches of ONE file (deterministic
+        re-execution of a failed shard, global file index)."""
+        return HMPBSource(self.files[index]).batches(batch_size)
+
+    def batches(self, batch_size: int = 1 << 20):
+        """String-column Source view over this shard's files."""
+        for _, path in self.my_files():
+            yield from HMPBSource(path).batches(batch_size)
+
+
 def _stamp_to_i64(s) -> int:
     """Timestamp -> stored i64: ints/strings pass through as epoch
     values; datetime/date become epoch-ms (the shape timespan._to_date
@@ -216,12 +317,20 @@ def _stamp_to_i64(s) -> int:
 
 
 def convert_to_hmpb(source_spec: str, out_path: str,
-                    batch_size: int = 1 << 20) -> dict:
+                    batch_size: int = 1 << 20,
+                    shard_rows: int | None = None) -> dict:
     """Convert any source spec to HMPB (columns held in memory once).
 
     CSV inputs use the native decoder's fast path end-to-end; other
-    sources route user ids host-side. Returns {"n": ..., "groups": ...}.
+    sources route user ids host-side. With ``shard_rows``, ``out_path``
+    becomes a DIRECTORY of ``part-NNNNN.hmpb`` files of at most that
+    many rows each (the HMPBDirSource range-shard layout for multihost
+    ingest); every part carries the full shared names table, so parts
+    are independently readable and ids are consistent without
+    remapping. Returns {"n": ..., "groups": ...}.
     """
+    if shard_rows is not None and shard_rows < 1:
+        raise ValueError(f"shard_rows must be >= 1, got {shard_rows}")
     lats, lons, tss, rids, bgs = [], [], [], [], []
     names: list = []
 
@@ -289,13 +398,29 @@ def convert_to_hmpb(source_spec: str, out_path: str,
             bgs.append(bg)
 
     n = sum(len(a) for a in lats)
-    write_hmpb(
-        out_path,
-        np.concatenate(lats) if n else np.empty(0),
-        np.concatenate(lons) if n else np.empty(0),
-        np.concatenate(rids) if n else np.empty(0, np.int32),
-        names,
-        timestamp=np.concatenate(tss) if n else None,
-        background=np.concatenate(bgs) if n else None,
-    )
-    return {"n": n, "groups": len(names), "output": out_path}
+    lat = np.concatenate(lats) if n else np.empty(0)
+    lon = np.concatenate(lons) if n else np.empty(0)
+    rid = np.concatenate(rids) if n else np.empty(0, np.int32)
+    ts = np.concatenate(tss) if n else None
+    bg = np.concatenate(bgs) if n else None
+    if shard_rows is None:
+        write_hmpb(out_path, lat, lon, rid, names,
+                   timestamp=ts, background=bg)
+        return {"n": n, "groups": len(names), "output": out_path}
+    os.makedirs(out_path, exist_ok=True)
+    n_parts = max(1, -(-n // shard_rows))
+    # A re-convert with fewer parts must not leave stale shards behind:
+    # HMPBDirSource reads every *.hmpb in the directory as data.
+    for f in os.listdir(out_path):
+        if f.endswith(".hmpb"):
+            os.remove(os.path.join(out_path, f))
+    for p in range(n_parts):
+        lo, hi = p * shard_rows, min((p + 1) * shard_rows, max(n, 0))
+        write_hmpb(
+            os.path.join(out_path, f"part-{p:05d}.hmpb"),
+            lat[lo:hi], lon[lo:hi], rid[lo:hi], names,
+            timestamp=None if ts is None else ts[lo:hi],
+            background=None if bg is None else bg[lo:hi],
+        )
+    return {"n": n, "groups": len(names), "output": out_path,
+            "parts": n_parts}

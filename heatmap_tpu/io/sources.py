@@ -522,8 +522,10 @@ def open_source(spec: str, **kwargs) -> Source:
     if kind == "cosmosdb":
         return CosmosDBSource(**kwargs)
     if kind == "hmpb":
-        from heatmap_tpu.io.hmpb import HMPBSource
+        from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
 
+        if os.path.isdir(rest):
+            return HMPBDirSource(rest, **kwargs)
         return HMPBSource(rest, **kwargs)
     # Bare path: sniff the extension.
     if spec.endswith(".csv"):

@@ -203,3 +203,96 @@ def test_missing_timestamps_sentinel(tmp_path):
     assert (b["timestamp"] == TS_MISSING).all()
     (sb,) = list(HMPBSource(p).batches(10))
     assert sb["timestamp"] == [None, None, None]
+
+
+class TestHMPBDirSource:
+    def _make_dir(self, tmp_path, n=5000, parts=4):
+        from heatmap_tpu.io.hmpb import convert_to_hmpb
+
+        csv = tmp_path / "pts.csv"
+        _write_csv(csv, n, seed=11)
+        d = tmp_path / "shards"
+        stats = convert_to_hmpb(str(csv), str(d),
+                                shard_rows=-(-n // parts))
+        return d, stats
+
+    def test_sharded_convert_and_fast_job_parity(self, tmp_path):
+        """A directory of part files must produce exactly the blobs of
+        the single-file conversion, through run_job_fast (per-file name
+        tables remap into one global intern)."""
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource, convert_to_hmpb
+        from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+
+        d, stats = self._make_dir(tmp_path)
+        assert stats["parts"] >= 4
+        single = tmp_path / "one.hmpb"
+        convert_to_hmpb(str(tmp_path / "pts.csv"), str(single))
+        cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=8)
+        want = run_job_fast(HMPBSource(str(single)), config=cfg,
+                            batch_size=700)
+        got = run_job_fast(HMPBDirSource(str(d)), config=cfg,
+                           batch_size=700)
+        assert want == got
+
+    def test_interleaved_shards_cover_all_files_once(self, tmp_path):
+        from heatmap_tpu.io.hmpb import HMPBDirSource
+
+        d, _ = self._make_dir(tmp_path)
+        full = HMPBDirSource(str(d))
+        seen = []
+        for k in range(3):
+            s = HMPBDirSource(str(d), shard_index=k, shard_count=3)
+            seen.extend(i for i, _ in s.my_files())
+        assert sorted(seen) == list(range(full.n_ranges))
+
+    def test_range_batches_reread_one_file(self, tmp_path):
+        from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
+
+        d, _ = self._make_dir(tmp_path)
+        s = HMPBDirSource(str(d))
+        got = [u for b in s.range_batches(1) for u in b["user_id"]]
+        again = [u for b in s.range_batches(1) for u in b["user_id"]]
+        assert got == again
+        direct = [u for b in HMPBSource(s.files[1]).batches()
+                  for u in b["user_id"]]
+        assert got == direct
+
+    def test_open_source_detects_directory(self, tmp_path):
+        from heatmap_tpu.io.hmpb import HMPBDirSource
+        from heatmap_tpu.io.sources import open_source
+
+        d, _ = self._make_dir(tmp_path)
+        assert isinstance(open_source(f"hmpb:{d}"), HMPBDirSource)
+        with pytest.raises(ValueError, match="no .hmpb files"):
+            HMPBDirSource(str(tmp_path))
+
+    def test_bad_shard_assignment_rejected(self, tmp_path):
+        from heatmap_tpu.io.hmpb import HMPBDirSource
+
+        d, _ = self._make_dir(tmp_path)
+        with pytest.raises(ValueError, match="shard"):
+            HMPBDirSource(str(d), shard_index=3, shard_count=3)
+
+    def test_multihost_shard_source_reinstantiates(self, tmp_path):
+        from heatmap_tpu.io.hmpb import HMPBDirSource
+        from heatmap_tpu.parallel.multihost import shard_source
+
+        d, _ = self._make_dir(tmp_path)
+        s = shard_source(HMPBDirSource(str(d)), process_count=2,
+                         process_index=1)
+        assert isinstance(s, HMPBDirSource)
+        assert s.shard_count == 2 and s.shard_index == 1
+        assert all(i % 2 == 1 for i, _ in s.my_files())
+
+    def test_reconvert_removes_stale_parts(self, tmp_path):
+        from heatmap_tpu.io.hmpb import HMPBDirSource, convert_to_hmpb
+
+        d, stats = self._make_dir(tmp_path, n=5000, parts=5)
+        assert stats["parts"] >= 5
+        stats2 = convert_to_hmpb(str(tmp_path / "pts.csv"), str(d),
+                                 shard_rows=5000)
+        assert stats2["parts"] == 1
+        assert HMPBDirSource(str(d)).n_ranges == 1
