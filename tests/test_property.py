@@ -1,0 +1,119 @@
+"""Property-based tests (hypothesis): randomized shapes/values against
+the exact contracts the example-based suites pin pointwise.
+
+The reference has no tests at all (SURVEY.md §4); the oracle suites
+here cover chosen examples, and these properties sweep the input space
+around them: Morton codec bijectivity, partitioned-kernel equality
+with the scatter contract under arbitrary point distributions and
+tunables, and blob-id formatting parity between the native and numpy
+paths for arbitrary names.
+"""
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip("hypothesis")  # optional test dep
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from heatmap_tpu import native
+from heatmap_tpu.tilemath import morton
+
+# Module-scale hypothesis budget: each example runs jitted numpy/JAX
+# code, so keep example counts small but shapes meaningful.
+_FAST = settings(max_examples=25, deadline=None)
+_SLOW = settings(max_examples=10, deadline=None)
+
+
+@_FAST
+@given(
+    zoom=st.integers(min_value=0, max_value=31),
+    data=st.data(),
+)
+def test_morton_roundtrip_random(zoom, data):
+    n = data.draw(st.integers(min_value=1, max_value=2048))
+    rng = np.random.default_rng(data.draw(st.integers(0, 2**32 - 1)))
+    rows = rng.integers(0, 1 << zoom, n) if zoom else np.zeros(n, np.int64)
+    cols = rng.integers(0, 1 << zoom, n) if zoom else np.zeros(n, np.int64)
+    codes = morton.morton_encode_np(rows, cols)
+    r2, c2 = morton.morton_decode_np(codes)
+    np.testing.assert_array_equal(r2, rows)
+    np.testing.assert_array_equal(c2, cols)
+    # Parent coarsening: one right-shift by 2 halves each axis.
+    if zoom:
+        pr, pc = morton.morton_decode_np(np.asarray(codes) >> 2)
+        np.testing.assert_array_equal(pr, rows >> 1)
+        np.testing.assert_array_equal(pc, cols >> 1)
+
+
+@_SLOW
+@given(
+    seed=st.integers(0, 2**32 - 1),
+    n=st.integers(min_value=1, max_value=1 << 13),
+    block_cells=st.sampled_from([1 << 12, 1 << 14, 1 << 16]),
+    chunk=st.sampled_from([256, 512, 1024]),
+    streams=st.sampled_from([1, 2, 4]),
+    spread=st.floats(min_value=0.01, max_value=1.0),
+)
+def test_partitioned_matches_scatter_random(seed, n, block_cells, chunk,
+                                            streams, spread):
+    """Any distribution, any tunables: partitioned == scatter exactly
+    (interpret mode; the on-chip verifier re-checks under Mosaic)."""
+    import jax.numpy as jnp
+
+    from heatmap_tpu.ops import Window
+    from heatmap_tpu.ops.histogram import bin_rowcol_window
+    from heatmap_tpu.ops.partitioned import bin_rowcol_window_partitioned
+
+    window = Window(zoom=12, row0=256, col0=128, height=512, width=384)
+    rng = np.random.default_rng(seed)
+    # spread interpolates clustered -> uniform-over-superset (includes
+    # out-of-window points on every side).
+    r0 = 256 + 256 * rng.random(n)
+    c0 = 128 + 192 * rng.random(n)
+    rows = (r0 + spread * rng.normal(0, 400, n)).astype(np.int64)
+    cols = (c0 + spread * rng.normal(0, 300, n)).astype(np.int64)
+    want = np.asarray(bin_rowcol_window(
+        jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32), window
+    ))
+    got = np.asarray(bin_rowcol_window_partitioned(
+        jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32), window,
+        block_cells=block_cells, chunk=chunk, streams=streams,
+        interpret=True,
+    ))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.skipif(native.format_blob_ids is None,
+                    reason="native library not built")
+@_FAST
+@given(
+    seed=st.integers(0, 2**32 - 1),
+    names=st.lists(
+        st.text(
+            # Any unicode except the reference's '|' separator, NUL
+            # (ids embed in 'user|timespan|tile' strings), and
+            # surrogates (not UTF-8-encodable).
+            alphabet=st.characters(blacklist_characters="|\x00",
+                                   blacklist_categories=("Cs",)),
+            min_size=0, max_size=12,
+        ),
+        min_size=1, max_size=8, unique=True,
+    ),
+    zoom=st.integers(0, 31),
+)
+def test_native_blob_ids_match_python_random(seed, names, zoom):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 300))
+    user_names = np.array(names)
+    ts_names = np.array(["alltime"])
+    uidx = rng.integers(0, len(user_names), n).astype(np.int32)
+    tidx = np.zeros(n, np.int32)
+    crow = rng.integers(0, 1 << min(zoom, 30), n).astype(np.int32) \
+        if zoom else np.zeros(n, np.int32)
+    ccol = rng.integers(0, 1 << min(zoom, 30), n).astype(np.int32) \
+        if zoom else np.zeros(n, np.int32)
+    want = [f"{user_names[u]}|alltime|{zoom}_{r}_{c}"
+            for u, r, c in zip(uidx, crow, ccol)]
+    got = native.format_blob_ids(uidx, tidx, crow, ccol, zoom,
+                                 user_names, ts_names)
+    assert got == want
