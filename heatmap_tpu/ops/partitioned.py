@@ -43,6 +43,11 @@ from jax.experimental import pallas as pl
 from heatmap_tpu.ops.histogram import Window
 
 DEFAULT_CHUNK = 1024
+#: Independently sorted rows per call (1 = one flat sort). Flip after
+#: the on-chip sort-rows sweep (PERF_NOTES pending runlist) if batched
+#: row sorts beat the flat sort; every caller inherits via the
+#: bin_rowcol_window_partitioned default.
+DEFAULT_STREAMS = 1
 #: Cells per aligned output block (a side x side one-hot factor pair).
 #: Smaller blocks cut the per-point one-hot construction (VPU, 2*side
 #: compares+casts per point) and the MXU MACs quadratically, at the
@@ -199,7 +204,7 @@ def bin_rowcol_window_partitioned(
     interpret: bool | None = None,
     dtype=jnp.int32,
     block_cells: int = DEFAULT_BLOCK_CELLS,
-    streams: int = 1,
+    streams: int = DEFAULT_STREAMS,
 ):
     """Count-only binning of pre-projected points into a large window.
 
