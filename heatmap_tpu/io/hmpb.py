@@ -117,24 +117,36 @@ class HMPBSource:
 
     def __init__(self, path: str):
         self.path = path
+        size = os.path.getsize(path)
         with open(path, "rb") as f:
             if f.read(len(MAGIC)) != MAGIC:
                 raise ValueError(f"{path}: not an HMPB file")
             (hlen,) = np.frombuffer(f.read(8), "<u8")
-            header = json.loads(f.read(int(hlen)).decode())
+            if int(hlen) > size:
+                raise ValueError(
+                    f"{path}: corrupt header length {int(hlen)} "
+                    f"(file is {size} bytes)"
+                )
+            try:
+                header = json.loads(f.read(int(hlen)).decode())
+                self.n = int(header["n"])
+                self.names = list(header["names"])
+            except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
+                # json.JSONDecodeError is a ValueError; surface every
+                # header-corruption shape as one clean error.
+                raise ValueError(f"{path}: corrupt HMPB header: {e}") from e
+            if self.n < 0:
+                raise ValueError(f"{path}: corrupt HMPB header: n={self.n}")
             self._data_off = f.tell() + (-f.tell()) % 8  # header NUL pad
-        self.n = int(header["n"])
-        self.names = list(header["names"])
         offsets = {}
         off = self._data_off
         for name, dtype in _COLUMNS:
             offsets[name] = (off, dtype)
             off += self.n * np.dtype(dtype).itemsize
         expected = off
-        actual = os.path.getsize(path)
-        if actual < expected:
+        if size < expected:
             raise ValueError(
-                f"{path}: truncated ({actual} bytes, need {expected})"
+                f"{path}: truncated ({size} bytes, need {expected})"
             )
         # Map the file once; per-batch reads are plain slices of these
         # column views (no per-batch open/mmap syscalls).
