@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import sys
 import time
 
@@ -221,6 +222,43 @@ def cmd_tiles(args) -> int:
     from heatmap_tpu.pipeline import load_columns
 
     proj_dtype = jnp.float32 if args.no_x64 else jnp.float64
+    source = open_source(args.input)
+    if args.auto_bounds:
+        # One pre-pass over the source for the data's bounding box (the
+        # fixed flag defaults cover the US Pacific Northwest; data
+        # elsewhere would silently bin to zero tiles). Sources iterate
+        # deterministically, so re-reading is safe. Raw lat/lon columns
+        # only — no load_columns (its per-row user_id/timestamp lists
+        # would double the job's Python cost for a min/max; background
+        # rows merely widen the covering window harmlessly). NaN
+        # coordinates are skipped (nanmin); window_from_bounds clamps
+        # to the Mercator-valid band itself.
+        import warnings
+
+        lat_lo = lon_lo = float("inf")
+        lat_hi = lon_hi = float("-inf")
+        for batch in source.batches(args.batch_size):
+            lat = np.asarray(batch["latitude"], np.float64)
+            lon = np.asarray(batch["longitude"], np.float64)
+            if len(lat) == 0:
+                continue
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN
+                blo, bhi = float(np.nanmin(lat)), float(np.nanmax(lat))
+                olo, ohi = float(np.nanmin(lon)), float(np.nanmax(lon))
+            if math.isnan(blo) or math.isnan(olo):
+                continue  # batch had no finite coordinates
+            lat_lo, lat_hi = min(lat_lo, blo), max(lat_hi, bhi)
+            lon_lo, lon_hi = min(lon_lo, olo), max(lon_hi, ohi)
+        if lat_lo > lat_hi:
+            print(json.dumps({"tiles": 0, "output": args.output}))
+            return 0
+        pad_lat = max(0.05 * (lat_hi - lat_lo), 1e-3)
+        pad_lon = max(0.05 * (lon_hi - lon_lo), 1e-3)
+        args.lat_min = lat_lo - pad_lat
+        args.lat_max = lat_hi + pad_lat
+        args.lon_min = lon_lo - pad_lon
+        args.lon_max = lon_hi + pad_lon
     window = window_from_bounds(
         (args.lat_min, args.lat_max),
         (args.lon_min, args.lon_max),
@@ -228,7 +266,6 @@ def cmd_tiles(args) -> int:
         align_levels=min(args.pixel_delta, args.zoom),
         pad_multiple=1 << args.pixel_delta,
     )
-    source = open_source(args.input)
     raster = None
     t0 = time.perf_counter()
     for batch in source.batches(args.batch_size):
@@ -257,6 +294,8 @@ def cmd_tiles(args) -> int:
             {
                 "tiles": n,
                 "tile_zoom": args.zoom - args.pixel_delta,
+                "bounds": [round(args.lat_min, 6), round(args.lat_max, 6),
+                           round(args.lon_min, 6), round(args.lon_max, 6)],
                 "seconds": round(dt, 3),
                 "output": args.output,
             }
@@ -507,6 +546,10 @@ def build_parser() -> argparse.ArgumentParser:
     p_tiles.add_argument("--lat-max", type=float, default=50.0)
     p_tiles.add_argument("--lon-min", type=float, default=-125.0)
     p_tiles.add_argument("--lon-max", type=float, default=-119.0)
+    p_tiles.add_argument("--auto-bounds", action="store_true",
+                         help="derive the window from the data's "
+                         "bounding box (one extra pass over the "
+                         "source) instead of the --lat/--lon flags")
     p_tiles.add_argument("--batch-size", type=int, default=1 << 20)
     p_tiles.add_argument("--splat", type=int, default=0, metavar="K",
                          help="smooth with a KxK Gaussian kernel before "

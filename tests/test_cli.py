@@ -322,3 +322,29 @@ class TestRender:
                      "--output", str(tmp_path / "t"))
         assert r.returncode != 0
         assert "available" in r.stderr
+
+
+class TestAutoBounds:
+    def test_tiles_auto_bounds_finds_distant_data(self, tmp_path):
+        """Data outside the default PNW window: the fixed flags miss it
+        entirely; --auto-bounds derives the window from the data."""
+        import json as _json
+
+        p = tmp_path / "tokyo.csv"
+        rows = ["latitude,longitude,user_id,source,timestamp"]
+        rows += [f"{35.68 + i * 1e-4},{139.69 + i * 1e-4},u,gps,{i}"
+                 for i in range(200)]
+        p.write_text("\n".join(rows) + "\n")
+        r0 = _run_cli("tiles", "--backend", "cpu", "--input", str(p),
+                      "--zoom", "12", "--pixel-delta", "6",
+                      "--output", str(tmp_path / "t0"))
+        assert r0.returncode == 0, r0.stderr
+        assert _json.loads(r0.stdout.strip().splitlines()[-1])["tiles"] == 0
+        r1 = _run_cli("tiles", "--backend", "cpu", "--input", str(p),
+                      "--zoom", "12", "--pixel-delta", "6", "--auto-bounds",
+                      "--output", str(tmp_path / "t1"))
+        assert r1.returncode == 0, r1.stderr
+        stats = _json.loads(r1.stdout.strip().splitlines()[-1])
+        assert stats["tiles"] >= 1
+        lat_min, lat_max, lon_min, lon_max = stats["bounds"]
+        assert lat_min < 35.68 < lat_max and lon_min < 139.69 < lon_max
