@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import sys
 import time
 
@@ -203,6 +202,46 @@ def cmd_run(args) -> int:
     return 0
 
 
+def _scan_bounds(source, batch_size):
+    """Data bounding box, padded 5%, or None for no finite coordinates.
+
+    One pre-pass over the source (the fixed flag defaults cover the US
+    Pacific Northwest; data elsewhere would silently bin to zero
+    tiles). Sources iterate deterministically, so re-reading is safe.
+    Raw lat/lon columns only — no load_columns (its per-row
+    user_id/timestamp lists would double the job's Python cost for a
+    min/max; background rows merely widen the covering window
+    harmlessly). NaN coordinates are skipped; window_from_bounds
+    clamps to the Mercator-valid band itself.
+    """
+    import numpy as np
+
+    lat_lo = lon_lo = float("inf")
+    lat_hi = lon_hi = float("-inf")
+    for batch in source.batches(batch_size):
+        lat = np.asarray(batch["latitude"], np.float64)
+        lon = np.asarray(batch["longitude"], np.float64)
+        if len(lat) == 0:
+            continue
+        # Finite coordinates only: NaN AND ±inf rows must not poison
+        # the bbox (CSV float() happily parses "inf"); the projection
+        # clamps latitude but an infinite longitude would overflow.
+        finite = np.isfinite(lat) & np.isfinite(lon)
+        if not finite.any():
+            continue
+        flat, flon = lat[finite], lon[finite]
+        lat_lo = min(lat_lo, float(flat.min()))
+        lat_hi = max(lat_hi, float(flat.max()))
+        lon_lo = min(lon_lo, float(flon.min()))
+        lon_hi = max(lon_hi, float(flon.max()))
+    if lat_lo > lat_hi:
+        return None
+    pad_lat = max(0.05 * (lat_hi - lat_lo), 1e-3)
+    pad_lon = max(0.05 * (lon_hi - lon_lo), 1e-3)
+    return (lat_lo - pad_lat, lat_hi + pad_lat,
+            lon_lo - pad_lon, lon_hi + pad_lon)
+
+
 def cmd_tiles(args) -> int:
     if args.zoom < args.pixel_delta:
         raise SystemExit(
@@ -224,41 +263,11 @@ def cmd_tiles(args) -> int:
     proj_dtype = jnp.float32 if args.no_x64 else jnp.float64
     source = open_source(args.input)
     if args.auto_bounds:
-        # One pre-pass over the source for the data's bounding box (the
-        # fixed flag defaults cover the US Pacific Northwest; data
-        # elsewhere would silently bin to zero tiles). Sources iterate
-        # deterministically, so re-reading is safe. Raw lat/lon columns
-        # only — no load_columns (its per-row user_id/timestamp lists
-        # would double the job's Python cost for a min/max; background
-        # rows merely widen the covering window harmlessly). NaN
-        # coordinates are skipped (nanmin); window_from_bounds clamps
-        # to the Mercator-valid band itself.
-        import warnings
-
-        lat_lo = lon_lo = float("inf")
-        lat_hi = lon_hi = float("-inf")
-        for batch in source.batches(args.batch_size):
-            lat = np.asarray(batch["latitude"], np.float64)
-            lon = np.asarray(batch["longitude"], np.float64)
-            if len(lat) == 0:
-                continue
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore", RuntimeWarning)  # all-NaN
-                blo, bhi = float(np.nanmin(lat)), float(np.nanmax(lat))
-                olo, ohi = float(np.nanmin(lon)), float(np.nanmax(lon))
-            if math.isnan(blo) or math.isnan(olo):
-                continue  # batch had no finite coordinates
-            lat_lo, lat_hi = min(lat_lo, blo), max(lat_hi, bhi)
-            lon_lo, lon_hi = min(lon_lo, olo), max(lon_hi, ohi)
-        if lat_lo > lat_hi:
+        bounds = _scan_bounds(source, args.batch_size)
+        if bounds is None:
             print(json.dumps({"tiles": 0, "output": args.output}))
             return 0
-        pad_lat = max(0.05 * (lat_hi - lat_lo), 1e-3)
-        pad_lon = max(0.05 * (lon_hi - lon_lo), 1e-3)
-        args.lat_min = lat_lo - pad_lat
-        args.lat_max = lat_hi + pad_lat
-        args.lon_min = lon_lo - pad_lon
-        args.lon_max = lon_hi + pad_lon
+        args.lat_min, args.lat_max, args.lon_min, args.lon_max = bounds
     window = window_from_bounds(
         (args.lat_min, args.lat_max),
         (args.lon_min, args.lon_max),
@@ -326,6 +335,16 @@ def cmd_stream(args) -> int:
     from heatmap_tpu.streaming import HeatmapStream, StreamConfig
     from heatmap_tpu.utils import CheckpointManager
 
+    if args.auto_bounds:
+        # Needs a re-iterable (file) source; same file on resume gives
+        # the same window (restore() rejects a shifted one).
+        bounds = _scan_bounds(open_source(args.input), args.batch_points)
+        if bounds is None:
+            print(json.dumps({"batches": 0, "stream_seconds": 0.0,
+                              "live_mass": 0.0, "tiles": 0,
+                              "seconds": 0.0, "output": args.output}))
+            return 0
+        args.lat_min, args.lat_max, args.lon_min, args.lon_max = bounds
     window = window_from_bounds(
         (args.lat_min, args.lat_max),
         (args.lon_min, args.lon_max),
@@ -370,6 +389,8 @@ def cmd_stream(args) -> int:
         "batches": stream.n_batches,
         "stream_seconds": stream.t,
         "live_mass": float(np.sum(snap)),
+        "bounds": [round(args.lat_min, 6), round(args.lat_max, 6),
+                   round(args.lon_min, 6), round(args.lon_max, 6)],
         "tiles": n_tiles,
         "seconds": round(time.perf_counter() - t0, 3),
         "output": args.output,
@@ -581,6 +602,11 @@ def build_parser() -> argparse.ArgumentParser:
     p_stream.add_argument("--lat-max", type=float, default=50.0)
     p_stream.add_argument("--lon-min", type=float, default=-125.0)
     p_stream.add_argument("--lon-max", type=float, default=-119.0)
+    p_stream.add_argument("--auto-bounds", action="store_true",
+                          help="derive the window from the data's "
+                          "bounding box (file sources only: one extra "
+                          "pass; resume keeps the same window for the "
+                          "same file)")
     p_stream.add_argument("--checkpoint-dir", default=None)
     p_stream.add_argument("--checkpoint-every", type=int, default=16)
     p_stream.set_defaults(fn=cmd_stream)

@@ -185,15 +185,35 @@ class HeatmapStream:
     def checkpoint(self, manager) -> str:
         """Atomic checkpoint via utils.checkpoint.CheckpointManager,
         numbered by batches consumed."""
+        w = self.config.window
         return manager.save(
             self.n_batches,
             {"raster": self.snapshot()},
-            {"t": self.t, "n_batches": self.n_batches},
+            {"t": self.t, "n_batches": self.n_batches,
+             "window": [int(w.zoom), int(w.row0), int(w.col0)]},
         )
 
     def restore(self, manager, step: int | None = None):
-        """Load the latest (or a given) checkpoint into this stream."""
+        """Load the latest (or a given) checkpoint into this stream.
+
+        Validates the checkpoint's window ORIGIN, not just its shape:
+        a same-shaped raster restored into a shifted window (e.g.
+        --auto-bounds over a file whose extent moved) would silently
+        paint the old mass at the wrong place on the map.
+        """
         arrays, meta = manager.load(step)
+        w = self.config.window
+        ck_win = meta.get("window")  # absent in pre-origin checkpoints
+        if ck_win is not None and list(ck_win) != [int(w.zoom),
+                                                   int(w.row0),
+                                                   int(w.col0)]:
+            raise ValueError(
+                f"checkpoint window (zoom,row0,col0)={tuple(ck_win)} != "
+                f"stream window {(w.zoom, w.row0, w.col0)} — the data's "
+                "bounds changed (e.g. --auto-bounds over a grown file); "
+                "restart with fixed --lat/--lon flags or a fresh "
+                "checkpoint dir"
+            )
         return self.load_state_dict({
             "raster": arrays["raster"],
             "t": meta["t"],

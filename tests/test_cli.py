@@ -348,3 +348,19 @@ class TestAutoBounds:
         assert stats["tiles"] >= 1
         lat_min, lat_max, lon_min, lon_max = stats["bounds"]
         assert lat_min < 35.68 < lat_max and lon_min < 139.69 < lon_max
+
+    def test_stream_auto_bounds(self, tmp_path):
+        import json as _json
+
+        p = tmp_path / "sydney.csv"
+        rows = ["latitude,longitude,user_id,source,timestamp"]
+        rows += [f"{-33.86 + i * 1e-4},{151.20 + i * 1e-4},u,gps,{i}"
+                 for i in range(300)]
+        p.write_text("\n".join(rows) + "\n")
+        r = _run_cli("stream", "--backend", "cpu", "--input", str(p),
+                     "--zoom", "10", "--pixel-delta", "6", "--auto-bounds",
+                     "--batch-points", "128",
+                     "--output", str(tmp_path / "t"))
+        assert r.returncode == 0, r.stderr
+        stats = _json.loads(r.stdout.strip().splitlines()[-1])
+        assert stats["tiles"] >= 1 and stats["live_mass"] > 0

@@ -143,3 +143,29 @@ def test_run_stream_driver_filters_background():
                         on_batch=lambda s, t: seen.append(t))
     assert stream.snapshot().sum() == 1.0  # background row dropped
     assert seen == [0.0]
+
+
+def test_restore_rejects_shifted_window(tmp_path):
+    """A checkpoint written for one window origin must not restore into
+    a same-shaped but shifted window (silent geographic misplacement
+    under e.g. --auto-bounds over a file whose extent moved)."""
+    import pytest
+
+    from heatmap_tpu.ops import Window
+    from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+    from heatmap_tpu.utils import CheckpointManager
+
+    win = Window(zoom=10, row0=256, col0=256, height=128, width=128)
+    s = HeatmapStream(StreamConfig(window=win, half_life_s=10.0))
+    s.update(np.full(10, 47.6), np.full(10, -122.3), 1.0)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    s.checkpoint(mgr)
+
+    shifted = Window(zoom=10, row0=384, col0=256, height=128, width=128)
+    s2 = HeatmapStream(StreamConfig(window=shifted, half_life_s=10.0))
+    with pytest.raises(ValueError, match="window"):
+        s2.restore(mgr)
+    # Same origin restores fine.
+    s3 = HeatmapStream(StreamConfig(window=win, half_life_s=10.0))
+    s3.restore(mgr)
+    assert s3.n_batches == 1
