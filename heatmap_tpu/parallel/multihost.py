@@ -33,7 +33,7 @@ import json
 import jax
 import numpy as np
 
-from heatmap_tpu.parallel.mesh import DATA_AXIS, TILE_AXIS, make_mesh
+from heatmap_tpu.parallel.mesh import make_mesh
 
 
 def initialize(coordinator_address: str | None = None,

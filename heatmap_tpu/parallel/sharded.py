@@ -22,7 +22,6 @@ rasters too big for one device's HBM.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
