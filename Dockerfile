@@ -19,6 +19,7 @@ WORKDIR /opt/heatmap
 COPY native ./native
 RUN make -C native
 COPY heatmap_tpu ./heatmap_tpu
+COPY tools ./tools
 COPY submit-heatmap bench.py ./
 ENV PYTHONPATH=/opt/heatmap
 ENTRYPOINT ["./submit-heatmap"]
