@@ -364,3 +364,19 @@ class TestAutoBounds:
         assert r.returncode == 0, r.stderr
         stats = _json.loads(r.stdout.strip().splitlines()[-1])
         assert stats["tiles"] >= 1 and stats["live_mass"] > 0
+
+    def test_render_from_parquet_arrays(self, tmp_path):
+        import json as _json
+
+        lv = tmp_path / "lvpq"
+        r = _run_cli(
+            "run", "--backend", "cpu", "--input", "synthetic:1200:2",
+            "--output", f"arrays-parquet:{lv}",
+            "--detail-zoom", "11", "--min-detail-zoom", "8",
+        )
+        assert r.returncode == 0, r.stderr
+        r = _run_cli("render", "--input", f"arrays-parquet:{lv}",
+                     "--zoom", "9", "--pixel-delta", "6",
+                     "--output", str(tmp_path / "t"))
+        assert r.returncode == 0, r.stderr
+        assert _json.loads(r.stdout.strip().splitlines()[-1])["tiles"] >= 1
