@@ -133,9 +133,10 @@ def cmd_run(args) -> int:
         first_timespan_only=args.first_timespan_only,
         capacity=args.capacity,
     )
-    if args.max_points_in_flight is not None and (args.fast or args.checkpoint_dir):
-        raise SystemExit("--max-points-in-flight applies to the standard "
-                         "run path only (not --fast / --checkpoint-dir)")
+    if args.max_points_in_flight is not None and args.checkpoint_dir:
+        raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
+                         "mutually exclusive (chunk boundaries are not "
+                         "batch boundaries)")
     if args.multihost and (args.fast or args.checkpoint_dir
                            or args.max_points_in_flight is not None):
         raise SystemExit("--multihost runs the standard job path only "
@@ -169,10 +170,13 @@ def cmd_run(args) -> int:
     with prof:
         with open_sink(args.output) as sink:
             if args.fast:
-                blobs = run_job_fast(fast_source, sink, config,
-                                     batch_size=args.batch_size,
-                                     checkpoint_dir=args.checkpoint_dir,
-                                     checkpoint_every=args.checkpoint_every)
+                blobs = run_job_fast(
+                    fast_source, sink, config,
+                    batch_size=args.batch_size,
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every,
+                    max_points_in_flight=args.max_points_in_flight,
+                )
             elif args.checkpoint_dir:
                 blobs = run_job_resumable(
                     open_source(args.input), args.checkpoint_dir, sink,

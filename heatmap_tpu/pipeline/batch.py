@@ -21,7 +21,7 @@ import numpy as np
 from heatmap_tpu.pipeline import cascade as cascade_mod
 from heatmap_tpu.tilemath import mercator, morton
 from heatmap_tpu.pipeline.groups import ALL_GROUP, EXCLUDED, UserVocab
-from heatmap_tpu.pipeline.timespan import TimespanVocab
+from heatmap_tpu.pipeline.timespan import TS_MISSING, TimespanVocab
 
 BACKGROUND_SOURCE = "background"  # dropped at ingest, reference heatmap.py:28-29
 
@@ -260,9 +260,84 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     return blobs
 
 
+class _FastRouter:
+    """Maps fast-batch reader group ids into a shared UserVocab.
+
+    Fast batches carry ``routed`` ids into a reader-side ``names``
+    table that grows via ``new_group_names``; vocab ids are assigned in
+    first-use order of KEPT rows so they match the string path's
+    assignment order exactly (run_job_fast and the fast bounded path
+    share this logic — divergence here would silently shuffle user
+    attribution between paths).
+    """
+
+    def __init__(self, vocab: UserVocab):
+        self.vocab = vocab
+        self.names: list = []
+        self._map = np.full(1024, -2, np.int32)  # -2 = not yet mapped
+
+    def observe(self, batch):
+        """Grow the reader name table (REQUIRED for every batch, even
+        ones whose rows are skipped — later batches reference ids first
+        named earlier)."""
+        self.names.extend(batch["new_group_names"])
+
+    def route(self, batch):
+        """-> (lat, lon, gids, ts_i64), background rows dropped."""
+        if len(self.names) > len(self._map):
+            grown = np.full(max(len(self.names), 2 * len(self._map)),
+                            -2, np.int32)
+            grown[: len(self._map)] = self._map
+            self._map = grown
+        keep = ~batch["background"]
+        routed = batch["routed"][keep]
+        ref_ids = routed[routed >= 0]
+        unmapped = self._map[ref_ids] == -2
+        if unmapped.any():
+            first_use = ref_ids[unmapped]
+            _, order = np.unique(first_use, return_index=True)
+            for rid in first_use[np.sort(order)]:
+                if self._map[rid] == -2:
+                    self._map[rid] = self.vocab.id_for(self.names[rid])
+        gids = np.where(
+            routed >= 0, self._map[np.maximum(routed, 0)], EXCLUDED
+        ).astype(np.int32)
+        ts = batch.get("timestamp")
+        ts64 = (
+            np.full(int(keep.sum()), TS_MISSING, np.int64)
+            if ts is None else np.asarray(ts, np.int64)[keep]
+        )
+        return batch["latitude"][keep], batch["longitude"][keep], gids, ts64
+
+
+def _fast_batches_for(source, batch_size, checkpointing=False):
+    """The run_job_fast input contract: CSV path -> native decoder,
+    else an object with ``fast_batches``."""
+    if isinstance(source, str):
+        try:
+            from heatmap_tpu.native import parse_csv_batches
+        except ImportError as e:
+            raise RuntimeError(
+                "run_job_fast on a CSV path needs the native decoder "
+                "(native/ build failed or disabled); use "
+                "run_job(CSVSource(path)) instead"
+            ) from e
+        return parse_csv_batches(
+            source, batch_size, fast=True,
+            n_workers=1 if checkpointing else None,
+        )
+    if hasattr(source, "fast_batches"):
+        return source.fast_batches(batch_size)
+    raise TypeError(
+        f"run_job_fast needs a CSV path or a fast-batch source "
+        f"(got {type(source).__name__}); use run_job for generic "
+        f"sources"
+    )
+
+
 def _run_job_bounded(source, sink, config: BatchJobConfig,
                      batch_size: int, max_points: int,
-                     overlap_ingest: bool = True):
+                     overlap_ingest: bool = True, fast: bool = False):
     """Chunked cascade with host-side per-level aggregate merge.
 
     Spark streams partitions through executors (reference
@@ -302,7 +377,15 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     merged = [dict(empty) for _ in range(n_levels)]
 
     def chunks():
-        """Sequential chunk builder: ingest batches, cut at max_points."""
+        """Sequential chunk builder: ingest batches, cut at max_points.
+
+        ``fast`` consumes the integer fast-batch layout (native CSV
+        decoder / HMPB mmap) routed through the shared _FastRouter;
+        the string path goes through load_columns + vocab routing.
+        Either way a chunk is (lat, lon, gids, stamps) with stamps an
+        i64 array (fast) or a Python list (string) — build_emissions'
+        timespan labeler accepts both.
+        """
         lats, lons, gids, stamps = [], [], [], []
         pending = 0
 
@@ -312,25 +395,39 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 np.concatenate(lats),
                 np.concatenate(lons),
                 np.concatenate(gids).astype(np.int32),
-                [s for b in stamps for s in b],
+                np.concatenate(stamps) if fast
+                else [s for b in stamps for s in b],
             )
             lats.clear(); lons.clear(); gids.clear(); stamps.clear()
             pending = 0
             return chunk
 
-        for batch in source.batches(min(batch_size, max_points)):
+        if fast:
+            router = _FastRouter(vocab)
+            batches = _fast_batches_for(source, min(batch_size, max_points))
+        else:
+            batches = source.batches(min(batch_size, max_points))
+        for batch in batches:
             with tracer.span("ingest.batch"):
-                cols = load_columns(batch)
-                m = len(cols["latitude"])
+                if fast:
+                    router.observe(batch)
+                    lat, lon, g, ts = router.route(batch)
+                else:
+                    cols = load_columns(batch)
+                    lat = cols["latitude"]
+                    lon = cols["longitude"]
+                    g = vocab.group_ids(cols["user_id"])
+                    ts = cols["timestamp"]
+                m = len(lat)
                 # Cut BEFORE appending when the batch would overshoot,
                 # so a chunk never exceeds max_points (batches are read
                 # at most max_points long).
                 if pending and pending + m > max_points:
                     yield cut()
-                lats.append(cols["latitude"])
-                lons.append(cols["longitude"])
-                gids.append(vocab.group_ids(cols["user_id"]))
-                stamps.append(cols["timestamp"])
+                lats.append(lat)
+                lons.append(lon)
+                gids.append(g)
+                stamps.append(ts)
                 pending += m
             tracer.add_items("ingest.batch", m)
             if pending >= max_points:
@@ -530,7 +627,9 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
                  batch_size: int = 1 << 20,
                  checkpoint_dir: str | None = None,
                  checkpoint_every: int = 8,
-                 fault_injector=None):
+                 fault_injector=None,
+                 max_points_in_flight: int | None = None,
+                 overlap_ingest: bool = True):
     """Integer-fast-path job: no per-row Python objects anywhere.
 
     ``source`` is a CSV path (the native C++ decoder parses, routes
@@ -555,38 +654,44 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
     order, so checkpointing forces the native CSV reader to a single
     worker (parallel byte-range parsing reorders batches run to run);
     HMPB batches are always in file order.
+
+    ``max_points_in_flight`` bounds peak memory exactly like run_job's
+    knob — the cascade runs per chunk of at most that many points with
+    fast-path ingest, per-level aggregates merged host-side (the
+    BASELINE config-5 shape with mmap/native ingest). Mutually
+    exclusive with ``checkpoint_dir`` (chunk boundaries are not batch
+    boundaries, so batch-index resume would not line up).
     """
     config = config or BatchJobConfig()
     if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
-    from heatmap_tpu.pipeline.timespan import TS_MISSING
+    if max_points_in_flight is not None:
+        if checkpoint_dir is not None:
+            raise ValueError(
+                "max_points_in_flight and checkpoint_dir are mutually "
+                "exclusive on the fast path"
+            )
+        if fault_injector is not None:
+            # Silently accepting-and-ignoring the injector would make a
+            # recovery test pass without exercising anything.
+            raise ValueError(
+                "fault_injector is not supported with "
+                "max_points_in_flight (no batch-index resume on the "
+                "chunked path)"
+            )
+        return _run_job_bounded(
+            source, sink, config, batch_size, max_points_in_flight,
+            overlap_ingest=overlap_ingest, fast=True,
+        )
     from heatmap_tpu.utils.trace import get_tracer
 
     def make_batches():
-        if isinstance(source, str):
-            try:
-                from heatmap_tpu.native import parse_csv_batches
-            except ImportError as e:
-                raise RuntimeError(
-                    "run_job_fast on a CSV path needs the native decoder "
-                    "(native/ build failed or disabled); use "
-                    "run_job(CSVSource(path)) instead"
-                ) from e
-            return parse_csv_batches(
-                source, batch_size, fast=True,
-                n_workers=1 if checkpoint_dir is not None else None,
-            )
-        if hasattr(source, "fast_batches"):
-            return source.fast_batches(batch_size)
-        raise TypeError(
-            f"run_job_fast needs a CSV path or a fast-batch source "
-            f"(got {type(source).__name__}); use run_job for generic "
-            f"sources"
+        return _fast_batches_for(
+            source, batch_size, checkpointing=checkpoint_dir is not None
         )
 
     vocab = UserVocab()
-    names: list = []  # reader-side intern table, extended per batch
-    reader_to_vocab = np.full(1024, -2, np.int32)  # -2 = not yet mapped
+    router = _FastRouter(vocab)
     tracer = get_tracer()
     lats, lons, gids, tss = [], [], [], []
     mgr = None
@@ -643,42 +748,20 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
         for i, b in enumerate(make_batches()):
             # The intern table must grow even for skipped batches: a
             # post-resume batch may reference reader ids first named
-            # before the checkpoint.
-            names.extend(b["new_group_names"])
+            # before the checkpoint. (id_for inside route() is
+            # get-or-create, so names restored from a checkpoint keep
+            # their original ids on resume.)
+            router.observe(b)
             if i < done:
                 continue  # rows already checkpointed on a previous run
             if fault_injector is not None:
                 fault_injector.check(i)
             tracer.add_items("ingest.fast", len(b["latitude"]))
-            if len(names) > len(reader_to_vocab):
-                grown = np.full(max(len(names), 2 * len(reader_to_vocab)),
-                                -2, np.int32)
-                grown[: len(reader_to_vocab)] = reader_to_vocab
-                reader_to_vocab = grown
-            keep = ~b["background"]
-            routed = b["routed"][keep]
-            # Map only reader ids referenced by kept rows, in first-use
-            # order, so vocab ids match the string path's assignment
-            # order. (id_for is get-or-create, so names restored from a
-            # checkpoint keep their original ids on resume.)
-            ref_ids = routed[routed >= 0]
-            unmapped = reader_to_vocab[ref_ids] == -2
-            if unmapped.any():
-                first_use = ref_ids[unmapped]
-                _, order = np.unique(first_use, return_index=True)
-                for rid in first_use[np.sort(order)]:
-                    if reader_to_vocab[rid] == -2:
-                        reader_to_vocab[rid] = vocab.id_for(names[rid])
-            gids.append(np.where(
-                routed >= 0, reader_to_vocab[np.maximum(routed, 0)], EXCLUDED
-            ).astype(np.int32))
-            lats.append(b["latitude"][keep])
-            lons.append(b["longitude"][keep])
-            ts = b.get("timestamp")
-            tss.append(
-                np.full(int(keep.sum()), TS_MISSING, np.int64)
-                if ts is None else np.asarray(ts, np.int64)[keep]
-            )
+            lat, lon, g, ts64 = router.route(b)
+            lats.append(lat)
+            lons.append(lon)
+            gids.append(g)
+            tss.append(ts64)
             done = i + 1
             if mgr is not None and done % checkpoint_every == 0:
                 with tracer.span("checkpoint"):

@@ -296,3 +296,70 @@ class TestHMPBDirSource:
                                  shard_rows=5000)
         assert stats2["parts"] == 1
         assert HMPBDirSource(str(d)).n_ranges == 1
+
+
+class TestFastBounded:
+    def test_fast_bounded_matches_fast_and_string(self, tmp_path):
+        """--fast --max-points-in-flight: chunked cascade with fast
+        ingest must produce the exact blobs of both the unbounded fast
+        path and the bounded string path, at the default z21 shape."""
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        from heatmap_tpu.io.hmpb import HMPBSource, convert_to_hmpb
+        from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_fast
+
+        csv = tmp_path / "pts.csv"
+        _write_csv(csv, 4000, seed=23)
+        hmpb = tmp_path / "p.hmpb"
+        convert_to_hmpb(str(csv), str(hmpb))
+        cfg = BatchJobConfig()
+        want = run_job_fast(HMPBSource(str(hmpb)), config=cfg,
+                            batch_size=700)
+        got = run_job_fast(HMPBSource(str(hmpb)), config=cfg,
+                           batch_size=700, max_points_in_flight=900)
+        assert want == got
+        seq = run_job_fast(HMPBSource(str(hmpb)), config=cfg,
+                           batch_size=700, max_points_in_flight=900,
+                           overlap_ingest=False)
+        assert want == seq
+        # The string bounded path agrees too (cross-ingest identity).
+        from heatmap_tpu.io.sources import CSVSource
+
+        st = run_job(CSVSource(str(csv)), config=cfg, batch_size=700,
+                     max_points_in_flight=900)
+        assert want == st
+
+    def test_fast_bounded_rejects_checkpoint_combo(self, tmp_path):
+        from heatmap_tpu.io.hmpb import convert_to_hmpb
+        from heatmap_tpu.pipeline import run_job_fast
+
+        csv = tmp_path / "pts.csv"
+        _write_csv(csv, 50, seed=1)
+        hmpb = tmp_path / "p.hmpb"
+        convert_to_hmpb(str(csv), str(hmpb))
+        from heatmap_tpu.io.hmpb import HMPBSource
+
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            run_job_fast(HMPBSource(str(hmpb)),
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         max_points_in_flight=100)
+
+    def test_fast_bounded_dated_timespans(self, tmp_path):
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        from heatmap_tpu.io.hmpb import HMPBSource, convert_to_hmpb
+        from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+
+        csv = tmp_path / "pts.csv"
+        _write_csv(csv, 1500, seed=8)  # every row carries an i64 stamp
+        hmpb = tmp_path / "p.hmpb"
+        convert_to_hmpb(str(csv), str(hmpb))
+        cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=8,
+                             timespans=("alltime", "day"))
+        want = run_job_fast(HMPBSource(str(hmpb)), config=cfg,
+                            batch_size=400)
+        got = run_job_fast(HMPBSource(str(hmpb)), config=cfg,
+                           batch_size=400, max_points_in_flight=500)
+        assert want == got
