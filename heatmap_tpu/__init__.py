@@ -13,7 +13,7 @@ full build plan):
   Spark's reduceByKey/groupByKey shuffles, reference heatmap.py:111-112).
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from heatmap_tpu.tilemath import (  # noqa: F401
     Tile,
