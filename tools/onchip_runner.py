@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Resilient driver for the on-chip runlist (PERF_NOTES.md).
+
+The axon TPU relay is intermittently available: it answers for minutes,
+then wedges (backend init hangs) or drops the compile endpoint mid-run.
+This runner makes on-chip evidence collection survivable:
+
+- probes the relay in a SUBPROCESS with a short timeout (a wedged
+  backend init can hang the caller forever otherwise), and counts the
+  probe good only when the platform is NOT cpu (a silent CPU fallback
+  must not count as relay-alive — same rule as bench.probe_tpu);
+- when the relay answers, runs the next pending runlist item as a
+  subprocess, teeing output to ``onchip_state/<name>.log``;
+- an item is done when it exits 0 AND its log passes the item's
+  success check (bench.py exits 0 on its own CPU fallback by design;
+  that must not be recorded as on-chip evidence);
+- a failing item is retried at most ``max_attempts`` times and sent to
+  the back of the queue meanwhile, so one deterministic failure cannot
+  starve the rest of the runlist;
+- state lives in ``onchip_state/done.json`` (written atomically) so
+  restarts skip finished items; items that support ``--state``
+  checkpoint per-measurement, so a mid-run relay death costs only the
+  measurement in flight.
+
+    PYTHONPATH=. python tools/onchip_runner.py [--deadline-min 240]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+STATE_DIR = "onchip_state"
+
+PROBE = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "v = float(jnp.arange(128).sum());"
+    "print('PROBE_OK', d[0].platform, v, flush=True)"
+)
+
+
+def _last_json_with(log_path: str, key: str):
+    """Last JSON object line in the CURRENT attempt's log section that
+    has ``key``, else None. Logs append across attempts; a stale line
+    from an earlier attempt must not satisfy the success check."""
+    try:
+        with open(log_path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for i in range(len(lines) - 1, -1, -1):
+        if lines[i].startswith("===== attempt at "):
+            lines = lines[i + 1:]
+            break
+    for line in reversed(lines):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if key in rec:
+            return rec
+    return None
+
+
+def _check_bench(log_path: str) -> bool:
+    rec = _last_json_with(log_path, "device")
+    return (rec is not None and rec.get("device") != "cpu"
+            and "error" not in rec and "note" not in rec)
+
+
+def _check_bench_job(log_path: str) -> bool:
+    rec = _last_json_with(log_path, "device")
+    return rec is not None and rec.get("device") != "cpu"
+
+
+def runlist():
+    py = sys.executable
+    return [
+        {
+            "name": "verify_partitioned",
+            "cmd": [py, "tools/verify_partitioned_onchip.py",
+                    "--state", f"{STATE_DIR}/verify.jsonl"],
+            "timeout": 2700,
+        },
+        {
+            "name": "sweep_partitioned",
+            "cmd": [py, "tools/sweep_partitioned.py",
+                    "--state", f"{STATE_DIR}/sweep.jsonl"],
+            "timeout": 3600,
+        },
+        {
+            "name": "bench",
+            # --no-probe: the runner already probed (in a killable
+            # subprocess); bench's own CPU fallback would otherwise turn
+            # a mid-run relay death into a "successful" CPU artifact.
+            "cmd": [py, "bench.py", "--no-probe"],
+            "timeout": 1800,
+            "check": _check_bench,
+        },
+        {
+            "name": "bench_job",
+            "cmd": [py, "tools/bench_job.py", "--n", "20000000"],
+            "timeout": 3600,
+            "check": _check_bench_job,
+        },
+    ]
+
+
+def load_done():
+    path = os.path.join(STATE_DIR, "done.json")
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def save_done(done):
+    path = os.path.join(STATE_DIR, "done.json")
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(done, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def log(msg):
+    print(f"[onchip_runner {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def probe(timeout_s: float = 75.0) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE],
+                           timeout=timeout_s, capture_output=True, text=True)
+        if r.returncode == 0 and "PROBE_OK" in r.stdout:
+            line = r.stdout.split("PROBE_OK", 1)[1].split()
+            platform = line[0] if line else "?"
+            if platform != "cpu":
+                log(f"probe ok: platform={platform}")
+                return True
+            log("probe answered but on CPU fallback; relay NOT up")
+            return False
+        tail = (r.stderr or r.stdout).strip().splitlines()[-1:]
+        log(f"probe failed rc={r.returncode}: {tail}")
+        return False
+    except subprocess.TimeoutExpired:
+        log(f"probe timed out after {timeout_s:.0f}s (relay wedged)")
+        return False
+
+
+def run_item(item, env) -> int:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    log_path = os.path.join(STATE_DIR, f"{item['name']}.log")
+    log(f"running {item['name']} (log: {log_path})")
+    with open(log_path, "a") as lf:
+        lf.write(f"\n===== attempt at {time.strftime('%F %T')} =====\n")
+        lf.flush()
+        try:
+            r = subprocess.run(item["cmd"], timeout=item["timeout"],
+                               stdout=lf, stderr=subprocess.STDOUT, env=env)
+            return r.returncode
+        except subprocess.TimeoutExpired:
+            lf.write(f"\n[runner] TIMED OUT after {item['timeout']}s\n")
+            return -1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--deadline-min", type=float, default=240.0)
+    ap.add_argument("--poll-s", type=float, default=120.0)
+    ap.add_argument("--max-attempts", type=int, default=8)
+    args = ap.parse_args()
+
+    os.makedirs(STATE_DIR, exist_ok=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "." + os.pathsep + env.get("PYTHONPATH", "")
+
+    deadline = time.time() + args.deadline_min * 60
+    done = load_done()
+    queue = [it for it in runlist() if not done.get(it["name"])]
+    attempts = {it["name"]: 0 for it in queue}
+    while time.time() < deadline:
+        if not queue:
+            log("runlist complete")
+            return 0
+        if not probe():
+            time.sleep(args.poll_s)
+            continue
+        item = queue[0]
+        rc = run_item(item, env)
+        log_path = os.path.join(STATE_DIR, f"{item['name']}.log")
+        check = item.get("check")
+        ok = rc == 0 and (check is None or check(log_path))
+        if ok:
+            done[item["name"]] = {"at": time.strftime("%F %T")}
+            save_done(done)
+            queue.pop(0)
+            log(f"{item['name']} DONE")
+            continue
+        attempts[item["name"]] += 1
+        why = f"rc={rc}" if rc != 0 else "success-check failed (cpu?)"
+        if attempts[item["name"]] >= args.max_attempts:
+            done[item["name"]] = {"failed": why,
+                                  "at": time.strftime("%F %T")}
+            save_done(done)
+            queue.pop(0)
+            log(f"{item['name']} FAILED permanently ({why})")
+        else:
+            # Back of the queue: one flaky item must not starve the rest.
+            queue.append(queue.pop(0))
+            log(f"{item['name']} failed ({why}); requeued "
+                f"(attempt {attempts[item['name']]}/{args.max_attempts})")
+            time.sleep(args.poll_s / 2)
+    pending = ", ".join(it["name"] for it in queue)
+    log(f"deadline reached; pending: {pending or 'none'}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,16 +6,36 @@ and chunk (points per grid step) on the headline bench workload, plus
 the XLA scatter reference. One JSON line per configuration. Run on the
 real chip; see PERF_NOTES.md for recorded results.
 
-    python tools/sweep_partitioned.py [--n 25] [--steps 5]
+    python tools/sweep_partitioned.py [--n 25] [--steps 5] [--state FILE]
+
+``--state FILE`` appends each configuration's result as it lands and a
+re-run skips configurations already measured — the axon relay dies
+mid-run often enough that all-or-nothing sweeps never finish.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
+
+
+def _load_state(path):
+    if not path or not os.path.exists(path):
+        return {}
+    out = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line from a killed writer
+            if "config" in rec:
+                out[rec["config"]] = rec
+    return out
 
 
 def main():
@@ -23,7 +43,10 @@ def main():
     ap.add_argument("--n", type=int, default=25, help="log2 point count")
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--zoom", type=int, default=15)
+    ap.add_argument("--state", default=None,
+                    help="JSONL checkpoint; measured configs are skipped")
     args = ap.parse_args()
+    state = _load_state(args.state)
 
     import jax
     import jax.numpy as jnp
@@ -55,17 +78,32 @@ def main():
         return (time.perf_counter() - t0) / args.steps
 
     def report(name, dt, **extra):
-        print(json.dumps({
+        rec = {
             "config": name, "ms": round(dt * 1e3, 1),
             "mpts_per_s": round(n / dt / 1e6, 1), **extra,
-        }), flush=True)
+        }
+        print(json.dumps(rec), flush=True)
+        if args.state:
+            with open(args.state, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def measured(name):
+        if name in state:
+            rec = dict(state[name])
+            rec["cached"] = True
+            print(json.dumps(rec), flush=True)
+            return True
+        return False
 
     @jax.jit
     def xla(la, lo):
         r, c, v = mercator.project_points(la, lo, win.zoom, dtype=jnp.float32)
         return bin_rowcol_window(r, c, win, valid=v)
 
-    report("xla-scatter", timed(xla))
+    if not measured("xla-scatter"):
+        report("xla-scatter", timed(xla))
 
     # Sort cost in isolation, stable vs unstable (the idx sort needs no
     # stability: duplicate cell ids are indistinguishable).
@@ -80,7 +118,8 @@ def main():
             idx = jnp.where(v, r * win.width + c, win.height * win.width)
             return lax.sort(idx, is_stable=st)
 
-        report(f"sort-only stable={stable}", timed(sort_only))
+        if not measured(f"sort-only stable={stable}"):
+            report(f"sort-only stable={stable}", timed(sort_only))
 
     # Sort-shape probe: k independent row sorts of n/k elements (vmapped
     # along axis -1). If this beats the flat sort meaningfully, a
@@ -96,7 +135,8 @@ def main():
             return lax.sort(idx.reshape(kk, -1), dimension=1,
                             is_stable=False)
 
-        report(f"sort-rows k={k}", timed(sort_rows))
+        if not measured(f"sort-rows k={k}"):
+            report(f"sort-rows k={k}", timed(sort_rows))
 
     combos = [
         # (block_cells, chunk, bad_frac, streams): block size sweep at
@@ -128,6 +168,8 @@ def main():
 
         name = (f"partitioned bc={block_cells} chunk={chunk} "
                 f"bf={bad_frac} k={streams}")
+        if measured(name):
+            continue
         try:
             report(name, timed(part), block_cells=block_cells,
                    chunk=chunk, bad_frac=bad_frac, streams=streams)

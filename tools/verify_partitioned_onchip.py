@@ -9,18 +9,52 @@ raster bit-for-bit against the XLA scatter contract at the headline
 window for clustered, adversarial-uniform, and boundary-straddling
 inputs, across the swept tunable space.
 
-    PYTHONPATH=. python tools/verify_partitioned_onchip.py
+    PYTHONPATH=. python tools/verify_partitioned_onchip.py [--state FILE]
+
+``--state FILE`` records each (case, combo) verdict as it lands, and a
+re-run skips combos already verified — the axon relay dies mid-run
+often enough that all-or-nothing verification never finishes.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
 import sys
 
 import numpy as np
 
 
+def _load_state(path):
+    if not path or not os.path.exists(path):
+        return {}
+    out = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn final line from a killed writer
+            out.update(rec)
+    return out
+
+
+def _append_state(path, key, ok):
+    if not path:
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps({key: ok}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
 def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--state", default=None,
+                    help="JSONL checkpoint; verified combos are skipped")
+    args = ap.parse_args()
+    state = _load_state(args.state)
     import jax
     import jax.numpy as jnp
 
@@ -81,13 +115,26 @@ def main() -> int:
         {"streams": 8, "block_cells": 1 << 14},
     ]
     failures = 0
+    done = 0
     for name, (lat, lon) in cases.items():
+        todo = [kw for kw in combos
+                if state.get(f"{name}|{json.dumps(kw, sort_keys=True)}")
+                is not True]
+        if not todo:
+            done += len(combos)
+            continue
         r, c, v = project(lat, lon)
         expected = np.asarray(bin_rowcol_window(r, c, win, valid=v))
         for kw in combos:
+            key = f"{name}|{json.dumps(kw, sort_keys=True)}"
+            if state.get(key) is True:
+                done += 1
+                continue
             got = np.asarray(bin_rowcol_window_partitioned(
                 r, c, win, valid=v, interpret=False, **kw))
             ok = bool((got == expected).all())
+            _append_state(args.state, key, ok)
+            done += 1
             print(json.dumps({"case": name, "kw": kw, "bit_exact": ok,
                               "total": int(expected.sum())}), flush=True)
             if not ok:
@@ -97,6 +144,7 @@ def main() -> int:
     print(json.dumps({
         "device": jax.devices()[0].platform,
         "failures": failures,
+        "combos_done": done,
         "verdict": "BIT-EXACT" if failures == 0 else "MISMATCH",
     }), flush=True)
     return 1 if failures else 0
