@@ -145,8 +145,10 @@ def _pick_backend(backend: str, window: Window, weighted: bool = False) -> str:
         return "pallas"
     # Large windows: sort-partitioned MXU binning wins big for counts
     # (measured 149 M vs 67 M pts/s on the ~1024x1280 z15 headline
-    # window, v5e-1, same session); it is count-only, so weighted
-    # binning stays on the scatter path.
+    # window, v5e-1, same session). The weighted variant (pair-sorted
+    # weights + weight-scaled one-hots) exists but stays off auto until
+    # its on-chip win is measured (PERF_NOTES pending runlist) — request
+    # backend="partitioned" explicitly meanwhile.
     return "xla" if weighted else "partitioned"
 
 
@@ -160,24 +162,19 @@ def bin_rowcol_window(row, col, window: Window, weights=None, valid=None,
 
     ``backend``: "xla" (scatter-add), "pallas" (MXU one-hot matmul
     kernel, TPU only), "partitioned" (sort + per-block MXU kernel for
-    LARGE windows, count-only; ops/partitioned.py), or "auto" (pallas
-    on TPU for windows up to PALLAS_AUTO_MAX_CELLS cells). The pallas
-    paths accumulate in f32 — exact for < 2^24 counts per cell per
-    call — and are cast to the requested ``dtype``.
+    LARGE windows, counts and weighted sums; ops/partitioned.py), or
+    "auto" (pallas on TPU for windows up to PALLAS_AUTO_MAX_CELLS
+    cells). The pallas paths accumulate in f32 — exact for < 2^24
+    counts per cell per call — and are cast to the requested ``dtype``.
     """
     if dtype is None:
         dtype = jnp.int32 if weights is None else jnp.float32
     picked = _pick_backend(backend, window, weighted=weights is not None)
     if picked == "partitioned":
-        if weights is not None:
-            raise ValueError(
-                "backend='partitioned' is count-only; use xla/pallas "
-                "for weighted binning"
-            )
         from heatmap_tpu.ops.partitioned import bin_rowcol_window_partitioned
 
         return bin_rowcol_window_partitioned(
-            row, col, window, valid=valid, dtype=dtype
+            row, col, window, weights=weights, valid=valid, dtype=dtype
         )
     if picked == "pallas":
         from heatmap_tpu.ops.pallas_kernels import bin_rowcol_window_pallas

@@ -29,6 +29,16 @@ cells). This module restores MXU locality for big windows:
 Counts accumulate in f32 inside the kernel (exact < 2^24 per cell per
 call) and int32 on the scatter tail; the merged raster is returned in
 the requested dtype.
+
+Weighted binning (BASELINE.md config 3) rides the same machinery: the
+sort carries the weight as a ``lax.sort`` payload operand (XLA's sort
+permutes payloads in-pass — no separate gather, which costs as much as
+the scatter being avoided, PERF_NOTES.md), and the per-chunk matmul
+scales the column one-hot by the weight, so each good chunk is
+``row_onehot @ (col_onehot * w)``. Weighted sums accumulate in f32:
+bit-exact vs the scatter path for integer-valued weights with per-cell
+sums < 2^24 (the oracle-testable contract), within f32 rounding
+otherwise (summation order differs from the scatter path).
 """
 
 from __future__ import annotations
@@ -60,8 +70,9 @@ DEFAULT_BLOCK_CELLS = 1 << 16
 def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
                       zeros_ref, out_ref, acc_ref, *, chunk, block_cells,
                       side, n_blocks):
-    # This backend is count-only (histogram.py routes weighted binning
-    # to xla/pallas); zeros_ref only alias-inits the output.
+    # Count path (weighted binning goes through the separate
+    # _partition_kernel_weighted twin); zeros_ref only alias-inits the
+    # output.
     del zeros_ref
     i = pl.program_id(0)
 
@@ -89,8 +100,44 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
         out_ref[:] = acc_ref[:]
 
 
+def _partition_kernel_weighted(base_ref, good_ref, first_ref, last_ref,
+                               s_ref, w_ref, zeros_ref, out_ref, acc_ref, *,
+                               chunk, block_cells, side, n_blocks):
+    """Weighted twin of :func:`_partition_kernel` (kept as a SEPARATE
+    kernel, not a kwarg branch, so the count path stays byte-stable):
+    the column one-hot is scaled by the point's weight, making each
+    chunk's contribution ``row_onehot @ (col_onehot * w)``. Masked /
+    out-of-block lanes zero out through the all-false one-hot row."""
+    del zeros_ref
+    i = pl.program_id(0)
+
+    @pl.when(first_ref[i] == 1)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    local = s_ref[0, 0, :] - (base_ref[i] % n_blocks) * block_cells
+    ok = (good_ref[i] == 1) & (local >= 0) & (local < block_cells)
+    rloc = jnp.where(ok, local // side, -1)
+    cloc = jnp.where(ok, local % side, 0)
+
+    r_ids = lax.broadcasted_iota(jnp.int32, (side, chunk), 0)
+    c_ids = lax.broadcasted_iota(jnp.int32, (chunk, side), 1)
+    # f32 one-hots here: the weight factor makes bf16 lossy (weights are
+    # arbitrary f32), and the f32/bf16 gap measured ~0 at >= 256x256.
+    row_onehot = (r_ids == rloc[None, :]).astype(jnp.float32)
+    col_w = (c_ids == cloc[:, None]).astype(jnp.float32) * w_ref[0, 0, :][:, None]
+    acc_ref[0] += jnp.dot(
+        row_onehot, col_w, preferred_element_type=jnp.float32
+    )
+
+    @pl.when(last_ref[i] == 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
 def _partitioned_path(s2, good2, n_blocks, hw, chunk,
-                      bad_cap_chunks, interpret, block_cells, side):
+                      bad_cap_chunks, interpret, block_cells, side,
+                      w2=None):
     """Good chunks -> pallas blocks; bad tail -> bounded scatter.
 
     ``s2`` is (streams, L): each row independently sorted (one flat
@@ -101,6 +148,10 @@ def _partitioned_path(s2, good2, n_blocks, hw, chunk,
     blocks; the slabs sum at the end (counts are linear), which keeps
     every output block's visits consecutive WITHIN the flattened grid
     without any cross-stream ordering requirement.
+
+    ``w2`` (same shape as ``s2``, f32, already permuted by the caller's
+    pair sort) switches to the weighted kernel and a weighted f32
+    scatter tail.
     """
     streams, L = s2.shape
     nck = L // chunk
@@ -136,39 +187,45 @@ def _partitioned_path(s2, good2, n_blocks, hw, chunk,
 
     from jax.experimental.pallas import tpu as pltpu
 
+    # (n_chunks, 1, chunk) so the last-two block dims (1, chunk)
+    # satisfy the TPU tiling rule: sublane block == array dim
+    # (1 == 1), lane block divisible by 128.  A flat
+    # (n_chunks, chunk) array with block (1, chunk) is rejected
+    # by Mosaic (sublane 1 neither 8-divisible nor full).
+    stream_spec = pl.BlockSpec((1, 1, chunk), lambda i, *_: (i, 0, 0))
+    block_spec = pl.BlockSpec(
+        (1, side, side), lambda i, base, *_: (base[i], 0, 0)
+    )
+    weighted = w2 is not None
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(n_chunks,),
-        in_specs=[
-            # (n_chunks, 1, chunk) so the last-two block dims (1, chunk)
-            # satisfy the TPU tiling rule: sublane block == array dim
-            # (1 == 1), lane block divisible by 128.  A flat
-            # (n_chunks, chunk) array with block (1, chunk) is rejected
-            # by Mosaic (sublane 1 neither 8-divisible nor full).
-            pl.BlockSpec((1, 1, chunk), lambda i, *_: (i, 0, 0)),
-            pl.BlockSpec(
-                (1, side, side),
-                lambda i, base, *_: (base[i], 0, 0),
-            ),
-        ],
-        out_specs=pl.BlockSpec(
-            (1, side, side), lambda i, base, *_: (base[i], 0, 0)
+        in_specs=(
+            [stream_spec, stream_spec, block_spec] if weighted
+            else [stream_spec, block_spec]
         ),
+        out_specs=block_spec,
         scratch_shapes=[pltpu.VMEM((1, side, side), jnp.float32)],
     )
+    kernel = _partition_kernel_weighted if weighted else _partition_kernel
     zeros = jnp.zeros((streams * n_blocks, side, side), jnp.float32)
+    operands = [ob, gi, first_visit, last_visit,
+                s2.reshape(n_chunks, 1, chunk)]
+    if weighted:
+        operands.append(w2.reshape(n_chunks, 1, chunk))
+    operands.append(zeros)
     blocks = pl.pallas_call(
-        functools.partial(_partition_kernel, chunk=chunk,
+        functools.partial(kernel, chunk=chunk,
                           block_cells=block_cells, side=side,
                           n_blocks=n_blocks),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
             (streams * n_blocks, side, side), jnp.float32
         ),
-        input_output_aliases={5: 0},  # zeros operand -> output
+        # zeros operand -> output (position counts the scalar prefetches)
+        input_output_aliases={6 if weighted else 5: 0},
         interpret=interpret,
-    )(ob, gi, first_visit, last_visit,
-      s2.reshape(n_chunks, 1, chunk), zeros)
+    )(*operands)
     dense = (
         blocks.reshape(streams, n_blocks * block_cells).sum(axis=0)[:hw]
         if streams > 1
@@ -186,6 +243,17 @@ def _partitioned_path(s2, good2, n_blocks, hw, chunk,
         s2.reshape(n_chunks, chunk), bad_idx, axis=0,
         mode="fill", fill_value=hw,
     )
+    if weighted:
+        bad_w = jnp.take(
+            w2.reshape(n_chunks, chunk), bad_idx, axis=0,
+            mode="fill", fill_value=0.0,
+        )
+        tail = (
+            jnp.zeros(hw, jnp.float32)
+            .at[bad_rows.reshape(-1)]
+            .add(bad_w.reshape(-1), mode="drop")
+        )
+        return dense + tail
     tail = (
         jnp.zeros(hw, jnp.int32)
         .at[bad_rows.reshape(-1)]
@@ -198,34 +266,41 @@ def bin_rowcol_window_partitioned(
     row,
     col,
     window: Window,
+    weights=None,
     valid=None,
     chunk: int = DEFAULT_CHUNK,
     bad_frac: int = 8,
     interpret: bool | None = None,
-    dtype=jnp.int32,
+    dtype=None,
     block_cells: int = DEFAULT_BLOCK_CELLS,
     streams: int = DEFAULT_STREAMS,
 ):
-    """Count-only binning of pre-projected points into a large window.
+    """Sort-partitioned binning of pre-projected points into a large window.
 
-    Contract matches ops.histogram.bin_rowcol_window(weights=None):
-    out-of-window / invalid points drop. ``bad_frac``: the scatter tail
-    is sized n/bad_frac points; distributions badder than that fall
-    back to the full scatter inside the same jit (lax.cond).
-    ``interpret`` defaults to True on CPU (pallas has no compiled CPU
-    lowering), False on accelerators. ``block_cells`` sets the aligned
-    output-block size (must be an even power of two >= 2^12 so the
-    side is a lane-friendly square; see DEFAULT_BLOCK_CELLS).
-    ``streams`` splits the cell-id stream into that many independently
-    sorted rows (one batched row sort instead of one flat sort; each
-    row can be VMEM-resident), each accumulating its own output-block
-    slab, summed at the end — same raster bit-for-bit, different
-    sort-cost/memory tradeoff. streams=1 is the flat-sort baseline.
+    Contract matches ops.histogram.bin_rowcol_window: out-of-window /
+    invalid points drop. ``weights=None`` counts occurrences (int32,
+    bit-exact vs the scatter path); ``weights`` given sums them in f32
+    (bit-exact vs scatter for integer-valued weights with per-cell sums
+    < 2^24, within f32 rounding otherwise — the pair sort changes
+    summation order). ``bad_frac``: the scatter tail is sized
+    n/bad_frac points; distributions badder than that fall back to the
+    full scatter inside the same jit (lax.cond). ``interpret`` defaults
+    to True on CPU (pallas has no compiled CPU lowering), False on
+    accelerators. ``block_cells`` sets the aligned output-block size
+    (must be an even power of two >= 2^12 so the side is a
+    lane-friendly square; see DEFAULT_BLOCK_CELLS). ``streams`` splits
+    the cell-id stream into that many independently sorted rows (one
+    batched row sort instead of one flat sort; each row can be
+    VMEM-resident), each accumulating its own output-block slab, summed
+    at the end — same raster bit-for-bit, different sort-cost/memory
+    tradeoff. streams=1 is the flat-sort baseline.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
+    if dtype is None:
+        dtype = jnp.int32 if weights is None else jnp.float32
     return _bin_partitioned_jit(
-        row, col, window, valid, chunk=chunk, bad_frac=bad_frac,
+        row, col, window, weights, valid, chunk=chunk, bad_frac=bad_frac,
         interpret=interpret, dtype=dtype, block_cells=block_cells,
         streams=streams,
     )
@@ -242,6 +317,7 @@ def _bin_partitioned_jit(
     row,
     col,
     window: Window,
+    weights=None,
     valid=None,
     chunk: int = DEFAULT_CHUNK,
     bad_frac: int = 8,
@@ -271,6 +347,12 @@ def _bin_partitioned_jit(
     if valid is not None:
         ok = ok & valid
     idx = jnp.where(ok, r * w + c, sentinel)
+    weighted = weights is not None
+    if weighted:
+        # Dropped lanes carry weight 0 as well as the sentinel cell id,
+        # so every downstream path (matmul mask, bounded tail, full-
+        # scatter fallback) is doubly safe.
+        wts = jnp.where(ok, jnp.asarray(weights, jnp.float32), 0.0)
 
     n = idx.shape[0]
     # Pad so each of the `streams` rows is a whole number of chunks.
@@ -280,16 +362,29 @@ def _bin_partitioned_jit(
         idx = jnp.concatenate(
             [idx, jnp.full(n_pad - n, sentinel, jnp.int32)]
         )
+        if weighted:
+            wts = jnp.concatenate([wts, jnp.zeros(n_pad - n, jnp.float32)])
     n_chunks = n_pad // chunk
     # Padding sentinels land in the trailing rows and sort to each
     # row's end, so they can mark up to ~streams extra chunks bad on
     # top of the data-dependent ones.
     bad_cap_chunks = max(streams + 1, n_chunks // bad_frac)
 
-    # Unstable sort: cell ids are the only payload, so equal keys are
-    # indistinguishable and stability would only cost time. With
+    # Unstable sort: for counts, cell ids are the only payload, so equal
+    # keys are indistinguishable and stability would only cost time.
+    # Weighted, the weight rides as a lax.sort payload operand — XLA
+    # permutes it in-pass, avoiding the separate gather that costs as
+    # much as the scatter being avoided (PERF_NOTES.md). With
     # streams > 1 this is one batched row sort (axis -1).
-    s2 = jnp.sort(idx.reshape(streams, per_stream), axis=-1, stable=False)
+    if weighted:
+        s2, w2 = lax.sort(
+            (idx.reshape(streams, per_stream),
+             wts.reshape(streams, per_stream)),
+            dimension=1, num_keys=1, is_stable=False,
+        )
+    else:
+        s2 = jnp.sort(idx.reshape(streams, per_stream), axis=-1, stable=False)
+        w2 = None
     # The single source of truth for chunk goodness: fully inside one
     # aligned block AND free of sentinels. The bounded tail in
     # _partitioned_path covers exactly the chunks this marks bad, and
@@ -299,16 +394,33 @@ def _bin_partitioned_jit(
     good2 = (first // block_cells == last // block_cells) & (last < sentinel)
     n_bad = (~good2).sum()
 
-    raster = lax.cond(
-        n_bad <= bad_cap_chunks,
-        lambda s_, good_: _partitioned_path(
-            s_, good_, n_blocks, hw, chunk, bad_cap_chunks,
-            interpret, block_cells, side,
-        ),
-        lambda s_, good_: (
-            jnp.zeros(hw, jnp.int32).at[s_.reshape(-1)].add(1, mode="drop")
-        ),
-        s2,
-        good2,
-    )
+    if weighted:
+        raster = lax.cond(
+            n_bad <= bad_cap_chunks,
+            lambda s_, ww_, good_: _partitioned_path(
+                s_, good_, n_blocks, hw, chunk, bad_cap_chunks,
+                interpret, block_cells, side, w2=ww_,
+            ),
+            lambda s_, ww_, good_: (
+                jnp.zeros(hw, jnp.float32)
+                .at[s_.reshape(-1)]
+                .add(ww_.reshape(-1), mode="drop")
+            ),
+            s2,
+            w2,
+            good2,
+        )
+    else:
+        raster = lax.cond(
+            n_bad <= bad_cap_chunks,
+            lambda s_, good_: _partitioned_path(
+                s_, good_, n_blocks, hw, chunk, bad_cap_chunks,
+                interpret, block_cells, side,
+            ),
+            lambda s_, good_: (
+                jnp.zeros(hw, jnp.int32).at[s_.reshape(-1)].add(1, mode="drop")
+            ),
+            s2,
+            good2,
+        )
     return raster.reshape(h, w).astype(dtype)

@@ -112,7 +112,7 @@ def test_block_boundary_straddle():
     _diff(row, col)
 
 
-def test_backend_plumbing_and_weighted_rejection():
+def test_backend_plumbing_counts_and_weighted():
     rng = np.random.default_rng(5)
     row = jnp.asarray(rng.integers(500, 700, 1000), jnp.int32)
     col = jnp.asarray(rng.integers(280, 360, 1000), jnp.int32)
@@ -128,11 +128,15 @@ def test_backend_plumbing_and_weighted_rejection():
     assert via_backend.dtype == jnp.float32
     np.testing.assert_array_equal(np.asarray(via_backend),
                                   np.asarray(expected))
-    with pytest.raises(ValueError):
-        bin_rowcol_window(
-            row, col, WINDOW, weights=jnp.ones(1000),
-            backend="partitioned",
-        )
+    # Weighted dispatch through the public entry (integer-valued f32
+    # weights: order-independent sums, so exact equality holds).
+    w = jnp.asarray(rng.integers(0, 8, 1000), jnp.float32)
+    via_w = bin_rowcol_window(
+        row, col, WINDOW, weights=w, valid=valid, backend="partitioned",
+    )
+    exp_w = bin_rowcol_window(row, col, WINDOW, weights=w, valid=valid)
+    assert via_w.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(via_w), np.asarray(exp_w))
 
 
 @pytest.mark.parametrize("streams", [2, 4, 8])
@@ -173,3 +177,93 @@ def test_streams_one_equals_flat_path():
     a = _diff(row, col, streams=1)
     b = _diff(row, col, streams=8)
     np.testing.assert_array_equal(a, b)
+
+
+def _diff_weighted(row, col, weights, window=WINDOW, valid=None, exact=True,
+                   **kw):
+    """Weighted twin of _diff. ``exact`` for integer-valued weights
+    (order-independent f32 sums); otherwise allclose within f32
+    reordering tolerance."""
+    row = jnp.asarray(row, jnp.int32)
+    col = jnp.asarray(col, jnp.int32)
+    weights = jnp.asarray(weights, jnp.float32)
+    expected = bin_rowcol_window(row, col, window, weights=weights,
+                                 valid=valid)
+    got = bin_rowcol_window_partitioned(
+        row, col, window, weights=weights, valid=valid, interpret=True, **kw
+    )
+    assert got.dtype == jnp.float32
+    if exact:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(expected))
+    else:
+        # Summation-order difference grows with per-cell fan-in: a few
+        # ulps of the cell sum (observed ~15 ulps at 100k-point
+        # pileups), so the relative tolerance is the meaningful one.
+        np.testing.assert_allclose(np.asarray(got), np.asarray(expected),
+                                   rtol=1e-5, atol=1e-4)
+    return np.asarray(expected)
+
+
+def test_weighted_clustered_bit_exact():
+    rng = np.random.default_rng(20)
+    n = (1 << 15) + 333  # not a multiple of chunk: exercises weight padding
+    row = rng.integers(520, 620, n)
+    col = rng.integers(300, 500, n)
+    row[:500] = rng.integers(0, 4096, 500)  # fringe + out-of-window
+    col[:500] = rng.integers(0, 4096, 500)
+    w = rng.integers(0, 16, n).astype(np.float32)
+    assert _diff_weighted(row, col, w).sum() > 0
+
+
+def test_weighted_uniform_fallback():
+    """Hostile distribution routes to the weighted full-scatter
+    fallback inside the cond; must still match exactly."""
+    rng = np.random.default_rng(21)
+    n = 1 << 14
+    w = rng.integers(1, 4, n).astype(np.float32)
+    _diff_weighted(rng.integers(512, 1536, n), rng.integers(256, 896, n), w)
+
+
+def test_weighted_valid_mask_and_pileup():
+    rng = np.random.default_rng(22)
+    n = 1 << 14
+    valid = jnp.asarray(rng.random(n) < 0.6)
+    # Single-cell pileup: per-cell sum ~n*mean(w) stays far below 2^24.
+    row = np.full(n, 600)
+    col = np.full(n, 400)
+    row[: n // 8] = rng.integers(-100, 5000, n // 8)
+    col[: n // 8] = rng.integers(-100, 5000, n // 8)
+    w = rng.integers(0, 8, n).astype(np.float32)
+    _diff_weighted(row, col, w, valid=valid)
+
+
+@pytest.mark.parametrize("streams", [2, 8])
+def test_weighted_streams(streams):
+    rng = np.random.default_rng(23)
+    n = (1 << 14) + 77
+    row = rng.integers(520, 620, n)
+    col = rng.integers(300, 500, n)
+    w = rng.integers(0, 8, n).astype(np.float32)
+    _diff_weighted(row, col, w, streams=streams)
+
+
+def test_weighted_float_weights_close():
+    """Arbitrary float weights: summation order differs from the
+    scatter path, so the contract is allclose, not bit-equal."""
+    rng = np.random.default_rng(24)
+    n = 1 << 14
+    row = rng.integers(520, 620, n)
+    col = rng.integers(300, 500, n)
+    w = rng.random(n).astype(np.float32) * 3.7
+    _diff_weighted(row, col, w, exact=False)
+
+
+def test_weighted_empty_and_zero_weights():
+    _diff_weighted(np.empty(0, np.int64), np.empty(0, np.int64),
+                   np.empty(0, np.float32))
+    rng = np.random.default_rng(25)
+    n = 4096
+    out = _diff_weighted(rng.integers(520, 620, n),
+                         rng.integers(300, 500, n),
+                         np.zeros(n, np.float32))
+    assert out.sum() == 0

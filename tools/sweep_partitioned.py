@@ -179,6 +179,34 @@ def main():
                 "error": f"{type(e).__name__}: {e}"[:200],
             }), flush=True)
 
+    # Weighted binning (BASELINE config 3 shape): the pair-sort +
+    # weight-scaled one-hot variant vs the weighted XLA scatter. Decides
+    # whether _pick_backend routes weighted large windows to partitioned.
+    wts = jnp.asarray(rng.integers(1, 16, n).astype(np.float32))
+    dw = jax.device_put(wts)
+
+    @jax.jit
+    def xla_weighted(la, lo):
+        r, c, v = mercator.project_points(la, lo, win.zoom, dtype=jnp.float32)
+        return bin_rowcol_window(r, c, win, weights=dw, valid=v)
+
+    @jax.jit
+    def part_weighted(la, lo):
+        r, c, v = mercator.project_points(la, lo, win.zoom, dtype=jnp.float32)
+        return bin_rowcol_window_partitioned(r, c, win, weights=dw, valid=v)
+
+    for name, fn in (("xla-scatter weighted", xla_weighted),
+                     ("partitioned weighted", part_weighted)):
+        if measured(name):
+            continue
+        try:
+            report(name, timed(fn))
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            print(json.dumps({
+                "config": name,
+                "error": f"{type(e).__name__}: {e}"[:200],
+            }), flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -141,6 +141,44 @@ def main() -> int:
                 failures += 1
                 bad = np.argwhere(got != expected)
                 print(f"  first diffs at {bad[:5].tolist()}", flush=True)
+
+    # Weighted variant (after the count gate — counts decide the
+    # headline routing): integer-valued f32 weights make the sums
+    # order-independent, so bit-exactness vs the weighted scatter is
+    # the on-chip contract exactly as for counts — PROVIDED every
+    # per-cell sum stays below 2^24. The pileup case drops ~7/8 of the
+    # 2^22 points into one cell, so weights must be <= 3 to keep that
+    # cell's sum (~3.7M * 3 = 11M) inside the exact range.
+    w_int = jnp.asarray(rng.integers(0, 4, n).astype(np.float32))
+    weighted_combos = [{}, {"streams": 8}]
+    for name, (lat, lon) in cases.items():
+        todo = [kw for kw in weighted_combos
+                if state.get(
+                    f"{name}|weighted|{json.dumps(kw, sort_keys=True)}")
+                is not True]
+        if not todo:
+            done += len(weighted_combos)
+            continue
+        r, c, v = project(lat, lon)
+        expected = np.asarray(bin_rowcol_window(
+            r, c, win, weights=w_int, valid=v))
+        for kw in weighted_combos:
+            key = f"{name}|weighted|{json.dumps(kw, sort_keys=True)}"
+            if state.get(key) is True:
+                done += 1
+                continue
+            got = np.asarray(bin_rowcol_window_partitioned(
+                r, c, win, weights=w_int, valid=v, interpret=False, **kw))
+            ok = bool((got == expected).all())
+            _append_state(args.state, key, ok)
+            done += 1
+            print(json.dumps({"case": name, "weighted": True, "kw": kw,
+                              "bit_exact": ok,
+                              "total": float(expected.sum())}), flush=True)
+            if not ok:
+                failures += 1
+                bad = np.argwhere(got != expected)
+                print(f"  first diffs at {bad[:5].tolist()}", flush=True)
     print(json.dumps({
         "device": jax.devices()[0].platform,
         "failures": failures,
