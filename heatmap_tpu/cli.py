@@ -149,7 +149,7 @@ def cmd_run(args) -> int:
         from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
         from heatmap_tpu.io.sources import CSVSource
 
-        src = open_source(args.input)
+        src = open_source(args.input, read_value=False)
         if isinstance(src, CSVSource):
             fast_source = src.path
         elif isinstance(src, (HMPBSource, HMPBDirSource)):
@@ -179,18 +179,21 @@ def cmd_run(args) -> int:
                 )
             elif args.checkpoint_dir:
                 blobs = run_job_resumable(
-                    open_source(args.input), args.checkpoint_dir, sink,
+                    open_source(args.input, read_value=False),
+                    args.checkpoint_dir, sink,
                     config, batch_size=args.batch_size,
                     checkpoint_every=args.checkpoint_every,
                 )
             elif args.multihost:
                 from heatmap_tpu.parallel import run_job_multihost
 
-                blobs = run_job_multihost(open_source(args.input), sink,
+                blobs = run_job_multihost(open_source(args.input,
+                                                      read_value=False), sink,
                                           config,
                                           batch_size=args.batch_size)
             else:
-                blobs = run_job(open_source(args.input), sink, config,
+                blobs = run_job(open_source(args.input, read_value=False),
+                                sink, config,
                                 batch_size=args.batch_size,
                                 max_points_in_flight=args.max_points_in_flight)
     dt = time.perf_counter() - t0
@@ -265,7 +268,11 @@ def cmd_tiles(args) -> int:
     from heatmap_tpu.pipeline import load_columns
 
     proj_dtype = jnp.float32 if args.no_x64 else jnp.float64
-    source = open_source(args.input)
+    # Count-only runs skip the value column so weighted CSVs keep the
+    # native fast parser; --weighted reads it (auto would too, but the
+    # explicit hint makes the missing-column error come from this
+    # command, not a parser heuristic).
+    source = open_source(args.input, read_value=bool(args.weighted))
     if args.auto_bounds:
         bounds = _scan_bounds(source, args.batch_size)
         if bounds is None:
@@ -283,11 +290,21 @@ def cmd_tiles(args) -> int:
     t0 = time.perf_counter()
     for batch in source.batches(args.batch_size):
         cols = load_columns(batch)
+        weights = None
+        if args.weighted:
+            if "value" not in cols:
+                raise SystemExit(
+                    "--weighted needs a 'value' column in the input "
+                    "(CSV/JSONL/Parquet column named 'value')"
+                )
+            weights = jnp.asarray(cols["value"], jnp.float32)
         part = bin_points_window(
             jnp.asarray(cols["latitude"]),
             jnp.asarray(cols["longitude"]),
             window,
+            weights=weights,
             proj_dtype=proj_dtype,
+            backend=args.bin_backend,
         )
         raster = part if raster is None else raster + part
     if raster is None:
@@ -342,7 +359,8 @@ def cmd_stream(args) -> int:
     if args.auto_bounds:
         # Needs a re-iterable (file) source; same file on resume gives
         # the same window (restore() rejects a shifted one).
-        bounds = _scan_bounds(open_source(args.input), args.batch_points)
+        bounds = _scan_bounds(open_source(args.input, read_value=False),
+                              args.batch_points)
         if bounds is None:
             print(json.dumps({"batches": 0, "stream_seconds": 0.0,
                               "live_mass": 0.0, "tiles": 0,
@@ -373,7 +391,8 @@ def cmd_stream(args) -> int:
     resumed = stream.n_batches
     t_stream = stream.t or 0.0
     i = 0
-    for batch in open_source(args.input).batches(args.batch_points):
+    for batch in open_source(args.input,
+                             read_value=False).batches(args.batch_points):
         i += 1
         if i <= resumed:
             continue  # deterministic source replay up to the checkpoint
@@ -581,6 +600,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "rendering (e.g. 9; 0 = off)")
     p_tiles.add_argument("--sigma", type=float, default=None,
                          help="Gaussian sigma in cells (default K/4)")
+    p_tiles.add_argument("--weighted", action="store_true",
+                         help="sum the input's per-point 'value' column "
+                         "instead of counting points (BASELINE config 3)")
+    p_tiles.add_argument("--bin-backend", default="auto",
+                         choices=("auto", "xla", "pallas", "partitioned"),
+                         help="binning path (as in bench.py): auto routes "
+                         "TPU windows to the measured-fastest kernel; xla "
+                         "is the plain scatter")
     p_tiles.set_defaults(fn=cmd_tiles)
 
     p_stream = sub.add_parser(

@@ -29,6 +29,12 @@ import numpy as np
 #: (reference heatmap.py:25-36).
 COLUMNS = ("latitude", "longitude", "user_id", "source", "timestamp")
 
+#: Optional per-point weight column (BASELINE.md config 3: weighted
+#: heatmap, per-point value sum). The reference table has no such
+#: column — file sources pass it through when the input names one
+#: literally ``value``, and batches omit the key otherwise.
+VALUE_COLUMN = "value"
+
 DEFAULT_BATCH = 1 << 20
 
 
@@ -50,6 +56,16 @@ def _finalize(cols):
         "source": list(cols["source"]),
         "timestamp": list(cols["timestamp"]),
     }
+
+
+def _finalize_with_value(cols, vals):
+    """_finalize plus the optional weight column: ``vals`` is a list of
+    per-row weights (missing entries already defaulted to 1.0) or None
+    when the source carries no value column."""
+    out = _finalize(cols)
+    if vals is not None:
+        out[VALUE_COLUMN] = np.asarray(vals, np.float64)
+    return out
 
 
 class Source:
@@ -143,13 +159,22 @@ class CSVSource(Source):
     """CSV reader with a header row naming (a superset of) COLUMNS.
 
     Numeric columns are parsed with numpy for speed; uses the native
-    C++ fast parser when available (heatmap_tpu.native)."""
+    C++ fast parser when available (heatmap_tpu.native).
+
+    ``read_value=None`` (auto) reads a ``value`` weight column when the
+    header names one — which routes off the native decoder (it knows
+    the reference's column contract only) onto the Python reader.
+    Consumers that never use weights (the count-only batch job) pass
+    ``read_value=False`` to keep the native fast path regardless."""
 
     path: str
     use_native: bool = True
+    read_value: bool | None = None
 
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
-        if self.use_native:
+        has_value = (self.read_value is not False
+                     and self._has_value_column())
+        if self.use_native and not has_value:
             try:
                 from heatmap_tpu.native import parse_csv_batches
             except ImportError:
@@ -162,50 +187,90 @@ class CSVSource(Source):
         with open(self.path, newline="") as f:
             reader = csv.DictReader(f)
             cols = {k: [] for k in COLUMNS}
+            vals = [] if has_value else None
             for row in reader:
                 cols["latitude"].append(float(row["latitude"]))
                 cols["longitude"].append(float(row["longitude"]))
                 cols["user_id"].append(row.get("user_id", ""))
                 cols["source"].append(row.get("source", ""))
                 cols["timestamp"].append(row.get("timestamp"))
+                if vals is not None:
+                    v = row.get(VALUE_COLUMN)
+                    vals.append(float(v) if v not in (None, "") else 1.0)
                 if len(cols["latitude"]) >= batch_size:
-                    yield _finalize(cols)
+                    yield _finalize_with_value(cols, vals)
                     cols = {k: [] for k in COLUMNS}
+                    vals = [] if has_value else None
             if cols["latitude"]:
-                yield _finalize(cols)
+                yield _finalize_with_value(cols, vals)
+
+    def _has_value_column(self) -> bool:
+        with open(self.path, newline="") as f:
+            header = next(csv.reader(f), None)
+        return header is not None and VALUE_COLUMN in header
 
 
 @dataclasses.dataclass
 class JSONLSource(Source):
-    """One JSON object per line with the reference column names."""
+    """One JSON object per line with the reference column names.
+
+    The FIRST data row decides whether the file is weighted
+    (``read_value=None``): if it carries ``value``, every batch gets
+    the column (missing entries default to 1.0); if it doesn't, a
+    ``value`` appearing on a later row raises — per-batch presence
+    flapping would abort weighted consumers mid-stream, and silently
+    dropping late weights would corrupt sums. ``read_value=False``
+    ignores the column entirely."""
 
     path: str
+    read_value: bool | None = None
 
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         cols = {k: [] for k in COLUMNS}
+        weighted = None if self.read_value is not False else False
+        vals = []
+        line_no = 0
         with open(self.path) as f:
             for line in f:
+                line_no += 1
                 line = line.strip()
                 if not line:
                     continue
                 row = json.loads(line)
+                v = row.get(VALUE_COLUMN)
+                if weighted is None:  # first data row decides
+                    weighted = v is not None
+                elif v is not None and not weighted and self.read_value is None:
+                    raise ValueError(
+                        f"{self.path}:{line_no}: 'value' appears after "
+                        "the first row lacked it; weighted JSONL files "
+                        "must carry the column from row 1 (missing "
+                        "entries default to 1.0)"
+                    )
                 cols["latitude"].append(float(row["latitude"]))
                 cols["longitude"].append(float(row["longitude"]))
                 cols["user_id"].append(row.get("user_id", ""))
                 cols["source"].append(row.get("source", ""))
                 cols["timestamp"].append(row.get("timestamp"))
+                if weighted:
+                    vals.append(float(v) if v is not None else 1.0)
                 if len(cols["latitude"]) >= batch_size:
-                    yield _finalize(cols)
+                    yield _finalize_with_value(cols, vals if weighted else None)
                     cols = {k: [] for k in COLUMNS}
+                    vals = []
         if cols["latitude"]:
-            yield _finalize(cols)
+            yield _finalize_with_value(cols, vals if weighted else None)
 
 
 @dataclasses.dataclass
 class ParquetSource(Source):
-    """Parquet reader (pyarrow), batched at row-group granularity."""
+    """Parquet reader (pyarrow), batched at row-group granularity.
+
+    A ``value`` weight column in the schema passes through (nulls
+    default to 1.0) unless ``read_value=False``."""
 
     path: str
+    read_value: bool | None = None
 
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         import pyarrow.parquet as pq
@@ -213,13 +278,19 @@ class ParquetSource(Source):
         pf = pq.ParquetFile(self.path)
         for rb in pf.iter_batches(batch_size=batch_size):
             d = rb.to_pydict()
-            yield {
+            out = {
                 "latitude": np.asarray(d["latitude"], np.float64),
                 "longitude": np.asarray(d["longitude"], np.float64),
                 "user_id": [str(u) for u in d.get("user_id", [""] * rb.num_rows)],
                 "source": [str(s) for s in d.get("source", [""] * rb.num_rows)],
                 "timestamp": list(d.get("timestamp", [None] * rb.num_rows)),
             }
+            if VALUE_COLUMN in d and self.read_value is not False:
+                out[VALUE_COLUMN] = np.asarray(
+                    [1.0 if v is None else float(v) for v in d[VALUE_COLUMN]],
+                    np.float64,
+                )
+            yield out
 
 
 @dataclasses.dataclass
@@ -494,12 +565,18 @@ class CosmosDBSource(Source):
             yield _finalize(cols)
 
 
-def open_source(spec: str, **kwargs) -> Source:
+def open_source(spec: str, read_value: bool | None = None, **kwargs) -> Source:
     """Parse a CLI source spec into a Source.
 
     Specs: ``synthetic:N`` (optionally ``synthetic:N:seed``),
     ``csv:PATH``, ``jsonl:PATH``, ``parquet:PATH``,
-    ``cassandra:[ENDPOINT]``. Extension sniffing for bare paths."""
+    ``cassandra:[ENDPOINT]``. Extension sniffing for bare paths.
+
+    ``read_value`` controls the optional per-point weight column on the
+    file sources that support one (CSV/JSONL/Parquet): None = auto
+    (read it when present), False = ignore it (count-only consumers
+    keep the native CSV fast path). Sources without a value concept
+    ignore the option."""
     kind, _, rest = spec.partition(":")
     if kind == "synthetic":
         parts = rest.split(":") if rest else ["1000000"]
@@ -507,11 +584,11 @@ def open_source(spec: str, **kwargs) -> Source:
         seed = int(parts[1]) if len(parts) > 1 else 0
         return SyntheticSource(n=n, seed=seed, **kwargs)
     if kind == "csv":
-        return CSVSource(rest, **kwargs)
+        return CSVSource(rest, read_value=read_value, **kwargs)
     if kind == "jsonl":
-        return JSONLSource(rest, **kwargs)
+        return JSONLSource(rest, read_value=read_value, **kwargs)
     if kind == "parquet":
-        return ParquetSource(rest, **kwargs)
+        return ParquetSource(rest, read_value=read_value, **kwargs)
     if kind == "cassandra":
         cfg = CassandraConfig(endpoint=rest or None)
         if not cfg.endpoint:
@@ -529,11 +606,11 @@ def open_source(spec: str, **kwargs) -> Source:
         return HMPBSource(rest, **kwargs)
     # Bare path: sniff the extension.
     if spec.endswith(".csv"):
-        return CSVSource(spec, **kwargs)
+        return CSVSource(spec, read_value=read_value, **kwargs)
     if spec.endswith((".jsonl", ".ndjson")):
-        return JSONLSource(spec, **kwargs)
+        return JSONLSource(spec, read_value=read_value, **kwargs)
     if spec.endswith((".parquet", ".pq")):
-        return ParquetSource(spec, **kwargs)
+        return ParquetSource(spec, read_value=read_value, **kwargs)
     if spec.endswith(".hmpb"):
         from heatmap_tpu.io.hmpb import HMPBSource
 

@@ -189,6 +189,9 @@ def load_columns(batch):
     lon = np.asarray(batch["longitude"], np.float64)
     users = batch["user_id"]
     stamps = batch.get("timestamp")
+    values = batch.get("value")  # optional per-point weight (config 3)
+    if values is not None:
+        values = np.asarray(values, np.float64)
     if stamps is None or len(stamps) == 0:
         stamps = [None] * len(lat)
     if src is not None and len(src):
@@ -198,12 +201,17 @@ def load_columns(batch):
             lat, lon = lat[idx], lon[idx]
             users = [users[i] for i in idx]
             stamps = [stamps[i] for i in idx]
-    return {
+            if values is not None:
+                values = values[idx]
+    out = {
         "latitude": lat,
         "longitude": lon,
         "user_id": list(users),
         "timestamp": list(stamps),
     }
+    if values is not None:
+        out["value"] = values
+    return out
 
 
 def run_job(source, sink=None, config: BatchJobConfig | None = None,

@@ -255,6 +255,60 @@ class TestEndToEnd:
         pngs = [f for _, _, fs in os.walk(out) for f in fs]
         assert len(pngs) == stats["tiles"]
 
+    def test_tiles_weighted_csv(self, tmp_path):
+        """--weighted sums the input's 'value' column (BASELINE config
+        3): non-uniform weights change the rendered pixels, uniform
+        weights of 1.0 reproduce counting byte-for-byte, and a missing
+        value column fails cleanly."""
+
+        def render(csv_path, subdir, *extra):
+            out = tmp_path / subdir
+            r = _run_cli(
+                "tiles", "--backend", "cpu",
+                "--input", f"csv:{csv_path}", "--output", str(out),
+                "--zoom", "12", "--pixel-delta", "6",
+                "--lat-min", "47.0", "--lat-max", "48.5",
+                "--lon-min", "-123.0", "--lon-max", "-121.5", *extra,
+            )
+            assert r.returncode == 0, r.stderr
+            assert json.loads(r.stdout.strip().splitlines()[-1])["tiles"] >= 1
+            return {
+                os.path.relpath(os.path.join(d, f), out):
+                    open(os.path.join(d, f), "rb").read()
+                for d, _, fs in os.walk(out) for f in fs
+            }
+
+        def write_csv(path, value_expr):
+            with open(path, "w") as f:
+                f.write("latitude,longitude,user_id,source,timestamp,value\n")
+                for i in range(50):
+                    f.write(f"47.{600 + i},-122.{300 + i},u,gps,1,"
+                            f"{value_expr(i)}\n")
+
+        p = tmp_path / "w.csv"
+        write_csv(p, lambda i: 1.0 + 10.0 * (i % 7))  # non-uniform
+        weighted = render(p, "tw", "--weighted")
+        counted = render(p, "tc")
+        assert weighted.keys() == counted.keys()
+        # Non-uniform weights must actually change at least one pixel.
+        assert weighted != counted
+        # Uniform weights of 1.0 == counting, byte-for-byte.
+        p1 = tmp_path / "w1.csv"
+        write_csv(p1, lambda i: 1.0)
+        assert render(p1, "t1w", "--weighted") == render(p1, "t1c")
+        # No value column -> clean error, not a stack trace.
+        p2 = tmp_path / "nw.csv"
+        with open(p2, "w") as f:
+            f.write("latitude,longitude,user_id,source,timestamp\n")
+            f.write("47.6,-122.3,u,gps,1\n")
+        r2 = _run_cli(
+            "tiles", "--backend", "cpu",
+            "--input", f"csv:{p2}", "--output", str(tmp_path / "t2"),
+            "--zoom", "12", "--pixel-delta", "6", "--weighted",
+        )
+        assert r2.returncode != 0
+        assert "value" in r2.stderr
+
     def test_info_reports_platform(self):
         r = _run_cli("info", "--backend", "cpu")
         assert r.returncode == 0, r.stderr

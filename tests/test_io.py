@@ -109,6 +109,82 @@ class TestSources:
         assert b["user_id"] == ["alice", "bob", "x-9", "rt-1"]
         assert b["latitude"].dtype == np.float64
 
+    def test_value_column_passthrough(self, tmp_path):
+        """Weighted inputs (BASELINE config 3): a 'value' column rides
+        through CSV/JSONL/Parquet batches and load_columns' background
+        filter; sources without one omit the key entirely."""
+        from heatmap_tpu.pipeline import load_columns
+
+        vrows = [dict(r, value=v) for r, v in zip(ROWS, (2.5, 0.5, 3.0, 7.0))]
+        # CSV (the value column routes past the native decoder).
+        p = tmp_path / "w.csv"
+        cols = ["latitude", "longitude", "user_id", "source", "timestamp",
+                "value"]
+        with open(p, "w") as f:
+            f.write(",".join(cols) + "\n")
+            for r in vrows:
+                f.write(",".join(str(r[c]) for c in cols) + "\n")
+        (b,) = list(CSVSource(str(p)).batches())
+        np.testing.assert_allclose(b["value"], [2.5, 0.5, 3.0, 7.0])
+        # JSONL.
+        pj = tmp_path / "w.jsonl"
+        with open(pj, "w") as f:
+            for r in vrows:
+                f.write(json.dumps(r) + "\n")
+        (bj,) = list(JSONLSource(str(pj)).batches())
+        np.testing.assert_allclose(bj["value"], [2.5, 0.5, 3.0, 7.0])
+        # Parquet.
+        pa = pytest.importorskip("pyarrow")
+        import pyarrow.parquet as pq
+
+        pp = tmp_path / "w.parquet"
+        pq.write_table(
+            pa.table({k: [r[k] for r in vrows] for k in vrows[0]}), pp)
+        (bp,) = list(ParquetSource(str(pp)).batches())
+        np.testing.assert_allclose(bp["value"], [2.5, 0.5, 3.0, 7.0])
+        # load_columns drops the background row's value with the row.
+        lc = load_columns(bj)
+        np.testing.assert_allclose(lc["value"], [2.5, 0.5, 3.0])
+        # No value column -> key absent end to end.
+        _write_csv(tmp_path / "nw.csv", ROWS)
+        (nb,) = list(CSVSource(str(tmp_path / "nw.csv")).batches())
+        assert "value" not in nb
+        assert "value" not in load_columns(nb)
+
+    def test_value_column_missing_entries_default_to_one(self, tmp_path):
+        pj = tmp_path / "m.jsonl"
+        with open(pj, "w") as f:
+            f.write(json.dumps(dict(ROWS[0], value=4.0)) + "\n")
+            f.write(json.dumps(ROWS[1]) + "\n")  # no value -> 1.0
+        (b,) = list(JSONLSource(str(pj)).batches())
+        np.testing.assert_allclose(b["value"], [4.0, 1.0])
+
+    def test_jsonl_late_value_raises_read_value_false_ignores(self, tmp_path):
+        """The first JSONL row decides weightedness for the whole file;
+        a 'value' appearing later is an error (silent dropping would
+        corrupt sums, per-batch flapping would abort consumers
+        mid-stream). read_value=False ignores values entirely."""
+        pj = tmp_path / "late.jsonl"
+        with open(pj, "w") as f:
+            f.write(json.dumps(ROWS[0]) + "\n")  # no value
+            f.write(json.dumps(dict(ROWS[1], value=9.0)) + "\n")
+        with pytest.raises(ValueError, match="value"):
+            list(JSONLSource(str(pj)).batches())
+        (b,) = list(JSONLSource(str(pj), read_value=False).batches())
+        assert "value" not in b
+
+    def test_read_value_false_keeps_csv_native_path(self, tmp_path):
+        """A value-bearing CSV with read_value=False must omit the
+        column (and so stays eligible for the native fast parser)."""
+        p = tmp_path / "w.csv"
+        with open(p, "w") as f:
+            f.write("latitude,longitude,user_id,source,timestamp,value\n")
+            f.write("47.6,-122.3,u,gps,1,2.5\n")
+        (b,) = list(CSVSource(str(p), read_value=False).batches())
+        assert "value" not in b
+        (bw,) = list(CSVSource(str(p)).batches())
+        np.testing.assert_allclose(bw["value"], [2.5])
+
     def test_rows_view_matches_batches(self, tmp_path):
         p = tmp_path / "pts.csv"
         _write_csv(p, ROWS)
