@@ -84,6 +84,10 @@ def _add_run_flags(p):
     p.add_argument("--first-timespan-only", action="store_true",
                    help="reproduce the reference's early-return timespan "
                    "quirk (SURVEY.md §8.2)")
+    p.add_argument("--weighted", action="store_true",
+                   help="sum the source's per-point 'value' column into "
+                   "the heatmaps instead of counting points (plain job "
+                   "path only)")
     p.add_argument("--fast", action="store_true",
                    help="integer-only native-decoder path (csv/hmpb "
                    "sources; dated timespans use the i64 epoch-ms "
@@ -132,7 +136,13 @@ def cmd_run(args) -> int:
         amplify_all=args.amplify_all,
         first_timespan_only=args.first_timespan_only,
         capacity=args.capacity,
+        weighted=args.weighted,
     )
+    if args.weighted and (args.fast or args.multihost or args.checkpoint_dir
+                          or args.max_points_in_flight is not None):
+        raise SystemExit("--weighted runs the plain job path only (not "
+                         "--fast / --multihost / --checkpoint-dir / "
+                         "--max-points-in-flight)")
     if args.max_points_in_flight is not None and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "
@@ -192,7 +202,8 @@ def cmd_run(args) -> int:
                                           config,
                                           batch_size=args.batch_size)
             else:
-                blobs = run_job(open_source(args.input, read_value=False),
+                blobs = run_job(open_source(args.input,
+                                            read_value=args.weighted),
                                 sink, config,
                                 batch_size=args.batch_size,
                                 max_points_in_flight=args.max_points_in_flight)

@@ -219,15 +219,18 @@ class JSONLSource(Source):
     the column (missing entries default to 1.0); if it doesn't, a
     ``value`` appearing on a later row raises — per-batch presence
     flapping would abort weighted consumers mid-stream, and silently
-    dropping late weights would corrupt sums. ``read_value=False``
-    ignores the column entirely."""
+    dropping late weights would corrupt sums. ``read_value=True``
+    forces the weighted reading regardless of the first row (every
+    missing entry is 1.0 — JSON rows are schema-less, so "column
+    absent" is only ever a per-row fact); ``read_value=False`` ignores
+    the column entirely."""
 
     path: str
     read_value: bool | None = None
 
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         cols = {k: [] for k in COLUMNS}
-        weighted = None if self.read_value is not False else False
+        weighted = self.read_value  # None -> first data row decides
         vals = []
         line_no = 0
         with open(self.path) as f:
@@ -245,7 +248,8 @@ class JSONLSource(Source):
                         f"{self.path}:{line_no}: 'value' appears after "
                         "the first row lacked it; weighted JSONL files "
                         "must carry the column from row 1 (missing "
-                        "entries default to 1.0)"
+                        "entries default to 1.0), or pass "
+                        "read_value=True to force weighted reading"
                     )
                 cols["latitude"].append(float(row["latitude"]))
                 cols["longitude"].append(float(row["longitude"]))

@@ -259,6 +259,14 @@ def run_job_multihost(source, sink=None, config=None,
         )
     if jax.process_count() == 1:
         return run_job(source, sink, config, batch_size=batch_size)
+    if config.weighted:
+        # The multi-process branch drops the 'value' column when
+        # assembling the data dict; failing here beats ingesting the
+        # whole source first and then blaming the source.
+        raise NotImplementedError(
+            "weighted jobs run the plain path only for now "
+            "(not multi-process run_job_multihost)"
+        )
     sharded = shard_source(source)
     if sharded is not None:
         batches = sharded.batches(batch_size)

@@ -39,6 +39,11 @@ class BatchJobConfig:
     amplify_all: bool = False
     first_timespan_only: bool = False
     capacity: int | None = None
+    #: Sum the source's per-point 'value' column instead of counting
+    #: (the cascade accumulates in f64; blob values become the sums).
+    #: The reference counts 1.0 per row (heatmap.py:35) — weighted jobs
+    #: are a capability extension, not a parity surface.
+    weighted: bool = False
 
     def cascade_config(self) -> cascade_mod.CascadeConfig:
         return cascade_mod.CascadeConfig(
@@ -127,7 +132,8 @@ def _cascade_codes(lat, lon, detail_zoom):
 
 
 def build_emissions(codes, valid, group_ids, timestamps,
-                    config: BatchJobConfig, ts_vocab: TimespanVocab | None = None):
+                    config: BatchJobConfig, ts_vocab: TimespanVocab | None = None,
+                    weights=None):
     """Expand points into (code, slot) emissions + slot name table.
 
     Mirrors the reference mapper's group expansion (heatmap.py:64-75):
@@ -143,6 +149,10 @@ def build_emissions(codes, valid, group_ids, timestamps,
     assembled on device as well, from int32 uploads of the host-vocab
     id columns (half the transfer of pre-built int64 slots, no host
     concatenation). ``group_ids`` must be numpy.
+
+    ``weights`` (per-point values, weighted jobs) expand exactly like
+    the codes — each emission carries its point's weight; the returned
+    weights entry is None when not given.
     """
     ts_vocab = ts_vocab if ts_vocab is not None else TimespanVocab()
     timespans = (
@@ -158,6 +168,7 @@ def build_emissions(codes, valid, group_ids, timestamps,
     keep_x = xp.asarray(keep)
     routed = np.where(keep, group_ids, 0).astype(np.int32)
     routed_x = xp.asarray(routed)
+    weights_x = None if weights is None else xp.asarray(weights)
     emit_codes, emit_slots, emit_valid = [], [], []
     for ts_ids in per_ts_ids:
         ts_x = xp.asarray(ts_ids.astype(np.int32))
@@ -170,12 +181,18 @@ def build_emissions(codes, valid, group_ids, timestamps,
         emit_codes.append(codes)
         emit_slots.append(ts64 * n_groups + routed_x)
         emit_valid.append(valid & keep_x)
+    n_copies = 2 * len(per_ts_ids)
+    e_weights = (
+        None if weights_x is None
+        else xp.concatenate([weights_x] * n_copies)
+    )
     return (
         xp.concatenate(emit_codes),
         xp.concatenate(emit_slots),
         xp.concatenate(emit_valid),
         ts_vocab,
         n_groups,
+        e_weights,
     )
 
 
@@ -241,12 +258,17 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
 
     config = config or BatchJobConfig()
     if max_points_in_flight is not None:
+        if config.weighted:
+            raise NotImplementedError(
+                "weighted jobs run the plain path only for now "
+                "(not max_points_in_flight)"
+            )
         return _run_job_bounded(
             source, sink, config, batch_size, max_points_in_flight,
             overlap_ingest=overlap_ingest,
         )
     tracer = get_tracer()
-    lats, lons, users, stamps = [], [], [], []
+    lats, lons, users, stamps, vals = [], [], [], [], []
     for batch in source.batches(batch_size):
         with tracer.span("ingest.batch"):
             cols = load_columns(batch)
@@ -254,6 +276,13 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
             lons.append(cols["longitude"])
             users.extend(cols["user_id"])
             stamps.extend(cols["timestamp"])
+            if config.weighted:
+                if "value" not in cols:
+                    raise ValueError(
+                        "weighted job needs a 'value' column in the "
+                        "source (CSV/JSONL/Parquet column named 'value')"
+                    )
+                vals.append(cols["value"])
         tracer.add_items("ingest.batch", len(cols["latitude"]))
     if not lats or sum(len(a) for a in lats) == 0:
         return {}
@@ -263,6 +292,8 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
         "user_id": users,
         "timestamp": stamps,
     }
+    if config.weighted:
+        data["value"] = np.concatenate(vals)
     with tracer.span("cascade", items=len(data["latitude"])):
         blobs = _run_loaded(data, config, as_json=True, sink=sink)
     return blobs
@@ -447,7 +478,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
         lat, lon, group_ids, flat_stamps = chunk
         with tracer.span("cascade.chunk", items=len(lat)):
             codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
-            e_codes, e_slots, e_valid, _, n_groups = build_emissions(
+            e_codes, e_slots, e_valid, _, n_groups, _ = build_emissions(
                 codes, valid, group_ids, flat_stamps, config, ts_vocab=ts_vocab
             )
             level_data = cascade_mod.build_cascade(
@@ -670,6 +701,11 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
     exclusive with ``checkpoint_dir`` (chunk boundaries are not batch
     boundaries, so batch-index resume would not line up).
     """
+    if config is not None and config.weighted:
+        raise NotImplementedError(
+            "weighted jobs run the standard string path only for now "
+            "(the fast-path formats carry no 'value' column)"
+        )
     config = config or BatchJobConfig()
     if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
@@ -812,6 +848,11 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
     ``fault_injector`` (utils.recovery.FaultInjector) fails chosen
     batch indices for recovery testing.
     """
+    if config is not None and config.weighted:
+        raise NotImplementedError(
+            "weighted jobs run the plain path only for now "
+            "(not checkpoint/resume)"
+        )
     from heatmap_tpu.utils import CheckpointManager
     from heatmap_tpu.utils.trace import get_tracer
 
@@ -972,24 +1013,34 @@ def _run_loaded(data, config: BatchJobConfig, as_json: bool, sink=None):
     return _run_grouped(
         data["latitude"], data["longitude"], group_ids,
         data["timestamp"], vocab, config, as_json, sink=sink,
+        weights=data.get("value") if config.weighted else None,
     )
 
 
 def _run_grouped(lat, lon, group_ids, timestamps, vocab,
-                 config: BatchJobConfig, as_json: bool, sink=None):
+                 config: BatchJobConfig, as_json: bool, sink=None,
+                 weights=None):
     from heatmap_tpu.utils.trace import get_tracer
 
+    if config.weighted and weights is None:
+        raise ValueError("config.weighted needs per-point weights "
+                         "(a 'value' column in the source)")
     tracer = get_tracer()
     with tracer.span("cascade.project", items=len(lat)):
         codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
     with tracer.span("cascade.emissions"):
-        e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
-            codes, valid, group_ids, timestamps, config
+        e_codes, e_slots, e_valid, ts_vocab, n_groups, e_weights = (
+            build_emissions(
+                codes, valid, group_ids, timestamps, config,
+                weights=weights if config.weighted else None,
+            )
         )
     n_slots = len(ts_vocab) * n_groups
 
     ccfg = config.cascade_config()
     with tracer.span("cascade.device"):
+        import jax.numpy as jnp
+
         levels = cascade_mod.build_cascade(
             e_codes,
             e_slots,
@@ -997,6 +1048,11 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             n_slots=n_slots,
             valid=e_valid,
             capacity=config.capacity or len(e_codes),
+            weights=e_weights,
+            # Weighted sums accumulate in f64 (f32 would both round and
+            # stop moving near 2^24-scale cell sums; counts use the
+            # int32 path, SURVEY.md §8.8).
+            acc_dtype=jnp.float64 if e_weights is not None else None,
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

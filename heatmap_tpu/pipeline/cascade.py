@@ -92,13 +92,15 @@ def decode_level_keys(level_keys: np.ndarray, detail_zoom: int, level: int):
 
 
 def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
-                  weights=None, valid=None, capacity=None):
+                  weights=None, valid=None, capacity=None, acc_dtype=None):
     """Device-side cascade: per-level (composite key, sum) aggregates.
 
     Args:
       codes: detail-zoom Morton codes per emission.
       slots: (timespan*G + group) slot id per emission.
-      weights/valid/capacity: as in ops.pyramid.pyramid_sparse_morton.
+      weights/valid/capacity/acc_dtype: as in
+        ops.pyramid.pyramid_sparse_morton (weighted jobs pass f64
+        weights + acc_dtype=f64 for exact-at-scale sums).
 
     Returns the list of per-level (keys, sums, n_unique) — level i at
     detail zoom ``config.detail_zoom - i``.
@@ -110,6 +112,7 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         valid=valid,
         levels=config.n_levels,
         capacity=capacity,
+        acc_dtype=acc_dtype,
     )
 
 
@@ -380,10 +383,10 @@ def _blob_bodies(lvl, is_start):
     """Per-blob '{...}' JSON documents for one level, in order.
 
     The multithreaded native formatter handles the common case —
-    integral count values, which is everything blob egress ever sees
-    from the cascade (weights never reach it) — at C speed; the numpy
-    join/split path is the fallback and the formatting oracle (tested
-    equal byte-for-byte).
+    integral values, i.e. every count job and any weighted job whose
+    sums happen to be whole numbers — at C speed; the numpy join/split
+    path formats fractional weighted sums and doubles as the formatting
+    oracle (tested equal byte-for-byte on integral inputs).
     """
     values = lvl["value"]
     # Lazy import: native asserts against pipeline.timespan at load, so

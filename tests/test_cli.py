@@ -85,6 +85,39 @@ class TestEndToEnd:
         assert len(loaded) == stats["blobs"]
         assert any(k.startswith("all|alltime|") for k in loaded)
 
+    def test_run_weighted_jsonl_source(self, tmp_path):
+        """run --weighted sums the source's value column into blob
+        values; composing with --fast fails cleanly."""
+        src = tmp_path / "pts.jsonl"
+        with open(src, "w") as f:
+            for v in (1.25, 2.0):
+                f.write(json.dumps({
+                    "latitude": 47.6, "longitude": -122.3,
+                    "user_id": "alice", "value": v,
+                }) + "\n")
+        out = tmp_path / "blobs.jsonl"
+        r = _run_cli(
+            "run", "--backend", "cpu",
+            "--input", f"jsonl:{src}", "--output", f"jsonl:{out}",
+            "--detail-zoom", "10", "--min-detail-zoom", "4", "--weighted",
+        )
+        assert r.returncode == 0, r.stderr
+        from heatmap_tpu.io import JSONLBlobSink
+        from heatmap_tpu.tilemath.tile import Tile
+
+        loaded = JSONLBlobSink.load(str(out))
+        detail = Tile.tile_id_from_lat_long(47.6, -122.3, 10)
+        alice = next(b if isinstance(b, dict) else json.loads(b)
+                     for k, b in loaded.items() if k.startswith("alice|"))
+        assert alice[detail] == 3.25
+        r2 = _run_cli(
+            "run", "--backend", "cpu",
+            "--input", f"jsonl:{src}", "--output", "memory:",
+            "--weighted", "--fast",
+        )
+        assert r2.returncode != 0
+        assert "--weighted" in r2.stderr
+
     def test_run_fast_csv_matches_plain(self, tmp_path):
         import csv
         import numpy as np

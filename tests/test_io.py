@@ -172,6 +172,10 @@ class TestSources:
             list(JSONLSource(str(pj)).batches())
         (b,) = list(JSONLSource(str(pj), read_value=False).batches())
         assert "value" not in b
+        # read_value=True forces weighted reading: row 1's missing
+        # value defaults to 1.0, the late value is kept, no error.
+        (bt,) = list(JSONLSource(str(pj), read_value=True).batches())
+        np.testing.assert_allclose(bt["value"], [1.0, 9.0])
 
     def test_read_value_false_keeps_csv_native_path(self, tmp_path):
         """A value-bearing CSV with read_value=False must omit the

@@ -122,7 +122,7 @@ def test_json_blobs_match_dict_path_exactly():
     gids = vocab.group_ids(users)
     cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=7)
     codes, valid = _cascade_codes(lat, lon, cfg.detail_zoom)
-    e_codes, e_slots, e_valid, ts_vocab, n_groups = build_emissions(
+    e_codes, e_slots, e_valid, ts_vocab, n_groups, _ = build_emissions(
         codes, valid, gids, [None] * n, cfg
     )
     ccfg = cfg.cascade_config()
@@ -297,13 +297,16 @@ class _ColSource:
     def batches(self, batch_size):
         for i in range(0, len(self.rows), batch_size):
             chunk = self.rows[i : i + batch_size]
-            yield {
+            out = {
                 "latitude": [r["latitude"] for r in chunk],
                 "longitude": [r["longitude"] for r in chunk],
                 "user_id": [r["user_id"] for r in chunk],
                 "timestamp": [r.get("timestamp") for r in chunk],
                 "source": [r.get("source", "gps") for r in chunk],
             }
+            if any("value" in r for r in chunk):
+                out["value"] = [float(r.get("value", 1.0)) for r in chunk]
+            yield out
 
 
 @pytest.mark.parametrize("amplify", [False, True])
@@ -329,6 +332,88 @@ def test_run_job_bounded_matches_unbounded(amplify):
         max_points_in_flight=150, overlap_ingest=False,
     )
     assert plain == sequential
+
+
+def test_weighted_job_is_linear_in_weights():
+    """config.weighted with every value == 2.5 must yield exactly
+    2.5x the count job's blob values (the cascade is a linear (key,
+    sum) reduction; counts are oracle-verified elsewhere), across every
+    level, slot, and timespan."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=800, seed=3)
+    wrows = [dict(r, value=2.5) for r in rows]
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=6,
+                         timespans=("alltime", "month"))
+    import dataclasses
+
+    counted = run_job(_ColSource(rows), config=cfg, batch_size=128)
+    weighted = run_job(
+        _ColSource(wrows),
+        config=dataclasses.replace(cfg, weighted=True),
+        batch_size=128,
+    )
+    assert counted.keys() == weighted.keys()
+    for key, blob in counted.items():
+        c = json.loads(blob)
+        w = json.loads(weighted[key])
+        assert c.keys() == w.keys(), key
+        for tile, cnt in c.items():
+            assert w[tile] == pytest.approx(2.5 * cnt), (key, tile)
+
+
+def test_weighted_job_hand_computed_sums():
+    """Distinct per-row values on known tiles: blob values must be the
+    exact per-(user, tile) sums, 'all' the total, background dropped,
+    x-users only in 'all'."""
+    from heatmap_tpu.pipeline import run_job
+
+    base = {"latitude": 47.6, "longitude": -122.3, "timestamp": None}
+    rows = [
+        dict(base, user_id="alice", value=1.25),
+        dict(base, user_id="alice", value=2.0),
+        dict(base, user_id="bob", value=10.0),
+        dict(base, user_id="x-spy", value=100.0),   # 'all' only
+        dict(base, user_id="carol", value=5.0, source="background"),
+    ]
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
+    blobs = run_job(_ColSource(rows), config=cfg, batch_size=10)
+    from heatmap_tpu.tilemath.tile import Tile
+
+    detail = Tile.tile_id_from_lat_long(47.6, -122.3, 10)
+    per_user = {}
+    for key, blob in blobs.items():
+        user = key.split("|")[0]
+        doc = json.loads(blob)
+        if detail in doc:
+            per_user[user] = doc[detail]
+    assert per_user["alice"] == pytest.approx(3.25)
+    assert per_user["bob"] == pytest.approx(10.0)
+    assert "x-spy" not in per_user
+    assert "carol" not in per_user
+    assert per_user["all"] == pytest.approx(113.25)
+
+
+def test_weighted_job_missing_value_column_raises():
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=20, seed=1)
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
+    with pytest.raises(ValueError, match="value"):
+        run_job(_ColSource(rows), config=cfg)
+
+
+def test_weighted_job_unsupported_paths_raise():
+    from heatmap_tpu.pipeline import run_job, run_job_fast, run_job_resumable
+
+    rows = [dict(r, value=1.0) for r in _rows(n=20, seed=1)]
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
+    with pytest.raises(NotImplementedError):
+        run_job(_ColSource(rows), config=cfg, max_points_in_flight=10)
+    with pytest.raises(NotImplementedError):
+        run_job_fast("nonexistent.csv", config=cfg)
+    with pytest.raises(NotImplementedError):
+        run_job_resumable(_ColSource(rows), "/tmp/nope", config=cfg)
 
 
 def test_run_job_bounded_propagates_ingest_errors():
