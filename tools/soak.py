@@ -12,6 +12,8 @@ equivalence checks at configurable scale on the current backend:
                     single-device kernel, on skewed keys
   resume            crash (fault injection) + resume == uninterrupted
   streaming         sharded decayed raster: deterministic replay
+  weighted          weighted job linearity (3x values == 3x counts,
+                    exact) + weighted partitioned-vs-scatter kernels
 
     PYTHONPATH=.:$PYTHONPATH XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python tools/soak.py [--n 2000000] [--checks fast-vs-bounded,...]
@@ -24,6 +26,7 @@ through); the mesh checks need the 8-device XLA_FLAGS above.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import shutil
@@ -33,7 +36,7 @@ import time
 
 import numpy as np
 
-CHECKS = ("fast-vs-bounded", "mesh", "resume", "streaming")
+CHECKS = ("fast-vs-bounded", "mesh", "resume", "streaming", "weighted")
 
 
 def _synth_hmpb(path, n, n_users=300, seed=1, dated=False):
@@ -174,6 +177,77 @@ def check_streaming(n, tmp):
             "sharded": mesh is not None}
 
 
+def check_weighted(n, tmp):
+    """Weighted-path equivalences at scale.
+
+    (a) Job linearity: run --weighted semantics with every value == 3
+    must equal exactly 3x the counted blobs (integer-valued weights
+    keep the f64 sums exact at any fan-in). (b) Kernel cross-path:
+    weighted sort-partitioned binning vs the weighted XLA scatter,
+    bit-equal for integer weights at a million-point z15 window.
+    """
+    import jax.numpy as jnp
+
+    from heatmap_tpu.ops import window_from_bounds
+    from heatmap_tpu.ops.histogram import bin_rowcol_window
+    from heatmap_tpu.ops.partitioned import bin_rowcol_window_partitioned
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+    from heatmap_tpu.tilemath import mercator
+
+    rng = np.random.default_rng(9)
+    n_job = min(n, 200_000)  # the string job path is host-bound
+    users = (["all_is_reserved"] + [f"u{i}" for i in range(50)]
+             + ["x-hidden", "rt-bus"])
+    lat = 47.6 + rng.normal(0, 0.5, n_job)
+    lon = -122.3 + rng.normal(0, 0.7, n_job)
+    uid = rng.integers(0, len(users), n_job)
+
+    class _Src:
+        def __init__(self, with_values):
+            self.with_values = with_values
+
+        def batches(self, batch_size):
+            for i in range(0, n_job, batch_size):
+                sl = slice(i, i + batch_size)
+                out = {
+                    "latitude": lat[sl], "longitude": lon[sl],
+                    "user_id": [users[j] for j in uid[sl]],
+                    "source": [], "timestamp": [],
+                }
+                if self.with_values:
+                    out["value"] = np.full(len(lat[sl]), 3.0)
+                yield out
+
+    cfg = BatchJobConfig(detail_zoom=14, min_detail_zoom=6)
+    counted = run_job(_Src(False), config=cfg, batch_size=1 << 16)
+    weighted = run_job(_Src(True),
+                       config=dataclasses.replace(cfg, weighted=True),
+                       batch_size=1 << 16)
+    assert counted.keys() == weighted.keys()
+    checked = 0
+    for key, blob in counted.items():
+        c = json.loads(blob)
+        w = json.loads(weighted[key])
+        assert c.keys() == w.keys(), key
+        for tile, cnt in c.items():
+            assert w[tile] == 3.0 * cnt, (key, tile, w[tile], cnt)
+            checked += 1
+
+    win = window_from_bounds((44.0, 51.0), (-127.0, -117.0), zoom=15,
+                             align_levels=12, pad_multiple=256)
+    m = min(n, 1 << 20)
+    kl = jnp.asarray((47.6 + rng.normal(0, 0.5, m)).astype(np.float32))
+    ko = jnp.asarray((-122.3 + rng.normal(0, 0.7, m)).astype(np.float32))
+    kw = jnp.asarray(rng.integers(0, 16, m).astype(np.float32))
+    r, c, v = mercator.project_points(kl, ko, win.zoom, dtype=jnp.float32)
+    a = np.asarray(bin_rowcol_window(r, c, win, weights=kw, valid=v))
+    b = np.asarray(bin_rowcol_window_partitioned(r, c, win, weights=kw,
+                                                 valid=v))
+    np.testing.assert_array_equal(a, b)
+    return {"blob_values_checked": checked, "kernel_points": m,
+            "kernel_mass": float(a.sum())}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=2_000_000)
@@ -191,7 +265,8 @@ def main():
     fns = {"fast-vs-bounded": check_fast_vs_bounded,
            "mesh": check_mesh,
            "resume": check_resume,
-           "streaming": check_streaming}
+           "streaming": check_streaming,
+           "weighted": check_weighted}
     failed = 0
     for name in args.checks.split(","):
         name = name.strip()
