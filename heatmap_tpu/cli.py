@@ -86,8 +86,8 @@ def _add_run_flags(p):
                    "quirk (SURVEY.md §8.2)")
     p.add_argument("--weighted", action="store_true",
                    help="sum the source's per-point 'value' column into "
-                   "the heatmaps instead of counting points (plain job "
-                   "path only)")
+                   "the heatmaps instead of counting points (plain or "
+                   "bounded job path)")
     p.add_argument("--fast", action="store_true",
                    help="integer-only native-decoder path (csv/hmpb "
                    "sources; dated timespans use the i64 epoch-ms "
@@ -138,11 +138,10 @@ def cmd_run(args) -> int:
         capacity=args.capacity,
         weighted=args.weighted,
     )
-    if args.weighted and (args.fast or args.multihost or args.checkpoint_dir
-                          or args.max_points_in_flight is not None):
-        raise SystemExit("--weighted runs the plain job path only (not "
-                         "--fast / --multihost / --checkpoint-dir / "
-                         "--max-points-in-flight)")
+    if args.weighted and (args.fast or args.multihost or args.checkpoint_dir):
+        raise SystemExit("--weighted runs the plain or bounded job path "
+                         "only (not --fast / --multihost / "
+                         "--checkpoint-dir)")
     if args.max_points_in_flight is not None and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "

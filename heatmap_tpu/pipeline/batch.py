@@ -248,7 +248,9 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     at most that many points and per-level aggregates merge on the host
     — exact, because every level is a linear (key, sum) reduction, the
     same property the Spark adapter's partition merge relies on
-    (spark_adapter.merge_heatmaps). Peak footprint is then
+    (spark_adapter.merge_heatmaps). (Counts and integer-valued weights
+    are bit-identical to the unchunked path; fractional weighted sums
+    agree up to f64 summation-order rounding.) Peak footprint is then
     O(chunk + unique aggregate keys) instead of O(total points).
     ``overlap_ingest`` double-buffers the bounded path: a prefetch
     thread parses chunk N+1 while the device cascades chunk N (see
@@ -258,11 +260,6 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
 
     config = config or BatchJobConfig()
     if max_points_in_flight is not None:
-        if config.weighted:
-            raise NotImplementedError(
-                "weighted jobs run the plain path only for now "
-                "(not max_points_in_flight)"
-            )
         return _run_job_bounded(
             source, sink, config, batch_size, max_points_in_flight,
             overlap_ingest=overlap_ingest,
@@ -404,6 +401,14 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
 
     if max_points < 1:
         raise ValueError(f"max_points_in_flight must be >= 1, got {max_points}")
+    if fast and config.weighted:
+        # The fast-batch formats carry no 'value' column; fail here
+        # with intent (run_job_fast guards too — this keeps a direct
+        # call from dying on an undefined name in the ingest loop).
+        raise NotImplementedError(
+            "weighted jobs run the string ingest path only "
+            "(fast-batch formats carry no 'value' column)"
+        )
     tracer = get_tracer()
     vocab = UserVocab()
     ts_vocab = TimespanVocab()
@@ -421,11 +426,12 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
         ``fast`` consumes the integer fast-batch layout (native CSV
         decoder / HMPB mmap) routed through the shared _FastRouter;
         the string path goes through load_columns + vocab routing.
-        Either way a chunk is (lat, lon, gids, stamps) with stamps an
-        i64 array (fast) or a Python list (string) — build_emissions'
-        timespan labeler accepts both.
+        Either way a chunk is (lat, lon, gids, stamps, weights) with
+        stamps an i64 array (fast) or a Python list (string) —
+        build_emissions' timespan labeler accepts both — and weights
+        an f64 array for weighted jobs, None otherwise.
         """
-        lats, lons, gids, stamps = [], [], [], []
+        lats, lons, gids, stamps, vals = [], [], [], [], []
         pending = 0
 
         def cut():
@@ -436,8 +442,10 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 np.concatenate(gids).astype(np.int32),
                 np.concatenate(stamps) if fast
                 else [s for b in stamps for s in b],
+                np.concatenate(vals) if config.weighted else None,
             )
             lats.clear(); lons.clear(); gids.clear(); stamps.clear()
+            vals.clear()
             pending = 0
             return chunk
 
@@ -457,6 +465,12 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                     lon = cols["longitude"]
                     g = vocab.group_ids(cols["user_id"])
                     ts = cols["timestamp"]
+                    if config.weighted and "value" not in cols:
+                        raise ValueError(
+                            "weighted job needs a 'value' column in "
+                            "the source (CSV/JSONL/Parquet column "
+                            "named 'value')"
+                        )
                 m = len(lat)
                 # Cut BEFORE appending when the batch would overshoot,
                 # so a chunk never exceeds max_points (batches are read
@@ -467,6 +481,8 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 lons.append(lon)
                 gids.append(g)
                 stamps.append(ts)
+                if config.weighted:
+                    vals.append(cols["value"])
                 pending += m
             tracer.add_items("ingest.batch", m)
             if pending >= max_points:
@@ -475,17 +491,24 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             yield cut()
 
     def process(chunk):
-        lat, lon, group_ids, flat_stamps = chunk
+        lat, lon, group_ids, flat_stamps, weights = chunk
         with tracer.span("cascade.chunk", items=len(lat)):
+            import jax.numpy as jnp
+
             codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
-            e_codes, e_slots, e_valid, _, n_groups, _ = build_emissions(
-                codes, valid, group_ids, flat_stamps, config, ts_vocab=ts_vocab
+            e_codes, e_slots, e_valid, _, n_groups, e_weights = (
+                build_emissions(
+                    codes, valid, group_ids, flat_stamps, config,
+                    ts_vocab=ts_vocab, weights=weights,
+                )
             )
             level_data = cascade_mod.build_cascade(
                 e_codes, e_slots, ccfg,
                 n_slots=len(ts_vocab) * n_groups,
                 valid=e_valid,
                 capacity=min(config.capacity or len(e_codes), len(e_codes)),
+                weights=e_weights,
+                acc_dtype=jnp.float64 if e_weights is not None else None,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):

@@ -404,16 +404,44 @@ def test_weighted_job_missing_value_column_raises():
 
 
 def test_weighted_job_unsupported_paths_raise():
-    from heatmap_tpu.pipeline import run_job, run_job_fast, run_job_resumable
+    from heatmap_tpu.pipeline import run_job_fast, run_job_resumable
 
     rows = [dict(r, value=1.0) for r in _rows(n=20, seed=1)]
     cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
     with pytest.raises(NotImplementedError):
-        run_job(_ColSource(rows), config=cfg, max_points_in_flight=10)
-    with pytest.raises(NotImplementedError):
         run_job_fast("nonexistent.csv", config=cfg)
     with pytest.raises(NotImplementedError):
         run_job_resumable(_ColSource(rows), "/tmp/nope", config=cfg)
+
+
+@pytest.mark.parametrize("overlap", [False, True])
+def test_weighted_bounded_matches_plain(overlap):
+    """Weighted jobs under max_points_in_flight: integer-valued weights
+    keep every f64 sum exact, so the chunked merge must reproduce the
+    plain path byte-for-byte."""
+    import dataclasses
+
+    from heatmap_tpu.pipeline import run_job
+
+    rng = np.random.default_rng(17)
+    rows = [dict(r, value=float(v))
+            for r, v in zip(_rows(n=1500, seed=11),
+                            rng.integers(0, 20, 1500))]
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=6,
+                         timespans=("alltime", "month"), weighted=True)
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=128)
+    bounded = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                      max_points_in_flight=200, overlap_ingest=overlap)
+    assert plain == bounded
+
+
+def test_weighted_bounded_missing_value_column_raises():
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=50, seed=1)  # no value column
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
+    with pytest.raises(ValueError, match="value"):
+        run_job(_ColSource(rows), config=cfg, max_points_in_flight=20)
 
 
 def test_run_job_bounded_propagates_ingest_errors():
