@@ -86,8 +86,9 @@ def _add_run_flags(p):
                    "quirk (SURVEY.md §8.2)")
     p.add_argument("--weighted", action="store_true",
                    help="sum the source's per-point 'value' column into "
-                   "the heatmaps instead of counting points (plain or "
-                   "bounded job path)")
+                   "the heatmaps instead of counting points (works with "
+                   "--fast on HMPB inputs converted from a weighted "
+                   "source, and with --max-points-in-flight)")
     p.add_argument("--fast", action="store_true",
                    help="integer-only native-decoder path (csv/hmpb "
                    "sources; dated timespans use the i64 epoch-ms "
@@ -138,10 +139,9 @@ def cmd_run(args) -> int:
         capacity=args.capacity,
         weighted=args.weighted,
     )
-    if args.weighted and (args.fast or args.multihost or args.checkpoint_dir):
-        raise SystemExit("--weighted runs the plain or bounded job path "
-                         "only (not --fast / --multihost / "
-                         "--checkpoint-dir)")
+    if args.weighted and (args.multihost or args.checkpoint_dir):
+        raise SystemExit("--weighted does not compose with --multihost "
+                         "or --checkpoint-dir yet")
     if args.max_points_in_flight is not None and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "

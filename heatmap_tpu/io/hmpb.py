@@ -19,16 +19,20 @@ Layout (explicitly little-endian, including on big-endian hosts):
             NUL-padded so the data section starts 8-byte aligned
     f64[N]  latitude
     f64[N]  longitude
+    f64[N]  value (OPTIONAL: per-point weight, weighted jobs)
     i64[N]  timestamp (TS_MISSING sentinel = INT64_MIN)
     i32[N]  routed group id
     u8[N]   background flag (reference heatmap.py:28-29)
 
-Sections are contiguous, in the order above (widest first, u8 last).
-Every column is *naturally* aligned for its element type — the data
-section starts 8-byte aligned, f64/i64 sections keep that, the i32
-section starts at data+24n (8-aligned) and the u8 section at data+28n
-(4-aligned, which u8 doesn't care about) — so external readers can
-mmap and cast each column pointer directly.
+Sections are contiguous, in the order above (widest first, u8 last);
+the header's ``columns`` list names exactly the sections present, in
+file order, so readers compute offsets from the header (files without
+the optional value column list five columns and older readers of such
+files see the original layout unchanged). Every column is *naturally*
+aligned for its element type — the data section starts 8-byte aligned,
+f64/i64 sections keep that, and the narrower sections follow
+widest-first — so external readers can mmap and cast each column
+pointer directly.
 
 Timestamp units: values pass through from the source unchanged
 (the reference's location feed carried epoch-milliseconds, reference
@@ -59,10 +63,18 @@ _COLUMNS = (
     ("background", "u1"),
 )
 
+#: Every column an HMPB header may name, with its storage dtype. The
+#: file's actual layout is the header's ``columns`` list in order.
+_COLUMN_DTYPES = dict(_COLUMNS) | {"value": "<f8"}
+
 
 def write_hmpb(path: str, latitude, longitude, routed, names,
-               timestamp=None, background=None):
-    """Write one HMPB file from fast-layout columns (atomic rename)."""
+               timestamp=None, background=None, value=None):
+    """Write one HMPB file from fast-layout columns (atomic rename).
+
+    ``value`` (optional f64 per-point weights) adds the value section —
+    readers expose it and weighted fast jobs consume it; files without
+    it keep the original five-column layout byte-for-byte."""
     lat = np.ascontiguousarray(latitude, "<f8")
     lon = np.ascontiguousarray(longitude, "<f8")
     n = lat.shape[0]
@@ -77,8 +89,12 @@ def write_hmpb(path: str, latitude, longitude, routed, names,
         if background is None
         else np.ascontiguousarray(background, "u1")
     )
-    for name, arr in (("longitude", lon), ("timestamp", ts),
-                      ("routed", rid), ("background", bg)):
+    val = None if value is None else np.ascontiguousarray(value, "<f8")
+    sections = [("latitude", lat), ("longitude", lon)]
+    if val is not None:
+        sections.append(("value", val))
+    sections += [("timestamp", ts), ("routed", rid), ("background", bg)]
+    for name, arr in sections[1:]:
         if arr.shape[0] != n:
             raise ValueError(f"{name} has {arr.shape[0]} rows, expected {n}")
     if rid.size and int(rid.max(initial=-1)) >= len(names):
@@ -86,7 +102,7 @@ def write_hmpb(path: str, latitude, longitude, routed, names,
     header = json.dumps({
         "n": int(n),
         "names": list(names),
-        "columns": [c for c, _ in _COLUMNS],
+        "columns": [c for c, _ in sections],
     }).encode()
     # NUL-pad so the data section (magic + u64 + header + pad) starts
     # 8-byte aligned: every later section is then aligned too (columns
@@ -98,7 +114,7 @@ def write_hmpb(path: str, latitude, longitude, routed, names,
         f.write(np.uint64(len(header)).astype("<u8").tobytes())
         f.write(header)
         f.write(b"\x00" * pad)
-        for arr in (lat, lon, ts, rid, bg):
+        for _, arr in sections:
             arr.tofile(f)
         f.flush()
         os.fsync(f.fileno())
@@ -131,16 +147,34 @@ class HMPBSource:
                 header = json.loads(f.read(int(hlen)).decode())
                 self.n = int(header["n"])
                 self.names = list(header["names"])
+                columns = list(header.get("columns")
+                               or [c for c, _ in _COLUMNS])
             except (UnicodeDecodeError, ValueError, KeyError, TypeError) as e:
                 # json.JSONDecodeError is a ValueError; surface every
                 # header-corruption shape as one clean error.
                 raise ValueError(f"{path}: corrupt HMPB header: {e}") from e
             if self.n < 0:
                 raise ValueError(f"{path}: corrupt HMPB header: n={self.n}")
+            unknown = [c for c in columns if c not in _COLUMN_DTYPES]
+            if unknown:
+                raise ValueError(
+                    f"{path}: HMPB header names unknown column(s) "
+                    f"{unknown} (written by a newer format revision?)"
+                )
+            required = [c for c, _ in _COLUMNS]
+            missing = [c for c in required if c not in columns]
+            if missing or len(set(columns)) != len(columns):
+                raise ValueError(
+                    f"{path}: corrupt HMPB header: columns={columns} "
+                    f"(missing {missing or 'none'}, duplicates "
+                    f"{'present' if len(set(columns)) != len(columns) else 'none'})"
+                )
             self._data_off = f.tell() + (-f.tell()) % 8  # header NUL pad
+        self.has_value = "value" in columns
         offsets = {}
         off = self._data_off
-        for name, dtype in _COLUMNS:
+        for name in columns:
+            dtype = _COLUMN_DTYPES[name]
             offsets[name] = (off, dtype)
             off += self.n * np.dtype(dtype).itemsize
         expected = off
@@ -164,7 +198,7 @@ class HMPBSource:
         sent_names = False
         for lo in range(0, self.n, batch_size):
             hi = min(lo + batch_size, self.n)
-            yield {
+            out = {
                 "latitude": np.asarray(self._col("latitude", lo, hi)),
                 "longitude": np.asarray(self._col("longitude", lo, hi)),
                 "timestamp": np.asarray(self._col("timestamp", lo, hi)),
@@ -174,6 +208,9 @@ class HMPBSource:
                 ).astype(bool),
                 "new_group_names": [] if sent_names else list(self.names),
             }
+            if self.has_value:
+                out["value"] = np.asarray(self._col("value", lo, hi))
+            yield out
             sent_names = True
 
     def batches(self, batch_size: int = 1 << 20):
@@ -196,7 +233,7 @@ class HMPBSource:
                     name = self.names[r]
                     users.append("rt-0" if name == "route" else name)
             ts = b["timestamp"]
-            yield {
+            out = {
                 "latitude": b["latitude"],
                 "longitude": b["longitude"],
                 "user_id": users,
@@ -207,6 +244,9 @@ class HMPBSource:
                     None if t == TS_MISSING else int(t) for t in ts
                 ],
             }
+            if "value" in b:
+                out["value"] = b["value"]
+            yield out
 
 
 @dataclasses.dataclass
@@ -288,7 +328,7 @@ class HMPBDirSource:
                         routed >= 0,
                         local_to_global[np.maximum(routed, 0)], -1,
                     ).astype(np.int32)
-                yield {
+                out = {
                     "latitude": b["latitude"],
                     "longitude": b["longitude"],
                     "timestamp": b["timestamp"],
@@ -296,6 +336,9 @@ class HMPBDirSource:
                     "background": b["background"],
                     "new_group_names": names[emitted:],
                 }
+                if "value" in b:
+                    out["value"] = b["value"]
+                yield out
                 emitted = len(names)
 
     def range_batches(self, index: int, batch_size: int = 1 << 20):
@@ -343,7 +386,7 @@ def convert_to_hmpb(source_spec: str, out_path: str,
     """
     if shard_rows is not None and shard_rows < 1:
         raise ValueError(f"shard_rows must be >= 1, got {shard_rows}")
-    lats, lons, tss, rids, bgs = [], [], [], [], []
+    lats, lons, tss, rids, bgs, vals = [], [], [], [], [], []
     names: list = []
 
     def ingest_fast(batches):
@@ -354,6 +397,8 @@ def convert_to_hmpb(source_spec: str, out_path: str,
             tss.append(np.asarray(b["timestamp"], np.int64))
             rids.append(np.asarray(b["routed"], np.int32))
             bgs.append(np.asarray(b["background"], np.uint8))
+            if "value" in b:
+                vals.append(np.asarray(b["value"], np.float64))
 
     kind, _, rest = source_spec.partition(":")
     is_csv = kind == "csv" or (not rest and source_spec.endswith(".csv"))
@@ -366,6 +411,14 @@ def convert_to_hmpb(source_spec: str, out_path: str,
             native_ok = True
         except ImportError:
             pass
+        if native_ok:
+            # The native decoder knows the reference columns only; a
+            # weighted CSV must take the string path so its value
+            # column lands in the HMPB file.
+            from heatmap_tpu.io.sources import CSVSource
+
+            if CSVSource(rest or source_spec).has_value_column():
+                native_ok = False
     if native_ok:
         ingest_fast(parse_csv_batches(
             rest if kind == "csv" else source_spec, batch_size, fast=True,
@@ -408,16 +461,27 @@ def convert_to_hmpb(source_spec: str, out_path: str,
             tss.append(ts)
             rids.append(rid)
             bgs.append(bg)
+            if "value" in b:
+                vals.append(np.asarray(b["value"], np.float64))
 
     n = sum(len(a) for a in lats)
+    if vals and sum(len(a) for a in vals) != n:
+        # All-or-nothing: a partial value column would silently mean
+        # "weight 1.0" for whole slices of the dataset.
+        raise ValueError(
+            f"{source_spec}: value column present on only part of the "
+            "source batches; cannot write a consistent HMPB value "
+            "section"
+        )
     lat = np.concatenate(lats) if n else np.empty(0)
     lon = np.concatenate(lons) if n else np.empty(0)
     rid = np.concatenate(rids) if n else np.empty(0, np.int32)
     ts = np.concatenate(tss) if n else None
     bg = np.concatenate(bgs) if n else None
+    val = np.concatenate(vals) if (n and vals) else None
     if shard_rows is None:
         write_hmpb(out_path, lat, lon, rid, names,
-                   timestamp=ts, background=bg)
+                   timestamp=ts, background=bg, value=val)
         return {"n": n, "groups": len(names), "output": out_path}
     os.makedirs(out_path, exist_ok=True)
     n_parts = max(1, -(-n // shard_rows))
@@ -433,6 +497,7 @@ def convert_to_hmpb(source_spec: str, out_path: str,
             lat[lo:hi], lon[lo:hi], rid[lo:hi], names,
             timestamp=None if ts is None else ts[lo:hi],
             background=None if bg is None else bg[lo:hi],
+            value=None if val is None else val[lo:hi],
         )
     return {"n": n, "groups": len(names), "output": out_path,
             "parts": n_parts}

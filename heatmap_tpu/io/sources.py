@@ -173,7 +173,7 @@ class CSVSource(Source):
 
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         has_value = (self.read_value is not False
-                     and self._has_value_column())
+                     and self.has_value_column())
         if self.use_native and not has_value:
             try:
                 from heatmap_tpu.native import parse_csv_batches
@@ -204,7 +204,10 @@ class CSVSource(Source):
             if cols["latitude"]:
                 yield _finalize_with_value(cols, vals)
 
-    def _has_value_column(self) -> bool:
+    def has_value_column(self) -> bool:
+        """Whether the CSV header names a ``value`` weight column
+        (public: convert_to_hmpb uses this to route weighted CSVs off
+        the value-blind native decoder)."""
         with open(self.path, newline="") as f:
             header = next(csv.reader(f), None)
         return header is not None and VALUE_COLUMN in header

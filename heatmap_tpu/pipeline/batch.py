@@ -319,7 +319,10 @@ class _FastRouter:
         self.names.extend(batch["new_group_names"])
 
     def route(self, batch):
-        """-> (lat, lon, gids, ts_i64), background rows dropped."""
+        """-> (lat, lon, gids, ts_i64, values_or_None), background rows
+        dropped. ``values`` comes through when the fast batch carries a
+        'value' column (HMPB with a value section), filtered by the
+        same keep mask."""
         if len(self.names) > len(self._map):
             grown = np.full(max(len(self.names), 2 * len(self._map)),
                             -2, np.int32)
@@ -343,7 +346,22 @@ class _FastRouter:
             np.full(int(keep.sum()), TS_MISSING, np.int64)
             if ts is None else np.asarray(ts, np.int64)[keep]
         )
-        return batch["latitude"][keep], batch["longitude"][keep], gids, ts64
+        vals = batch.get("value")
+        if vals is not None:
+            vals = np.asarray(vals, np.float64)[keep]
+        return (batch["latitude"][keep], batch["longitude"][keep], gids,
+                ts64, vals)
+
+
+def _require_fast_weights(values):
+    """Shared guard for weighted fast ingest: fast batches must carry a
+    'value' column (HMPB with a value section)."""
+    if values is None:
+        raise ValueError(
+            "weighted fast job needs a 'value' column in the fast "
+            "batches (convert the source to HMPB from an input with a "
+            "'value' column)"
+        )
 
 
 def _fast_batches_for(source, batch_size, checkpointing=False):
@@ -401,14 +419,6 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
 
     if max_points < 1:
         raise ValueError(f"max_points_in_flight must be >= 1, got {max_points}")
-    if fast and config.weighted:
-        # The fast-batch formats carry no 'value' column; fail here
-        # with intent (run_job_fast guards too — this keeps a direct
-        # call from dying on an undefined name in the ingest loop).
-        raise NotImplementedError(
-            "weighted jobs run the string ingest path only "
-            "(fast-batch formats carry no 'value' column)"
-        )
     tracer = get_tracer()
     vocab = UserVocab()
     ts_vocab = TimespanVocab()
@@ -458,14 +468,17 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             with tracer.span("ingest.batch"):
                 if fast:
                     router.observe(batch)
-                    lat, lon, g, ts = router.route(batch)
+                    lat, lon, g, ts, v = router.route(batch)
+                    if config.weighted:
+                        _require_fast_weights(v)
                 else:
                     cols = load_columns(batch)
                     lat = cols["latitude"]
                     lon = cols["longitude"]
                     g = vocab.group_ids(cols["user_id"])
                     ts = cols["timestamp"]
-                    if config.weighted and "value" not in cols:
+                    v = cols.get("value")
+                    if config.weighted and v is None:
                         raise ValueError(
                             "weighted job needs a 'value' column in "
                             "the source (CSV/JSONL/Parquet column "
@@ -482,7 +495,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 gids.append(g)
                 stamps.append(ts)
                 if config.weighted:
-                    vals.append(cols["value"])
+                    vals.append(v)
                 pending += m
             tracer.add_items("ingest.batch", m)
             if pending >= max_points:
@@ -724,12 +737,12 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
     exclusive with ``checkpoint_dir`` (chunk boundaries are not batch
     boundaries, so batch-index resume would not line up).
     """
-    if config is not None and config.weighted:
-        raise NotImplementedError(
-            "weighted jobs run the standard string path only for now "
-            "(the fast-path formats carry no 'value' column)"
-        )
     config = config or BatchJobConfig()
+    if config.weighted and checkpoint_dir is not None:
+        raise NotImplementedError(
+            "weighted fast jobs do not compose with checkpoint/resume "
+            "yet (the checkpoint layout carries no value column)"
+        )
     if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     if max_points_in_flight is not None:
@@ -760,7 +773,7 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
     vocab = UserVocab()
     router = _FastRouter(vocab)
     tracer = get_tracer()
-    lats, lons, gids, tss = [], [], [], []
+    lats, lons, gids, tss, vals = [], [], [], [], []
     mgr = None
     done = 0
     if checkpoint_dir is not None:
@@ -824,7 +837,10 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
             if fault_injector is not None:
                 fault_injector.check(i)
             tracer.add_items("ingest.fast", len(b["latitude"]))
-            lat, lon, g, ts64 = router.route(b)
+            lat, lon, g, ts64, v = router.route(b)
+            if config.weighted:
+                _require_fast_weights(v)
+                vals.append(v)
             lats.append(lat)
             lons.append(lon)
             gids.append(g)
@@ -846,6 +862,7 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
             config,
             as_json=True,
             sink=sink,
+            weights=np.concatenate(vals) if config.weighted else None,
         )
     return blobs
 

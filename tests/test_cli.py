@@ -110,10 +110,12 @@ class TestEndToEnd:
         alice = next(b if isinstance(b, dict) else json.loads(b)
                      for k, b in loaded.items() if k.startswith("alice|"))
         assert alice[detail] == 3.25
+        # --weighted composes with --fast now (HMPB value sections) but
+        # still not with checkpoint/resume.
         r2 = _run_cli(
             "run", "--backend", "cpu",
             "--input", f"jsonl:{src}", "--output", "memory:",
-            "--weighted", "--fast",
+            "--weighted", "--checkpoint-dir", str(tmp_path / "ck"),
         )
         assert r2.returncode != 0
         assert "--weighted" in r2.stderr

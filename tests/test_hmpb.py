@@ -45,6 +45,98 @@ def test_write_read_roundtrip(tmp_path):
         np.concatenate([b["background"] for b in got]), bg.astype(bool))
 
 
+def test_value_column_roundtrip_and_legacy(tmp_path):
+    """The optional value section round-trips through fast_batches and
+    the string view; files without it read as before (has_value False,
+    no 'value' key) and a truncated value section is detected."""
+    p = str(tmp_path / "w.hmpb")
+    rng = np.random.default_rng(2)
+    n = 500
+    lat = rng.uniform(-80, 80, n)
+    lon = rng.uniform(-170, 170, n)
+    rid = rng.integers(-1, 2, n).astype(np.int32)
+    val = rng.random(n) * 9
+    write_hmpb(p, lat, lon, rid, ["u1", "rt-x"], value=val)
+    src = HMPBSource(p)
+    assert src.has_value
+    got = list(src.fast_batches(128))
+    np.testing.assert_array_equal(
+        np.concatenate([b["value"] for b in got]), val)
+    np.testing.assert_array_equal(
+        np.concatenate([b["latitude"] for b in got]), lat)
+    (sb,) = list(src.batches(n))
+    np.testing.assert_array_equal(sb["value"], val)
+    # Legacy layout: no value written -> no value read.
+    p2 = str(tmp_path / "nv.hmpb")
+    write_hmpb(p2, lat, lon, rid, ["u1", "rt-x"])
+    src2 = HMPBSource(p2)
+    assert not src2.has_value
+    assert all("value" not in b for b in src2.fast_batches(128))
+    # The value section participates in the size check.
+    data = open(p, "rb").read()
+    trunc = str(tmp_path / "trunc.hmpb")
+    open(trunc, "wb").write(data[: len(data) - 4 * n])
+    with pytest.raises(ValueError, match="truncated"):
+        HMPBSource(trunc)
+    # Wrong-length value arrays are rejected at write time.
+    with pytest.raises(ValueError, match="value"):
+        write_hmpb(str(tmp_path / "bad.hmpb"), lat, lon, rid,
+                   ["u1", "rt-x"], value=val[:-1])
+
+
+def test_unknown_header_column_rejected(tmp_path):
+    p = str(tmp_path / "f.hmpb")
+    write_hmpb(p, np.zeros(1), np.zeros(1), np.zeros(1, np.int32), ["u"])
+    data = bytearray(open(p, "rb").read())
+    # Rewrite the header with a column name this reader doesn't know.
+    from heatmap_tpu.io.hmpb import MAGIC
+
+    hlen = int(np.frombuffer(data[len(MAGIC):len(MAGIC) + 8], "<u8")[0])
+    start = len(MAGIC) + 8
+    header = json.loads(bytes(data[start:start + hlen]).decode())
+    header["columns"] = header["columns"] + ["wormhole"]
+    new = json.dumps(header).encode()
+    pad = (-(len(MAGIC) + 8 + len(new))) % 8
+    body = data[start + hlen + ((-(start + hlen)) % 8):]
+    out = MAGIC + np.uint64(len(new)).astype("<u8").tobytes() + new \
+        + b"\x00" * pad + bytes(body)
+    p2 = str(tmp_path / "f2.hmpb")
+    open(p2, "wb").write(out)
+    with pytest.raises(ValueError, match="wormhole"):
+        HMPBSource(p2)
+
+
+def test_convert_carries_value_column(tmp_path):
+    """convert_to_hmpb from a weighted CSV routes off the native
+    decoder and lands the value section; sharded convert carries it
+    per part; hmpb->hmpb reconvert preserves it."""
+    p = tmp_path / "w.csv"
+    with open(p, "w") as f:
+        f.write("latitude,longitude,user_id,source,timestamp,value\n")
+        for i in range(40):
+            f.write(f"47.{600 + i},-122.{300 + i},u{i % 5},gps,1,{i}.5\n")
+    out = str(tmp_path / "w.hmpb")
+    convert_to_hmpb(f"csv:{p}", out)
+    src = HMPBSource(out)
+    assert src.has_value
+    (b,) = list(src.fast_batches(100))
+    np.testing.assert_allclose(b["value"], [i + 0.5 for i in range(40)])
+    # Sharded.
+    outdir = str(tmp_path / "shards")
+    info = convert_to_hmpb(f"csv:{p}", outdir, shard_rows=15)
+    assert info["parts"] == 3
+    from heatmap_tpu.io.hmpb import HMPBDirSource
+
+    vals = np.concatenate([
+        bb["value"] for bb in HMPBDirSource(outdir).fast_batches(100)
+    ])
+    np.testing.assert_allclose(vals, [i + 0.5 for i in range(40)])
+    # Reconvert.
+    out2 = str(tmp_path / "w2.hmpb")
+    convert_to_hmpb(f"hmpb:{out}", out2)
+    assert HMPBSource(out2).has_value
+
+
 def test_write_validates(tmp_path):
     p = str(tmp_path / "bad.hmpb")
     with pytest.raises(ValueError):

@@ -403,15 +403,76 @@ def test_weighted_job_missing_value_column_raises():
         run_job(_ColSource(rows), config=cfg)
 
 
-def test_weighted_job_unsupported_paths_raise():
+def test_weighted_job_unsupported_paths_raise(tmp_path):
     from heatmap_tpu.pipeline import run_job_fast, run_job_resumable
 
     rows = [dict(r, value=1.0) for r in _rows(n=20, seed=1)]
     cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
     with pytest.raises(NotImplementedError):
-        run_job_fast("nonexistent.csv", config=cfg)
+        run_job_fast("nonexistent.csv", config=cfg,
+                     checkpoint_dir=str(tmp_path / "ck"))
     with pytest.raises(NotImplementedError):
         run_job_resumable(_ColSource(rows), "/tmp/nope", config=cfg)
+
+
+def test_weighted_fast_hmpb_matches_string_path(tmp_path):
+    """run_job_fast on an HMPB file with a value section must produce
+    the same blobs as the string path over the same weighted rows —
+    plain AND bounded (integer weights keep every f64 sum exact)."""
+    from heatmap_tpu.io.hmpb import HMPBSource, write_hmpb
+    from heatmap_tpu.pipeline import run_job, run_job_fast
+    from heatmap_tpu.pipeline.groups import route_user
+
+    rng = np.random.default_rng(23)
+    rows = [dict(r, value=float(v))
+            for r, v in zip(_rows(n=600, seed=19),
+                            rng.integers(0, 12, 600))]
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=6, weighted=True)
+    want = run_job(_ColSource(rows), config=cfg, batch_size=128)
+
+    # Same rows in the fast layout (route host-side like convert does).
+    names, intern = [], {}
+    rid = np.empty(len(rows), np.int32)
+    for i, r in enumerate(rows):
+        name = route_user(r["user_id"])
+        if name is None:
+            rid[i] = -1
+            continue
+        if name not in intern:
+            intern[name] = len(names)
+            names.append(name)
+        rid[i] = intern[name]
+    path = write_hmpb(
+        str(tmp_path / "w.hmpb"),
+        np.asarray([r["latitude"] for r in rows]),
+        np.asarray([r["longitude"] for r in rows]),
+        rid, names,
+        timestamp=np.asarray([r["timestamp"] for r in rows], np.int64),
+        background=np.asarray(
+            [r.get("source") == "background" for r in rows], np.uint8),
+        value=np.asarray([r["value"] for r in rows]),
+    )
+    src = HMPBSource(path)
+    assert src.has_value
+    got = run_job_fast(src, config=cfg, batch_size=128)
+    assert want == got
+    bounded = run_job_fast(HMPBSource(path), config=cfg, batch_size=128,
+                           max_points_in_flight=150)
+    assert want == bounded
+
+
+def test_weighted_fast_without_value_column_raises(tmp_path):
+    from heatmap_tpu.io.hmpb import HMPBSource, write_hmpb
+    from heatmap_tpu.pipeline import run_job_fast
+
+    path = write_hmpb(str(tmp_path / "nv.hmpb"),
+                      np.asarray([47.6]), np.asarray([-122.3]),
+                      np.asarray([0], np.int32), ["u1"])
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
+    with pytest.raises(ValueError, match="value"):
+        run_job_fast(HMPBSource(path), config=cfg)
+    with pytest.raises(ValueError, match="value"):
+        run_job_fast(HMPBSource(path), config=cfg, max_points_in_flight=10)
 
 
 @pytest.mark.parametrize("overlap", [False, True])
