@@ -139,9 +139,9 @@ def cmd_run(args) -> int:
         capacity=args.capacity,
         weighted=args.weighted,
     )
-    if args.weighted and (args.multihost or args.checkpoint_dir):
-        raise SystemExit("--weighted does not compose with --multihost "
-                         "or --checkpoint-dir yet")
+    if args.weighted and args.checkpoint_dir:
+        raise SystemExit("--weighted does not compose with "
+                         "--checkpoint-dir yet")
     if args.max_points_in_flight is not None and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "
@@ -196,8 +196,10 @@ def cmd_run(args) -> int:
             elif args.multihost:
                 from heatmap_tpu.parallel import run_job_multihost
 
-                blobs = run_job_multihost(open_source(args.input,
-                                                      read_value=False), sink,
+                blobs = run_job_multihost(open_source(
+                                              args.input,
+                                              read_value=args.weighted,
+                                          ), sink,
                                           config,
                                           batch_size=args.batch_size)
             else:
@@ -396,23 +398,33 @@ def cmd_stream(args) -> int:
     if args.checkpoint_dir:
         mgr = CheckpointManager(args.checkpoint_dir)
         if mgr.latest_step() is not None:
-            stream.restore(mgr)
+            stream.restore(mgr, weighted=args.weighted)
     t0 = time.perf_counter()
     resumed = stream.n_batches
     t_stream = stream.t or 0.0
     i = 0
-    for batch in open_source(args.input,
-                             read_value=False).batches(args.batch_points):
+    for batch in open_source(
+        args.input, read_value=args.weighted,
+    ).batches(args.batch_points):
         i += 1
         if i <= resumed:
             continue  # deterministic source replay up to the checkpoint
         cols = load_columns(batch)
         t_stream += args.interval
-        stream.update(cols["latitude"], cols["longitude"], t_stream)
+        weights = None
+        if args.weighted:
+            if "value" not in cols:
+                raise SystemExit(
+                    "--weighted needs a 'value' column in the input "
+                    "(CSV/JSONL/Parquet column named 'value')"
+                )
+            weights = cols["value"]
+        stream.update(cols["latitude"], cols["longitude"], t_stream,
+                      weights=weights)
         if mgr is not None and stream.n_batches % args.checkpoint_every == 0:
-            stream.checkpoint(mgr)
+            stream.checkpoint(mgr, weighted=args.weighted)
     if mgr is not None:
-        stream.checkpoint(mgr)
+        stream.checkpoint(mgr, weighted=args.weighted)
     snap = stream.snapshot()  # one device->host copy, reused below
     n_tiles = 0
     if args.output:
@@ -650,6 +662,9 @@ def build_parser() -> argparse.ArgumentParser:
                           "same file)")
     p_stream.add_argument("--checkpoint-dir", default=None)
     p_stream.add_argument("--checkpoint-every", type=int, default=16)
+    p_stream.add_argument("--weighted", action="store_true",
+                          help="sum the input's per-point 'value' column "
+                          "into the decayed raster instead of counting")
     p_stream.set_defaults(fn=cmd_stream)
 
     p_render = sub.add_parser(

@@ -244,7 +244,7 @@ def run_job_multihost(source, sink=None, config=None,
     has ``n``; files can be pre-counted).
     """
     from heatmap_tpu.pipeline import BatchJobConfig, run_job
-    from heatmap_tpu.pipeline.batch import _run_loaded, load_columns
+    from heatmap_tpu.pipeline.batch import _run_loaded, ingest_columns
 
     config = config or BatchJobConfig()
     if sink is not None and hasattr(sink, "write_levels"):
@@ -259,14 +259,6 @@ def run_job_multihost(source, sink=None, config=None,
         )
     if jax.process_count() == 1:
         return run_job(source, sink, config, batch_size=batch_size)
-    if config.weighted:
-        # The multi-process branch drops the 'value' column when
-        # assembling the data dict; failing here beats ingesting the
-        # whole source first and then blaming the source.
-        raise NotImplementedError(
-            "weighted jobs run the plain path only for now "
-            "(not multi-process run_job_multihost)"
-        )
     sharded = shard_source(source)
     if sharded is not None:
         batches = sharded.batches(batch_size)
@@ -280,24 +272,12 @@ def run_job_multihost(source, sink=None, config=None,
                 )
         batches = shard_source_rows(source.batches(batch_size), n_total,
                                     batch_size)
-    lats, lons, users, stamps = [], [], [], []
-    for batch in batches:
-        cols = load_columns(batch)
-        lats.append(cols["latitude"])
-        lons.append(cols["longitude"])
-        users.extend(cols["user_id"])
-        stamps.extend(cols["timestamp"])
-    if lats and sum(len(a) for a in lats):
-        local = _run_loaded(
-            {
-                "latitude": np.concatenate(lats),
-                "longitude": np.concatenate(lons),
-                "user_id": users,
-                "timestamp": stamps,
-            },
-            config,
-            as_json=True,
-        )
+    data = ingest_columns(batches, config)
+    if data is not None:
+        # Cross-host blob merge: gather_blobs sums colliding numeric
+        # dicts, which is exactly the weighted semantics too (f64 sums
+        # are linear across host shards).
+        local = _run_loaded(data, config, as_json=True)
     else:
         local = {}
     blobs = gather_blobs(local)

@@ -265,8 +265,26 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
             overlap_ingest=overlap_ingest,
         )
     tracer = get_tracer()
+    data = ingest_columns(source.batches(batch_size), config)
+    if data is None:
+        return {}
+    with tracer.span("cascade", items=len(data["latitude"])):
+        blobs = _run_loaded(data, config, as_json=True, sink=sink)
+    return blobs
+
+
+def ingest_columns(batches, config: BatchJobConfig):
+    """Accumulate source batches into the ``_run_loaded`` data dict.
+
+    Shared by run_job and the multi-process run_job_multihost ingest so
+    weighted-column validation and assembly can't drift between them.
+    Returns None when the batches carried no rows.
+    """
+    from heatmap_tpu.utils.trace import get_tracer
+
+    tracer = get_tracer()
     lats, lons, users, stamps, vals = [], [], [], [], []
-    for batch in source.batches(batch_size):
+    for batch in batches:
         with tracer.span("ingest.batch"):
             cols = load_columns(batch)
             lats.append(cols["latitude"])
@@ -282,7 +300,7 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
                 vals.append(cols["value"])
         tracer.add_items("ingest.batch", len(cols["latitude"]))
     if not lats or sum(len(a) for a in lats) == 0:
-        return {}
+        return None
     data = {
         "latitude": np.concatenate(lats),
         "longitude": np.concatenate(lons),
@@ -291,9 +309,7 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     }
     if config.weighted:
         data["value"] = np.concatenate(vals)
-    with tracer.span("cascade", items=len(data["latitude"])):
-        blobs = _run_loaded(data, config, as_json=True, sink=sink)
-    return blobs
+    return data
 
 
 class _FastRouter:

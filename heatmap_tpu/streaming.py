@@ -182,24 +182,32 @@ class HeatmapStream:
             "n_batches": self.n_batches,
         }
 
-    def checkpoint(self, manager) -> str:
+    def checkpoint(self, manager, weighted: bool | None = None) -> str:
         """Atomic checkpoint via utils.checkpoint.CheckpointManager,
-        numbered by batches consumed."""
-        w = self.config.window
-        return manager.save(
-            self.n_batches,
-            {"raster": self.snapshot()},
-            {"t": self.t, "n_batches": self.n_batches,
-             "window": [int(w.zoom), int(w.row0), int(w.col0)]},
-        )
+        numbered by batches consumed.
 
-    def restore(self, manager, step: int | None = None):
+        ``weighted`` records the ingest semantics (value sums vs
+        counts) so a resume under the other mode fails loudly instead
+        of blending counted and weighted mass in one raster; None skips
+        recording (library callers managing their own semantics)."""
+        w = self.config.window
+        meta = {"t": self.t, "n_batches": self.n_batches,
+                "window": [int(w.zoom), int(w.row0), int(w.col0)]}
+        if weighted is not None:
+            meta["weighted"] = bool(weighted)
+        return manager.save(self.n_batches, {"raster": self.snapshot()}, meta)
+
+    def restore(self, manager, step: int | None = None,
+                weighted: bool | None = None):
         """Load the latest (or a given) checkpoint into this stream.
 
         Validates the checkpoint's window ORIGIN, not just its shape:
         a same-shaped raster restored into a shifted window (e.g.
         --auto-bounds over a file whose extent moved) would silently
-        paint the old mass at the wrong place on the map.
+        paint the old mass at the wrong place on the map. ``weighted``
+        (when given AND recorded in the checkpoint) must match the
+        recorded ingest semantics — resuming a weighted stream as a
+        counted one would blend value-sums and counts in one raster.
         """
         arrays, meta = manager.load(step)
         w = self.config.window
@@ -213,6 +221,16 @@ class HeatmapStream:
                 "bounds changed (e.g. --auto-bounds over a grown file); "
                 "restart with fixed --lat/--lon flags or a fresh "
                 "checkpoint dir"
+            )
+        ck_weighted = meta.get("weighted")
+        if (weighted is not None and ck_weighted is not None
+                and bool(weighted) != bool(ck_weighted)):
+            raise ValueError(
+                f"checkpoint was written by a "
+                f"{'weighted' if ck_weighted else 'counted'} stream but "
+                f"this resume is {'weighted' if weighted else 'counted'} "
+                "— rerun with the matching --weighted setting or a "
+                "fresh checkpoint dir"
             )
         return self.load_state_dict({
             "raster": arrays["raster"],

@@ -438,6 +438,32 @@ class TestAutoBounds:
         lat_min, lat_max, lon_min, lon_max = stats["bounds"]
         assert lat_min < 35.68 < lat_max and lon_min < 139.69 < lon_max
 
+    def test_stream_weighted_csv(self, tmp_path):
+        """stream --weighted decays weighted mass: uniform value 5.0
+        yields exactly 5x the counted live mass on the same input."""
+        p = tmp_path / "w.csv"
+        with open(p, "w") as f:
+            f.write("latitude,longitude,user_id,source,timestamp,value\n")
+            for i in range(4000):
+                f.write(f"47.{600 + i % 300},-122.{300 + i % 300},u,gps,1,5\n")
+        common = [
+            "stream", "--backend", "cpu",
+            "--input", f"csv:{p}",
+            "--batch-points", "1000",
+            "--interval", "600", "--half-life", "1200",
+            "--zoom", "10", "--pixel-delta", "6",
+            "--lat-min", "46", "--lat-max", "49",
+            "--lon-min", "-124", "--lon-max", "-120",
+        ]
+        rw = _run_cli(*common, "--weighted")
+        rc = _run_cli(*common)
+        assert rw.returncode == 0, rw.stderr
+        assert rc.returncode == 0, rc.stderr
+        mw = json.loads(rw.stdout.strip().splitlines()[-1])["live_mass"]
+        mc = json.loads(rc.stdout.strip().splitlines()[-1])["live_mass"]
+        assert mw == pytest.approx(5.0 * mc, rel=1e-6)
+        assert mc > 0
+
     def test_stream_auto_bounds(self, tmp_path):
         import json as _json
 

@@ -169,3 +169,33 @@ def test_restore_rejects_shifted_window(tmp_path):
     s3 = HeatmapStream(StreamConfig(window=win, half_life_s=10.0))
     s3.restore(mgr)
     assert s3.n_batches == 1
+
+
+def test_restore_rejects_weighted_mode_flip(tmp_path):
+    """A checkpoint recorded as weighted must not resume as counted
+    (and vice versa) — the raster would blend value-sums and counts."""
+    import pytest
+
+    from heatmap_tpu.ops import Window
+    from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+    from heatmap_tpu.utils import CheckpointManager
+
+    win = Window(zoom=10, row0=256, col0=256, height=128, width=128)
+    cfg = StreamConfig(window=win, half_life_s=10.0)
+    s = HeatmapStream(cfg)
+    s.update(np.full(10, 47.6), np.full(10, -122.3), 1.0,
+             weights=np.full(10, 3.0))
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    s.checkpoint(mgr, weighted=True)
+
+    with pytest.raises(ValueError, match="weighted"):
+        HeatmapStream(cfg).restore(mgr, weighted=False)
+    s2 = HeatmapStream(cfg)
+    s2.restore(mgr, weighted=True)
+    assert s2.n_batches == 1
+    # Checkpoints without a recorded mode (library callers, older
+    # files) restore under either declaration.
+    mgr2 = CheckpointManager(str(tmp_path / "ck2"))
+    s.checkpoint(mgr2)
+    HeatmapStream(cfg).restore(mgr2, weighted=False)
+    HeatmapStream(cfg).restore(mgr2, weighted=True)
