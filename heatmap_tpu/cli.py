@@ -139,9 +139,6 @@ def cmd_run(args) -> int:
         capacity=args.capacity,
         weighted=args.weighted,
     )
-    if args.weighted and args.checkpoint_dir:
-        raise SystemExit("--weighted does not compose with "
-                         "--checkpoint-dir yet")
     if args.max_points_in_flight is not None and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "
@@ -188,7 +185,7 @@ def cmd_run(args) -> int:
                 )
             elif args.checkpoint_dir:
                 blobs = run_job_resumable(
-                    open_source(args.input, read_value=False),
+                    open_source(args.input, read_value=args.weighted),
                     args.checkpoint_dir, sink,
                     config, batch_size=args.batch_size,
                     checkpoint_every=args.checkpoint_every,

@@ -292,11 +292,7 @@ def ingest_columns(batches, config: BatchJobConfig):
             users.extend(cols["user_id"])
             stamps.extend(cols["timestamp"])
             if config.weighted:
-                if "value" not in cols:
-                    raise ValueError(
-                        "weighted job needs a 'value' column in the "
-                        "source (CSV/JSONL/Parquet column named 'value')"
-                    )
+                _require_value_column(cols)
                 vals.append(cols["value"])
         tracer.add_items("ingest.batch", len(cols["latitude"]))
     if not lats or sum(len(a) for a in lats) == 0:
@@ -367,6 +363,33 @@ class _FastRouter:
             vals = np.asarray(vals, np.float64)[keep]
         return (batch["latitude"][keep], batch["longitude"][keep], gids,
                 ts64, vals)
+
+
+def _check_checkpoint_weighted(meta, config: BatchJobConfig,
+                               checkpoint_dir: str):
+    """Refuse to resume a checkpoint under the other ingest mode —
+    mixing counted and weighted rows in one accumulation would corrupt
+    every blob. Checkpoints without the key are counted: they predate
+    weighted checkpointing, which refused weighted+checkpoint outright,
+    so treating the absence as counted=True keeps the refusal message
+    (instead of a bare KeyError on the missing values array)."""
+    ck = bool(meta.get("weighted", False))
+    if ck != bool(config.weighted):
+        raise RuntimeError(
+            f"checkpoint at {checkpoint_dir!r} was written by a "
+            f"{'weighted' if ck else 'counted'} job; resume with the "
+            f"matching weighted setting or a fresh checkpoint dir"
+        )
+
+
+def _require_value_column(cols):
+    """Shared guard for weighted string-path ingest: the source batch
+    must carry a 'value' column."""
+    if "value" not in cols:
+        raise ValueError(
+            "weighted job needs a 'value' column in the source "
+            "(CSV/JSONL/Parquet column named 'value')"
+        )
 
 
 def _require_fast_weights(values):
@@ -495,11 +518,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                     ts = cols["timestamp"]
                     v = cols.get("value")
                     if config.weighted and v is None:
-                        raise ValueError(
-                            "weighted job needs a 'value' column in "
-                            "the source (CSV/JSONL/Parquet column "
-                            "named 'value')"
-                        )
+                        _require_value_column(cols)
                 m = len(lat)
                 # Cut BEFORE appending when the batch would overshoot,
                 # so a chunk never exceeds max_points (batches are read
@@ -754,11 +773,6 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
     boundaries, so batch-index resume would not line up).
     """
     config = config or BatchJobConfig()
-    if config.weighted and checkpoint_dir is not None:
-        raise NotImplementedError(
-            "weighted fast jobs do not compose with checkpoint/resume "
-            "yet (the checkpoint layout carries no value column)"
-        )
     if checkpoint_every < 1:
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     if max_points_in_flight is not None:
@@ -809,10 +823,13 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
                     "(run_job_resumable / drop --fast) or point --fast at "
                     "a fresh checkpoint dir"
                 )
+            _check_checkpoint_weighted(meta, config, checkpoint_dir)
             lats = [arrays["latitude"]]
             lons = [arrays["longitude"]]
             gids = [arrays["group_ids"]]
             tss = [arrays["timestamps_ms"]]
+            if config.weighted:
+                vals = [arrays["values"]]
             for name in meta["group_names"][1:]:  # [0] is always 'all'
                 vocab.id_for(name)
             done = meta["batches_done"]
@@ -828,10 +845,13 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
                 np.concatenate(tss) if tss else np.empty(0, np.int64)
             ),
         }
+        if config.weighted:
+            arrays["values"] = np.concatenate(vals) if vals else np.empty(0)
         mgr.save(step, arrays, {
             "group_names": list(vocab.names),
             "batches_done": step,
             "job_path": "fast",
+            "weighted": config.weighted,
         })
         # Collapse accumulated chunks so later checkpoints don't recopy
         # a growing list-of-arrays.
@@ -839,6 +859,8 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
         lons[:] = [arrays["longitude"]]
         gids[:] = [arrays["group_ids"]]
         tss[:] = [arrays["timestamps_ms"]]
+        if config.weighted:
+            vals[:] = [arrays["values"]]
 
     with tracer.span("ingest.fast"):
         for i, b in enumerate(make_batches()):
@@ -904,11 +926,6 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
     ``fault_injector`` (utils.recovery.FaultInjector) fails chosen
     batch indices for recovery testing.
     """
-    if config is not None and config.weighted:
-        raise NotImplementedError(
-            "weighted jobs run the plain path only for now "
-            "(not checkpoint/resume)"
-        )
     from heatmap_tpu.utils import CheckpointManager
     from heatmap_tpu.utils.trace import get_tracer
 
@@ -918,7 +935,7 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
     tracer = get_tracer()
     mgr = CheckpointManager(checkpoint_dir)
     vocab = UserVocab()
-    lats, lons, gids, stamps = [], [], [], []
+    lats, lons, gids, stamps, vals = [], [], [], [], []
     done = 0
     if mgr.latest_step() is not None:
         arrays, meta = mgr.load()
@@ -929,8 +946,11 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
                 f"{kind!r} job path; resume it with run_job_fast "
                 "(--fast) or point this run at a fresh checkpoint dir"
             )
+        _check_checkpoint_weighted(meta, config, checkpoint_dir)
         lats, lons = [arrays["latitude"]], [arrays["longitude"]]
         gids = [arrays["group_ids"]]
+        if config.weighted:
+            vals = [arrays["values"]]
         if "timestamps_ms" in arrays:
             from heatmap_tpu.pipeline.timespan import TS_MISSING
 
@@ -956,6 +976,10 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
             "longitude": np.concatenate(lons) if lons else np.empty(0),
             "group_ids": np.concatenate(gids) if gids else np.empty(0, np.int32),
         }
+        if config.weighted:
+            arrays["values"] = (
+                np.concatenate(vals) if vals else np.empty(0)
+            )
         flat_stamps = [s for chunk in stamps for s in chunk]
         if flat_stamps and any(s is not None for s in flat_stamps):
             # Mixed None/real streams must round-trip: None persists as
@@ -1007,6 +1031,7 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
             "group_names": list(vocab.names),
             "batches_done": step,
             "job_path": "string",
+            "weighted": config.weighted,
         })
         # Collapse accumulated chunks so later checkpoints don't recopy
         # a growing list-of-arrays.
@@ -1014,6 +1039,8 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
         lons[:] = [arrays["longitude"]]
         gids[:] = [arrays["group_ids"]]
         stamps[:] = [flat_stamps]
+        if config.weighted:
+            vals[:] = [arrays["values"]]
 
     for i, batch in enumerate(source.batches(batch_size)):
         if i < done:
@@ -1026,6 +1053,9 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
             lons.append(cols["longitude"])
             gids.append(vocab.group_ids(cols["user_id"]))
             stamps.append(cols["timestamp"])
+            if config.weighted:
+                _require_value_column(cols)
+                vals.append(cols["value"])
         tracer.add_items("ingest.batch", len(cols["latitude"]))
         done = i + 1
         if done % checkpoint_every == 0:
@@ -1044,6 +1074,7 @@ def run_job_resumable(source, checkpoint_dir: str, sink=None,
             config,
             as_json=True,
             sink=sink,
+            weights=np.concatenate(vals) if config.weighted else None,
         )
     return blobs
 

@@ -110,15 +110,17 @@ class TestEndToEnd:
         alice = next(b if isinstance(b, dict) else json.loads(b)
                      for k, b in loaded.items() if k.startswith("alice|"))
         assert alice[detail] == 3.25
-        # --weighted composes with --fast now (HMPB value sections) but
-        # still not with checkpoint/resume.
+        # --weighted composes with --checkpoint-dir too (values ride
+        # the checkpoint); same blobs as the plain weighted run.
+        out2 = tmp_path / "blobs_ck.jsonl"
         r2 = _run_cli(
             "run", "--backend", "cpu",
-            "--input", f"jsonl:{src}", "--output", "memory:",
-            "--weighted", "--checkpoint-dir", str(tmp_path / "ck"),
+            "--input", f"jsonl:{src}", "--output", f"jsonl:{out2}",
+            "--detail-zoom", "10", "--min-detail-zoom", "4", "--weighted",
+            "--checkpoint-dir", str(tmp_path / "ck"),
         )
-        assert r2.returncode != 0
-        assert "--weighted" in r2.stderr
+        assert r2.returncode == 0, r2.stderr
+        assert out2.read_bytes() == out.read_bytes()
 
     def test_run_fast_csv_matches_plain(self, tmp_path):
         import csv

@@ -403,18 +403,6 @@ def test_weighted_job_missing_value_column_raises():
         run_job(_ColSource(rows), config=cfg)
 
 
-def test_weighted_job_unsupported_paths_raise(tmp_path):
-    from heatmap_tpu.pipeline import run_job_fast, run_job_resumable
-
-    rows = [dict(r, value=1.0) for r in _rows(n=20, seed=1)]
-    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=4, weighted=True)
-    with pytest.raises(NotImplementedError):
-        run_job_fast("nonexistent.csv", config=cfg,
-                     checkpoint_dir=str(tmp_path / "ck"))
-    with pytest.raises(NotImplementedError):
-        run_job_resumable(_ColSource(rows), "/tmp/nope", config=cfg)
-
-
 def test_weighted_fast_hmpb_matches_string_path(tmp_path):
     """run_job_fast on an HMPB file with a value section must produce
     the same blobs as the string path over the same weighted rows —

@@ -193,6 +193,90 @@ def test_run_job_resumable_resumes_after_crash(tmp_path):
     assert resumed == run_job(src, config=_mini_cfg(), batch_size=512)
 
 
+def test_run_job_resumable_weighted_crash_resume(tmp_path):
+    """Weighted checkpoint/resume: values ride the checkpoint, a crash
+    + resume reproduces the uninterrupted weighted run exactly, and a
+    resume under the flipped mode is refused."""
+    import dataclasses
+    import json
+
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_resumable
+
+    rng = np.random.default_rng(41)
+    n = 4000
+    lat = 47.6 + rng.normal(0, 0.3, n)
+    lon = -122.3 + rng.normal(0, 0.4, n)
+    users = [f"u{int(i)}" for i in rng.integers(0, 10, n)]
+    value = rng.integers(0, 7, n).astype(np.float64)
+
+    class _WSrc:
+        def batches(self, batch_size):
+            for lo in range(0, n, batch_size):
+                hi = min(lo + batch_size, n)
+                yield {
+                    "latitude": lat[lo:hi], "longitude": lon[lo:hi],
+                    "user_id": users[lo:hi], "source": [],
+                    "timestamp": [], "value": value[lo:hi],
+                }
+
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8, weighted=True)
+    want = run_job(_WSrc(), config=cfg, batch_size=512)
+    ckdir = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError):
+        run_job_resumable(_WSrc(), ckdir, config=cfg, batch_size=512,
+                          checkpoint_every=2,
+                          fault_injector=FaultInjector({5: 1}))
+    assert CheckpointManager(ckdir).latest_step() == 4
+    # Flipped mode must refuse before ingesting anything.
+    with pytest.raises(RuntimeError, match="weighted"):
+        run_job_resumable(
+            _WSrc(), ckdir,
+            config=dataclasses.replace(cfg, weighted=False),
+            batch_size=512, checkpoint_every=2,
+        )
+    resumed = run_job_resumable(_WSrc(), ckdir, config=cfg,
+                                batch_size=512, checkpoint_every=2)
+    assert resumed == want
+    # Spot-check a real weighted value survived the round trip.
+    assert any(v != 1.0 for blob in want.values()
+               for v in json.loads(blob).values())
+
+
+def test_run_job_fast_weighted_crash_resume(tmp_path):
+    """Fast-path weighted checkpoint/resume over an HMPB value
+    section."""
+    import dataclasses
+
+    from heatmap_tpu.io.hmpb import HMPBSource, write_hmpb
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+
+    rng = np.random.default_rng(43)
+    n = 3000
+    path = write_hmpb(
+        str(tmp_path / "w.hmpb"),
+        47.6 + rng.normal(0, 0.3, n),
+        -122.3 + rng.normal(0, 0.4, n),
+        rng.integers(0, 5, n).astype(np.int32),
+        [f"u{i}" for i in range(5)],
+        value=rng.integers(0, 9, n).astype(np.float64),
+    )
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8, weighted=True)
+    want = run_job_fast(HMPBSource(path), config=cfg, batch_size=512)
+    ckdir = str(tmp_path / "ck")
+    with pytest.raises(RuntimeError):
+        run_job_fast(HMPBSource(path), config=cfg, batch_size=512,
+                     checkpoint_dir=ckdir, checkpoint_every=2,
+                     fault_injector=FaultInjector({4: 1}))
+    with pytest.raises(RuntimeError, match="weighted"):
+        run_job_fast(HMPBSource(path),
+                     config=dataclasses.replace(cfg, weighted=False),
+                     batch_size=512, checkpoint_dir=ckdir,
+                     checkpoint_every=2)
+    resumed = run_job_fast(HMPBSource(path), config=cfg, batch_size=512,
+                           checkpoint_dir=ckdir, checkpoint_every=2)
+    assert resumed == want
+
+
 def test_run_job_fast_resumes_after_crash(tmp_path):
     """Fast-path checkpoint/resume, with dated timespans riding the
     i64 epoch-ms column through the checkpoint."""
