@@ -56,6 +56,7 @@ def pyramid_sparse_morton(
     levels: int = 0,
     capacity=None,
     acc_dtype=None,
+    adaptive: bool = False,
 ):
     """Sparse pyramid: per-level (unique Morton codes, sums) from point codes.
 
@@ -67,6 +68,15 @@ def pyramid_sparse_morton(
     Returns a list of (codes[capacity_i], sums[capacity_i], n_unique),
     entry 0 at detail zoom, entry i coarsened by i zooms.
     ``capacity`` may be an int (same for all levels) or a per-level list.
+
+    ``adaptive=True`` (EAGER callers only — it reads each level's true
+    unique count from the device) shrinks every subsequent level's
+    arrays to the next power of two above the previous level's unique
+    count. Per-level reductions cost two ~8-30 ns/element scatters on
+    TPU (PERF_NOTES.md), so on collapsing data this turns
+    ``levels * capacity`` scatter work into ~``2-3 * n_unique_0`` —
+    results are identical (the dropped slots are sentinel padding).
+    Under jit the counts are tracers and this flag must stay False.
     """
     codes = jnp.asarray(codes)
     n = codes.shape[0]
@@ -85,12 +95,30 @@ def pyramid_sparse_morton(
     out.append((uniq, sums, count))
     sentinel = jnp.iinfo(codes.dtype).max
     for lvl in range(1, levels + 1):
+        if adaptive:
+            # One scalar sync per level; slots past n_unique are pure
+            # sentinel padding, so the slice changes nothing but the
+            # amount of padding the next reduction drags through HBM.
+            # The INPUT slice must never go below n_real (dropping real
+            # aggregates pre-reduction would falsify the unique count
+            # that overflow detection relies on) — a caller-configured
+            # caps[lvl] smaller than that bounds only the OUTPUT below,
+            # where n_unique > capacity stays detectable. An overflowed
+            # previous level (n_real > its array) skips shrinking.
+            n_real = int(count)
+            if n_real <= uniq.shape[0]:
+                keep = max(64, 1 << max(0, n_real - 1).bit_length())
+                if keep < uniq.shape[0]:
+                    uniq = uniq[:keep]
+                    sums = sums[:keep]
         # Parent codes of the previous level's uniques; sentinel slots
         # must stay sentinel (a plain shift would corrupt them into
         # plausible-looking codes).
         parents = jnp.where(uniq == sentinel, sentinel, uniq >> 2)
         uniq, sums, count = sparse_ops.aggregate_sorted_keys(
-            parents, sums, caps[lvl], sentinel=sentinel
+            parents, sums, min(caps[lvl], uniq.shape[0]) if adaptive
+            else caps[lvl],
+            sentinel=sentinel,
         )
         out.append((uniq, sums, count))
     return out

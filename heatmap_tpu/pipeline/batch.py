@@ -44,6 +44,14 @@ class BatchJobConfig:
     #: The reference counts 1.0 per row (heatmap.py:35) — weighted jobs
     #: are a capability extension, not a parity surface.
     weighted: bool = False
+    #: Shrink deep cascade levels to the real unique counts (one scalar
+    #: sync per level; identical results — see
+    #: ops.pyramid.pyramid_sparse_morton). Measured on CPU: ~1.1x warm,
+    #: but the data-dependent level shapes cost ~16 extra XLA compiles
+    #: cold (6x slower first run at 500k pts) — so OFF by default until
+    #: the on-chip stage balance shows the per-level scatters dominating
+    #: enough to pay for the compiles (PERF_NOTES pending item 4).
+    adaptive_capacity: bool = False
 
     def cascade_config(self) -> cascade_mod.CascadeConfig:
         return cascade_mod.CascadeConfig(
@@ -557,6 +565,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 capacity=min(config.capacity or len(e_codes), len(e_codes)),
                 weights=e_weights,
                 acc_dtype=jnp.float64 if e_weights is not None else None,
+                adaptive=config.adaptive_capacity,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
@@ -1140,6 +1149,7 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             # stop moving near 2^24-scale cell sums; counts use the
             # int32 path, SURVEY.md §8.8).
             acc_dtype=jnp.float64 if e_weights is not None else None,
+            adaptive=config.adaptive_capacity,
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

@@ -92,15 +92,20 @@ def decode_level_keys(level_keys: np.ndarray, detail_zoom: int, level: int):
 
 
 def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
-                  weights=None, valid=None, capacity=None, acc_dtype=None):
+                  weights=None, valid=None, capacity=None, acc_dtype=None,
+                  adaptive: bool = False):
     """Device-side cascade: per-level (composite key, sum) aggregates.
 
     Args:
       codes: detail-zoom Morton codes per emission.
       slots: (timespan*G + group) slot id per emission.
-      weights/valid/capacity/acc_dtype: as in
+      weights/valid/capacity/acc_dtype/adaptive: as in
         ops.pyramid.pyramid_sparse_morton (weighted jobs pass f64
-        weights + acc_dtype=f64 for exact-at-scale sums).
+        weights + acc_dtype=f64 for exact-at-scale sums; the eager job
+        paths pass adaptive=True only when
+        BatchJobConfig.adaptive_capacity opts in — deep levels then
+        shrink to the real unique counts at the cost of per-shape
+        recompiles, see PERF_NOTES.md).
 
     Returns the list of per-level (keys, sums, n_unique) — level i at
     detail zoom ``config.detail_zoom - i``.
@@ -113,6 +118,7 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         levels=config.n_levels,
         capacity=capacity,
         acc_dtype=acc_dtype,
+        adaptive=adaptive,
     )
 
 

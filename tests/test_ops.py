@@ -241,6 +241,38 @@ def test_pyramid_sparse_morton_matches_counters():
         assert int(s.sum()) == 3000
 
 
+def test_pyramid_sparse_morton_adaptive_matches_fixed():
+    """adaptive=True shrinks level arrays but the aggregates (and the
+    true unique counts overflow detection relies on) are identical."""
+    rng = np.random.default_rng(9)
+    codes = jnp.asarray(rng.integers(0, 1 << 18, 5000), jnp.int64)
+    fixed = pyramid_sparse_morton(codes, levels=6)
+    adapt = pyramid_sparse_morton(codes, levels=6, adaptive=True)
+    for (fk, fs, fn), (ak, as_, an) in zip(fixed, adapt):
+        n = int(fn)
+        assert int(an) == n
+        np.testing.assert_array_equal(np.asarray(fk)[:n], np.asarray(ak)[:n])
+        np.testing.assert_array_equal(np.asarray(fs)[:n], np.asarray(as_)[:n])
+    assert adapt[-1][0].shape[0] < fixed[-1][0].shape[0]
+
+
+def test_pyramid_sparse_morton_adaptive_keeps_overflow_detectable():
+    """A per-level capacity smaller than the real unique count must
+    still report the TRUE count under adaptive=True — the input slice
+    may never drop real aggregates pre-reduction (that would falsify
+    n_unique and silently truncate sums)."""
+    rng = np.random.default_rng(10)
+    # ~2000 distinct level-0 codes whose parents stay ~distinct.
+    codes = jnp.asarray(rng.permutation(1 << 14)[:2000] * 4, jnp.int64)
+    caps = [4096, 64]  # level-1 capacity far below the real uniques
+    fixed = pyramid_sparse_morton(codes, levels=1, capacity=caps)
+    adapt = pyramid_sparse_morton(codes, levels=1, capacity=caps,
+                                  adaptive=True)
+    true_n = int(fixed[1][2])
+    assert true_n > 64  # the scenario is real
+    assert int(adapt[1][2]) == true_n  # overflow stays detectable
+
+
 def test_pyramid_sparse_morton_weighted_with_invalid():
     zoom = 6
     rows = np.array([1, 1, 2, 3], np.int32)

@@ -493,6 +493,30 @@ def test_weighted_bounded_missing_value_column_raises():
         run_job(_ColSource(rows), config=cfg, max_points_in_flight=20)
 
 
+def test_adaptive_capacity_identical_results():
+    """adaptive_capacity shrinks deep cascade levels to the real
+    unique counts; blobs must be identical to the fixed-shape path
+    (counted AND weighted), including under amplify_all."""
+    import dataclasses
+
+    from heatmap_tpu.pipeline import run_job
+
+    rows = [dict(r, value=float(v))
+            for r, v in zip(_rows(n=1200, seed=29),
+                            np.random.default_rng(29).integers(0, 9, 1200))]
+    for weighted in (False, True):
+        for amplify in (False, True):
+            cfg = BatchJobConfig(detail_zoom=14, min_detail_zoom=5,
+                                 weighted=weighted, amplify_all=amplify,
+                                 adaptive_capacity=True)
+            a = run_job(_ColSource(rows), config=cfg, batch_size=256)
+            b = run_job(_ColSource(rows),
+                        config=dataclasses.replace(
+                            cfg, adaptive_capacity=False),
+                        batch_size=256)
+            assert a == b and len(a) > 0, (weighted, amplify)
+
+
 def test_run_job_bounded_propagates_ingest_errors():
     """A source failure in the prefetch thread must surface as the
     job's exception, not a hang or a silent partial result."""
