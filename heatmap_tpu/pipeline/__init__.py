@@ -21,6 +21,7 @@ from heatmap_tpu.pipeline.timespan import timespan_label  # noqa: F401
 from heatmap_tpu.pipeline.cascade import (  # noqa: F401
     CascadeConfig,
     build_cascade,
+    run_cascade,
 )
 from heatmap_tpu.pipeline.batch import (  # noqa: F401
     BatchJobConfig,

@@ -558,7 +558,10 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                     ts_vocab=ts_vocab, weights=weights,
                 )
             )
-            level_data = cascade_mod.build_cascade(
+            # jit=False: chunk emission shapes (and sometimes
+            # n_slots) vary call to call on the bounded path, so the
+            # jitted entry would recompile the whole cascade per chunk.
+            level_data = cascade_mod.run_cascade(
                 e_codes, e_slots, ccfg,
                 n_slots=len(ts_vocab) * n_groups,
                 valid=e_valid,
@@ -566,6 +569,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 weights=e_weights,
                 acc_dtype=jnp.float64 if e_weights is not None else None,
                 adaptive=config.adaptive_capacity,
+                jit=False,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
@@ -1137,7 +1141,7 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
     with tracer.span("cascade.device"):
         import jax.numpy as jnp
 
-        levels = cascade_mod.build_cascade(
+        levels = cascade_mod.run_cascade(
             e_codes,
             e_slots,
             ccfg,

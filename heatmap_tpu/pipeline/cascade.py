@@ -27,7 +27,9 @@ they are in the reference.
 from __future__ import annotations
 
 import dataclasses
+import functools
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -119,6 +121,40 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         capacity=capacity,
         acc_dtype=acc_dtype,
         adaptive=adaptive,
+    )
+
+
+#: build_cascade under one jit: a single dispatch instead of ~130
+#: eager op dispatches (each paying relay latency on the axon backend)
+#: and cross-level XLA fusion of the shift/compare/cumsum chains —
+#: measured 1.67x on the CPU cascade stage (PERF_NOTES.md). Static
+#: args recompile per (config, n_slots, capacity, acc_dtype), i.e.
+#: once per job shape.
+_build_cascade_jit = functools.partial(
+    jax.jit,
+    static_argnames=("config", "n_slots", "capacity", "acc_dtype"),
+)(build_cascade)
+
+
+def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
+                weights=None, valid=None, capacity=None, acc_dtype=None,
+                adaptive: bool = False, jit: bool = True):
+    """The production cascade entry: jitted whole, unless ``adaptive``
+    (which must read concrete per-level unique counts and therefore
+    runs eagerly — see ops.pyramid.pyramid_sparse_morton) or
+    ``jit=False`` (callers whose input shapes vary call to call — e.g.
+    the bounded chunked path — would recompile the whole graph per
+    call and should stay eager)."""
+    if adaptive or not jit:
+        return build_cascade(
+            codes, slots, config, n_slots, weights=weights, valid=valid,
+            capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
+        )
+    if isinstance(capacity, list):
+        capacity = tuple(capacity)  # static args must be hashable
+    return _build_cascade_jit(
+        codes, slots, config=config, n_slots=n_slots, weights=weights,
+        valid=valid, capacity=capacity, acc_dtype=acc_dtype,
     )
 
 

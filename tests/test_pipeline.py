@@ -548,13 +548,13 @@ def test_run_job_bounded_device_arrays_stay_small(monkeypatch):
     from heatmap_tpu.pipeline import run_job
 
     sizes = []
-    real = cascade_mod.build_cascade
+    real = cascade_mod.run_cascade
 
     def spy(e_codes, *a, **kw):
         sizes.append(len(e_codes))
         return real(e_codes, *a, **kw)
 
-    monkeypatch.setattr(batch_mod.cascade_mod, "build_cascade", spy)
+    monkeypatch.setattr(batch_mod.cascade_mod, "run_cascade", spy)
     rows = _rows(n=3000, seed=9)
     cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=7)
     bound = 300
