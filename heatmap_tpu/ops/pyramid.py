@@ -1,5 +1,14 @@
 """Zoom-pyramid rollups: reshape-sums (dense) and Morton shifts (sparse).
 
+``pyramid_sparse_morton`` is the scatter-based production path;
+``pyramid_sparse_morton_partitioned`` is the count-only MXU
+reformulation (ops/sparse_partitioned.py) that reduces EVERY level
+from the original sorted point stream under ``key >> 2i`` — unit
+weights at every level, which is what keeps the slab-wise f32
+accumulation exact (re-aggregating a previous level's counts as
+weights would overflow the f32 slab bound). Pending on-chip
+measurement before any routing (PERF_NOTES.md).
+
 The reference coarsens one zoom per Spark stage by round-tripping every
 aggregate through inverse+forward projection (reference heatmap.py:60-61,
 109-117) — 15 redundant trig passes and 32 shuffles. With integer tile
@@ -49,6 +58,18 @@ def pyramid_from_raster(raster, levels: int):
     return out
 
 
+def _level_caps(capacity, n: int, levels: int) -> list:
+    """Normalize the per-level capacity spec (int / None / list)."""
+    caps = (
+        [capacity or n] * (levels + 1)
+        if capacity is None or isinstance(capacity, int)
+        else list(capacity)
+    )
+    if len(caps) != levels + 1:
+        raise ValueError(f"need {levels + 1} capacities, got {len(caps)}")
+    return caps
+
+
 def pyramid_sparse_morton(
     codes,
     weights=None,
@@ -80,13 +101,7 @@ def pyramid_sparse_morton(
     """
     codes = jnp.asarray(codes)
     n = codes.shape[0]
-    caps = (
-        [capacity or n] * (levels + 1)
-        if capacity is None or isinstance(capacity, int)
-        else list(capacity)
-    )
-    if len(caps) != levels + 1:
-        raise ValueError(f"need {levels + 1} capacities, got {len(caps)}")
+    caps = _level_caps(capacity, n, levels)
 
     out = []
     uniq, sums, count = sparse_ops.aggregate_keys(
@@ -121,4 +136,64 @@ def pyramid_sparse_morton(
             sentinel=sentinel,
         )
         out.append((uniq, sums, count))
+    return out
+
+
+def pyramid_sparse_morton_partitioned(
+    codes,
+    valid=None,
+    levels: int = 0,
+    capacity=None,
+    chunk: int | None = None,
+    block_cells: int | None = None,
+    slab: int | None = None,
+    interpret: bool | None = None,
+):
+    """Count-only sparse pyramid on the multi-channel MXU reduction.
+
+    Same contract as :func:`pyramid_sparse_morton` with
+    ``weights=None`` (counts in int32, keys int64 with int64-max
+    sentinel padding, per-level capacities), but every level is
+    reduced from the ORIGINAL sorted stream shifted by ``2*level`` —
+    one sort, then ``levels+1`` kernel passes that replace the 2
+    scatters per level (ops/sparse_partitioned.py rationale). Keys
+    must fit 60 bits. Tunables default to sparse_partitioned's
+    DEFAULT_* values.
+    """
+    from heatmap_tpu.ops import sparse_partitioned as sp
+
+    chunk = sp.DEFAULT_CHUNK if chunk is None else chunk
+    block_cells = sp.DEFAULT_BLOCK_CELLS if block_cells is None else block_cells
+    slab = sp.DEFAULT_SLAB if slab is None else slab
+
+    codes = jnp.asarray(codes)
+    if codes.dtype != jnp.int64:
+        codes = codes.astype(jnp.int64)
+    n = codes.shape[0]
+    caps = _level_caps(capacity, n, levels)
+
+    sentinel = jnp.iinfo(jnp.int64).max
+    keys = codes if valid is None else jnp.where(valid, codes, sentinel)
+    skeys = jnp.sort(keys, stable=False)
+
+    out = []
+    for lvl in range(levels + 1):
+        # Right shifts preserve the sort; the shifted sentinel
+        # (intmax >> 2*lvl) still exceeds every real (< 2^60) key at
+        # the shifted width, so it keeps sorting last and masking out.
+        uniq, counts, n_unique = sp.aggregate_sorted_keys_partitioned(
+            skeys >> (2 * lvl),
+            caps[lvl],
+            sentinel=sentinel >> (2 * lvl),
+            chunk=chunk,
+            block_cells=block_cells,
+            slab=slab,
+            interpret=interpret,
+        )
+        # Normalize padding to the repo-wide int64-max sentinel (the
+        # per-level call pads with its SHIFTED sentinel, which a
+        # `uniq != intmax` consumer mask would let through as phantom
+        # zero-count cells).
+        uniq = jnp.where(counts > 0, uniq, sentinel)
+        out.append((uniq, counts, n_unique))
     return out

@@ -1,0 +1,289 @@
+"""Partitioned-MXU segment reduction for the cascade's sorted streams.
+
+``aggregate_sorted_keys`` (ops/sparse.py) costs two ~8-30 ns/element
+scatters per cascade level on v5e — the sums ``.at[seg].add`` and the
+unique-keys ``.at[seg].set`` — 32 scatters across a 16-level cascade,
+the dominant device cost of the batch job (PERF_NOTES.md). This module
+reformulates BOTH as one pass of the measured-2.2x sort-partitioned
+one-hot-matmul machinery (ops/partitioned.py), exploiting that the
+cascade's inputs are already sorted:
+
+- the segment index ``seg = cumsum(first) - 1`` is a sorted, dense
+  cell id into [0, capacity) — exactly the stream shape the
+  partitioned kernel bins, with NO sort needed;
+- counts stay exact at any fan-in by processing the stream in SLABS
+  of at most 2^24 elements: per-slab f32 accumulation cannot round
+  (every partial sum is an integer < 2^24), and slabs combine in f64
+  on the way out;
+- the unique key of a segment is reconstructed through three extra
+  matmul CHANNELS: the segment's FIRST element contributes its key
+  split into 20-bit pieces (each < 2^20, exactly one contribution per
+  segment globally, so f32 holds them exactly), and the pieces
+  reassemble as ``lo | mid<<20 | hi<<40`` — covering keys up to 60
+  bits, which includes the cascade's 58-bit composite keys. The
+  one-hot construction (the VPU cost that bounds the partitioned
+  kernel) is SHARED by all four channels; the extra matmuls ride the
+  MXU.
+
+Count-only by design: weighted cascades accumulate f64, which the MXU
+cannot do exactly — they stay on the scatter path. Keys must fit 60
+bits (a caller contract; the cascade's composite keys do by the int64
+packing check in pipeline/cascade.composite_keys).
+
+STATUS: interpret-mode verified (tests/test_sparse_partitioned.py,
+bit-equal to aggregate_sorted_keys including multi-slab and fallback
+paths); Mosaic lowering and the on-chip win are pending the relay
+(PERF_NOTES pending runlist) — nothing routes here by default yet.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+DEFAULT_CHUNK = 1024
+DEFAULT_BLOCK_CELLS = 1 << 16
+#: Max elements per exactness slab: f32 integer accumulation is exact
+#: below 2^24, and a slab contributes at most ``slab`` to any count.
+DEFAULT_SLAB = 1 << 24
+#: Bits per key-reconstruction channel (3 channels -> 60-bit keys).
+KEY_BITS = 20
+N_CHANNELS = 4  # counts + 3 key pieces
+
+
+def _segment_kernel(base_ref, good_ref, first_v_ref, last_v_ref,
+                    s_ref, w_ref, zeros_ref, out_ref, acc_ref, *,
+                    chunk, block_cells, side, n_blocks):
+    """Multi-channel twin of partitioned._partition_kernel: one shared
+    one-hot pair per chunk, N_CHANNELS weighted matmuls into a
+    (1, N_CHANNELS, side, side) accumulator."""
+    del zeros_ref
+    i = pl.program_id(0)
+
+    @pl.when(first_v_ref[i] == 1)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    local = s_ref[0, 0, :] - base_ref[i] * block_cells
+    ok = (good_ref[i] == 1) & (local >= 0) & (local < block_cells)
+    rloc = jnp.where(ok, local // side, -1)
+    cloc = jnp.where(ok, local % side, 0)
+
+    r_ids = lax.broadcasted_iota(jnp.int32, (side, chunk), 0)
+    c_ids = lax.broadcasted_iota(jnp.int32, (chunk, side), 1)
+    row_onehot = (r_ids == rloc[None, :]).astype(jnp.float32)
+    col_onehot = (c_ids == cloc[:, None]).astype(jnp.float32)
+    for ch in range(N_CHANNELS):  # static unroll; one-hots shared
+        acc_ref[0, ch] += jnp.dot(
+            row_onehot, col_onehot * w_ref[0, ch, :][:, None],
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(last_v_ref[i] == 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def _good_of(cells, chunk, block_cells, capacity):
+    """Per-chunk goodness: fully inside one aligned block AND free of
+    dropped lanes (cell id == capacity)."""
+    first = cells[::chunk]
+    last = cells[chunk - 1 :: chunk]
+    return (first // block_cells == last // block_cells) & (last < capacity)
+
+
+def _channel_path(cells, chans, good, capacity, n_blocks, chunk,
+                  bad_cap_chunks, interpret, block_cells, side):
+    """Good chunks -> multi-channel pallas blocks; bad chunks ->
+    bounded f64 scatter tails (exact: every channel is integer-valued
+    below 2^52). ``good`` is the caller's per-chunk mask — the same
+    one that sized the bounded tail."""
+    L = cells.shape[0]
+    nck = L // chunk
+    first = cells[::chunk]
+    # Forward-fill bad chunks with the last good block id (sorted
+    # stream -> good block ids are non-decreasing); leading bads clamp
+    # to block 0, fully masked.
+    base = jnp.maximum(
+        lax.cummax(jnp.where(good, first // block_cells, -1)), 0
+    ).astype(jnp.int32)
+    gi = good.astype(jnp.int32)
+    first_visit = jnp.concatenate(
+        [jnp.ones(1, jnp.int32), (base[1:] != base[:-1]).astype(jnp.int32)]
+    )
+    last_visit = jnp.concatenate(
+        [(base[1:] != base[:-1]).astype(jnp.int32), jnp.ones(1, jnp.int32)]
+    )
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nck,),
+        in_specs=[
+            # (nck, 1, chunk): last-two block dims (1, chunk) satisfy
+            # the TPU tiling rule (sublane == array dim, lane % 128).
+            pl.BlockSpec((1, 1, chunk), lambda i, *_: (i, 0, 0)),
+            # (nck, N_CHANNELS, chunk): channel dim taken whole.
+            pl.BlockSpec((1, N_CHANNELS, chunk), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec(
+                (1, N_CHANNELS, side, side),
+                lambda i, base_, *_: (base_[i], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, N_CHANNELS, side, side),
+            lambda i, base_, *_: (base_[i], 0, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((1, N_CHANNELS, side, side), jnp.float32)
+        ],
+    )
+    zeros = jnp.zeros((n_blocks, N_CHANNELS, side, side), jnp.float32)
+    blocks = pl.pallas_call(
+        functools.partial(_segment_kernel, chunk=chunk,
+                          block_cells=block_cells, side=side,
+                          n_blocks=n_blocks),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            (n_blocks, N_CHANNELS, side, side), jnp.float32
+        ),
+        input_output_aliases={6: 0},  # zeros operand -> output
+        interpret=interpret,
+    )(base, gi, first_visit, last_visit,
+      cells.reshape(nck, 1, chunk),
+      chans.reshape(N_CHANNELS, nck, chunk).transpose(1, 0, 2),
+      zeros)
+    dense = blocks.transpose(1, 0, 2, 3).reshape(
+        N_CHANNELS, n_blocks * block_cells
+    )[:, :capacity]
+
+    bad_idx = jnp.nonzero(~good, size=bad_cap_chunks, fill_value=nck)[0]
+    bad_cells = jnp.take(cells.reshape(nck, chunk), bad_idx, axis=0,
+                         mode="fill", fill_value=capacity).reshape(-1)
+    tails = []
+    for ch in range(N_CHANNELS):
+        bad_w = jnp.take(chans[ch].reshape(nck, chunk), bad_idx, axis=0,
+                         mode="fill", fill_value=0.0).reshape(-1)
+        tails.append(
+            jnp.zeros(capacity, jnp.float64)
+            .at[bad_cells]
+            .add(bad_w.astype(jnp.float64), mode="drop")
+        )
+    return dense.astype(jnp.float64) + jnp.stack(tails)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("capacity", "chunk", "block_cells", "bad_frac",
+                     "slab", "interpret"),
+)
+def aggregate_sorted_keys_partitioned(
+    sorted_keys,
+    capacity: int,
+    sentinel=None,
+    chunk: int = DEFAULT_CHUNK,
+    block_cells: int = DEFAULT_BLOCK_CELLS,
+    bad_frac: int = 8,
+    slab: int = DEFAULT_SLAB,
+    interpret: bool | None = None,
+):
+    """Count-only ``aggregate_sorted_keys`` on the partitioned kernel.
+
+    Contract matches ops.sparse.aggregate_sorted_keys with unit
+    weights: returns (unique[capacity] int64, counts[capacity] int32,
+    n_unique); slots past n_unique hold sentinel/zero; exact at ANY
+    per-key fan-in (slab-wise f32 accumulation, f64 combine). ``slab``
+    is a parameter so tests can exercise the multi-slab combine at
+    small sizes; it must be a multiple of ``chunk``.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform == "cpu"
+    keys = jnp.asarray(sorted_keys)
+    if sentinel is None:
+        sentinel = jnp.iinfo(keys.dtype).max
+    if keys.dtype != jnp.int64:
+        keys = keys.astype(jnp.int64)
+        sentinel = jnp.int64(sentinel)
+    n = keys.shape[0]
+    if slab % chunk:
+        raise ValueError(f"slab {slab} must be a multiple of chunk {chunk}")
+    side = 1 << (block_cells.bit_length() // 2)
+    if side * side != block_cells or side < 64:
+        raise ValueError(
+            f"block_cells must be an even power of two >= 4096, "
+            f"got {block_cells}"
+        )
+
+    is_real = keys != sentinel
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), keys[1:] != keys[:-1]]
+    ) & is_real
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    cells = jnp.where(is_real, seg, capacity)  # capacity == drop
+    n_unique = jnp.sum(first.astype(jnp.int32))
+
+    # Channels: counts + the segment-first element's key in 20-bit
+    # pieces (one nonzero contribution per segment -> f32-exact).
+    fw = first.astype(jnp.float32)
+    mask = (1 << KEY_BITS) - 1
+    chans = jnp.stack([
+        is_real.astype(jnp.float32),
+        fw * ((keys >> 0) & mask).astype(jnp.float32),
+        fw * ((keys >> KEY_BITS) & mask).astype(jnp.float32),
+        fw * ((keys >> (2 * KEY_BITS)) & mask).astype(jnp.float32),
+    ])
+
+    # Pad to whole slabs of whole chunks.
+    n_slabs = max(1, -(-max(n, 1) // slab))
+    n_pad = n_slabs * slab
+    if n_pad != n:
+        cells = jnp.concatenate(
+            [cells, jnp.full(n_pad - n, capacity, cells.dtype)]
+        )
+        chans = jnp.concatenate(
+            [chans, jnp.zeros((N_CHANNELS, n_pad - n), jnp.float32)], axis=1
+        )
+
+    n_blocks = -(-capacity // block_cells)
+    sums = jnp.zeros((N_CHANNELS, capacity), jnp.float64)
+    for s in range(n_slabs):  # static unroll: ~n/2^24 iterations
+        c_slab = cells[s * slab : (s + 1) * slab]
+        ch_slab = chans[:, s * slab : (s + 1) * slab]
+        nck = slab // chunk
+        bad_cap = max(2, nck // bad_frac)
+        good_slab = _good_of(c_slab, chunk, block_cells, capacity)
+        n_bad = (~good_slab).sum()
+
+        def scatter_all(c_, ch_, g_):
+            return jnp.stack([
+                jnp.zeros(capacity, jnp.float64)
+                .at[c_]
+                .add(ch_[ch].astype(jnp.float64), mode="drop")
+                for ch in range(N_CHANNELS)
+            ])
+
+        slab_sums = lax.cond(
+            n_bad <= bad_cap,
+            lambda c_, ch_, g_: _channel_path(
+                c_, ch_, g_, capacity, n_blocks, chunk, bad_cap,
+                interpret, block_cells, side,
+            ),
+            scatter_all,
+            c_slab,
+            ch_slab,
+            good_slab,
+        )
+        sums = sums + slab_sums
+
+    counts = jnp.round(sums[0]).astype(jnp.int32)
+    key_lo = jnp.round(sums[1]).astype(jnp.int64)
+    key_mid = jnp.round(sums[2]).astype(jnp.int64)
+    key_hi = jnp.round(sums[3]).astype(jnp.int64)
+    unique = key_lo | (key_mid << KEY_BITS) | (key_hi << (2 * KEY_BITS))
+    unique = jnp.where(counts > 0, unique, sentinel)
+    return unique, counts, n_unique

@@ -1,0 +1,171 @@
+"""Multi-channel partitioned segment reduction (ops.sparse_partitioned),
+interpret mode: bit-equal to ops.sparse.aggregate_sorted_keys on every
+path — good-chunk matmuls, bounded bad tails, the full-scatter
+fallback, and the multi-slab exactness combine."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from heatmap_tpu.ops.sparse import aggregate_sorted_keys
+from heatmap_tpu.ops.sparse_partitioned import (
+    aggregate_sorted_keys_partitioned,
+)
+
+SENTINEL = np.iinfo(np.int64).max
+
+
+def _diff(sorted_keys, capacity, **kw):
+    sorted_keys = jnp.asarray(np.sort(np.asarray(sorted_keys)), jnp.int64)
+    want_u, want_s, want_n = aggregate_sorted_keys(
+        sorted_keys, jnp.ones(len(sorted_keys), jnp.int32), capacity,
+        sentinel=SENTINEL,
+    )
+    got_u, got_s, got_n = aggregate_sorted_keys_partitioned(
+        sorted_keys, capacity, interpret=True, **kw
+    )
+    assert int(got_n) == int(want_n)
+    n = min(int(want_n), capacity)
+    np.testing.assert_array_equal(np.asarray(got_u)[:n],
+                                  np.asarray(want_u)[:n])
+    np.testing.assert_array_equal(np.asarray(got_s)[:n],
+                                  np.asarray(want_s)[:n])
+    # Padding slots: sentinel keys, zero counts — both contracts.
+    assert (np.asarray(got_u)[n:] == SENTINEL).all()
+    assert (np.asarray(got_s)[n:] == 0).all()
+    return int(want_n)
+
+
+def test_clustered_runs_good_chunks():
+    """Long runs (few segments per chunk) take the matmul path."""
+    rng = np.random.default_rng(0)
+    keys = np.repeat(rng.choice(1 << 40, 40, replace=False),
+                     rng.integers(100, 900, 40))
+    assert _diff(keys, capacity=1 << 12) == 40
+
+
+def test_mostly_unique_keys():
+    """Run length ~1: every chunk spans many segments, but segments are
+    dense so chunks still land inside blocks."""
+    rng = np.random.default_rng(1)
+    keys = rng.choice(1 << 50, 30_000, replace=False)
+    _diff(keys, capacity=30_000)
+
+
+def test_sentinel_padding_and_drop():
+    rng = np.random.default_rng(2)
+    keys = np.concatenate([
+        rng.integers(0, 1 << 30, 5000),
+        np.full(3000, SENTINEL),
+    ])
+    _diff(keys, capacity=8192)
+
+
+def test_multi_slab_combine_exact():
+    """slab smaller than the stream: per-slab partials must combine to
+    the global counts, including segments straddling slab boundaries
+    and per-key fan-in far above one slab's contribution."""
+    rng = np.random.default_rng(3)
+    keys = np.repeat(rng.choice(1 << 35, 13, replace=False),
+                     rng.integers(500, 4000, 13))
+    n = _diff(keys, capacity=4096, slab=4096)
+    assert n == 13
+
+
+def test_single_hot_key_fanin_beyond_slab():
+    """One segment larger than several slabs: counts must stay exact
+    (the f32-per-slab / f64-combine design point)."""
+    keys = np.full(40_000, 123456789)
+    got_u, got_s, got_n = aggregate_sorted_keys_partitioned(
+        jnp.asarray(keys, jnp.int64), 64, slab=8192, interpret=True,
+    )
+    assert int(got_n) == 1
+    assert int(got_s[0]) == 40_000
+    assert int(got_u[0]) == 123456789
+
+
+def test_58_bit_keys_reconstruct():
+    """Cascade-scale composite keys (58 bits) round-trip through the
+    three 20-bit channels."""
+    rng = np.random.default_rng(4)
+    keys = rng.integers(1 << 57, 1 << 58, 3000, dtype=np.int64)
+    _diff(keys, capacity=4096)
+
+
+def test_hostile_distribution_falls_back():
+    """capacity-spanning sparse segments make most chunks straddle
+    blocks -> the lax.cond scatter fallback must match too."""
+    rng = np.random.default_rng(5)
+    # Unique keys + big capacity: segments land far apart in cell space
+    # relative to block_cells, so chunks straddle constantly with a
+    # tiny block size.
+    keys = rng.choice(1 << 45, 20_000, replace=False)
+    _diff(keys, capacity=1 << 18, block_cells=1 << 12)
+
+
+def test_empty_and_tiny():
+    _diff(np.empty(0, np.int64), capacity=64)
+    _diff(np.asarray([7]), capacity=64)
+    _diff(np.asarray([7, 7, 8]), capacity=64)
+
+
+def test_pyramid_partitioned_matches_scatter_pyramid():
+    """The full count pyramid: kernel variant == scatter variant at
+    every level, including invalid lanes and per-level capacities."""
+    from heatmap_tpu.ops.pyramid import (
+        pyramid_sparse_morton,
+        pyramid_sparse_morton_partitioned,
+    )
+
+    rng = np.random.default_rng(7)
+    n = 20_000
+    # Clustered codes with repeats (collapsing pyramid) + invalid tail.
+    codes = np.sort(rng.choice(1 << 26, 700, replace=False))[
+        rng.integers(0, 700, n)
+    ].astype(np.int64)
+    valid = rng.random(n) < 0.9
+    levels = 6
+    want = pyramid_sparse_morton(
+        jnp.asarray(codes), valid=jnp.asarray(valid), levels=levels,
+        capacity=n,
+    )
+    got = pyramid_sparse_morton_partitioned(
+        jnp.asarray(codes), valid=jnp.asarray(valid), levels=levels,
+        capacity=n, interpret=True,
+    )
+    for lvl, ((wu, ws, wn), (gu, gs, gn)) in enumerate(zip(want, got)):
+        m = int(wn)
+        assert int(gn) == m, lvl
+        np.testing.assert_array_equal(np.asarray(wu)[:m],
+                                      np.asarray(gu)[:m])
+        np.testing.assert_array_equal(np.asarray(ws)[:m],
+                                      np.asarray(gs)[:m])
+        # Padding normalized to the repo-wide int64-max sentinel at
+        # EVERY level (the shifted per-level sentinel must not leak).
+        assert (np.asarray(gu)[m:] == SENTINEL).all(), lvl
+
+
+def test_matches_cascade_shift_reaggregation():
+    """The cascade use case: re-reduce a shifted (still sorted) unique
+    stream, sentinels preserved — exactly pyramid_sparse_morton's
+    per-level step."""
+    rng = np.random.default_rng(6)
+    base = np.sort(rng.choice(1 << 30, 10_000, replace=False))
+    u0, s0, n0 = aggregate_sorted_keys(
+        jnp.asarray(base, jnp.int64), jnp.ones(len(base), jnp.int32),
+        len(base), sentinel=SENTINEL,
+    )
+    parents = jnp.where(u0 == SENTINEL, SENTINEL, u0 >> 2)
+    want = aggregate_sorted_keys(parents, s0, len(base), sentinel=SENTINEL)
+    got = aggregate_sorted_keys_partitioned(parents, len(base),
+                                            interpret=True)
+    # Counts path only matches when the previous sums are unit counts
+    # re-aggregated; here s0 are counts of 1 so parent sums == segment
+    # sizes — the partitioned variant counts elements, which only
+    # coincides when every input element carries weight 1. Verify the
+    # keys agree and counts equal the number of child uniques folded in.
+    nw = int(want[2])
+    np.testing.assert_array_equal(np.asarray(got[0])[:nw],
+                                  np.asarray(want[0])[:nw])
+    np.testing.assert_array_equal(np.asarray(got[1])[:nw],
+                                  np.asarray(want[1])[:nw])

@@ -207,6 +207,79 @@ def main():
                 "error": f"{type(e).__name__}: {e}"[:200],
             }), flush=True)
 
+    # Cascade segment reduction: the per-level unit is 2 scatters over
+    # a sorted stream (aggregate_sorted_keys) vs the multi-channel MXU
+    # kernel (sparse_partitioned). Decides whether the count cascade
+    # routes to pyramid_sparse_morton_partitioned. This section FORCE-
+    # ENABLES x64 (the composite keys are int64); it runs LAST so the
+    # f32 sections above have already traced and executed — mid-process
+    # x64 flips are otherwise unsupported, so never add f32 sections
+    # after this point.
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_enable_x64", True)
+        from heatmap_tpu.ops.sparse import aggregate_sorted_keys
+        from heatmap_tpu.ops.sparse_partitioned import (
+            aggregate_sorted_keys_partitioned,
+        )
+        from heatmap_tpu.ops.pyramid import (
+            pyramid_sparse_morton,
+            pyramid_sparse_morton_partitioned,
+        )
+
+        kn = n
+        # Cascade-shaped keys: clustered z21-ish composite codes.
+        kkeys = np.sort(
+            rng.choice(1 << 42, max(kn // 8, 1), replace=False)[
+                rng.integers(0, max(kn // 8, 1), kn)
+            ].astype(np.int64)
+        )
+        dkeys = jax.device_put(jnp.asarray(kkeys, jnp.int64))
+        ones = jnp.ones(kn, jnp.int32)
+        sent = np.iinfo(np.int64).max
+
+        def timed_k(f):
+            out = f(dkeys, ones)
+            int(jnp.asarray(out[1]).ravel()[0])
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                out = f(dkeys, ones)
+                int(jnp.asarray(out[1]).ravel()[0])
+            return (time.perf_counter() - t0) / args.steps
+
+        # Symmetric jitting: each contender is one compiled dispatch
+        # (the repo measured 1.67x just from de-eagering the cascade,
+        # so an unjitted side would lose on dispatch latency alone).
+        for name, f in (
+            ("cascade-level scatter",
+             jax.jit(lambda k, o: aggregate_sorted_keys(
+                 k, o, kn, sentinel=sent))),
+            ("cascade-level partitioned",
+             jax.jit(lambda k, o: aggregate_sorted_keys_partitioned(
+                 k, kn, sentinel=sent))),
+            ("cascade-pyramid16 scatter",
+             jax.jit(lambda k, o: pyramid_sparse_morton(
+                 k, levels=16, capacity=kn)[-1])),
+            ("cascade-pyramid16 partitioned",
+             jax.jit(lambda k, o: pyramid_sparse_morton_partitioned(
+                 k, levels=16, capacity=kn)[-1])),
+        ):
+            if measured(name):
+                continue
+            try:
+                dt = timed_k(f)
+                report(name, dt)
+            except Exception as e:  # noqa: BLE001 — keep sweeping
+                print(json.dumps({
+                    "config": name,
+                    "error": f"{type(e).__name__}: {e}"[:200],
+                }), flush=True)
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"config": "cascade-suite",
+                          "error": f"{type(e).__name__}: {e}"[:200]}),
+              flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -179,6 +179,61 @@ def main() -> int:
                 failures += 1
                 bad = np.argwhere(got != expected)
                 print(f"  first diffs at {bad[:5].tolist()}", flush=True)
+    # Multi-channel cascade segment-reduction kernel
+    # (ops/sparse_partitioned.py): bit-exact vs aggregate_sorted_keys
+    # under real Mosaic lowering. Interpret-mode tests pass; this is
+    # the gate before pyramid_sparse_morton_partitioned routes anywhere.
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from heatmap_tpu.ops.sparse import aggregate_sorted_keys
+    from heatmap_tpu.ops.sparse_partitioned import (
+        aggregate_sorted_keys_partitioned,
+    )
+
+    sent = np.iinfo(np.int64).max
+    kn = 1 << 22
+    kcases = {
+        "seg-clustered": np.sort(
+            rng.choice(1 << 42, kn // 64, replace=False)[
+                rng.integers(0, kn // 64, kn)
+            ].astype(np.int64)),
+        "seg-unique": np.sort(
+            rng.choice(1 << 50, kn, replace=False).astype(np.int64)),
+        "seg-pileup": np.sort(np.concatenate([
+            np.full(kn - kn // 8, 123456789, np.int64),
+            rng.choice(1 << 40, kn // 8, replace=False).astype(np.int64),
+        ])),
+    }
+    kcombos = [{}, {"block_cells": 1 << 12}, {"slab": 1 << 20}]
+    for name, keys in kcases.items():
+        todo = [kw for kw in kcombos
+                if state.get(f"{name}|{json.dumps(kw, sort_keys=True)}")
+                is not True]
+        if not todo:
+            done += len(kcombos)
+            continue
+        dk = jnp.asarray(keys, jnp.int64)
+        wu, ws, wn = aggregate_sorted_keys(
+            dk, jnp.ones(kn, jnp.int32), kn, sentinel=sent)
+        wu, ws, m = np.asarray(wu), np.asarray(ws), int(wn)
+        for kw in kcombos:
+            key = f"{name}|{json.dumps(kw, sort_keys=True)}"
+            if state.get(key) is True:
+                done += 1
+                continue
+            gu, gs, gn = aggregate_sorted_keys_partitioned(
+                dk, kn, sentinel=sent, interpret=False, **kw)
+            ok = (int(gn) == m
+                  and bool((np.asarray(gu)[:m] == wu[:m]).all())
+                  and bool((np.asarray(gs)[:m] == ws[:m]).all()))
+            _append_state(args.state, key, ok)
+            done += 1
+            print(json.dumps({"case": name, "kw": kw, "bit_exact": ok,
+                              "uniques": m}), flush=True)
+            if not ok:
+                failures += 1
+
     print(json.dumps({
         "device": jax.devices()[0].platform,
         "failures": failures,
