@@ -44,6 +44,10 @@ class BatchJobConfig:
     #: The reference counts 1.0 per row (heatmap.py:35) — weighted jobs
     #: are a capability extension, not a parity surface.
     weighted: bool = False
+    #: Cascade reduction backend: "scatter" (default) or "partitioned"
+    #: (count-only multi-channel MXU reduction; enable after its
+    #: on-chip numbers land — PERF_NOTES pending item 5).
+    cascade_backend: str = "scatter"
     #: Shrink deep cascade levels to the real unique counts (one scalar
     #: sync per level; identical results — see
     #: ops.pyramid.pyramid_sparse_morton). Measured on CPU: ~1.1x warm,
@@ -52,6 +56,14 @@ class BatchJobConfig:
     #: the on-chip stage balance shows the per-level scatters dominating
     #: enough to pay for the compiles (PERF_NOTES pending item 4).
     adaptive_capacity: bool = False
+
+    def __post_init__(self):
+        if self.cascade_backend not in ("scatter", "partitioned"):
+            raise ValueError(
+                f"unknown cascade backend {self.cascade_backend!r} "
+                "(valid: scatter, partitioned) — rejected at config "
+                "time so a typo fails before a multi-hour ingest"
+            )
 
     def cascade_config(self) -> cascade_mod.CascadeConfig:
         return cascade_mod.CascadeConfig(
@@ -570,6 +582,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 acc_dtype=jnp.float64 if e_weights is not None else None,
                 adaptive=config.adaptive_capacity,
                 jit=False,
+                backend=config.cascade_backend,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
@@ -1154,6 +1167,7 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             # int32 path, SURVEY.md §8.8).
             acc_dtype=jnp.float64 if e_weights is not None else None,
             adaptive=config.adaptive_capacity,
+            backend=config.cascade_backend,
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

@@ -95,7 +95,7 @@ def decode_level_keys(level_keys: np.ndarray, detail_zoom: int, level: int):
 
 def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                   weights=None, valid=None, capacity=None, acc_dtype=None,
-                  adaptive: bool = False):
+                  adaptive: bool = False, backend: str = "scatter"):
     """Device-side cascade: per-level (composite key, sum) aggregates.
 
     Args:
@@ -109,10 +109,44 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         shrink to the real unique counts at the cost of per-shape
         recompiles, see PERF_NOTES.md).
 
+    ``backend``: "scatter" (aggregate_sorted_keys, the default) or
+    "partitioned" (count-only multi-channel MXU reduction,
+    ops/sparse_partitioned.py — route here only after its on-chip
+    numbers land, PERF_NOTES pending item 5).
+
     Returns the list of per-level (keys, sums, n_unique) — level i at
     detail zoom ``config.detail_zoom - i``.
     """
     ck = composite_keys(codes, slots, config.detail_zoom, n_slots)
+    if backend == "partitioned":
+        slot_bits = max(1, int(np.ceil(np.log2(max(n_slots, 2)))))
+        if 2 * config.detail_zoom + slot_bits > 60:
+            raise ValueError(
+                f"cascade backend 'partitioned' reconstructs keys from "
+                f"three 20-bit channels (60-bit limit); zoom "
+                f"{config.detail_zoom} with {n_slots} slots needs "
+                f"{2 * config.detail_zoom + slot_bits} bits — use the "
+                "scatter backend"
+            )
+        if weights is not None:
+            raise ValueError(
+                "cascade backend 'partitioned' is count-only (the MXU "
+                "reduction's exactness slabs assume unit weights); "
+                "weighted jobs use the scatter backend"
+            )
+        if adaptive:
+            raise ValueError(
+                "cascade backend 'partitioned' reduces every level from "
+                "the full stream; adaptive capacities do not apply"
+            )
+        return pyramid_ops.pyramid_sparse_morton_partitioned(
+            ck,
+            valid=valid,
+            levels=config.n_levels,
+            capacity=capacity,
+        )
+    if backend != "scatter":
+        raise ValueError(f"unknown cascade backend {backend!r}")
     return pyramid_ops.pyramid_sparse_morton(
         ck,
         weights=weights,
@@ -132,13 +166,15 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
 #: once per job shape.
 _build_cascade_jit = functools.partial(
     jax.jit,
-    static_argnames=("config", "n_slots", "capacity", "acc_dtype"),
+    static_argnames=("config", "n_slots", "capacity", "acc_dtype",
+                     "backend"),
 )(build_cascade)
 
 
 def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 weights=None, valid=None, capacity=None, acc_dtype=None,
-                adaptive: bool = False, jit: bool = True):
+                adaptive: bool = False, jit: bool = True,
+                backend: str = "scatter"):
     """The production cascade entry: jitted whole, unless ``adaptive``
     (which must read concrete per-level unique counts and therefore
     runs eagerly — see ops.pyramid.pyramid_sparse_morton) or
@@ -149,12 +185,14 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         return build_cascade(
             codes, slots, config, n_slots, weights=weights, valid=valid,
             capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
+            backend=backend,
         )
     if isinstance(capacity, list):
         capacity = tuple(capacity)  # static args must be hashable
     return _build_cascade_jit(
         codes, slots, config=config, n_slots=n_slots, weights=weights,
         valid=valid, capacity=capacity, acc_dtype=acc_dtype,
+        backend=backend,
     )
 
 

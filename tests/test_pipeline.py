@@ -493,6 +493,44 @@ def test_weighted_bounded_missing_value_column_raises():
         run_job(_ColSource(rows), config=cfg, max_points_in_flight=20)
 
 
+def test_cascade_backend_partitioned_identical_blobs():
+    """BatchJobConfig(cascade_backend='partitioned'): the MXU cascade
+    reduction produces the same blobs as the scatter backend for count
+    jobs; weighted jobs refuse it loudly."""
+    import dataclasses
+
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=900, seed=31)
+    cfg = BatchJobConfig(detail_zoom=13, min_detail_zoom=6,
+                         cascade_backend="partitioned")
+    a = run_job(_ColSource(rows), config=cfg, batch_size=256)
+    b = run_job(_ColSource(rows),
+                config=dataclasses.replace(cfg, cascade_backend="scatter"),
+                batch_size=256)
+    assert a == b and len(a) > 0
+    wrows = [dict(r, value=2.0) for r in rows]
+    with pytest.raises(ValueError, match="count-only"):
+        run_job(_ColSource(wrows),
+                config=dataclasses.replace(cfg, weighted=True),
+                batch_size=256)
+    # Bounded path honors the backend too (identical blobs).
+    bounded = run_job(_ColSource(rows), config=cfg, batch_size=256,
+                      max_points_in_flight=300)
+    assert bounded == a
+    # Typos die at config construction, not after a full ingest.
+    with pytest.raises(ValueError, match="unknown cascade backend"):
+        BatchJobConfig(cascade_backend="partioned")
+    # 60-bit key-budget guard: zoom 21 with huge slot counts cannot
+    # reconstruct through three 20-bit channels.
+    from heatmap_tpu.pipeline.cascade import CascadeConfig, build_cascade
+
+    with pytest.raises(ValueError, match="60-bit"):
+        build_cascade(np.zeros(4, np.int64), np.zeros(4, np.int64),
+                      CascadeConfig(detail_zoom=21), n_slots=1 << 19,
+                      backend="partitioned")
+
+
 def test_adaptive_capacity_identical_results():
     """adaptive_capacity shrinks deep cascade levels to the real
     unique counts; blobs must be identical to the fixed-shape path
