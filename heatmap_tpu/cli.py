@@ -84,6 +84,11 @@ def _add_run_flags(p):
     p.add_argument("--first-timespan-only", action="store_true",
                    help="reproduce the reference's early-return timespan "
                    "quirk (SURVEY.md §8.2)")
+    p.add_argument("--cascade-backend", default="scatter",
+                   choices=("scatter", "partitioned"),
+                   help="cascade reduction: scatter (default) or the "
+                   "count-only partitioned MXU kernel (enable once its "
+                   "on-chip numbers land; see PERF_NOTES.md)")
     p.add_argument("--weighted", action="store_true",
                    help="sum the source's per-point 'value' column into "
                    "the heatmaps instead of counting points (works with "
@@ -129,16 +134,20 @@ def cmd_run(args) -> int:
     )
     from heatmap_tpu.utils.trace import get_tracer, jax_profile
 
-    config = BatchJobConfig(
-        detail_zoom=args.detail_zoom,
-        min_detail_zoom=args.min_detail_zoom,
-        result_delta=args.result_delta,
-        timespans=requested,
-        amplify_all=args.amplify_all,
-        first_timespan_only=args.first_timespan_only,
-        capacity=args.capacity,
-        weighted=args.weighted,
-    )
+    try:
+        config = BatchJobConfig(
+            detail_zoom=args.detail_zoom,
+            min_detail_zoom=args.min_detail_zoom,
+            result_delta=args.result_delta,
+            timespans=requested,
+            amplify_all=args.amplify_all,
+            first_timespan_only=args.first_timespan_only,
+            capacity=args.capacity,
+            weighted=args.weighted,
+            cascade_backend=args.cascade_backend,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
     if args.max_points_in_flight is not None and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "

@@ -64,6 +64,13 @@ class BatchJobConfig:
                 "(valid: scatter, partitioned) — rejected at config "
                 "time so a typo fails before a multi-hour ingest"
             )
+        if self.weighted and self.cascade_backend == "partitioned":
+            raise ValueError(
+                "cascade backend 'partitioned' is count-only (its "
+                "exactness slabs assume unit weights); weighted jobs "
+                "use the scatter backend — rejected at config time so "
+                "the combination fails before ingest"
+            )
 
     def cascade_config(self) -> cascade_mod.CascadeConfig:
         return cascade_mod.CascadeConfig(

@@ -346,6 +346,35 @@ class TestEndToEnd:
         assert r2.returncode != 0
         assert "value" in r2.stderr
 
+    def test_run_cascade_backend_flag(self, tmp_path):
+        """--cascade-backend partitioned produces byte-identical blobs
+        to the default scatter backend, and the count-only rejection
+        proves the flag actually reaches the config (byte-equality
+        alone would pass even if the plumbing silently dropped it)."""
+        outs = {}
+        for be in ("scatter", "partitioned"):
+            out = tmp_path / f"{be}.jsonl"
+            r = _run_cli(
+                "run", "--backend", "cpu",
+                "--input", "synthetic:4000:6",
+                "--output", f"jsonl:{out}",
+                "--detail-zoom", "11", "--min-detail-zoom", "5",
+                "--cascade-backend", be,
+            )
+            assert r.returncode == 0, r.stderr
+            outs[be] = out.read_bytes()
+        assert outs["scatter"] == outs["partitioned"]
+        # The flag must reach BatchJobConfig: weighted+partitioned is
+        # rejected at config time, before any ingest, cleanly.
+        r2 = _run_cli(
+            "run", "--backend", "cpu",
+            "--input", "synthetic:10", "--output", "memory:",
+            "--cascade-backend", "partitioned", "--weighted",
+        )
+        assert r2.returncode != 0
+        assert "count-only" in r2.stderr
+        assert "Traceback" not in r2.stderr
+
     def test_info_reports_platform(self):
         r = _run_cli("info", "--backend", "cpu")
         assert r.returncode == 0, r.stderr
