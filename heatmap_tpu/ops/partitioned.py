@@ -67,6 +67,26 @@ DEFAULT_STREAMS = 1
 DEFAULT_BLOCK_CELLS = 1 << 16
 
 
+def masked_local_rc(block_start, good, stream, block_cells, side):
+    """(row-in-block, col-in-block) for one chunk's sorted cell ids,
+    with dropped lanes as row=-1/col=0 (matching no one-hot row).
+
+    Shared by every partitioned-MXU kernel (count / weighted /
+    multi-channel segment). Every constant is explicitly int32: under
+    ``jax_enable_x64`` (the batch job's z21 precision policy) weak
+    Python-int literals trace as int64 scalars inside Pallas kernels,
+    and Mosaic's int64->int32 convert lowering recurses forever
+    (RecursionError caught by the on-chip verify tool 2026-07-31;
+    pinned by tests/test_lowering.py)."""
+    bc = jnp.int32(block_cells)
+    sd = jnp.int32(side)
+    local = stream - block_start * bc
+    ok = (good == jnp.int32(1)) & (local >= jnp.int32(0)) & (local < bc)
+    rloc = jnp.where(ok, local // sd, jnp.int32(-1))
+    cloc = jnp.where(ok, local % sd, jnp.int32(0))
+    return rloc, cloc
+
+
 def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
                       zeros_ref, out_ref, acc_ref, *, chunk, block_cells,
                       side, n_blocks):
@@ -82,10 +102,10 @@ def _partition_kernel(base_ref, good_ref, first_ref, last_ref, s_ref,
 
     # base_ref holds FLAT output-slab ids stream*n_blocks + block; the
     # cell offset inside the window depends only on the block part.
-    local = s_ref[0, 0, :] - (base_ref[i] % n_blocks) * block_cells
-    ok = (good_ref[i] == 1) & (local >= 0) & (local < block_cells)
-    rloc = jnp.where(ok, local // side, -1)
-    cloc = jnp.where(ok, local % side, 0)
+    rloc, cloc = masked_local_rc(
+        base_ref[i] % jnp.int32(n_blocks), good_ref[i], s_ref[0, 0, :],
+        block_cells, side,
+    )
 
     r_ids = lax.broadcasted_iota(jnp.int32, (side, chunk), 0)
     c_ids = lax.broadcasted_iota(jnp.int32, (chunk, side), 1)
@@ -115,10 +135,10 @@ def _partition_kernel_weighted(base_ref, good_ref, first_ref, last_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    local = s_ref[0, 0, :] - (base_ref[i] % n_blocks) * block_cells
-    ok = (good_ref[i] == 1) & (local >= 0) & (local < block_cells)
-    rloc = jnp.where(ok, local // side, -1)
-    cloc = jnp.where(ok, local % side, 0)
+    rloc, cloc = masked_local_rc(
+        base_ref[i] % jnp.int32(n_blocks), good_ref[i], s_ref[0, 0, :],
+        block_cells, side,
+    )
 
     r_ids = lax.broadcasted_iota(jnp.int32, (side, chunk), 0)
     c_ids = lax.broadcasted_iota(jnp.int32, (chunk, side), 1)

@@ -45,6 +45,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from heatmap_tpu.ops.partitioned import masked_local_rc
+
 DEFAULT_CHUNK = 1024
 DEFAULT_BLOCK_CELLS = 1 << 16
 #: Max elements per exactness slab: f32 integer accumulation is exact
@@ -68,10 +70,9 @@ def _segment_kernel(base_ref, good_ref, first_v_ref, last_v_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    local = s_ref[0, 0, :] - base_ref[i] * block_cells
-    ok = (good_ref[i] == 1) & (local >= 0) & (local < block_cells)
-    rloc = jnp.where(ok, local // side, -1)
-    cloc = jnp.where(ok, local % side, 0)
+    rloc, cloc = masked_local_rc(
+        base_ref[i], good_ref[i], s_ref[0, 0, :], block_cells, side,
+    )
 
     r_ids = lax.broadcasted_iota(jnp.int32, (side, chunk), 0)
     c_ids = lax.broadcasted_iota(jnp.int32, (chunk, side), 1)

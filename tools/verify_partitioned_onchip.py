@@ -179,12 +179,61 @@ def main() -> int:
                 failures += 1
                 bad = np.argwhere(got != expected)
                 print(f"  first diffs at {bad[:5].tolist()}", flush=True)
+    # Everything below runs with x64 ENABLED — the batch job's actual
+    # configuration (z21 precision policy, int64 composite keys). The
+    # sections above ran with x64 off, which round 2 learned is a
+    # DIFFERENT Mosaic lowering: weak Python-int literals trace as
+    # int64 under x64 and can break kernel lowering outright
+    # (tests/test_lowering.py pins the lowering; this section pins
+    # on-chip execution bit-exactness in the x64 world).
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    # Window kernels under x64, f64 projection -> int64 rows/cols,
+    # exactly as run_job hands them to the binning backend.
+    x64_combos = [{}, {"streams": 8}]
+    for name in ("clustered", "pileup"):
+        lat, lon = cases[name]
+        todo = [kw for kw in x64_combos
+                if state.get(f"{name}|x64|{json.dumps(kw, sort_keys=True)}")
+                is not True
+                or state.get(
+                    f"{name}|x64|weighted|{json.dumps(kw, sort_keys=True)}")
+                is not True]
+        if not todo:
+            done += 2 * len(x64_combos)
+            continue
+        r, c, v = mercator.project_points(
+            jnp.asarray(lat, jnp.float64), jnp.asarray(lon, jnp.float64),
+            win.zoom, dtype=jnp.float64)
+        expected = np.asarray(bin_rowcol_window(r, c, win, valid=v))
+        expected_w = np.asarray(bin_rowcol_window(
+            r, c, win, weights=w_int, valid=v))
+        for kw in x64_combos:
+            for wtd in (False, True):
+                key = (f"{name}|x64|weighted|{json.dumps(kw, sort_keys=True)}"
+                       if wtd else
+                       f"{name}|x64|{json.dumps(kw, sort_keys=True)}")
+                if state.get(key) is True:
+                    done += 1
+                    continue
+                got = np.asarray(bin_rowcol_window_partitioned(
+                    r, c, win, weights=w_int if wtd else None, valid=v,
+                    interpret=False, **kw))
+                exp = expected_w if wtd else expected
+                ok = bool((got == exp).all())
+                _append_state(args.state, key, ok)
+                done += 1
+                print(json.dumps({"case": name, "x64": True,
+                                  "weighted": wtd, "kw": kw,
+                                  "bit_exact": ok}), flush=True)
+                if not ok:
+                    failures += 1
+
     # Multi-channel cascade segment-reduction kernel
     # (ops/sparse_partitioned.py): bit-exact vs aggregate_sorted_keys
     # under real Mosaic lowering. Interpret-mode tests pass; this is
     # the gate before pyramid_sparse_morton_partitioned routes anywhere.
-    jax.config.update("jax_enable_x64", True)
-    import jax.numpy as jnp
 
     from heatmap_tpu.ops.sparse import aggregate_sorted_keys
     from heatmap_tpu.ops.sparse_partitioned import (
