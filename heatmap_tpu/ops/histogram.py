@@ -19,6 +19,7 @@ import dataclasses
 import math
 
 import jax.numpy as jnp
+import numpy as _np
 
 from heatmap_tpu.tilemath import mercator
 from heatmap_tpu.tilemath import tile as _tile
@@ -131,6 +132,16 @@ def window_from_bounds(
 #: 256x256 and 2.6-2.9x over XLA scatter (PERF_NOTES.md); above it the
 #: N*H*W MAC term overtakes the scatter cost.
 PALLAS_AUTO_MAX_CELLS = 256 * 256
+
+#: The zero constant for Pallas BlockSpec index maps, shared by every
+#: kernel module. Must be a CONCRETE int32 (numpy scalar, not jnp —
+#: index maps may not capture tracers): under jax_enable_x64 a literal
+#: Python 0 traces as int64 and the Mosaic backend fails to legalize
+#: the index-map function ("failed to legalize operation 'func.func'",
+#: caught on the real chip 2026-07-31 — a stage past what
+#: tests/test_lowering.py's jax.export lowering reaches, so only
+#: on-chip runs exercise it; that is why this lives in ONE place).
+IMAP_ZERO = _np.int32(0)
 
 
 def _pick_backend(backend: str, window: Window, weighted: bool = False) -> str:

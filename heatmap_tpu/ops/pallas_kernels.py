@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-from heatmap_tpu.ops.histogram import Window
+from heatmap_tpu.ops.histogram import IMAP_ZERO, Window
 from heatmap_tpu.tilemath import mercator
 
 # Lane-friendly defaults: chunk is a multiple of 128 lanes; 8-row
@@ -152,15 +152,16 @@ def bin_rowcol_window_pallas(
         _histogram_kernel, height=h, width=w, chunk=chunk,
         precision=precision, onehot_dtype=onehot_dtype,
     )
+    z = IMAP_ZERO  # concrete int32; see histogram.IMAP_ZERO
     return pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((h, w), jnp.float32),
         grid=(n_pad // chunk,),
         in_specs=[
-            pl.BlockSpec((2, chunk), lambda i: (0, i)),
-            pl.BlockSpec((1, chunk), lambda i: (0, i)),
+            pl.BlockSpec((2, chunk), lambda i: (z, i)),
+            pl.BlockSpec((1, chunk), lambda i: (z, i)),
         ],
-        out_specs=pl.BlockSpec((h, w), lambda i: (0, 0)),
+        out_specs=pl.BlockSpec((h, w), lambda i: (z, z)),
         scratch_shapes=[pltpu_vmem((h, w), jnp.float32)],
         interpret=interpret,
     )(rc, wts)

@@ -32,8 +32,12 @@ packing check in pipeline/cascade.composite_keys).
 
 STATUS: interpret-mode verified (tests/test_sparse_partitioned.py,
 bit-equal to aggregate_sorted_keys including multi-slab and fallback
-paths); Mosaic lowering and the on-chip win are pending the relay
-(PERF_NOTES pending runlist) — nothing routes here by default yet.
+paths) AND compiled + bit-exact on v5e under real Mosaic lowering
+(2026-07-31, clustered 1M-key drive, after the x64 int32-constant
+fixes). The on-chip WIN measurement (cascade suite of
+tools/sweep_partitioned.py) decides whether
+BatchJobConfig.cascade_backend defaults here — nothing routes here by
+default yet.
 """
 
 from __future__ import annotations
@@ -45,6 +49,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from heatmap_tpu.ops.histogram import IMAP_ZERO
 from heatmap_tpu.ops.partitioned import masked_local_rc
 
 DEFAULT_CHUNK = 1024
@@ -122,23 +127,24 @@ def _channel_path(cells, chans, good, capacity, n_blocks, chunk,
 
     from jax.experimental.pallas import tpu as pltpu
 
+    z = IMAP_ZERO  # concrete int32; see histogram.IMAP_ZERO
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=4,
         grid=(nck,),
         in_specs=[
             # (nck, 1, chunk): last-two block dims (1, chunk) satisfy
             # the TPU tiling rule (sublane == array dim, lane % 128).
-            pl.BlockSpec((1, 1, chunk), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, 1, chunk), lambda i, *_: (i, z, z)),
             # (nck, N_CHANNELS, chunk): channel dim taken whole.
-            pl.BlockSpec((1, N_CHANNELS, chunk), lambda i, *_: (i, 0, 0)),
+            pl.BlockSpec((1, N_CHANNELS, chunk), lambda i, *_: (i, z, z)),
             pl.BlockSpec(
                 (1, N_CHANNELS, side, side),
-                lambda i, base_, *_: (base_[i], 0, 0, 0),
+                lambda i, base_, *_: (base_[i], z, z, z),
             ),
         ],
         out_specs=pl.BlockSpec(
             (1, N_CHANNELS, side, side),
-            lambda i, base_, *_: (base_[i], 0, 0, 0),
+            lambda i, base_, *_: (base_[i], z, z, z),
         ),
         scratch_shapes=[
             pltpu.VMEM((1, N_CHANNELS, side, side), jnp.float32)

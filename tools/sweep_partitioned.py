@@ -154,6 +154,13 @@ def main():
         (1 << 16, 1024, 8, 8),
         (1 << 16, 1024, 8, 32),
         (1 << 16, 1024, 8, 128),
+        # Round-2 follow-ups: the tail-cap sweep won ~10% at k=1
+        # (bf=128: 354.8 ms vs 403.2) and the k-stream variant won 2x
+        # (k=8: 197.5 ms); measure whether the two compose at the new
+        # streams=8 default.
+        (1 << 16, 1024, 32, 8),
+        (1 << 16, 1024, 128, 8),
+        (1 << 14, 1024, 8, 8),
     ]
     for block_cells, chunk, bad_frac, streams in combos:
 
@@ -190,13 +197,22 @@ def main():
         r, c, v = mercator.project_points(la, lo, win.zoom, dtype=jnp.float32)
         return bin_rowcol_window(r, c, win, weights=dw, valid=v)
 
-    @jax.jit
-    def part_weighted(la, lo):
-        r, c, v = mercator.project_points(la, lo, win.zoom, dtype=jnp.float32)
-        return bin_rowcol_window_partitioned(r, c, win, weights=dw, valid=v)
+    def make_part_weighted(st):
+        @jax.jit
+        def part_weighted(la, lo):
+            r, c, v = mercator.project_points(la, lo, win.zoom,
+                                              dtype=jnp.float32)
+            return bin_rowcol_window_partitioned(
+                r, c, win, weights=dw, valid=v, streams=st)
+        return part_weighted
 
+    # "partitioned weighted" (the original k=1 run) measured 56.7
+    # M pts/s vs the weighted scatter's 76.3 — the pair sort erases the
+    # matmul win at k=1. The k=8 entry decides whether the streams
+    # default flips that.
     for name, fn in (("xla-scatter weighted", xla_weighted),
-                     ("partitioned weighted", part_weighted)):
+                     ("partitioned weighted", make_part_weighted(1)),
+                     ("partitioned weighted k=8", make_part_weighted(8))):
         if measured(name):
             continue
         try:
