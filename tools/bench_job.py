@@ -54,6 +54,12 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the axon sitecustomize "
                     "overrides JAX_PLATFORMS, so the env var is not enough)")
+    ap.add_argument("--cascade-backend", default=None,
+                    choices=("scatter", "partitioned", "both"),
+                    help="cascade reduction backend; 'both' runs every "
+                    "run twice and prints one result line per backend — "
+                    "the on-chip A/B that decides the "
+                    "BatchJobConfig.cascade_backend default")
     args = ap.parse_args()
 
     import jax
@@ -77,34 +83,41 @@ def main():
                           "path": hmpb,
                           "bytes": os.path.getsize(hmpb)}), flush=True)
 
-        config = BatchJobConfig()
+        backends = (("scatter", "partitioned")
+                    if args.cascade_backend == "both"
+                    else (args.cascade_backend,))
         tracer = get_tracer()
         for run in range(args.runs):
-            tracer.reset()
-            if args.egress == "arrays":
-                sink = LevelArraysSink(os.path.join(tmpdir, f"levels{run}"))
-            elif args.egress == "json":
-                sink = MemorySink()
-            else:
-                sink = None
-            t0 = time.perf_counter()
-            out = run_job_fast(HMPBSource(hmpb), sink=sink, config=config)
-            dt = time.perf_counter() - t0
-            stages = {
-                name: round(r["total_s"], 3)
-                for name, r in sorted(tracer.report().items())
-            }
-            print(json.dumps({
-                "run": run,
-                "device": jax.devices()[0].platform,
-                "n_points": args.n,
-                "egress": args.egress,
-                "total_s": round(dt, 2),
-                "pts_per_s": round(args.n / dt),
-                "stages": stages,
-                "out": (len(out) if hasattr(out, "__len__")
-                        else str(out)[:80]),
-            }), flush=True)
+            for backend in backends:
+                config = (BatchJobConfig() if backend is None
+                          else BatchJobConfig(cascade_backend=backend))
+                tracer.reset()
+                if args.egress == "arrays":
+                    sink = LevelArraysSink(
+                        os.path.join(tmpdir, f"levels{run}-{backend}"))
+                elif args.egress == "json":
+                    sink = MemorySink()
+                else:
+                    sink = None
+                t0 = time.perf_counter()
+                out = run_job_fast(HMPBSource(hmpb), sink=sink, config=config)
+                dt = time.perf_counter() - t0
+                stages = {
+                    name: round(r["total_s"], 3)
+                    for name, r in sorted(tracer.report().items())
+                }
+                print(json.dumps({
+                    "run": run,
+                    "device": jax.devices()[0].platform,
+                    "n_points": args.n,
+                    "cascade_backend": backend or "default",
+                    "egress": args.egress,
+                    "total_s": round(dt, 2),
+                    "pts_per_s": round(args.n / dt),
+                    "stages": stages,
+                    "out": (len(out) if hasattr(out, "__len__")
+                            else str(out)[:80]),
+                }), flush=True)
     finally:
         if args.keep:
             print(json.dumps({"kept": tmpdir}), flush=True)

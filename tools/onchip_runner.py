@@ -107,7 +107,10 @@ def runlist():
         },
         {
             "name": "bench_job",
-            "cmd": [py, "tools/bench_job.py", "--n", "20000000"],
+            # Both cascade backends in one item: the A/B that decides
+            # the BatchJobConfig.cascade_backend default.
+            "cmd": [py, "tools/bench_job.py", "--n", "20000000",
+                    "--cascade-backend", "both"],
             "timeout": 3600,
             "check": _check_bench_job,
         },
