@@ -59,6 +59,34 @@ def probe_tpu(timeout_s: float = 120.0, attempts: int = 3,
     return False
 
 
+_LAST_TPU_PATH = "onchip_state/last_bench_tpu.json"
+
+
+def _load_last_tpu():
+    """Last persisted on-chip result of this benchmark, or None."""
+    try:
+        with open(_LAST_TPU_PATH) as f:
+            rec = json.load(f)
+        return rec if rec.get("unit") == "points/sec" else None
+    except (OSError, ValueError):
+        return None
+
+
+def _save_last_tpu(out):
+    """Persist a TPU run's result (best effort; artifact printing must
+    never fail on a read-only or missing state dir)."""
+    try:
+        import os
+
+        os.makedirs("onchip_state", exist_ok=True)
+        rec = dict(out)
+        rec["measured"] = time.strftime("%Y-%m-%d %H:%M UTC", time.gmtime())
+        with open(_LAST_TPU_PATH, "w") as f:
+            json.dump(rec, f, indent=1)
+    except OSError:
+        pass
+
+
 def _make_points(n, seed=0):
     """Clustered synthetic GPS points (hot-spot mixture over a metro area),
     the access pattern heatmaps actually see."""
@@ -125,11 +153,14 @@ def main():
             device = "cpu"
             note = "tpu-unavailable; cpu fallback"
 
-    #: Most recent verified on-chip run of this same benchmark
-    #: (PERF_NOTES.md); attached to CPU-fallback artifacts so a relay
-    #: outage at bench time doesn't erase the measured evidence.
-    #: Clearly labeled — the "value" field is always what ran NOW.
-    LAST_TPU_MEASUREMENT = {
+    #: Most recent verified on-chip run of this same benchmark,
+    #: attached to CPU-fallback artifacts so a relay outage at bench
+    #: time doesn't erase the measured evidence. Self-updating: every
+    #: TPU run persists its result to onchip_state/last_bench_tpu.json
+    #: (committed across rounds); the literal below is only the
+    #: fallback if that file has never been written. Clearly labeled —
+    #: the "value" field is always what ran NOW.
+    LAST_TPU_MEASUREMENT = _load_last_tpu() or {
         "value": 171373869,
         "unit": "points/sec",
         "bin_backend_resolved": "partitioned",
@@ -237,6 +268,8 @@ def main():
         out["last_tpu_measurement"] = LAST_TPU_MEASUREMENT
     if note2:
         out["note_backend"] = note2
+    if device != "cpu":
+        _save_last_tpu(out)
     print(json.dumps(out))
 
 
