@@ -95,9 +95,14 @@ def _add_run_flags(p):
                    "--fast on HMPB inputs converted from a weighted "
                    "source, and with --max-points-in-flight)")
     p.add_argument("--fast", action="store_true",
-                   help="integer-only native-decoder path (csv/hmpb "
-                   "sources; dated timespans use the i64 epoch-ms "
-                   "column; needs the native/ build for csv)")
+                   help="force the integer-only native-decoder path "
+                   "(csv/hmpb sources; dated timespans use the i64 "
+                   "epoch-ms column; needs the native/ build for csv). "
+                   "Eligible sources route here AUTOMATICALLY — this "
+                   "flag only turns silent fallback into a hard error")
+    p.add_argument("--no-fast", action="store_true",
+                   help="disable the automatic fast-path routing and "
+                   "run the generic per-row ingest")
     p.add_argument("--checkpoint-dir", default=None,
                    help="checkpoint ingest progress here and resume from "
                    "the latest checkpoint on rerun")
@@ -158,6 +163,8 @@ def cmd_run(args) -> int:
                          "(not --fast / --checkpoint-dir / "
                          "--max-points-in-flight)")
     fast_source = None
+    if args.fast and args.no_fast:
+        raise SystemExit("--fast and --no-fast are mutually exclusive")
     if args.fast:
         # Resolve through open_source so bare paths and prefixed specs
         # behave identically to every other subcommand.
@@ -173,6 +180,31 @@ def cmd_run(args) -> int:
             raise SystemExit(
                 f"--fast needs a csv or hmpb source, got {args.input!r}"
             )
+    elif (not args.no_fast and not args.multihost
+          and not args.checkpoint_dir):
+        # AUTO fast-path routing: the default ingest should never pay
+        # per-row Python when the native/mmap path produces identical
+        # blobs (equality pinned by tests/test_cli.py
+        # test_run_fast_csv_matches_plain and tests/test_pipeline.py
+        # weighted-HMPB tests). Conservative by construction — only
+        # configurations those tests cover switch over; --checkpoint-dir
+        # stays on the standard resumable path so reruns never change
+        # an existing checkpoint's format mid-flight. --no-fast opts
+        # out; --fast makes ineligibility a hard error instead.
+        from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
+        from heatmap_tpu.io.sources import CSVSource
+
+        src = open_source(args.input, read_value=False)
+        if isinstance(src, CSVSource) and not args.weighted:
+            try:
+                from heatmap_tpu.native import parse_csv_batches  # noqa: F401
+
+                fast_source = src.path
+            except ImportError:
+                pass  # native decoder unavailable: per-row path
+        elif isinstance(src, (HMPBSource, HMPBDirSource)) and (
+                not args.weighted or getattr(src, "has_value", False)):
+            fast_source = src
     if args.multihost:
         # Must run BEFORE anything that initializes the local backend —
         # the profiler's start_trace does — or jax.distributed.initialize
@@ -184,7 +216,7 @@ def cmd_run(args) -> int:
     prof = jax_profile(args.profile) if args.profile else contextlib.nullcontext()
     with prof:
         with open_sink(args.output) as sink:
-            if args.fast:
+            if fast_source is not None:
                 blobs = run_job_fast(
                     fast_source, sink, config,
                     batch_size=args.batch_size,
@@ -217,7 +249,8 @@ def cmd_run(args) -> int:
     dt = time.perf_counter() - t0
     if args.profile:
         print(get_tracer().format_report(), file=sys.stderr)
-    summary = {"seconds": round(dt, 3), "output": args.output}
+    summary = {"seconds": round(dt, 3), "output": args.output,
+               "ingest": "fast" if fast_source is not None else "standard"}
     if isinstance(blobs, dict) and blobs.get("egress") == "levels":
         summary["levels"] = blobs["levels"]
         summary["rows"] = blobs["rows"]

@@ -143,7 +143,12 @@ class TestEndToEnd:
                     "background" if rng.random() < 0.1 else "gps", 1,
                 ])
         outs = {}
-        for name, extra in (("plain", []), ("fast", ["--fast"])):
+        summaries = {}
+        # "plain" needs --no-fast now: eligible CSV sources auto-route
+        # to the fast path, and this test exists to pin the two paths'
+        # blob equality. "auto" (no flag) must take fast by itself.
+        for name, extra in (("plain", ["--no-fast"]), ("fast", ["--fast"]),
+                            ("auto", [])):
             out = tmp_path / f"{name}.jsonl"
             r = _run_cli(
                 "run", "--backend", "cpu",
@@ -156,7 +161,11 @@ class TestEndToEnd:
             from heatmap_tpu.io import JSONLBlobSink
 
             outs[name] = JSONLBlobSink.load(str(out))
-        assert outs["plain"] == outs["fast"]
+            summaries[name] = json.loads(r.stdout.strip().splitlines()[-1])
+        assert outs["plain"] == outs["fast"] == outs["auto"]
+        assert summaries["plain"]["ingest"] == "standard"
+        assert summaries["fast"]["ingest"] == "fast"
+        assert summaries["auto"]["ingest"] == "fast"
 
     def test_run_with_checkpoint_dir_resumes(self, tmp_path):
         out = tmp_path / "blobs.jsonl"
