@@ -303,3 +303,20 @@ def test_bandsharded_rejects_tile1():
         bin_points_bandsharded(
             jnp.zeros(8), jnp.zeros(8), win, make_mesh()
         )
+
+
+def test_replicated_binning_partitioned_backend(mesh):
+    """Shard-local kernel routing: backend="partitioned" (interpret on
+    CPU) under shard_map must match the xla-scatter mesh result — the
+    multi-chip analog of the single-chip backend-equality tests."""
+    lats, lons = _points(seed=5)
+    win = window_from_bounds((35.0, 55.0), (-5.0, 20.0), zoom=10,
+                             align_levels=3, pad_multiple=8)
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    args = (jnp.asarray(pla), jnp.asarray(plo), win, mesh)
+    got = np.asarray(bin_points_replicated(
+        *args, valid=jnp.asarray(valid), backend="partitioned"))
+    want = np.asarray(bin_points_replicated(
+        *args, valid=jnp.asarray(valid), backend="xla"))
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() == len(lats)
