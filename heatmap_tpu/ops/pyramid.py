@@ -148,6 +148,7 @@ def pyramid_sparse_morton_partitioned(
     block_cells: int | None = None,
     slab: int | None = None,
     interpret: bool | None = None,
+    streams: int = 1,
 ):
     """Count-only sparse pyramid on the multi-channel MXU reduction.
 
@@ -189,6 +190,7 @@ def pyramid_sparse_morton_partitioned(
             block_cells=block_cells,
             slab=slab,
             interpret=interpret,
+            streams=streams,
         )
         # Normalize padding to the repo-wide int64-max sentinel (the
         # per-level call pads with its SHIFTED sentinel, which a

@@ -75,8 +75,13 @@ def _segment_kernel(base_ref, good_ref, first_v_ref, last_v_ref,
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
+    # base_ref holds FLAT output-slab ids stream*n_blocks + block
+    # (streams=1 makes this the plain block id); the cell offset
+    # depends only on the block part — same scheme as the window
+    # kernel (ops/partitioned.py).
     rloc, cloc = masked_local_rc(
-        base_ref[i], good_ref[i], s_ref[0, 0, :], block_cells, side,
+        base_ref[i] % jnp.int32(n_blocks), good_ref[i], s_ref[0, 0, :],
+        block_cells, side,
     )
 
     r_ids = lax.broadcasted_iota(jnp.int32, (side, chunk), 0)
@@ -103,20 +108,42 @@ def _good_of(cells, chunk, block_cells, capacity):
 
 
 def _channel_path(cells, chans, good, capacity, n_blocks, chunk,
-                  bad_cap_chunks, interpret, block_cells, side):
+                  bad_cap_chunks, interpret, block_cells, side,
+                  streams=1):
     """Good chunks -> multi-channel pallas blocks; bad chunks ->
     bounded f64 scatter tails (exact: every channel is integer-valued
     below 2^52). ``good`` is the caller's per-chunk mask — the same
-    one that sized the bounded tail."""
+    one that sized the bounded tail.
+
+    ``streams`` splits the (globally sorted) slab into that many
+    contiguous sub-streams, each accumulating into its own slab of
+    output blocks, summed at the end — the same grid-pipelining trick
+    the window kernel's streams=8 default bought 2.0x from
+    (PERF_NOTES 2026-07-31). Identical math: counts and key-piece
+    channels are linear, every segment's FIRST element lands in
+    exactly one sub-stream, and chunk boundaries are unchanged
+    (sub-streams are whole runs of chunks), so the bad-chunk tail is
+    untouched. The sub-slab sums stay f32-exact: each slab holds at
+    most 2^24 elements total, so every per-cell partial and the
+    cross-stream integer sum are <= 2^24."""
     L = cells.shape[0]
     nck = L // chunk
-    first = cells[::chunk]
-    # Forward-fill bad chunks with the last good block id (sorted
-    # stream -> good block ids are non-decreasing); leading bads clamp
-    # to block 0, fully masked.
-    base = jnp.maximum(
-        lax.cummax(jnp.where(good, first // block_cells, -1)), 0
+    # Forward-fill bad chunks with the last good block id per
+    # sub-stream (each sub-stream is a contiguous slice of the sorted
+    # slab, so good block ids are non-decreasing within it); leading
+    # bads clamp to block 0, fully masked.
+    first2 = cells.reshape(streams, L // streams)[:, ::chunk]
+    good2 = good.reshape(streams, nck // streams)
+    base2 = jnp.maximum(
+        lax.cummax(jnp.where(good2, first2 // block_cells, -1), axis=1), 0
     ).astype(jnp.int32)
+    # Flat output-slab id stream*n_blocks + block: monotone within a
+    # sub-stream, strictly increasing across sub-stream boundaries'
+    # slabs -> visit runs stay consecutive over the flattened grid.
+    base = (
+        jnp.arange(streams, dtype=jnp.int32)[:, None] * jnp.int32(n_blocks)
+        + base2
+    ).reshape(-1)
     gi = good.astype(jnp.int32)
     first_visit = jnp.concatenate(
         [jnp.ones(1, jnp.int32), (base[1:] != base[:-1]).astype(jnp.int32)]
@@ -150,14 +177,15 @@ def _channel_path(cells, chans, good, capacity, n_blocks, chunk,
             pltpu.VMEM((1, N_CHANNELS, side, side), jnp.float32)
         ],
     )
-    zeros = jnp.zeros((n_blocks, N_CHANNELS, side, side), jnp.float32)
+    zeros = jnp.zeros((streams * n_blocks, N_CHANNELS, side, side),
+                      jnp.float32)
     blocks = pl.pallas_call(
         functools.partial(_segment_kernel, chunk=chunk,
                           block_cells=block_cells, side=side,
                           n_blocks=n_blocks),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
-            (n_blocks, N_CHANNELS, side, side), jnp.float32
+            (streams * n_blocks, N_CHANNELS, side, side), jnp.float32
         ),
         input_output_aliases={6: 0},  # zeros operand -> output
         interpret=interpret,
@@ -165,6 +193,10 @@ def _channel_path(cells, chans, good, capacity, n_blocks, chunk,
       cells.reshape(nck, 1, chunk),
       chans.reshape(N_CHANNELS, nck, chunk).transpose(1, 0, 2),
       zeros)
+    if streams > 1:
+        blocks = blocks.reshape(
+            streams, n_blocks, N_CHANNELS, side, side
+        ).sum(axis=0)
     dense = blocks.transpose(1, 0, 2, 3).reshape(
         N_CHANNELS, n_blocks * block_cells
     )[:, :capacity]
@@ -187,7 +219,7 @@ def _channel_path(cells, chans, good, capacity, n_blocks, chunk,
 @functools.partial(
     jax.jit,
     static_argnames=("capacity", "chunk", "block_cells", "bad_frac",
-                     "slab", "interpret"),
+                     "slab", "interpret", "streams"),
 )
 def aggregate_sorted_keys_partitioned(
     sorted_keys,
@@ -198,6 +230,7 @@ def aggregate_sorted_keys_partitioned(
     bad_frac: int = 8,
     slab: int = DEFAULT_SLAB,
     interpret: bool | None = None,
+    streams: int = 1,
 ):
     """Count-only ``aggregate_sorted_keys`` on the partitioned kernel.
 
@@ -206,7 +239,11 @@ def aggregate_sorted_keys_partitioned(
     n_unique); slots past n_unique hold sentinel/zero; exact at ANY
     per-key fan-in (slab-wise f32 accumulation, f64 combine). ``slab``
     is a parameter so tests can exercise the multi-slab combine at
-    small sizes; it must be a multiple of ``chunk``.
+    small sizes; it must be a multiple of ``streams * chunk``.
+    ``streams`` splits each slab into contiguous sub-streams with
+    per-stream output slabs (see _channel_path; bit-identical results,
+    measured for grid pipelining on-chip before any default flips —
+    costs ``streams`` x the output-blocks buffer).
     """
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
@@ -217,8 +254,13 @@ def aggregate_sorted_keys_partitioned(
         keys = keys.astype(jnp.int64)
         sentinel = jnp.int64(sentinel)
     n = keys.shape[0]
-    if slab % chunk:
-        raise ValueError(f"slab {slab} must be a multiple of chunk {chunk}")
+    if streams < 1:
+        raise ValueError(f"streams must be >= 1, got {streams}")
+    if slab % (streams * chunk):
+        raise ValueError(
+            f"slab {slab} must be a multiple of streams*chunk "
+            f"({streams}*{chunk})"
+        )
     side = 1 << (block_cells.bit_length() // 2)
     if side * side != block_cells or side < 64:
         raise ValueError(
@@ -278,7 +320,7 @@ def aggregate_sorted_keys_partitioned(
             n_bad <= bad_cap,
             lambda c_, ch_, g_: _channel_path(
                 c_, ch_, g_, capacity, n_blocks, chunk, bad_cap,
-                interpret, block_cells, side,
+                interpret, block_cells, side, streams=streams,
             ),
             scatter_all,
             c_slab,

@@ -169,3 +169,34 @@ def test_matches_cascade_shift_reaggregation():
                                   np.asarray(want[0])[:nw])
     np.testing.assert_array_equal(np.asarray(got[1])[:nw],
                                   np.asarray(want[1])[:nw])
+
+
+def test_streams_variant_bit_equal():
+    """streams>1 (per-sub-stream output slabs, summed) must be
+    bit-identical to streams=1 and to the scatter contract — the
+    cascade analog of the window kernel's streams=8 default."""
+    rng = np.random.default_rng(11)
+    n = 1 << 14
+    keys = rng.choice(1 << 42, n // 16, replace=False)[
+        rng.integers(0, n // 16, n)
+    ].astype(np.int64)
+    for streams in (2, 4):
+        _diff(keys, n, slab=1 << 13, chunk=512, streams=streams)
+
+
+def test_streams_with_sentinel_padding():
+    rng = np.random.default_rng(12)
+    n = 3000  # pads to whole slabs/chunks internally
+    keys = np.concatenate([
+        rng.choice(1 << 40, n - 500, replace=False).astype(np.int64),
+        np.full(500, SENTINEL, np.int64),
+    ])
+    _diff(keys, n, slab=1 << 12, chunk=512, streams=4)
+
+
+def test_streams_rejects_bad_slab():
+    with pytest.raises(ValueError, match="streams"):
+        aggregate_sorted_keys_partitioned(
+            jnp.zeros(8, jnp.int64), 8, interpret=True,
+            slab=1 << 12, chunk=512, streams=3,
+        )

@@ -274,12 +274,22 @@ def main():
             ("cascade-level partitioned",
              jax.jit(lambda k, o: aggregate_sorted_keys_partitioned(
                  k, kn, sentinel=sent))),
+            ("cascade-level partitioned k=4",
+             jax.jit(lambda k, o: aggregate_sorted_keys_partitioned(
+                 k, kn, sentinel=sent, streams=4))),
             ("cascade-pyramid16 scatter",
              jax.jit(lambda k, o: pyramid_sparse_morton(
                  k, levels=16, capacity=kn)[-1])),
             ("cascade-pyramid16 partitioned",
              jax.jit(lambda k, o: pyramid_sparse_morton_partitioned(
                  k, levels=16, capacity=kn)[-1])),
+            # k-stream variant (per-sub-stream output slabs, summed):
+            # the window kernel's streams=8 default came from exactly
+            # this shape winning 2x; k=4 bounds the extra output
+            # buffer at 4 x capacity x 16B.
+            ("cascade-pyramid16 partitioned k=4",
+             jax.jit(lambda k, o: pyramid_sparse_morton_partitioned(
+                 k, levels=16, capacity=kn, streams=4)[-1])),
         ):
             if measured(name):
                 continue
