@@ -87,3 +87,13 @@ def test_segment_kernel_lowers_for_tpu():
     f = functools.partial(aggregate_sorted_keys_partitioned,
                           capacity=1 << 14, interpret=False)
     _export_tpu(f, jnp.asarray(keys))
+
+
+def test_segment_kernel_streams_lowers_for_tpu():
+    keys = np.sort(
+        np.random.default_rng(10).integers(0, 1 << 42, N).astype(np.int64)
+    )
+    f = functools.partial(aggregate_sorted_keys_partitioned,
+                          capacity=1 << 14, interpret=False,
+                          slab=1 << 12, chunk=512, streams=4)
+    _export_tpu(f, jnp.asarray(keys))
