@@ -230,6 +230,34 @@ def main() -> int:
                 if not ok:
                     failures += 1
 
+    # shard_map + pallas on the real chip: a 1-device mesh exercises
+    # the mesh kernels' Mosaic compile (pallas_call under shard_map,
+    # check_vma=False) that the 8-CPU-mesh tests can only run in
+    # interpret mode. Routing backend="partitioned" explicitly — auto
+    # picks it for this window anyway, but the artifact should name
+    # what it verified.
+    key = "mesh1|x64|partitioned"
+    if state.get(key) is not True:
+        from heatmap_tpu.parallel import bin_points_replicated, make_mesh
+
+        mesh1 = make_mesh(data=1, tile=1)
+        lat, lon = cases["clustered"]
+        dla = jnp.asarray(lat, jnp.float64)
+        dlo = jnp.asarray(lon, jnp.float64)
+        got = np.asarray(bin_points_replicated(
+            dla, dlo, win, mesh1, backend="partitioned"))
+        r, c, v = mercator.project_points(dla, dlo, win.zoom,
+                                          dtype=jnp.float64)
+        expected = np.asarray(bin_rowcol_window(r, c, win, valid=v))
+        ok = bool((got == expected).all())
+        _append_state(args.state, key, ok)
+        done += 1
+        print(json.dumps({"case": "mesh1", "x64": True,
+                          "backend": "partitioned", "bit_exact": ok}),
+              flush=True)
+        if not ok:
+            failures += 1
+
     # Multi-channel cascade segment-reduction kernel
     # (ops/sparse_partitioned.py): bit-exact vs aggregate_sorted_keys
     # under real Mosaic lowering. Interpret-mode tests pass; this is
@@ -254,7 +282,8 @@ def main() -> int:
             rng.choice(1 << 40, kn // 8, replace=False).astype(np.int64),
         ])),
     }
-    kcombos = [{}, {"block_cells": 1 << 12}, {"slab": 1 << 20}]
+    kcombos = [{}, {"block_cells": 1 << 12}, {"slab": 1 << 20},
+               {"streams": 4, "slab": 1 << 20}]
     for name, keys in kcases.items():
         todo = [kw for kw in kcombos
                 if state.get(f"{name}|{json.dumps(kw, sort_keys=True)}")
