@@ -252,6 +252,16 @@ def main():
     bl_dt = time.perf_counter() - t0
     bl_pts_per_sec = args.baseline_n / bl_dt
 
+    # Record what ACTUALLY ran, not what was requested: with --no-probe
+    # a missing TPU silently falls back to CPU inside JAX, and a CPU
+    # number labeled "tpu" would both corrupt the round artifact and
+    # overwrite real on-chip evidence in last_bench_tpu.json.
+    actual_platform = jax.devices()[0].platform
+    if device != "cpu" and actual_platform == "cpu":
+        device = "cpu"
+        fallback = "requested tpu; jax resolved cpu"
+        note = f"{note}; {fallback}" if note else fallback
+
     out = {
         "metric": f"points/sec binned into z0-z{args.zoom} tile pyramid",
         "value": round(pts_per_sec),

@@ -191,20 +191,32 @@ def cmd_run(args) -> int:
         # stays on the standard resumable path so reruns never change
         # an existing checkpoint's format mid-flight. --no-fast opts
         # out; --fast makes ineligibility a hard error instead.
-        from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
-        from heatmap_tpu.io.sources import CSVSource
-
-        src = open_source(args.input, read_value=False)
-        if isinstance(src, CSVSource) and not args.weighted:
+        # Sniff the spec kind BEFORE constructing anything: opening is
+        # not free (an .hmpb probe header-parses and mmaps the whole
+        # file), so ineligible kinds (synthetic:, jsonl:, ...) never pay
+        # for a probe, and a probe-opened source becomes the job source
+        # on every run that proceeds.
+        kind = args.input.partition(":")[0]
+        is_csv = kind == "csv" or args.input.endswith(".csv")
+        is_hmpb = kind == "hmpb" or args.input.endswith(".hmpb")
+        if is_csv and not args.weighted:
             try:
                 from heatmap_tpu.native import parse_csv_batches  # noqa: F401
-
-                fast_source = src.path
             except ImportError:
                 pass  # native decoder unavailable: per-row path
-        elif isinstance(src, (HMPBSource, HMPBDirSource)) and (
-                not args.weighted or getattr(src, "has_value", False)):
-            fast_source = src
+            else:
+                from heatmap_tpu.io.sources import CSVSource
+
+                src = open_source(args.input, read_value=False)
+                if isinstance(src, CSVSource):
+                    fast_source = src.path
+        elif is_hmpb:
+            from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
+
+            src = open_source(args.input, read_value=False)
+            if isinstance(src, (HMPBSource, HMPBDirSource)) and (
+                    not args.weighted or getattr(src, "has_value", False)):
+                fast_source = src
     if args.multihost:
         # Must run BEFORE anything that initializes the local backend —
         # the profiler's start_trace does — or jax.distributed.initialize

@@ -399,7 +399,7 @@ def bin_points_bandsharded(
     proj_dtype=None,
     dtype=None,
     send_capacity: int | None = None,
-    backend: str = "auto",
+    backend: str = "xla",
 ):
     """Tile-space-parallel binning: no device materializes the raster.
 
@@ -420,6 +420,12 @@ def bin_points_bandsharded(
     Smaller values save memory but silently drop points past the
     capacity — only use when the point distribution over bands is
     known to be balanced.
+
+    ``backend`` routes the band binning; unlike the replicated /
+    rowsharded kernels it defaults to "xla", not "auto": this function
+    needs tile >= 2 — i.e. real multi-chip hardware — so no 1-device
+    on-chip gate can verify its pallas routing (docs/DESIGN.md §9
+    verification ladder); opt in explicitly once a pod run verifies it.
     """
     T = mesh.shape[TILE_AXIS]
     D = mesh.shape[DATA_AXIS]

@@ -93,6 +93,7 @@ def make_update_step(config: StreamConfig, mesh=None):
             valid=valid,
             proj_dtype=config.proj_dtype,
             dtype=raster.dtype,
+            backend=config.backend,
         )
         return raster * decay + fresh
 

@@ -103,16 +103,23 @@ def main() -> int:
     lon[: n // 8] = rng.uniform(-180.0, 179.9, n // 8)
     cases["pileup"] = (lat, lon)
 
+    # Every combo names "streams" explicitly: checkpoint keys must not
+    # alias across DEFAULT_STREAMS flips (the round-2 1->8 flip turned
+    # the old "{}" key into a different configuration). The pre-flip
+    # "{}"/bare-tunable entries in existing state files recorded
+    # streams=1 runs and stay as history; the list below covers the
+    # flat-sort path explicitly plus the PRODUCTION default shape
+    # (streams=8) across the tunable grid.
     combos = [
-        {},  # defaults
-        {"block_cells": 1 << 12},
-        {"block_cells": 1 << 14},
-        {"chunk": 512},
-        {"chunk": 2048},
-        {"bad_frac": 32},
+        {"streams": 1},
         {"streams": 8},
         {"streams": 32},
+        {"streams": 8, "block_cells": 1 << 12},
         {"streams": 8, "block_cells": 1 << 14},
+        {"streams": 8, "chunk": 512},
+        {"streams": 8, "chunk": 2048},
+        {"streams": 8, "bad_frac": 32},
+        {"streams": 8, "bad_frac": 128},
     ]
     failures = 0
     done = 0
@@ -150,7 +157,7 @@ def main() -> int:
     # 2^22 points into one cell, so weights must be <= 3 to keep that
     # cell's sum (~3.7M * 3 = 11M) inside the exact range.
     w_int = jnp.asarray(rng.integers(0, 4, n).astype(np.float32))
-    weighted_combos = [{}, {"streams": 8}]
+    weighted_combos = [{"streams": 1}, {"streams": 8}]
     for name, (lat, lon) in cases.items():
         todo = [kw for kw in weighted_combos
                 if state.get(
@@ -191,7 +198,7 @@ def main() -> int:
 
     # Window kernels under x64, f64 projection -> int64 rows/cols,
     # exactly as run_job hands them to the binning backend.
-    x64_combos = [{}, {"streams": 8}]
+    x64_combos = [{"streams": 1}, {"streams": 8}]
     for name in ("clustered", "pileup"):
         lat, lon = cases[name]
         todo = [kw for kw in x64_combos
@@ -236,25 +243,39 @@ def main() -> int:
     # interpret mode. Routing backend="partitioned" explicitly — auto
     # picks it for this window anyway, but the artifact should name
     # what it verified.
-    key = "mesh1|x64|partitioned"
-    if state.get(key) is not True:
-        from heatmap_tpu.parallel import bin_points_replicated, make_mesh
+    from heatmap_tpu.parallel import (
+        bin_points_replicated,
+        bin_points_rowsharded,
+        make_mesh,
+    )
 
-        mesh1 = make_mesh(data=1, tile=1)
-        lat, lon = cases["clustered"]
-        dla = jnp.asarray(lat, jnp.float64)
-        dlo = jnp.asarray(lon, jnp.float64)
-        got = np.asarray(bin_points_replicated(
-            dla, dlo, win, mesh1, backend="partitioned"))
-        r, c, v = mercator.project_points(dla, dlo, win.zoom,
-                                          dtype=jnp.float64)
-        expected = np.asarray(bin_rowcol_window(r, c, win, valid=v))
-        ok = bool((got == expected).all())
+    mesh1 = make_mesh(data=1, tile=1)
+    lat, lon = cases["clustered"]
+    dla = jnp.asarray(lat, jnp.float64)
+    dlo = jnp.asarray(lon, jnp.float64)
+    mesh_fns = {
+        # psum over a pallas output (the replicated merge) and
+        # psum_scatter over one (the rowsharded merge) are different
+        # Mosaic/collective compositions; gate both.
+        "mesh1|x64|replicated-partitioned": lambda: bin_points_replicated(
+            dla, dlo, win, mesh1, backend="partitioned"),
+        "mesh1|x64|rowsharded-partitioned": lambda: bin_points_rowsharded(
+            dla, dlo, win, mesh1, backend="partitioned"),
+    }
+    expected_mesh = None
+    for key, fn in mesh_fns.items():
+        if state.get(key) is True:
+            done += 1
+            continue
+        if expected_mesh is None:
+            r, c, v = mercator.project_points(dla, dlo, win.zoom,
+                                              dtype=jnp.float64)
+            expected_mesh = np.asarray(bin_rowcol_window(r, c, win, valid=v))
+        got = np.asarray(fn())
+        ok = bool((got == expected_mesh).all())
         _append_state(args.state, key, ok)
         done += 1
-        print(json.dumps({"case": "mesh1", "x64": True,
-                          "backend": "partitioned", "bit_exact": ok}),
-              flush=True)
+        print(json.dumps({"case": key, "bit_exact": ok}), flush=True)
         if not ok:
             failures += 1
 
