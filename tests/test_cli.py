@@ -254,6 +254,41 @@ class TestEndToEnd:
         # The checkpoint run actually wrote checkpoints.
         assert any((tmp_path / "ck").iterdir())
 
+    def test_hmpb_auto_routes_fast(self, tmp_path):
+        """An hmpb input with no flag must take the fast path and match
+        the --no-fast standard path blob-for-blob (mirror of the CSV
+        auto-routing test; checkpoint runs must stay standard)."""
+        from heatmap_tpu.io import JSONLBlobSink
+        from heatmap_tpu.io.hmpb import convert_to_hmpb
+
+        hp = tmp_path / "pts.hmpb"
+        convert_to_hmpb("synthetic:2000:5", str(hp))
+        outs = {}
+        ingests = {}
+        for name, extra in (
+            ("auto", []),
+            ("plain", ["--no-fast"]),
+            ("ckpt", ["--checkpoint-dir", str(tmp_path / "ck")]),
+        ):
+            out = tmp_path / f"{name}.jsonl"
+            r = _run_cli(
+                "run", "--backend", "cpu",
+                "--input", f"hmpb:{hp}",
+                "--output", f"jsonl:{out}",
+                "--detail-zoom", "11", "--min-detail-zoom", "9",
+                *extra,
+            )
+            assert r.returncode == 0, r.stderr
+            outs[name] = JSONLBlobSink.load(str(out))
+            ingests[name] = json.loads(
+                r.stdout.strip().splitlines()[-1])["ingest"]
+        assert outs["auto"] == outs["plain"] == outs["ckpt"]
+        assert ingests["auto"] == "fast"
+        assert ingests["plain"] == "standard"
+        # --checkpoint-dir keeps the resumable standard path (format
+        # stability for existing checkpoints).
+        assert ingests["ckpt"] == "standard"
+
     def test_stream_synthetic_decay_and_resume(self, tmp_path):
         out = tmp_path / "live"
         ck = tmp_path / "ck"
