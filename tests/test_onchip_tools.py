@@ -1,0 +1,93 @@
+"""The on-chip evidence machinery itself (tools/onchip_runner.py
+helpers, bench.py last-TPU persistence): every hardware measurement
+flows through these, so a bug here silently corrupts or discards a
+round's evidence. All CPU-testable."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_runner():
+    spec = importlib.util.spec_from_file_location(
+        "onchip_runner", os.path.join(REPO, "tools", "onchip_runner.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+runner = _load_runner()
+
+
+def test_last_json_ignores_previous_attempts(tmp_path):
+    log = tmp_path / "item.log"
+    log.write_text(
+        "===== attempt at 2026-07-31 01:00:00 =====\n"
+        + json.dumps({"device": "tpu", "value": 111}) + "\n"
+        + "===== attempt at 2026-07-31 02:00:00 =====\n"
+        + "some warning line\n"
+    )
+    # The stale success line from attempt 1 must not satisfy the check.
+    assert runner._last_json_with(str(log), "device") is None
+    assert runner._check_bench(str(log)) is False
+
+
+def test_last_json_takes_last_matching_line(tmp_path):
+    log = tmp_path / "item.log"
+    log.write_text(
+        "===== attempt at 2026-07-31 02:00:00 =====\n"
+        + json.dumps({"device": "cpu", "value": 1}) + "\n"
+        + json.dumps({"device": "tpu", "value": 2}) + "\n"
+        + "{torn json\n"
+    )
+    rec = runner._last_json_with(str(log), "device")
+    assert rec == {"device": "tpu", "value": 2}
+    assert runner._check_bench(str(log)) is True
+
+
+def test_check_bench_rejects_cpu_fallback_notes(tmp_path):
+    log = tmp_path / "item.log"
+    log.write_text(
+        "===== attempt at x =====\n"
+        + json.dumps({"device": "tpu", "note": "tpu-unavailable"}) + "\n"
+    )
+    # A noted fallback must not count as on-chip evidence.
+    assert runner._check_bench(str(log)) is False
+
+
+def test_done_json_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(runner, "STATE_DIR", str(tmp_path))
+    runner.save_done({"bench": {"at": "now"}})
+    assert runner.load_done() == {"bench": {"at": "now"}}
+    # Corrupt file -> empty dict, not a crash.
+    (tmp_path / "done.json").write_text("{torn")
+    assert runner.load_done() == {}
+
+
+def test_bench_last_tpu_roundtrip(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py")
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    monkeypatch.chdir(tmp_path)
+    assert bench._load_last_tpu() is None
+    bench._save_last_tpu({"value": 123, "unit": "points/sec",
+                          "device": "tpu"})
+    rec = bench._load_last_tpu()
+    assert rec["value"] == 123 and "measured" in rec
+    # A record with the wrong unit (corrupt/foreign file) is rejected.
+    with open(bench._LAST_TPU_PATH, "w") as f:
+        json.dump({"value": 1, "unit": "bananas"}, f)
+    assert bench._load_last_tpu() is None
+
+
+def test_runlist_items_reference_existing_tools():
+    for item in runner.runlist():
+        script = item["cmd"][1]
+        if script.endswith(".py") and script != sys.executable:
+            assert os.path.exists(os.path.join(REPO, script)), script
