@@ -118,6 +118,22 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     detail zoom ``config.detail_zoom - i``.
     """
     ck = composite_keys(codes, slots, config.detail_zoom, n_slots)
+    # Zoom-clamped per-level capacities: level l's key space is at most
+    # n_slots * 4^(detail_zoom - l) — a STATIC bound that no data can
+    # exceed — so coarse levels get small arrays instead of n-sized
+    # padding. On the scatter backend (which feeds each level from the
+    # previous level's capacity-sized aggregates) this shrinks the deep
+    # half of the cascade's compute outright; on the partitioned
+    # backend it shrinks the per-level output buffers. Unlike
+    # adaptive_capacity this costs no extra compiles and no device
+    # syncs (everything stays shape-static). Callers passing an
+    # explicit per-level LIST keep full control.
+    if capacity is None or isinstance(capacity, int):
+        base = capacity or max(int(codes.shape[0]), 1)
+        capacity = [
+            min(base, n_slots << (2 * (config.detail_zoom - lvl)))
+            for lvl in range(config.n_levels + 1)
+        ]
     if backend == "partitioned":
         slot_bits = max(1, int(np.ceil(np.log2(max(n_slots, 2)))))
         if 2 * config.detail_zoom + slot_bits > 60:

@@ -645,3 +645,31 @@ def test_merge_sorted_level_int32_slots_wide_codes():
     assert m["g"].tolist() == [3, 200, 299]
     assert m["code"].tolist() == [1, (1 << 42) - 5, (1 << 42) - 5]
     assert m["value"].tolist() == [6.0, 2.0, 7.0]
+
+
+def test_zoom_clamped_capacities_match_unclamped():
+    """build_cascade's static per-level capacity clamp (n_slots * 4^zoom
+    bounds the key space) must not change any aggregate — only array
+    padding. Uses a LOW detail zoom so the clamp actually bites."""
+    import jax.numpy as jnp
+
+    from heatmap_tpu.pipeline import cascade as cascade_mod
+
+    rng = np.random.default_rng(21)
+    n, n_slots = 20_000, 7
+    cfg = cascade_mod.CascadeConfig(detail_zoom=6, min_detail_zoom=2,
+                                    result_delta=2)
+    codes = jnp.asarray(rng.integers(0, 1 << 12, n), jnp.int64)
+    slots = jnp.asarray(rng.integers(0, n_slots, n), jnp.int32)
+
+    clamped = cascade_mod.build_cascade(codes, slots, cfg, n_slots)
+    explicit = cascade_mod.build_cascade(
+        codes, slots, cfg, n_slots,
+        capacity=[n] * (cfg.n_levels + 1))
+    for lvl, ((cu, cs, cn), (eu, es, en)) in enumerate(zip(clamped, explicit)):
+        zoom = cfg.detail_zoom - lvl
+        assert cu.shape[0] <= n_slots << (2 * zoom)
+        m = int(en)
+        assert int(cn) == m, lvl
+        np.testing.assert_array_equal(np.asarray(cu)[:m], np.asarray(eu)[:m])
+        np.testing.assert_array_equal(np.asarray(cs)[:m], np.asarray(es)[:m])
