@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Evaluate the pending measurement-gated decisions (PERF_NOTES.md)
+against the collected on-chip evidence.
+
+Reads onchip_state/sweep.jsonl + verify.jsonl and prints one JSON line
+per decision rule: satisfied / refuted / insufficient-data, with the
+numbers that decided it. Read-only — flips stay deliberate, human
+commits; this tool just removes the re-derivation work (and the
+temptation to flip on a misremembered number).
+
+    PYTHONPATH=. python tools/apply_decisions.py [--state-dir onchip_state]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def _load_jsonl(path):
+    out = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if "config" in rec:  # sweep rows
+                    out[rec["config"]] = rec
+                else:  # verify rows: {key: bool}
+                    out.update(rec)
+    except OSError:
+        pass
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--state-dir", default="onchip_state")
+    args = ap.parse_args()
+    sweep = _load_jsonl(os.path.join(args.state_dir, "sweep.jsonl"))
+    verify = _load_jsonl(os.path.join(args.state_dir, "verify.jsonl"))
+
+    def ms(name):
+        rec = sweep.get(name)
+        return rec.get("ms") if rec else None
+
+    decisions = []
+
+    # Rule (a): weighted large-window routing flips to partitioned only
+    # if weighted k=8 beats the weighted scatter (k=1 already lost).
+    w_scatter, w_part8 = ms("xla-scatter weighted"), ms("partitioned weighted k=8")
+    if w_scatter is None or w_part8 is None:
+        verdict = "insufficient-data"
+    elif w_part8 < w_scatter:
+        verdict = "FLIP (_pick_backend: route weighted large windows to partitioned)"
+    else:
+        verdict = "keep scatter"
+    decisions.append({
+        "decision": "weighted-routing",
+        "verdict": verdict,
+        "weighted_scatter_ms": w_scatter,
+        "weighted_partitioned_k8_ms": w_part8,
+    })
+
+    # Rule (b): cascade_backend default flips to partitioned for count
+    # jobs only if the pyramid16 A/B wins AND the seg-* verify cases
+    # are bit-exact under Mosaic.
+    seg_keys = [k for k in verify if k.startswith("seg-")]
+    seg_ok = bool(seg_keys) and all(verify[k] is True for k in seg_keys)
+    c_scatter = ms("cascade-pyramid16 scatter")
+    candidates = {
+        "partitioned": ms("cascade-pyramid16 partitioned"),
+        "partitioned k=4": ms("cascade-pyramid16 partitioned k=4"),
+    }
+    best_name, best_ms = None, None
+    for name, val in candidates.items():
+        if val is not None and (best_ms is None or val < best_ms):
+            best_name, best_ms = name, val
+    if c_scatter is None or best_ms is None:
+        verdict = "insufficient-data"
+    elif not seg_ok:
+        verdict = ("blocked: seg-* verify cases not all bit-exact"
+                   if seg_keys else "blocked: no seg-* verify results")
+    elif best_ms < c_scatter:
+        verdict = (f"FLIP (BatchJobConfig.cascade_backend -> "
+                   f"'{best_name}' for count jobs)")
+    else:
+        verdict = "keep scatter"
+    decisions.append({
+        "decision": "cascade-backend",
+        "verdict": verdict,
+        "pyramid16_scatter_ms": c_scatter,
+        "pyramid16_partitioned_ms": candidates["partitioned"],
+        "pyramid16_partitioned_k4_ms": candidates["partitioned k=4"],
+        "seg_verify_count": len(seg_keys),
+        "seg_verify_all_ok": seg_ok,
+    })
+
+    # Rule (c): bad_frac default if the tail-cap win composes with k=8.
+    k8 = ms("partitioned bc=65536 chunk=1024 bf=8 k=8")
+    k8_bf32 = ms("partitioned bc=65536 chunk=1024 bf=32 k=8")
+    k8_bf128 = ms("partitioned bc=65536 chunk=1024 bf=128 k=8")
+    best_bf, best_bf_ms = 8, k8
+    for bf, val in ((32, k8_bf32), (128, k8_bf128)):
+        if val is not None and best_bf_ms is not None and val < best_bf_ms:
+            best_bf, best_bf_ms = bf, val
+    if k8 is None or (k8_bf32 is None and k8_bf128 is None):
+        verdict = "insufficient-data"
+    elif best_bf != 8:
+        verdict = f"FLIP (partitioned default bad_frac -> {best_bf})"
+    else:
+        verdict = "keep bad_frac=8"
+    decisions.append({
+        "decision": "bad-frac-default",
+        "verdict": verdict,
+        "k8_bf8_ms": k8, "k8_bf32_ms": k8_bf32, "k8_bf128_ms": k8_bf128,
+    })
+
+    for rec in decisions:
+        print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
