@@ -91,3 +91,58 @@ def test_runlist_items_reference_existing_tools():
         script = item["cmd"][1]
         if script.endswith(".py") and script != sys.executable:
             assert os.path.exists(os.path.join(REPO, script)), script
+
+
+def _load_decisions():
+    spec = importlib.util.spec_from_file_location(
+        "apply_decisions", os.path.join(REPO, "tools", "apply_decisions.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_decision_rules_fire_on_synthetic_evidence(tmp_path, capsys, monkeypatch):
+    dec = _load_decisions()
+    with open(tmp_path / "sweep.jsonl", "w") as f:
+        for rec in [
+            {"config": "xla-scatter weighted", "ms": 400.0},
+            {"config": "partitioned weighted k=8", "ms": 300.0},
+            {"config": "cascade-pyramid16 scatter", "ms": 5000.0},
+            {"config": "cascade-pyramid16 partitioned", "ms": 1000.0},
+            {"config": "cascade-pyramid16 partitioned k=4", "ms": 800.0},
+            {"config": "partitioned bc=65536 chunk=1024 bf=8 k=8", "ms": 197.0},
+            {"config": "partitioned bc=65536 chunk=1024 bf=128 k=8", "ms": 180.0},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+    with open(tmp_path / "verify.jsonl", "w") as f:
+        f.write(json.dumps({"seg-clustered|{}": True}) + "\n")
+        f.write(json.dumps({"seg-pileup|{}": True}) + "\n")
+    monkeypatch.setattr(sys, "argv",
+                        ["apply_decisions", "--state-dir", str(tmp_path)])
+    dec.main()
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    by = {r["decision"]: r for r in lines}
+    assert by["weighted-routing"]["verdict"].startswith("FLIP")
+    assert "partitioned k=4" in by["cascade-backend"]["verdict"]
+    assert "128" in by["bad-frac-default"]["verdict"]
+
+
+def test_decision_rules_block_on_failed_verify(tmp_path, capsys, monkeypatch):
+    dec = _load_decisions()
+    with open(tmp_path / "sweep.jsonl", "w") as f:
+        f.write(json.dumps({"config": "cascade-pyramid16 scatter",
+                            "ms": 5000.0}) + "\n")
+        f.write(json.dumps({"config": "cascade-pyramid16 partitioned",
+                            "ms": 1000.0}) + "\n")
+    with open(tmp_path / "verify.jsonl", "w") as f:
+        f.write(json.dumps({"seg-clustered|{}": False}) + "\n")
+    monkeypatch.setattr(sys, "argv",
+                        ["apply_decisions", "--state-dir", str(tmp_path)])
+    dec.main()
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    by = {r["decision"]: r for r in lines}
+    # A faster kernel that is not bit-exact must stay blocked.
+    assert by["cascade-backend"]["verdict"].startswith("blocked")
