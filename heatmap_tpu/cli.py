@@ -621,14 +621,33 @@ def cmd_info(args) -> int:
     jax = _init_backend(args)
     from heatmap_tpu import native
 
-    devs = jax.devices()
+    # Device discovery in a KILLABLE worker thread: on the accelerator
+    # backend, jax.devices() blocks inside backend init when the relay
+    # tunnel is down, and an `info` command must never hang a terminal
+    # (discovered against a dead relay 2026-07-31 — bench.py probes for
+    # exactly the same reason).
+    import threading
+
+    dev_info = {}
+
+    def _probe():
+        devs = jax.devices()
+        dev_info.update(platform=devs[0].platform, n_devices=len(devs),
+                        n_processes=jax.process_count())
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(timeout=args.probe_timeout)
+    if t.is_alive():
+        dev_info = {"platform": "unreachable", "n_devices": 0,
+                    "note": f"backend init exceeded {args.probe_timeout:.0f}s "
+                            "(accelerator relay down?); rerun with "
+                            "--backend cpu for host info"}
     print(
         json.dumps(
             {
                 "backend": args.backend,
-                "platform": devs[0].platform,
-                "n_devices": len(devs),
-                "n_processes": jax.process_count(),
+                **dev_info,
                 "x64": bool(jax.config.jax_enable_x64),
                 "native": native.available(),
                 "version": __import__("heatmap_tpu").__version__,
@@ -752,6 +771,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_info = sub.add_parser("info", help="resolved config + devices")
     _add_backend_flags(p_info)
+    p_info.add_argument("--probe-timeout", type=float, default=20.0,
+                        help="seconds to wait for device discovery before "
+                        "reporting the backend unreachable (a dead "
+                        "accelerator relay otherwise hangs forever)")
     p_info.set_defaults(fn=cmd_info)
     return ap
 
