@@ -33,6 +33,12 @@ def _add_backend_flags(p):
         "(default), cpu = force host platform",
     )
     p.add_argument(
+        "--device-timeout", type=float, default=60.0,
+        help="seconds to wait for the accelerator to answer before "
+        "failing the command (0 disables the probe; a dead relay "
+        "otherwise hangs backend init forever)",
+    )
+    p.add_argument(
         "--no-x64",
         action="store_true",
         help="keep JAX in 32-bit mode (the composite-key cascade needs "
@@ -47,6 +53,33 @@ def _init_backend(args):
         jax.config.update("jax_platforms", "cpu")
     if not args.no_x64:
         jax.config.update("jax_enable_x64", True)
+    if args.backend != "cpu" and getattr(args, "device_timeout", 0) > 0:
+        # Fail FAST and loud when the accelerator is unreachable:
+        # backend init blocks forever on a dead relay tunnel, which
+        # turns "the device is down" into a silent multi-hour hang in
+        # the middle of a job submission. No silent CPU fallback here —
+        # a job the user pinned to tpu must not quietly produce CPU
+        # results (bench.py's fallback is different: an artifact must
+        # always exist). The probe thread is daemonized; if it never
+        # returns it dies with the process.
+        import threading
+
+        probe_ok = threading.Event()
+
+        def _probe():
+            jax.devices()
+            probe_ok.set()
+
+        t = threading.Thread(target=_probe, daemon=True)
+        t.start()
+        t.join(timeout=args.device_timeout)
+        if not probe_ok.is_set():
+            raise SystemExit(
+                f"accelerator backend did not answer within "
+                f"{args.device_timeout:.0f}s (relay/tunnel down?) — "
+                "retry later, raise --device-timeout, or run with "
+                "--backend cpu"
+            )
     return jax
 
 
@@ -618,6 +651,9 @@ def cmd_convert(args) -> int:
 
 
 def cmd_info(args) -> int:
+    # info reports unreachability as structured JSON (below) rather
+    # than the fail-fast SystemExit the job commands want.
+    args.device_timeout = 0.0
     jax = _init_backend(args)
     from heatmap_tpu import native
 
