@@ -53,7 +53,12 @@ def _init_backend(args):
         jax.config.update("jax_platforms", "cpu")
     if not args.no_x64:
         jax.config.update("jax_enable_x64", True)
-    if args.backend != "cpu" and getattr(args, "device_timeout", 0) > 0:
+    if (args.backend != "cpu" and getattr(args, "device_timeout", 0) > 0
+            and not getattr(args, "multihost", False)):
+        # NOTE the multihost exclusion: the probe initializes the LOCAL
+        # backend, after which jax.distributed.initialize() fails
+        # (parallel/multihost.py ordering contract) — pods fail loudly
+        # on a dead relay inside distributed init anyway.
         # Fail FAST and loud when the accelerator is unreachable:
         # backend init blocks forever on a dead relay tunnel, which
         # turns "the device is down" into a silent multi-hour hang in
