@@ -33,10 +33,13 @@ def _add_backend_flags(p):
         "(default), cpu = force host platform",
     )
     p.add_argument(
-        "--device-timeout", type=float, default=60.0,
+        "--device-timeout", type=float, default=180.0,
         help="seconds to wait for the accelerator to answer before "
         "failing the command (0 disables the probe; a dead relay "
-        "otherwise hangs backend init forever)",
+        "otherwise hangs backend init forever). Generous by default: "
+        "relay round-trip cost varies 2-5x day to day, and a job "
+        "false-failed on a slow-but-alive backend costs more than a "
+        "longer wait on a dead one",
     )
     p.add_argument(
         "--no-x64",
@@ -112,7 +115,9 @@ def _add_run_flags(p):
                    metavar="N",
                    help="bound peak memory: run the cascade per chunk of "
                    "at most N points and merge per-level aggregates "
-                   "(exact; for sources larger than host RAM)")
+                   "(exact). Default: auto — sources estimated larger "
+                   "than host RAM take the bounded path with a "
+                   "RAM-derived chunk; 0 forces single-shot")
     p.add_argument("--capacity", type=int, default=None,
                    help="unique-key capacity for the device cascade "
                    "(default: #emissions)")
@@ -191,12 +196,14 @@ def cmd_run(args) -> int:
         )
     except ValueError as e:
         raise SystemExit(str(e)) from e
-    if args.max_points_in_flight is not None and args.checkpoint_dir:
+    # 0 means "explicitly single-shot", which composes with both
+    # checkpointing and multihost; only a positive bound conflicts.
+    if args.max_points_in_flight and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "
                          "batch boundaries)")
     if args.multihost and (args.fast or args.checkpoint_dir
-                           or args.max_points_in_flight is not None):
+                           or args.max_points_in_flight):
         raise SystemExit("--multihost runs the standard job path only "
                          "(not --fast / --checkpoint-dir / "
                          "--max-points-in-flight)")
@@ -248,6 +255,7 @@ def cmd_run(args) -> int:
                 src = open_source(args.input, read_value=False)
                 if isinstance(src, CSVSource):
                     fast_source = src.path
+                src.close()  # only the path is kept either way
         elif is_hmpb:
             from heatmap_tpu.io.hmpb import HMPBDirSource, HMPBSource
 
@@ -255,6 +263,11 @@ def cmd_run(args) -> int:
             if isinstance(src, (HMPBSource, HMPBDirSource)) and (
                     not args.weighted or getattr(src, "has_value", False)):
                 fast_source = src
+            else:
+                # Probe result discarded (e.g. weighted without a value
+                # column): unmap now — the standard path re-opens the
+                # input itself.
+                src.close()
     if args.multihost:
         # Must run BEFORE anything that initializes the local backend —
         # the profiler's start_trace does — or jax.distributed.initialize
@@ -284,12 +297,11 @@ def cmd_run(args) -> int:
             elif args.multihost:
                 from heatmap_tpu.parallel import run_job_multihost
 
-                blobs = run_job_multihost(open_source(
-                                              args.input,
-                                              read_value=args.weighted,
-                                          ), sink,
-                                          config,
-                                          batch_size=args.batch_size)
+                blobs = run_job_multihost(
+                    open_source(args.input, read_value=args.weighted),
+                    sink, config, batch_size=args.batch_size,
+                    max_points_in_flight=args.max_points_in_flight,
+                )
             else:
                 blobs = run_job(open_source(args.input,
                                             read_value=args.weighted),
@@ -301,7 +313,9 @@ def cmd_run(args) -> int:
         print(get_tracer().format_report(), file=sys.stderr)
     summary = {"seconds": round(dt, 3), "output": args.output,
                "ingest": "fast" if fast_source is not None else "standard"}
-    if isinstance(blobs, dict) and blobs.get("egress") == "levels":
+    if isinstance(blobs, dict) and str(
+            blobs.get("egress", "")).startswith("levels"):
+        # "levels" (columnar) and "levels-sharded" (multihost columnar)
         summary["levels"] = blobs["levels"]
         summary["rows"] = blobs["rows"]
     else:
@@ -657,7 +671,13 @@ def cmd_convert(args) -> int:
 
 def cmd_info(args) -> int:
     # info reports unreachability as structured JSON (below) rather
-    # than the fail-fast SystemExit the job commands want.
+    # than the fail-fast SystemExit the job commands want; an explicit
+    # positive --device-timeout acts as the probe timeout (both flags
+    # name the same wait here — honoring it beats silently preferring
+    # --probe-timeout). 0 keeps its documented "no fail-fast probe"
+    # meaning: info's own discovery probe stays on --probe-timeout.
+    if args.device_timeout:
+        args.probe_timeout = args.device_timeout
     args.device_timeout = 0.0
     jax = _init_backend(args)
     from heatmap_tpu import native
@@ -816,7 +836,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="seconds to wait for device discovery before "
                         "reporting the backend unreachable (a dead "
                         "accelerator relay otherwise hangs forever)")
-    p_info.set_defaults(fn=cmd_info)
+    # info never uses the fail-fast job probe; an explicitly-passed
+    # --device-timeout is honored as the probe timeout instead of
+    # silently ignored (None = flag not given).
+    p_info.set_defaults(fn=cmd_info, device_timeout=None)
     return ap
 
 

@@ -194,6 +194,18 @@ class HMPBSource:
     def _col(self, name, lo, hi):
         return self._cols[name][lo:hi]
 
+    def close(self) -> None:
+        """Release the file map. The source yields no batches after
+        this. Drops the source's own references only — the map is
+        unmapped by refcount immediately when no batch views escaped
+        (the cli probe case), or as soon as outstanding zero-copy batch
+        views die. Never force-closes the mmap: numpy memmap views
+        don't pin the buffer against ``mmap.close()``, so forcing it
+        would turn a held view into a segfault."""
+        self._cols = {}
+        self._mm = None
+        self.n = 0  # closed source iterates as empty, not KeyError
+
     def fast_batches(self, batch_size: int = 1 << 20):
         sent_names = False
         for lo in range(0, self.n, batch_size):
@@ -321,25 +333,34 @@ class HMPBDirSource:
                      == np.arange(len(src.names))).all()
                 )
             )
-            for b in src.fast_batches(batch_size):
-                routed = np.asarray(b["routed"], np.int32)
-                if not identity:
-                    routed = np.where(
-                        routed >= 0,
-                        local_to_global[np.maximum(routed, 0)], -1,
-                    ).astype(np.int32)
-                out = {
-                    "latitude": b["latitude"],
-                    "longitude": b["longitude"],
-                    "timestamp": b["timestamp"],
-                    "routed": routed,
-                    "background": b["background"],
-                    "new_group_names": names[emitted:],
-                }
-                if "value" in b:
-                    out["value"] = b["value"]
-                yield out
-                emitted = len(names)
+            try:
+                for b in src.fast_batches(batch_size):
+                    routed = np.asarray(b["routed"], np.int32)
+                    if not identity:
+                        routed = np.where(
+                            routed >= 0,
+                            local_to_global[np.maximum(routed, 0)], -1,
+                        ).astype(np.int32)
+                    out = {
+                        "latitude": b["latitude"],
+                        "longitude": b["longitude"],
+                        "timestamp": b["timestamp"],
+                        "routed": routed,
+                        "background": b["background"],
+                        "new_group_names": names[emitted:],
+                    }
+                    if "value" in b:
+                        out["value"] = b["value"]
+                    yield out
+                    emitted = len(names)
+            finally:
+                # Unmap each shard as soon as its batches are consumed
+                # instead of accumulating every file's map until GC
+                # (close tolerates consumers still holding batch views).
+                src.close()
+
+    def close(self) -> None:
+        """No held maps: per-file sources open and close per iteration."""
 
     def range_batches(self, index: int, batch_size: int = 1 << 20):
         """String-column batches of ONE file (deterministic

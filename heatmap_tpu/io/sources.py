@@ -74,6 +74,10 @@ class Source:
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         raise NotImplementedError
 
+    def close(self) -> None:
+        """Release held resources (mmaps, connections). Base: no-op —
+        most sources open per-iteration; HMPBSource holds a file map."""
+
     def rows(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         """Row-dict view (compat with pipeline.batch.load_rows and the
         reference's per-row mappers). Slow path; prefer ``batches``."""

@@ -63,6 +63,15 @@ DEFAULT_CHUNK = 1024
 #: visit-run sequence. streams=8 over 32: same speed, fewer slabs
 #: (less zero-padding and a smaller output-blocks buffer).
 DEFAULT_STREAMS = 8
+
+#: Cap on the summed per-stream output-slab footprint (bytes). Each
+#: stream accumulates its own (n_blocks * block_cells) f32 slab, so
+#: streams multiplies output memory x8 by default; a window near the
+#: int32 cell-id cap (~8 GiB of cells) fits HBM at streams=1 but not
+#: x8. 4 GiB leaves the measured headline configs (z15 window, 256 MiB
+#: slab -> 16 streams allowed) untouched while clamping the giant-
+#: window tail down to what fits.
+STREAM_SLAB_BUDGET = 4 << 30
 #: Cells per aligned output block (a side x side one-hot factor pair).
 #: Smaller blocks cut the per-point one-hot construction (VPU, 2*side
 #: compares+casts per point) and the MXU MACs quadratically, at the
@@ -288,6 +297,15 @@ def _partitioned_path(s2, good2, n_blocks, hw, chunk,
     return dense.astype(jnp.int32) + tail
 
 
+def clamp_streams(streams: int, window: Window,
+                  block_cells: int = DEFAULT_BLOCK_CELLS) -> int:
+    """Largest stream count <= ``streams`` whose summed output slabs
+    fit STREAM_SLAB_BUDGET for this window (always >= 1)."""
+    hw = window.height * window.width
+    slab_bytes = -(-hw // block_cells) * block_cells * 4
+    return max(1, min(streams, STREAM_SLAB_BUDGET // max(slab_bytes, 1)))
+
+
 def bin_rowcol_window_partitioned(
     row,
     col,
@@ -320,11 +338,18 @@ def bin_rowcol_window_partitioned(
     VMEM-resident), each accumulating its own output-block slab, summed
     at the end — same raster bit-for-bit, different sort-cost/memory
     tradeoff. streams=1 is the flat-sort baseline.
+
+    ``streams`` is clamped so the summed per-stream output slabs
+    (streams * n_blocks * block_cells f32, ~32 B/cell at the x8
+    default) stay under STREAM_SLAB_BUDGET: windows near the int32
+    cell-id cap fit HBM at streams=1 and must not OOM just because
+    backend="auto" routed here with the streams default.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform == "cpu"
     if dtype is None:
         dtype = jnp.int32 if weights is None else jnp.float32
+    streams = clamp_streams(streams, window, block_cells)
     return _bin_partitioned_jit(
         row, col, window, weights, valid, chunk=chunk, bad_frac=bad_frac,
         interpret=interpret, dtype=dtype, block_cells=block_cells,

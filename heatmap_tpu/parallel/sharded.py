@@ -153,11 +153,12 @@ def bin_points_rowsharded(
 def pyramid_rowsharded(raster, levels: int, mesh: Mesh):
     """Pyramid over a row-sharded raster (output of bin_points_rowsharded).
 
-    Levels coarsen locally while every device's row block stays evenly
-    divisible; the remaining coarse levels run replicated after one
-    ``all_gather``. Returns ``levels+1`` rasters: the first
-    ``local_levels+1`` row-sharded, the rest replicated — callers can
-    inspect ``.sharding`` or just use the values.
+    Levels coarsen locally (vma-checked shard_map) while every device's
+    row block stays evenly divisible; the remaining coarse levels run
+    as plain jit ops on the by-then-tiny global array, with GSPMD
+    choosing their layout. Returns ``levels+1`` rasters: the first
+    ``local_levels+1`` row-sharded; for the trailing levels use the
+    VALUES, not ``.sharding`` — their placement is the compiler's.
     """
     axes, ndev = _shard_axes(mesh)
     h, w = raster.shape
@@ -172,23 +173,20 @@ def pyramid_rowsharded(raster, levels: int, mesh: Mesh):
         for _ in range(local_levels):
             block = pyramid_ops.coarsen_raster(block)
             outs.append(block)
-        if gather_levels:
-            full = lax.all_gather(block, axes, axis=0, tiled=True)
-            for _ in range(gather_levels):
-                full = pyramid_ops.coarsen_raster(full)
-                outs.append(full)
         return tuple(outs)
 
-    out_specs = tuple(
-        [P(axes)] * (local_levels + 1) + [P()] * gather_levels
-    )
-    # Outputs after the all_gather are replicated by construction; VMA
-    # can't infer that statically, hence check_vma=False.
-    fn = jax.shard_map(
-        body, mesh=mesh, in_specs=(P(axes),), out_specs=out_specs,
-        check_vma=False,
-    )
-    return list(fn(raster))
+    out_specs = tuple([P(axes)] * (local_levels + 1))
+    # vma-checked: every in-shard_map output is genuinely row-sharded.
+    # The remaining coarse levels (shard rows no longer divisible by 2)
+    # run outside as plain jit ops on the global array — GSPMD gathers
+    # the by-then-tiny raster instead of an explicit all_gather.
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(axes),), out_specs=out_specs)
+    outs = list(fn(raster))
+    full = outs[-1]
+    for _ in range(gather_levels):
+        full = pyramid_ops.coarsen_raster(full)
+        outs.append(full)
+    return outs
 
 
 def aggregate_keys_sharded(
@@ -229,31 +227,31 @@ def aggregate_keys_sharded(
         u, s, local_n = sparse_ops.aggregate_keys(
             k, weights=w, valid=v, capacity=local_capacity, acc_dtype=acc_dtype
         )
-        gu = lax.all_gather(u, axes, axis=0, tiled=True)
-        gs = lax.all_gather(s, axes, axis=0, tiled=True)
-        mu, ms, mn = sparse_ops.aggregate_keys(
-            gu, weights=gs, valid=gu != sentinel, capacity=capacity,
-            acc_dtype=acc_dtype,
-        )
-        # Keep the documented overflow contract (ops/sparse.py): if ANY
-        # device overflowed its local stage, keys were already dropped
-        # before the merge and the merged count can look clean — force
-        # the returned n_unique past capacity so callers detect it.
-        local_overflow = lax.pmax(
-            (local_n > local_capacity).astype(jnp.int32), axes
-        )
-        mn = jnp.where(local_overflow > 0, jnp.maximum(mn, capacity + 1), mn)
-        return mu, ms, mn
+        return u, s, local_n[None]
 
-    # Replicated-by-construction outputs (post-all_gather re-reduce).
+    # The per-device compact partials come back as ordinary sharded
+    # global arrays; the merge re-reduce runs OUTSIDE shard_map as
+    # plain jit ops (GSPMD inserts the gather for the global sort).
+    # Keeping the collective stage vma-checked means a spec regression
+    # here fails at trace time instead of producing wrong numbers.
     fn = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P(axes)),
-        out_specs=(P(), P(), P()),
-        check_vma=False,
+        out_specs=(P(axes), P(axes), P(axes)),
     )
-    return fn(keys, w, v)
+    gu, gs, gn = fn(keys, w, v)
+    mu, ms, mn = sparse_ops.aggregate_keys(
+        gu, weights=gs, valid=gu != sentinel, capacity=capacity,
+        acc_dtype=acc_dtype,
+    )
+    # Keep the documented overflow contract (ops/sparse.py): if ANY
+    # device overflowed its local stage, keys were already dropped
+    # before the merge and the merged count can look clean — force
+    # the returned n_unique past capacity so callers detect it.
+    local_overflow = (gn > local_capacity).any()
+    mn = jnp.where(local_overflow, jnp.maximum(mn, capacity + 1), mn)
+    return mu, ms, mn
 
 
 def pyramid_sparse_morton_sharded(
@@ -287,41 +285,37 @@ def pyramid_sparse_morton_sharded(
         u, s, local_n = sparse_ops.aggregate_keys(
             k, weights=w, valid=v, capacity=local_capacity, acc_dtype=acc_dtype
         )
-        gu = lax.all_gather(u, axes, axis=0, tiled=True)
-        gs = lax.all_gather(s, axes, axis=0, tiled=True)
-        out = pyramid_ops.pyramid_sparse_morton(
-            gu,
-            weights=gs,
-            valid=gu != sentinel,
-            levels=levels,
-            capacity=capacity,
-            acc_dtype=acc_dtype,
-        )
-        # Propagate per-device overflow into every level's n_unique so
-        # the ops/sparse.py overflow contract holds (see
-        # aggregate_keys_sharded).
-        local_overflow = lax.pmax(
-            (local_n > local_capacity).astype(jnp.int32), axes
-        )
-        return tuple(
-            (
-                lu,
-                ls,
-                jnp.where(local_overflow > 0, jnp.maximum(ln, capacity + 1), ln),
-            )
-            for (lu, ls, ln) in out
-        )
+        return u, s, local_n[None]
 
-    out_specs = tuple((P(), P(), P()) for _ in range(levels + 1))
-    # Replicated-by-construction outputs (post-all_gather rollup).
+    # Same structure as aggregate_keys_sharded: vma-checked sharded
+    # stage -> per-device compact partials, merge + rollup outside as
+    # plain jit ops on the global arrays.
     fn = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P(axes)),
-        out_specs=out_specs,
-        check_vma=False,
+        out_specs=(P(axes), P(axes), P(axes)),
     )
-    return list(fn(codes, w, v))
+    gu, gs, gn = fn(codes, w, v)
+    out = pyramid_ops.pyramid_sparse_morton(
+        gu,
+        weights=gs,
+        valid=gu != sentinel,
+        levels=levels,
+        capacity=capacity,
+        acc_dtype=acc_dtype,
+    )
+    # Propagate per-device overflow into every level's n_unique so the
+    # ops/sparse.py overflow contract holds (see aggregate_keys_sharded).
+    local_overflow = (gn > local_capacity).any()
+    return [
+        (
+            lu,
+            ls,
+            jnp.where(local_overflow, jnp.maximum(ln, capacity + 1), ln),
+        )
+        for (lu, ls, ln) in out
+    ]
 
 
 def splat_rowsharded(raster, kernel_1d, mesh: Mesh):
@@ -417,9 +411,14 @@ def bin_points_bandsharded(
 
     ``send_capacity`` bounds the per-destination all_to_all buffer
     (default: the per-device point count, which cannot overflow).
-    Smaller values save memory but silently drop points past the
-    capacity — only use when the point distribution over bands is
-    known to be balanced.
+    Smaller values save memory but drop points past the capacity, so
+    a capacity-bounded call returns ``(band_raster, dropped)`` where
+    ``dropped`` is the replicated global count of points lost to the
+    cap — the ops/sparse.py overflow contract applied to the
+    collective: callers must check ``dropped == 0`` and fail/retry
+    with a larger capacity rather than trust a skew assumption (the
+    pattern is pinned by tests/test_parallel.py's skewed-band test).
+    With the default capacity the raster alone is returned.
 
     ``backend`` routes the band binning; unlike the replicated /
     rowsharded kernels it defaults to "xla", not "auto": this function
@@ -470,7 +469,13 @@ def bin_points_bandsharded(
         order = jnp.argsort(dest)
         sd = dest[order]
         m = sd.shape[0]
-        starts = jnp.searchsorted(sd, jnp.arange(T, dtype=sd.dtype))
+        bounds = jnp.searchsorted(sd, jnp.arange(T + 1, dtype=sd.dtype))
+        starts = bounds[:T]
+        # Points past a destination's capacity fall out of the send
+        # buffer (mode="drop" below); count them so the loss is LOUD —
+        # psum'd across the whole mesh and returned to the caller.
+        per_dest = bounds[1:] - bounds[:T]
+        local_dropped = jnp.maximum(per_dest - cap, 0).sum().astype(jnp.int32)
         slot = jnp.arange(m, dtype=jnp.int32) - starts[jnp.clip(sd, 0, T - 1)]
         send_r = jnp.full((T, cap), -1, jnp.int32).at[sd, slot].set(
             r[order], mode="drop"
@@ -502,7 +507,9 @@ def bin_points_bandsharded(
         )
         # Different data-axis rows hold disjoint point shards of the
         # same band: merge, leaving the band replicated over data.
-        return lax.psum(band, DATA_AXIS)
+        merged = lax.psum(band, DATA_AXIS)
+        dropped = lax.psum(local_dropped, (DATA_AXIS, TILE_AXIS))
+        return merged, dropped
 
     fn = jax.shard_map(
         local,
@@ -513,7 +520,13 @@ def bin_points_bandsharded(
             P((DATA_AXIS, TILE_AXIS)),
             P((DATA_AXIS, TILE_AXIS)),
         ),
-        out_specs=P(TILE_AXIS, None),
+        out_specs=(P(TILE_AXIS, None), P()),
         check_vma=False,
     )
-    return fn(latitude, longitude, w, v)
+    band_raster, dropped = fn(latitude, longitude, w, v)
+    if send_capacity is None:
+        # cap == n_local: per-destination counts cannot exceed the
+        # buffer, so the drop channel is structurally zero — keep the
+        # plain-raster return for the common case.
+        return band_raster
+    return band_raster, dropped

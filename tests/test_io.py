@@ -448,6 +448,77 @@ class TestSinks:
         assert isinstance(open_sink(str(tmp_path / "o.jsonl")), JSONLBlobSink)
         assert isinstance(open_sink(f"dir:{tmp_path}/d"), DirectoryBlobSink)
 
+    def test_cassandra_sink_batches_async_inserts(self):
+        """C12 egress (reference heatmap.py:149-150,157): statements
+        carry (id, json) params against rhom.heatmaps, async futures
+        drain every `concurrency` writes and at close."""
+        from heatmap_tpu.io.sinks import CassandraBlobSink
+
+        class FakeFuture:
+            def __init__(self, log):
+                self.log = log
+                self.resolved = False
+
+            def result(self):
+                self.resolved = True
+                self.log.append("drain")
+
+        class FakeSession:
+            def __init__(self):
+                self.calls = []
+                self.log = []
+
+            def execute_async(self, cql, params):
+                self.calls.append((cql, params))
+                self.log.append("insert")
+                return FakeFuture(self.log)
+
+        session = FakeSession()
+        with CassandraBlobSink(session=session, concurrency=2) as sink:
+            sink.write([
+                ("u1|alltime|3_1_1", {"8_32_32": 2.0}),
+                ("u2|alltime|3_1_2", {"8_33_32": 1.0}),
+                ("u3|alltime|3_1_3", {"8_34_32": 4.0}),
+            ])
+        assert len(session.calls) == 3
+        cql, params = session.calls[0]
+        assert "INSERT INTO rhom.heatmaps" in cql
+        assert params[0] == "u1|alltime|3_1_1"
+        assert json.loads(params[1]) == {"8_32_32": 2.0}
+        # Futures 1-2 drained at the concurrency threshold (after the
+        # 2nd insert), the 3rd at close — nothing left pending.
+        assert session.log == ["insert", "insert", "drain", "drain",
+                               "insert", "drain"]
+        assert sink._pending == []
+
+    def test_cassandra_sink_without_session_raises(self):
+        from heatmap_tpu.io.sinks import CassandraBlobSink
+
+        with pytest.raises(RuntimeError, match="session"):
+            CassandraBlobSink().write_one("id", {"t": 1.0})
+
+    def test_cassandra_sink_custom_table_and_keyspace(self):
+        from heatmap_tpu.io.sinks import CassandraBlobSink
+
+        class FakeSession:
+            def __init__(self):
+                self.calls = []
+
+            def execute_async(self, cql, params):
+                self.calls.append(cql)
+
+                class _F:
+                    def result(self):
+                        pass
+
+                return _F()
+
+        session = FakeSession()
+        sink = CassandraBlobSink(session=session, keyspace="ks", table="hm")
+        sink.write_one("a|b|1_0_0", {"2_0_0": 1.0})
+        sink.close()
+        assert "INSERT INTO ks.hm " in session.calls[0]
+
 
 class TestPNG:
     def test_png_decodes_via_pil(self):

@@ -56,14 +56,39 @@ def test_shard_source_range_shardable():
         shard_source(mine, process_count=4, process_index=0)
 
 
-def test_run_job_multihost_rejects_columnar_sinks(tmp_path):
+def test_run_job_multihost_gather_rejects_columnar_sinks(tmp_path):
+    """Explicit gather egress is blob-based; columnar sinks must be
+    refused at submit time (sharded egress is the columnar path)."""
     from heatmap_tpu.io.sinks import LevelArraysSink
     from heatmap_tpu.io.sources import SyntheticSource
     from heatmap_tpu.parallel.multihost import run_job_multihost
 
-    with pytest.raises(ValueError, match="blob"):
+    with pytest.raises(ValueError, match="sharded"):
         run_job_multihost(SyntheticSource(n=10),
-                          LevelArraysSink(str(tmp_path / "c")))
+                          LevelArraysSink(str(tmp_path / "c")),
+                          egress="gather")
+    with pytest.raises(ValueError, match="egress"):
+        run_job_multihost(SyntheticSource(n=10), egress="bogus")
+
+
+def test_run_job_multihost_columnar_single_process(tmp_path):
+    """Columnar sinks now work through run_job_multihost (the round-2
+    refusal is lifted): single-process degrades to run_job, writing the
+    same level files a plain columnar job writes."""
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8)
+    src = SyntheticSource(n=500, seed=3)
+    run_job_multihost(src, LevelArraysSink(str(tmp_path / "mh")), config=cfg)
+    run_job(src, LevelArraysSink(str(tmp_path / "ref")), config=cfg)
+    got = LevelArraysSink.load(str(tmp_path / "mh"))
+    want = LevelArraysSink.load(str(tmp_path / "ref"))
+    assert set(got) == set(want)
+    for zoom in want:
+        for col in ("row", "col", "value", "user", "timespan"):
+            np.testing.assert_array_equal(got[zoom][col], want[zoom][col])
 
 
 def test_shard_source_returns_none_for_plain_sources():
@@ -216,6 +241,165 @@ def test_sharded_weighted_merge_equals_global():
     assert set(merged) == set(global_blobs)
     for key in global_blobs:
         assert json.loads(merged[key]) == json.loads(global_blobs[key])
+
+
+def test_blob_owner_deterministic_in_range():
+    from heatmap_tpu.parallel.multihost import blob_owner
+
+    keys = [f"u{i}|alltime|3_{i % 7}_{i % 5}" for i in range(1000)]
+    owners = [blob_owner(k, 4) for k in keys]
+    assert owners == [blob_owner(k, 4) for k in keys]  # stable
+    assert set(owners) == {0, 1, 2, 3}  # every shard used
+    assert all(0 <= o < 4 for o in owners)
+
+
+def test_scatter_blobs_partition_merge_equals_gather():
+    """Sharded egress algebra: per-host partition + owner-side merge
+    yields disjoint shards whose union equals the full gather merge."""
+    from heatmap_tpu.parallel.multihost import (
+        blob_owner, merge_blob_parts, partition_blobs,
+    )
+
+    rng = np.random.default_rng(5)
+    k = 3
+    # Overlapping keys across hosts (straddling blobs) with numeric
+    # JSON payloads that must SUM on collision.
+    locals_ = []
+    for host in range(k):
+        blobs = {}
+        for i in rng.integers(0, 40, 25):
+            key = f"u{i % 6}|alltime|4_{i % 4}_{i % 3}"
+            blobs[key] = json.dumps({f"9_{i}_{i}": float(host + 1)})
+        locals_.append(blobs)
+
+    want = merge_blob_parts(locals_)
+
+    owned = []
+    for owner in range(k):
+        parts = [partition_blobs(loc, k)[owner] for loc in locals_]
+        owned.append(merge_blob_parts(parts))
+    # Disjoint at blob granularity, each key on its blob_owner shard...
+    seen = {}
+    for host, shard in enumerate(owned):
+        for key in shard:
+            assert key not in seen
+            assert blob_owner(key, k) == host
+            seen[key] = shard[key]
+    # ...and the union IS the gather result.
+    assert set(seen) == set(want)
+    for key in want:
+        assert json.loads(seen[key]) == json.loads(want[key])
+
+
+def test_scatter_blobs_fake_transport_wiring():
+    """scatter_blobs end to end with an injected transport simulating
+    3 processes: every host receives exactly its owner shard."""
+    from heatmap_tpu.parallel.multihost import (
+        blob_owner, partition_blobs, scatter_blobs,
+    )
+
+    k = 3
+    locals_ = [
+        {f"u{j}|alltime|2_{j}_1": json.dumps({"7_1_1": 1.0 * (i + 1)})
+         for j in range(6)}
+        for i in range(k)
+    ]
+    # Phase 1: what every host would SEND (payloads[d] JSON of its
+    # owner-d sub-dict) — precomputed so the fake transport can hand
+    # host i row i of every sender.
+    sent = [
+        [json.dumps(p).encode() for p in partition_blobs(loc, k)]
+        for loc in locals_
+    ]
+    results = []
+    for i in range(k):
+        transport = lambda payloads, i=i: [sent[s][i] for s in range(k)]
+        results.append(
+            scatter_blobs(locals_[i], process_count=k, transport=transport)
+        )
+    all_keys = set().union(*locals_)
+    for i, owned in enumerate(results):
+        assert set(owned) == {key for key in all_keys
+                              if blob_owner(key, k) == i}
+        for key, val in owned.items():
+            # 3 hosts each contributed 1.0*(host+1) under the same
+            # inner tile key -> summed to 6.0.
+            assert json.loads(val) == {"7_1_1": 6.0}
+
+
+def test_scatter_levels_equals_global_columnar_run(tmp_path):
+    """The VERDICT r2 'done' bar: per-host cascade + level scatter +
+    per-host columnar writes reassemble to exactly the global columnar
+    run, with no host ever holding the full result."""
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+    from heatmap_tpu.pipeline.batch import _run_loaded, load_columns
+    from heatmap_tpu.parallel.multihost import (
+        _CaptureLevels, _levels_from_bytes, _levels_to_bytes,
+        merge_level_parts, partition_levels,
+    )
+
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8)
+    src = SyntheticSource(n=3000, seed=9)
+    batch_size = 256
+    run_job(src, LevelArraysSink(str(tmp_path / "global")), config=cfg,
+            batch_size=batch_size)
+    want = LevelArraysSink.load(str(tmp_path / "global"))
+
+    k = 3
+    # Phase 1: per-host local cascades -> per-destination payloads
+    # (through the real serialization, as the jax transport would).
+    sent: list[list[bytes]] = []
+    for pi in range(k):
+        lats, lons, users, stamps = [], [], [], []
+        for batch in shard_source_rows(src.batches(batch_size),
+                                       n_total=3000, batch_size=batch_size,
+                                       process_count=k, process_index=pi):
+            cols = load_columns(batch)
+            lats.append(cols["latitude"])
+            lons.append(cols["longitude"])
+            users.extend(cols["user_id"])
+            stamps.extend(cols["timestamp"])
+        cap = _CaptureLevels()
+        if lats and sum(len(a) for a in lats):
+            _run_loaded(
+                {
+                    "latitude": np.concatenate(lats),
+                    "longitude": np.concatenate(lons),
+                    "user_id": users,
+                    "timestamp": stamps,
+                },
+                cfg, as_json=False, sink=cap,
+            )
+        sent.append([_levels_to_bytes(p)
+                     for p in partition_levels(cap.levels, k)])
+
+    # Phase 2: deliver + merge + per-host columnar write.
+    for pi in range(k):
+        owned = merge_level_parts(
+            _levels_from_bytes(sent[s][pi]) for s in range(k)
+        )
+        LevelArraysSink(str(tmp_path / f"host{pi}")).write_levels(owned)
+
+    # Reassemble the per-host shards and compare to the global run.
+    for zoom, wlvl in want.items():
+        rows = {c: [] for c in ("row", "col", "value", "user", "timespan")}
+        for pi in range(k):
+            got = LevelArraysSink.load(str(tmp_path / f"host{pi}"))
+            if zoom in got:
+                for c in rows:
+                    rows[c].append(got[zoom][c])
+        got_cols = {c: np.concatenate(rows[c]) for c in rows}
+        assert len(got_cols["value"]) == len(wlvl["value"])
+        # Order-insensitive compare: sort both sides the same way.
+        def _order(c):
+            return np.lexsort((c["col"], c["row"], c["user"], c["timespan"]))
+        go, wo = _order(got_cols), _order(wlvl)
+        for c in rows:
+            np.testing.assert_array_equal(
+                got_cols[c][go], np.asarray(wlvl[c])[wo]
+            )
 
 
 def test_run_job_multihost_weighted_single_process():

@@ -294,6 +294,44 @@ def test_bandsharded_under_jit(mesh2d):
     np.testing.assert_array_equal(got, want)
 
 
+def test_bandsharded_send_capacity_overflow_is_loud(mesh2d):
+    """A skewed band (every point in one raster band) past
+    send_capacity must be COUNTED, not silently dropped
+    (ops/sparse.py overflow contract applied to the all_to_all)."""
+    from heatmap_tpu.parallel import bin_points_bandsharded
+
+    T = mesh2d.shape["tile"]
+    win = window_from_bounds(
+        (35.0, 55.0), (-5.0, 20.0), zoom=10, align_levels=3, pad_multiple=8
+    )
+    band_h = win.height // T
+    # All points in the FIRST band: rows [row0, row0+band_h) only.
+    n = 8 * 64
+    rng = np.random.default_rng(21)
+    rows = win.row0 + rng.integers(0, band_h, n)
+    cols = win.col0 + rng.integers(0, win.width, n)
+    lats = np.asarray(mercator.latitude_from_row(rows + 0.5, win.zoom))
+    lons = np.asarray(mercator.longitude_from_column(cols + 0.5, win.zoom))
+    cap = 16  # per-destination slots; n // (D*T) points/device, all -> dest 0
+    band, dropped = bin_points_bandsharded(
+        jnp.asarray(lats), jnp.asarray(lons), win, mesh2d, send_capacity=cap
+    )
+    n_dev = mesh2d.devices.size
+    expect_dropped = n - n_dev * min(cap, n // n_dev)
+    assert int(dropped) == expect_dropped > 0
+    # Kept points all landed in the raster (none lost untracked).
+    assert int(np.asarray(band).sum()) == n - int(dropped)
+
+    # Adequate capacity: zero drops and exact counts.
+    band2, dropped2 = bin_points_bandsharded(
+        jnp.asarray(lats), jnp.asarray(lons), win, mesh2d,
+        send_capacity=n // n_dev,
+    )
+    assert int(dropped2) == 0
+    want = np.asarray(bin_points_window(np.asarray(lats), np.asarray(lons), win))
+    np.testing.assert_array_equal(np.asarray(band2), want)
+
+
 def test_bandsharded_rejects_tile1():
     from heatmap_tpu.parallel import bin_points_bandsharded
 

@@ -169,6 +169,30 @@ def test_streams_uniform_fallback_and_pileup():
     _diff(row2, col2, streams=4)
 
 
+def test_clamp_streams_bounds_slab_memory():
+    """streams must shrink for giant windows (the x8 default would OOM
+    where streams=1 fits HBM) and stay untouched for measured configs."""
+    from heatmap_tpu.ops.partitioned import (
+        STREAM_SLAB_BUDGET, clamp_streams,
+    )
+    from heatmap_tpu.ops.histogram import Window
+
+    # Headline-class window (8192^2 = 256 MiB slab): default untouched.
+    z15 = Window(zoom=15, row0=0, col0=0, height=8192, width=8192)
+    assert clamp_streams(8, z15) == 8
+    # Near the int32 cell-id cap (~8 GiB of cells): forced to 1.
+    giant = Window(zoom=21, row0=0, col0=0, height=1 << 16, width=1 << 15)
+    assert clamp_streams(8, giant) == 1
+    # Mid-size: partial clamp, and the budget is actually respected.
+    mid = Window(zoom=18, row0=0, col0=0, height=1 << 14, width=1 << 14)
+    k = clamp_streams(8, mid)
+    assert 1 <= k < 8
+    assert k * (1 << 28) * 4 <= STREAM_SLAB_BUDGET
+    # Tiny windows never exceed the requested count.
+    small = Window(zoom=10, row0=0, col0=0, height=256, width=256)
+    assert clamp_streams(8, small) == 8
+
+
 def test_streams_one_equals_flat_path():
     rng = np.random.default_rng(13)
     n = 1 << 14

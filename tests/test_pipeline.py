@@ -334,6 +334,81 @@ def test_run_job_bounded_matches_unbounded(amplify):
     assert plain == sequential
 
 
+def test_auto_points_in_flight_decision():
+    """Oversized sources auto-route to the bounded path; sources that
+    fit (or can't be sized) keep the single-shot path."""
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline.batch import (
+        _HOST_BYTES_PER_POINT, _auto_points_in_flight,
+        _estimate_source_points,
+    )
+
+    small = SyntheticSource(n=1000)
+    big = SyntheticSource(n=50_000_000)
+    assert _estimate_source_points(small) == 1000
+    # Fits the budget comfortably: unchanged single-shot.
+    assert _auto_points_in_flight(small, ram_budget=1 << 30) is None
+    # 50M points vs a 1 GiB budget (~6.7M points): bounded, chunk a
+    # quarter of what fits.
+    got = _auto_points_in_flight(big, ram_budget=1 << 30)
+    fits = (1 << 30) // _HOST_BYTES_PER_POINT
+    assert got == max(1 << 16, fits // 4)
+    # Tiny-RAM host: the floor must stay under the budget's order of
+    # magnitude, not balloon past it (75 MB budget -> ~490k fit; the
+    # chunk must be <= what fits, not a fixed 1M).
+    tiny = _auto_points_in_flight(big, ram_budget=75 << 20)
+    assert tiny <= (75 << 20) // _HOST_BYTES_PER_POINT
+    assert tiny >= 1 << 16
+    # Unsizeable sources (no n, no path) can't auto-route.
+    assert _auto_points_in_flight(object()) is None
+
+
+def test_estimate_source_points_from_file_size(tmp_path):
+    from heatmap_tpu.pipeline.batch import (
+        _MIN_TEXT_ROW_BYTES, _estimate_source_points,
+    )
+
+    p = tmp_path / "pts.csv"
+    p.write_text("lat,lon,user\n" * 1000)
+    est = _estimate_source_points(str(p))
+    assert est == p.stat().st_size // _MIN_TEXT_ROW_BYTES
+    # Path-holding source objects estimate the same way.
+    class _S:
+        path = str(p)
+    assert _estimate_source_points(_S()) == est
+
+
+def test_run_job_auto_bounds_oversized_source(monkeypatch):
+    """With host RAM faked tiny, the default run_job call takes the
+    bounded path on its own — and stays exactly equal to single-shot
+    (linearity), with 0 forcing single-shot back."""
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import batch as batch_mod
+    from heatmap_tpu.pipeline import run_job
+
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=7)
+    src = SyntheticSource(n=3000, seed=11)
+    plain = run_job(src, config=cfg, max_points_in_flight=0)
+
+    taken = {}
+    real_bounded = batch_mod._run_job_bounded
+
+    def spy(source, sink, config, batch_size, max_points, **kw):
+        taken["max_points"] = max_points
+        return real_bounded(source, sink, config, batch_size, max_points,
+                            **kw)
+
+    monkeypatch.setattr(batch_mod, "_run_job_bounded", spy)
+    # ~48 KiB budget -> fits ~300 points, so n=3000 must auto-bound
+    # (the 64k floor kicks in; correctness is chunk-size independent).
+    monkeypatch.setattr(
+        batch_mod, "_available_ram_bytes", lambda: 96 * 1024
+    )
+    auto = run_job(src, config=cfg)
+    assert taken["max_points"] == 1 << 16  # floor kicked in
+    assert auto == plain
+
+
 def test_weighted_job_is_linear_in_weights():
     """config.weighted with every value == 2.5 must yield exactly
     2.5x the count job's blob values (the cascade is a linear (key,
