@@ -118,6 +118,11 @@ def _add_run_flags(p):
                    "(exact). Default: auto — sources estimated larger "
                    "than host RAM take the bounded path with a "
                    "RAM-derived chunk; 0 forces single-shot")
+    p.add_argument("--merge-spill-dir", default=None, metavar="DIR",
+                   help="bounded path only: spill per-chunk aggregates "
+                   "to DIR and merge one level at a time at egress, "
+                   "bounding the cross-chunk merge table too (for "
+                   "near-unique-output shapes; exact results)")
     p.add_argument("--capacity", type=int, default=None,
                    help="unique-key capacity for the device cascade "
                    "(default: #emissions)")
@@ -196,6 +201,13 @@ def cmd_run(args) -> int:
         )
     except ValueError as e:
         raise SystemExit(str(e)) from e
+    if args.merge_spill_dir and (args.multihost or args.checkpoint_dir):
+        # The spill merge lives on the bounded path; those modes never
+        # route there — ignoring the flag would quietly run the
+        # unbounded in-RAM merge the operator asked to avoid.
+        raise SystemExit("--merge-spill-dir applies to the bounded "
+                         "(chunked) path only; it cannot combine with "
+                         "--multihost or --checkpoint-dir")
     # 0 means "explicitly single-shot", which composes with both
     # checkpointing and multihost; only a positive bound conflicts.
     if args.max_points_in_flight and args.checkpoint_dir:
@@ -286,6 +298,7 @@ def cmd_run(args) -> int:
                     checkpoint_dir=args.checkpoint_dir,
                     checkpoint_every=args.checkpoint_every,
                     max_points_in_flight=args.max_points_in_flight,
+                    merge_spill_dir=args.merge_spill_dir,
                 )
             elif args.checkpoint_dir:
                 blobs = run_job_resumable(
@@ -307,7 +320,8 @@ def cmd_run(args) -> int:
                                             read_value=args.weighted),
                                 sink, config,
                                 batch_size=args.batch_size,
-                                max_points_in_flight=args.max_points_in_flight)
+                                max_points_in_flight=args.max_points_in_flight,
+                                merge_spill_dir=args.merge_spill_dir)
     dt = time.perf_counter() - t0
     if args.profile:
         print(get_tracer().format_report(), file=sys.stderr)

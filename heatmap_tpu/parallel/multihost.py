@@ -17,16 +17,17 @@ TPU-native equivalent (BASELINE.md config 5, 10B points on v5e-64):
   devices so consecutive data-axis neighbors are ICI-local (XLA then
   hierarchically decomposes cross-host reductions: reduce over ICI
   first, DCN once per host);
-- egress is tile-space-sharded by default when a sink is given:
-  ``scatter_blobs`` / ``scatter_levels`` partition the blob keyspace
-  deterministically over processes (``blob_owner``) and one
-  all-to-all moves each blob to its owner, which writes its own sink
-  shard — the analog of the reference's Spark reducers each writing
-  their hash partition to Cassandra (reference heatmap.py:149-150).
-  ``gather_blobs`` (DCN byte-level allgather, every host gets the
-  full merged dict, process 0 writes) remains the small-job path —
-  the analog of the reference's driver-side collect
-  (heatmap.py:156-158).
+- egress: ``gather_blobs`` (DCN byte-level allgather, every host gets
+  the full merged dict, process 0 writes) is the default / small-job
+  path — the analog of the reference's driver-side collect
+  (heatmap.py:156-158). Tile-space-sharded egress is the explicit
+  opt-in (``egress="sharded"``, per-host sink paths required):
+  ``scatter_blobs`` partitions the blob keyspace deterministically
+  over processes (``blob_owner``; ``scatter_levels`` uses the
+  equivalent name+tile hash ``_level_row_owner`` for columnar rows)
+  and one all-to-all moves each blob to its owner, which writes its
+  own sink shard — the analog of the reference's Spark reducers each
+  writing their hash partition to Cassandra (heatmap.py:149-150).
 
 Everything degrades to a no-op on a single process, so the same job
 script runs unchanged from a laptop CPU to a v5e-64 pod.
@@ -40,6 +41,7 @@ import zlib
 import jax
 import numpy as np
 
+from heatmap_tpu.io.sinks import LevelArraysSink as _LevelArraysSink
 from heatmap_tpu.parallel.mesh import make_mesh
 
 
@@ -359,8 +361,9 @@ def _level_row_owner(lvl, process_count: int) -> np.ndarray:
     return (h % np.uint64(process_count)).astype(np.int64)
 
 
-_LEVEL_ROW_COLS = ("row", "col", "value", "user_idx", "timespan_idx",
-                   "coarse_row", "coarse_col")
+# The per-row level schema IS the columnar sink schema — one source of
+# truth, so a column added there can't silently drop from the exchange.
+_LEVEL_ROW_COLS = _LevelArraysSink.COLUMNS
 
 
 def partition_levels(levels, process_count: int) -> list[list[dict]]:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import numpy as np
@@ -261,7 +262,8 @@ def load_columns(batch):
 def run_job(source, sink=None, config: BatchJobConfig | None = None,
             batch_size: int = 1 << 20,
             max_points_in_flight: int | None = None,
-            overlap_ingest: bool = True):
+            overlap_ingest: bool = True,
+            merge_spill_dir: str | None = None):
     """Source-to-sink job over columnar batches (the production entry;
     reference batchMain shape with get_rows/write_heatmap_dataframes
     replaced by heatmap_tpu.io sources/sinks, heatmap.py:152-158).
@@ -291,8 +293,11 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     (VERDICT r2 weak #5: the default run on a bigger-than-RAM CSV must
     not OOM). Pass ``0`` to force the single-shot path, or an explicit
     point count to pick the chunk size yourself. The bounded path's
-    cross-chunk merge stays O(unique output keys) either way
-    (PERF_NOTES memory model).
+    in-RAM cross-chunk merge is O(unique output keys) (PERF_NOTES
+    memory model); ``merge_spill_dir`` lifts that too, spilling
+    per-chunk aggregates to disk and merging one level at a time at
+    egress (_SpillMerge) — for near-unique-output shapes whose merge
+    table outgrows RAM.
     """
     from heatmap_tpu.utils.trace import get_tracer
 
@@ -302,7 +307,7 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     if max_points_in_flight:  # 0/None -> single-shot
         return _run_job_bounded(
             source, sink, config, batch_size, max_points_in_flight,
-            overlap_ingest=overlap_ingest,
+            overlap_ingest=overlap_ingest, spill_dir=merge_spill_dir,
         )
     tracer = get_tracer()
     data = ingest_columns(source.batches(batch_size), config)
@@ -555,7 +560,8 @@ def _fast_batches_for(source, batch_size, checkpointing=False):
 
 def _run_job_bounded(source, sink, config: BatchJobConfig,
                      batch_size: int, max_points: int,
-                     overlap_ingest: bool = True, fast: bool = False):
+                     overlap_ingest: bool = True, fast: bool = False,
+                     spill_dir: str | None = None):
     """Chunked cascade with host-side per-level aggregate merge.
 
     Spark streams partitions through executors (reference
@@ -575,6 +581,11 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     identical to the sequential path; peak footprint grows to at most
     3 chunks (building + queued + in-cascade). Set False for the
     strict 1-chunk memory bound.
+
+    ``spill_dir``: write per-chunk level aggregates to disk instead of
+    folding them into an in-RAM table, merging one level at a time at
+    egress (_SpillMerge) — for near-unique-output shapes where the
+    merge table itself outgrows RAM. Byte-identical results.
     """
     import queue as queue_mod
     import threading
@@ -593,6 +604,8 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
         "code": np.empty(0, np.int64), "value": np.empty(0, np.float64),
     }
     merged = [dict(empty) for _ in range(n_levels)]
+    spill = _SpillMerge(spill_dir, n_levels) if spill_dir is not None else None
+    n_runs = 0
 
     def chunks():
         """Sequential chunk builder: ingest batches, cut at max_points.
@@ -691,11 +704,19 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
+            nonlocal n_runs
             for i, lvl in enumerate(levels):
-                merged[i] = _merge_sorted_level(
-                    merged[i], lvl["slot"] // n_groups, lvl["slot"] % n_groups,
-                    lvl["code"], lvl["value"],
-                )
+                ts_ids = lvl["slot"] // n_groups
+                g_ids = lvl["slot"] % n_groups
+                if spill is not None:
+                    spill.add_level(
+                        n_runs, i, ts_ids, g_ids, lvl["code"], lvl["value"]
+                    )
+                else:
+                    merged[i] = _merge_sorted_level(
+                        merged[i], ts_ids, g_ids, lvl["code"], lvl["value"],
+                    )
+            n_runs += 1
 
     if not overlap_ingest:
         for chunk in chunks():
@@ -743,25 +764,164 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
         if errors:
             raise errors[0]
 
-    if all(len(m["code"]) == 0 for m in merged):
-        return {}
-
     # Egress: re-pack slots with the complete vocabs, then the shared
     # finalize + blob path.
     n_groups = len(vocab)
-    levels = []
-    for i, m in enumerate(merged):
+    slot_names = _slot_names(vocab, ts_vocab, n_groups)
+
+    def assemble(m, i):
         rows, cols_ = morton.morton_decode_np(m["code"])
-        levels.append({
+        return {
             "zoom": ccfg.detail_zoom - i,
             "slot": m["ts"] * n_groups + m["g"],
             "code": m["code"],
             "row": rows,
             "col": cols_,
             "value": m["value"],
-        })
-    return _finish_blobs(levels, ccfg, _slot_names(vocab, ts_vocab, n_groups),
-                         as_json=True, sink=sink)
+        }
+
+    try:
+        if spill is not None:
+            if spill.rows_spilled == 0:
+                return {}
+            if not config.amplify_all:
+                # True one-level-at-a-time egress: merge, finalize and
+                # write each level before touching the next — peak is
+                # O(chunk + largest single level). Blob ids never
+                # collide across levels (the coarse zoom is part of
+                # the id) and sinks upsert per blob / per level, so
+                # per-level _finish_blobs calls compose exactly.
+                out = None
+                for i in range(n_levels):
+                    part = _finish_blobs(
+                        [assemble(spill.merge_level(i, n_runs), i)],
+                        ccfg, slot_names, as_json=True, sink=sink,
+                    )
+                    if (isinstance(part, dict)
+                            and part.get("egress") == "levels"):
+                        if out is None:
+                            out = {"egress": "levels", "levels": 0,
+                                   "rows": 0}
+                        out["levels"] += part["levels"]
+                        out["rows"] += part["rows"]
+                    else:
+                        if out is None:
+                            out = {}
+                        out.update(part)
+                return {} if out is None else out
+            # amplify_all's cross-level recurrence needs every level in
+            # hand (cascade._patch_amplified); materialize the merged
+            # levels once, like the unbounded path.
+            merged = [spill.merge_level(i, n_runs) for i in range(n_levels)]
+        elif all(len(m["code"]) == 0 for m in merged):
+            return {}
+
+        return _finish_blobs(
+            [assemble(m, i) for i, m in enumerate(merged)],
+            ccfg, slot_names, as_json=True, sink=sink,
+        )
+    finally:
+        if spill is not None:
+            spill.cleanup()
+
+
+class _SpillMerge:
+    """Disk-backed cross-chunk merge for the bounded path.
+
+    The in-RAM merge table is O(unique output keys) — the one bound
+    ``max_points_in_flight`` cannot give (PERF_NOTES memory model);
+    near-unique-output shapes (output ~= input) made it 12 GB RSS at
+    20M adversarial points. Spilling instead writes each chunk's
+    decoded level aggregates as flat column files (24 B/row:
+    int32 ts/g + int64 code + f64 value) and aggregates ONE LEVEL AT A
+    TIME at egress via mmap-concat + one stable sort + reduceat, so
+    peak host memory is O(chunk + largest single level) instead of
+    O(all levels' uniques + merge temporaries) — except under
+    ``amplify_all``, whose cross-level recurrence forces all merged
+    levels resident at egress (ingest-time memory is still O(chunk)).
+    Values sum in chunk order per key — byte-identical to the
+    iterative two-run merge.
+    The reference analog is Spark's shuffle spill to local disk
+    (reference submit-heatmap:14, spark.local.dir).
+    """
+
+    def __init__(self, root: str, n_levels: int):
+        import tempfile
+
+        os.makedirs(root, exist_ok=True)
+        self.dir = tempfile.mkdtemp(prefix="merge-spill-", dir=root)
+        self.n_levels = n_levels
+        self.rows_spilled = 0
+
+    def _base(self, run: int, level: int) -> str:
+        return os.path.join(self.dir, f"run{run:05d}_l{level:02d}")
+
+    def add_level(self, run: int, level: int, ts, g, code, value) -> None:
+        if len(code) == 0:
+            return  # empty runs simply have no files
+        base = self._base(run, level)
+        np.save(base + "_ts.npy", np.asarray(ts, np.int32))
+        np.save(base + "_g.npy", np.asarray(g, np.int32))
+        np.save(base + "_code.npy", np.asarray(code, np.int64))
+        np.save(base + "_value.npy", np.asarray(value, np.float64))
+        self.rows_spilled += len(code)
+
+    def merge_level(self, level: int, n_runs: int) -> dict:
+        cols = {"ts": [], "g": [], "code": [], "value": []}
+        for run in range(n_runs):
+            base = self._base(run, level)
+            if not os.path.exists(base + "_code.npy"):
+                continue
+            for name in cols:
+                cols[name].append(
+                    np.load(f"{base}_{name}.npy", mmap_mode="r")
+                )
+        if not cols["code"]:
+            return {
+                "ts": np.empty(0, np.int64), "g": np.empty(0, np.int64),
+                "code": np.empty(0, np.int64),
+                "value": np.empty(0, np.float64),
+            }
+        ts = np.concatenate(cols["ts"]).astype(np.int64)
+        g = np.concatenate(cols["g"]).astype(np.int64)
+        code = np.concatenate(cols["code"])
+        value = np.concatenate(cols["value"])
+        return _aggregate_runs(ts, g, code, value)
+
+    def cleanup(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+def _aggregate_runs(ts, g, code, value) -> dict:
+    """Sum values over equal (ts, g, code) keys across concatenated
+    runs; output sorted by (ts, g, code). Stable sort keeps run order
+    within a key, so f64 sums accumulate in chunk order — the same
+    order as the iterative _merge_sorted_level fold."""
+    # Same int64 key packing (and pathological-width fallback) as
+    # _merge_sorted_level.
+    code_bits = int(code.max(initial=0)).bit_length()
+    gmax = int(g.max(initial=0)) + 1
+    tmax = int(ts.max(initial=0)) + 1
+    if code_bits + (gmax * tmax).bit_length() < 62:
+        keys = ((ts * gmax + g) << code_bits) | code
+        order = np.argsort(keys, kind="stable")
+    else:
+        order = np.lexsort((code, g, ts))
+    ts, g, code, value = ts[order], g[order], code[order], value[order]
+    first = np.empty(len(code), bool)
+    first[:1] = True
+    first[1:] = (ts[1:] != ts[:-1]) | (g[1:] != g[:-1]) \
+        | (code[1:] != code[:-1])
+    starts = np.flatnonzero(first)
+    return {
+        "ts": ts[starts],
+        "g": g[starts],
+        "code": code[starts],
+        "value": np.add.reduceat(value, starts) if len(starts)
+        else value[:0],
+    }
 
 
 def _merge_sorted_level(m, ts2, g2, code2, value2):
@@ -870,7 +1030,8 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
                  checkpoint_every: int = 8,
                  fault_injector=None,
                  max_points_in_flight: int | None = None,
-                 overlap_ingest: bool = True):
+                 overlap_ingest: bool = True,
+                 merge_spill_dir: str | None = None):
     """Integer-fast-path job: no per-row Python objects anywhere.
 
     ``source`` is a CSV path (the native C++ decoder parses, routes
@@ -932,6 +1093,7 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
         return _run_job_bounded(
             source, sink, config, batch_size, max_points_in_flight,
             overlap_ingest=overlap_ingest, fast=True,
+            spill_dir=merge_spill_dir,
         )
     from heatmap_tpu.utils.trace import get_tracer
 

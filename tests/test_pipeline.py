@@ -334,6 +334,68 @@ def test_run_job_bounded_matches_unbounded(amplify):
     assert plain == sequential
 
 
+@pytest.mark.parametrize("amplify", [False, True])
+def test_bounded_spill_merge_matches_in_ram(tmp_path, amplify):
+    """merge_spill_dir replaces the in-RAM cross-chunk table with disk
+    runs + per-level egress merges — byte-identical blobs, spill files
+    cleaned up afterwards (both amplify modes: streaming egress for
+    False, materialized for True)."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2000, seed=7)
+    cfg = BatchJobConfig(
+        detail_zoom=12, min_detail_zoom=6,
+        timespans=("alltime", "month"), amplify_all=amplify,
+    )
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                    max_points_in_flight=150)
+    spill_root = tmp_path / "spill"
+    spilled = run_job(
+        _ColSource(rows), config=cfg, batch_size=128,
+        max_points_in_flight=150, merge_spill_dir=str(spill_root),
+    )
+    assert spilled == plain
+    # The temp run directory is removed; only the (empty) root remains.
+    assert list(spill_root.iterdir()) == []
+
+
+def test_bounded_spill_weighted_and_columnar(tmp_path):
+    """Weighted spill sums match the in-RAM merge exactly (chunk-order
+    summation), and the streaming per-level egress composes with a
+    columnar sink (per-level write_levels calls, summed stats)."""
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=1500, seed=21)
+    for i, r in enumerate(rows):
+        r["value"] = float((i % 7) + 1)  # integer-valued -> exact sums
+    cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=7, weighted=True)
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=100,
+                    max_points_in_flight=200)
+    spilled = run_job(
+        _ColSource(rows), config=cfg, batch_size=100,
+        max_points_in_flight=200, merge_spill_dir=str(tmp_path / "s"),
+    )
+    assert spilled == plain
+
+    stats_ram = run_job(_ColSource(rows),
+                        LevelArraysSink(str(tmp_path / "ram")),
+                        config=cfg, batch_size=100,
+                        max_points_in_flight=200)
+    stats_spill = run_job(
+        _ColSource(rows), LevelArraysSink(str(tmp_path / "spl")),
+        config=cfg, batch_size=100, max_points_in_flight=200,
+        merge_spill_dir=str(tmp_path / "s2"),
+    )
+    assert stats_spill == stats_ram
+    got = LevelArraysSink.load(str(tmp_path / "spl"))
+    want = LevelArraysSink.load(str(tmp_path / "ram"))
+    assert set(got) == set(want)
+    for zoom in want:
+        for col in ("row", "col", "value", "user", "timespan"):
+            np.testing.assert_array_equal(got[zoom][col], want[zoom][col])
+
+
 def test_auto_points_in_flight_decision():
     """Oversized sources auto-route to the bounded path; sources that
     fit (or can't be sized) keep the single-shot path."""
