@@ -164,6 +164,14 @@ def _add_run_flags(p):
                    "per-process ingest shard (connector ranges or batch "
                    "slices), DCN blob merge, process 0 writes the sink; "
                    "single-process falls through to the plain job")
+    p.add_argument("--multihost-egress",
+                   choices=("auto", "gather", "sharded"), default="auto",
+                   help="gather (the auto default): full DCN merge, "
+                   "process 0 writes. sharded: blob keys partition "
+                   "across processes and EVERY host writes its own sink "
+                   "shard (path sinks get a per-host suffix "
+                   "automatically) — the scalable reducer-write path; "
+                   "required for columnar sinks on pods")
 
 
 def cmd_run(args) -> int:
@@ -201,6 +209,11 @@ def cmd_run(args) -> int:
         )
     except ValueError as e:
         raise SystemExit(str(e)) from e
+    if args.multihost_egress != "auto" and not args.multihost:
+        # A forgotten --multihost would otherwise run the full plain
+        # job on EVERY host of a per-host launch script, with all of
+        # them writing the same output path.
+        raise SystemExit("--multihost-egress requires --multihost")
     if args.merge_spill_dir and (args.multihost or args.checkpoint_dir):
         # The spill merge lives on the bounded path; those modes never
         # route there — ignoring the flag would quietly run the
@@ -287,10 +300,20 @@ def cmd_run(args) -> int:
         from heatmap_tpu.parallel import initialize
 
         initialize()
+    output_spec = args.output
+    if args.multihost and args.multihost_egress == "sharded":
+        # Sharded egress: every process writes its own shard, so
+        # path-backed sinks get this process's derived path (after
+        # distributed init so process_index is final).
+        import jax
+
+        from heatmap_tpu.io.sinks import per_process_sink_spec
+
+        output_spec = per_process_sink_spec(args.output, jax.process_index())
     t0 = time.perf_counter()
     prof = jax_profile(args.profile) if args.profile else contextlib.nullcontext()
     with prof:
-        with open_sink(args.output) as sink:
+        with open_sink(output_spec) as sink:
             if fast_source is not None:
                 blobs = run_job_fast(
                     fast_source, sink, config,
@@ -314,6 +337,7 @@ def cmd_run(args) -> int:
                     open_source(args.input, read_value=args.weighted),
                     sink, config, batch_size=args.batch_size,
                     max_points_in_flight=args.max_points_in_flight,
+                    egress=args.multihost_egress,
                 )
             else:
                 blobs = run_job(open_source(args.input,
@@ -325,7 +349,7 @@ def cmd_run(args) -> int:
     dt = time.perf_counter() - t0
     if args.profile:
         print(get_tracer().format_report(), file=sys.stderr)
-    summary = {"seconds": round(dt, 3), "output": args.output,
+    summary = {"seconds": round(dt, 3), "output": output_spec,
                "ingest": "fast" if fast_source is not None else "standard"}
     if isinstance(blobs, dict) and str(
             blobs.get("egress", "")).startswith("levels"):

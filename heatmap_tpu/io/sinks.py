@@ -368,6 +368,29 @@ class PNGTileSink:
         return count
 
 
+def per_process_sink_spec(spec: str, process_index: int) -> str:
+    """Derive this process's sink spec for sharded multi-host egress.
+
+    Every host writes its own shard (parallel.multihost
+    ``egress="sharded"``), so path-backed sinks need distinct per-host
+    paths on shared storage: file sinks get a ``.pNNN`` suffix,
+    directory sinks a ``hostNNN/`` subdirectory. ``memory:`` is
+    process-local already and ``cassandra:`` upserts by blob id, so
+    concurrent per-host writers need no derivation — the reference's
+    reducers wrote the same table concurrently (heatmap.py:149-150).
+    """
+    kind, _, rest = spec.partition(":")
+    tag = f"p{process_index:03d}"
+    if kind == "jsonl" or (not rest and spec.endswith((".jsonl", ".ndjson"))):
+        path = rest or spec
+        return f"jsonl:{path}.{tag}"
+    if kind in ("arrays", "arrays-parquet", "dir"):
+        return f"{kind}:{os.path.join(rest, 'host' + f'{process_index:03d}')}"
+    if kind in ("memory", "cassandra"):
+        return spec
+    raise ValueError(f"unrecognized sink spec {spec!r}")
+
+
 def open_sink(spec: str) -> BlobSink:
     """CLI sink spec: ``jsonl:PATH``, ``dir:PATH``, ``memory:``,
     ``cassandra:``, ``arrays:DIR`` (columnar per-level npz) or a bare

@@ -304,6 +304,14 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     config = config or BatchJobConfig()
     if max_points_in_flight is None:
         max_points_in_flight = _auto_points_in_flight(source)
+    if merge_spill_dir is not None and not max_points_in_flight:
+        raise ValueError(
+            "merge_spill_dir lives on the bounded path, but this job "
+            "routed single-shot (source fits host RAM, is unsizeable, "
+            "or bounding was disabled with 0); pass "
+            "max_points_in_flight > 0 to chunk — silently ignoring the "
+            "spill request would run the in-RAM merge it exists to avoid"
+        )
     if max_points_in_flight:  # 0/None -> single-shot
         return _run_job_bounded(
             source, sink, config, batch_size, max_points_in_flight,
@@ -718,51 +726,60 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                     )
             n_runs += 1
 
-    if not overlap_ingest:
-        for chunk in chunks():
-            process(chunk)
-    else:
-        # Double-buffer: the producer thread builds chunk N+1 (source
-        # IO, parsing, group routing — pure host work, no JAX) while
-        # this thread runs chunk N's device cascade + merge.
-        q: queue_mod.Queue = queue_mod.Queue(maxsize=1)
-        stop = threading.Event()
-        DONE = object()
-        errors: list = []
+    # Any failure between the first spilled run and egress must still
+    # remove the spill tempdir (tens of GB at the shapes spill
+    # targets), so ingest runs under the same cleanup as egress.
+    try:
+        if not overlap_ingest:
+            for chunk in chunks():
+                process(chunk)
+        else:
+            # Double-buffer: the producer thread builds chunk N+1
+            # (source IO, parsing, group routing — pure host work, no
+            # JAX) while this thread runs chunk N's device cascade +
+            # merge.
+            q: queue_mod.Queue = queue_mod.Queue(maxsize=1)
+            stop = threading.Event()
+            DONE = object()
+            errors: list = []
 
-        def put(item) -> bool:
-            while not stop.is_set():
+            def put(item) -> bool:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except queue_mod.Full:
+                        continue
+                return False
+
+            def producer():
                 try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue_mod.Full:
-                    continue
-            return False
+                    for chunk in chunks():
+                        if not put(chunk):
+                            return
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    errors.append(e)
+                finally:
+                    put(DONE)
 
-        def producer():
+            t = threading.Thread(target=producer, name="ingest-prefetch",
+                                 daemon=True)
+            t.start()
             try:
-                for chunk in chunks():
-                    if not put(chunk):
-                        return
-            except BaseException as e:  # noqa: BLE001 — re-raised below
-                errors.append(e)
+                while True:
+                    item = q.get()
+                    if item is DONE:
+                        break
+                    process(item)
             finally:
-                put(DONE)
-
-        t = threading.Thread(target=producer, name="ingest-prefetch",
-                             daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is DONE:
-                    break
-                process(item)
-        finally:
-            stop.set()
-            t.join()
-        if errors:
-            raise errors[0]
+                stop.set()
+                t.join()
+            if errors:
+                raise errors[0]
+    except BaseException:
+        if spill is not None:
+            spill.cleanup()
+        raise
 
     # Egress: re-pack slots with the complete vocabs, then the shared
     # finalize + blob path.
@@ -899,15 +916,10 @@ def _aggregate_runs(ts, g, code, value) -> dict:
     runs; output sorted by (ts, g, code). Stable sort keeps run order
     within a key, so f64 sums accumulate in chunk order — the same
     order as the iterative _merge_sorted_level fold."""
-    # Same int64 key packing (and pathological-width fallback) as
-    # _merge_sorted_level.
-    code_bits = int(code.max(initial=0)).bit_length()
-    gmax = int(g.max(initial=0)) + 1
-    tmax = int(ts.max(initial=0)) + 1
-    if code_bits + (gmax * tmax).bit_length() < 62:
-        keys = ((ts * gmax + g) << code_bits) | code
-        order = np.argsort(keys, kind="stable")
-    else:
+    pack = _level_key_packer(ts, g, code)
+    if pack is not None:
+        order = np.argsort(pack(ts, g, code), kind="stable")
+    else:  # pathological widths: correct but slower full sort
         order = np.lexsort((code, g, ts))
     ts, g, code, value = ts[order], g[order], code[order], value[order]
     first = np.empty(len(code), bool)
@@ -922,6 +934,30 @@ def _aggregate_runs(ts, g, code, value) -> dict:
         "value": np.add.reduceat(value, starts) if len(starts)
         else value[:0],
     }
+
+
+def _level_key_packer(ts, g, code):
+    """Closure packing (ts, g, code) rows into ONE comparable int64 —
+    field widths taken from THESE arrays (pass the union of everything
+    you will pack) — or None when the widths don't fit 62 bits (the
+    cascade's own composite keys already prove slot<<code_bits fits;
+    the global G here can only be larger by the vocab tail, so guard).
+    Single source of truth for the merge paths: the spill merge's
+    byte-identical-to-in-RAM guarantee rests on both using THIS key
+    order."""
+    code_bits = int(code.max(initial=0)).bit_length()
+    gmax = int(g.max(initial=0)) + 1
+    tmax = int(ts.max(initial=0)) + 1
+    if code_bits + (gmax * tmax).bit_length() >= 62:
+        return None
+
+    def pack(t_, g_, c_):
+        # int64 up front: ts/g arrive int32 off the native key
+        # decoder, and << code_bits (up to 42 at z21) would silently
+        # wrap in int32 — unsorted pack keys then corrupt the merges.
+        return ((t_.astype(np.int64) * gmax + g_) << code_bits) | c_
+
+    return pack
 
 
 def _merge_sorted_level(m, ts2, g2, code2, value2):
@@ -940,20 +976,8 @@ def _merge_sorted_level(m, ts2, g2, code2, value2):
     value = np.concatenate([m["value"], value2])
     if len(code) == 0:
         return m
-    # Pack (ts, g, code) into one comparable int64 when it fits (the
-    # cascade's own composite keys already prove slot<<code_bits fits;
-    # the global G here can only be larger by the vocab tail, so guard).
-    code_bits = int(code.max(initial=0)).bit_length()
-    gmax = int(g.max(initial=0)) + 1
-    tmax = int(ts.max(initial=0)) + 1
-    if code_bits + (gmax * tmax).bit_length() < 62:
-        def pack(t_, g_, c_):
-            # int64 up front: ts/g arrive int32 off the native key
-            # decoder, and << code_bits (up to 42 at z21) would
-            # silently wrap in int32 — unsorted pack keys then corrupt
-            # the positional merge below.
-            return ((t_.astype(np.int64) * gmax + g_) << code_bits) | c_
-
+    pack = _level_key_packer(ts, g, code)
+    if pack is not None:
         pa = pack(m["ts"], m["g"], m["code"])
         pb = pack(ts2, g2, code2)
         if len(pa) and len(pb):
@@ -1076,6 +1100,12 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
     if (max_points_in_flight is None and checkpoint_dir is None
             and fault_injector is None):
         max_points_in_flight = _auto_points_in_flight(source)
+    if merge_spill_dir is not None and not max_points_in_flight:
+        raise ValueError(
+            "merge_spill_dir lives on the bounded path, but this job "
+            "routed single-shot; pass max_points_in_flight > 0 to "
+            "chunk (see run_job)"
+        )
     if max_points_in_flight:  # 0/None -> single-shot
         if checkpoint_dir is not None:
             raise ValueError(

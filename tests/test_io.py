@@ -448,6 +448,25 @@ class TestSinks:
         assert isinstance(open_sink(str(tmp_path / "o.jsonl")), JSONLBlobSink)
         assert isinstance(open_sink(f"dir:{tmp_path}/d"), DirectoryBlobSink)
 
+    def test_per_process_sink_spec(self):
+        """Sharded multihost egress derives distinct per-host paths for
+        path-backed sinks and passes through process-local / upsert
+        sinks unchanged."""
+        from heatmap_tpu.io.sinks import per_process_sink_spec as pps
+
+        assert pps("jsonl:/out/h.jsonl", 2) == "jsonl:/out/h.jsonl.p002"
+        assert pps("/out/h.jsonl", 7) == "jsonl:/out/h.jsonl.p007"
+        assert pps("arrays:/out/cols", 0) == "arrays:/out/cols/host000"
+        assert pps("arrays-parquet:/o", 1) == "arrays-parquet:/o/host001"
+        assert pps("dir:/out/blobs", 11) == "dir:/out/blobs/host011"
+        assert pps("memory:", 3) == "memory:"
+        assert pps("cassandra:", 5) == "cassandra:"
+        with pytest.raises(ValueError):
+            pps("bogus:/x", 0)
+        # Derived specs all open.
+        for spec in ("jsonl:/tmp/x.jsonl.p002", "dir:/tmp/d/host000"):
+            open_sink(spec)
+
     def test_cassandra_sink_batches_async_inserts(self):
         """C12 egress (reference heatmap.py:149-150,157): statements
         carry (id, json) params against rhom.heatmaps, async futures

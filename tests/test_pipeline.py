@@ -359,6 +359,42 @@ def test_bounded_spill_merge_matches_in_ram(tmp_path, amplify):
     assert list(spill_root.iterdir()) == []
 
 
+def test_bounded_spill_cleans_up_on_ingest_failure(tmp_path):
+    """A source that dies mid-run must not leave spill run files
+    behind (they are tens of GB at the shapes spill targets)."""
+    from heatmap_tpu.pipeline import run_job
+
+    good = _rows(n=600, seed=3)
+
+    class _Boom:
+        def batches(self, batch_size):
+            yield from _ColSource(good).batches(batch_size)
+            raise RuntimeError("source died")
+
+    root = tmp_path / "spill"
+    with pytest.raises(RuntimeError, match="source died"):
+        run_job(_Boom(), config=BatchJobConfig(detail_zoom=10,
+                                               min_detail_zoom=8),
+                batch_size=100, max_points_in_flight=200,
+                merge_spill_dir=str(root))
+    assert list(root.iterdir()) == []
+
+
+def test_spill_requires_bounded_path():
+    """merge_spill_dir on a single-shot route must refuse loudly, not
+    silently run the in-RAM merge it exists to avoid."""
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.pipeline import run_job
+    from heatmap_tpu.pipeline.batch import run_job_fast
+
+    with pytest.raises(ValueError, match="bounded path"):
+        run_job(SyntheticSource(n=50), config=BatchJobConfig(),
+                max_points_in_flight=0, merge_spill_dir="/tmp/nope")
+    with pytest.raises(ValueError, match="bounded path"):
+        run_job_fast(SyntheticSource(n=50), config=BatchJobConfig(),
+                     max_points_in_flight=0, merge_spill_dir="/tmp/nope")
+
+
 def test_bounded_spill_weighted_and_columnar(tmp_path):
     """Weighted spill sums match the in-RAM merge exactly (chunk-order
     summation), and the streaming per-level egress composes with a

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Peak-RSS probe for the bounded path's cross-chunk merge.
+
+Reproduces the PERF_NOTES adversarial shape (normal sigma ~0.5 deg ->
+near-unique z21 keys, output ~= input) and measures peak RSS of
+``run_job_fast(..., max_points_in_flight=...)`` with the in-RAM merge
+vs the disk-spill merge (``merge_spill_dir``), each in a fresh
+subprocess so high-water marks don't pollute each other. Sinks to
+arrays: egress (the at-scale path). Prints one JSON line per mode:
+
+    {"mode": "ram"|"spill", "peak_rss_gb": ..., "seconds": ...,
+     "rows": ..., "n": ..., "chunks": ...}
+
+Usage:
+    PYTHONPATH=.:$PYTHONPATH python tools/mem_probe.py \
+        [--n 20000000] [--chunk 2000000] [--modes ram,spill]
+
+The probe is CPU-only (forces jax_platforms=cpu): merge behavior is
+host-side; no relay needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_CHILD = """
+import json, os, resource, sys, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+from heatmap_tpu.io.hmpb import HMPBSource
+from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+
+hmpb, out_dir, spill_dir, chunk = sys.argv[1:5]
+chunk = int(chunk)
+cfg = BatchJobConfig()
+t0 = time.perf_counter()
+stats = run_job_fast(
+    HMPBSource(hmpb), LevelArraysSink(out_dir), cfg,
+    max_points_in_flight=chunk,
+    merge_spill_dir=spill_dir if spill_dir != "-" else None,
+)
+dt = time.perf_counter() - t0
+peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+print(json.dumps({
+    "peak_rss_gb": round(peak_kb / (1 << 20), 2),
+    "seconds": round(dt, 1),
+    "rows": stats.get("rows"),
+}), flush=True)
+"""
+
+
+def build_points(path: str, n: int, seed: int = 3) -> None:
+    from heatmap_tpu.io.hmpb import write_hmpb
+
+    rng = np.random.default_rng(seed)
+    lat = rng.normal(47.6, 0.5, n)
+    lon = rng.normal(-122.3, 0.5, n)
+    routed = rng.integers(0, 8, n).astype(np.int32)
+    write_hmpb(path, lat, lon, routed, [f"u{i}" for i in range(8)])
+
+
+def run_mode(hmpb: str, mode: str, chunk: int, work: str) -> dict:
+    out_dir = os.path.join(work, f"out-{mode}")
+    spill = os.path.join(work, "spill") if mode == "spill" else "-"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "." + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.perf_counter()
+    r = subprocess.run(
+        [sys.executable, "-c", _CHILD, hmpb, out_dir, spill, str(chunk)],
+        capture_output=True, text=True, env=env,
+    )
+    if r.returncode != 0:
+        raise SystemExit(f"{mode} child failed:\n{r.stderr[-2000:]}")
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    rec.update(mode=mode, wall_s=round(time.perf_counter() - t0, 1))
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n", type=int, default=20_000_000)
+    ap.add_argument("--chunk", type=int, default=2_000_000)
+    ap.add_argument("--modes", default="ram,spill")
+    ap.add_argument("--workdir", default=None,
+                    help="default: a fresh temp dir (removed on exit)")
+    args = ap.parse_args()
+
+    import shutil
+
+    work = args.workdir or tempfile.mkdtemp(prefix="mem-probe-")
+    try:
+        hmpb = os.path.join(work, "pts.hmpb")
+        build_points(hmpb, args.n)
+        for mode in args.modes.split(","):
+            rec = run_mode(hmpb, mode.strip(), args.chunk, work)
+            rec.update(n=args.n, chunks=-(-args.n // args.chunk))
+            print(json.dumps(rec), flush=True)
+    finally:
+        if args.workdir is None:
+            shutil.rmtree(work, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    main()
