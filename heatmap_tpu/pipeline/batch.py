@@ -338,6 +338,58 @@ _HOST_BYTES_PER_POINT = 160
 #: overestimates points, which errs toward bounding — the safe side.
 _MIN_TEXT_ROW_BYTES = 32
 
+#: Bounded path: convert the in-RAM cross-chunk merge table to the
+#: disk-spill merge once it exceeds this many aggregate rows (~200 MB
+#: of columns; the spilled runs are 24 B/row in the system temp dir).
+#: Past this size the iterative fold's per-chunk re-scan of the whole
+#: table loses to one egress-time sort per level — measured 2.8x slower
+#: and +3.4 GB at 131M rows (PERF_NOTES round 3). Small-output jobs
+#: never cross it and never touch disk.
+AUTO_SPILL_ROWS = 8_000_000
+
+#: Directory for AUTOMATIC spill (None -> tempfile.gettempdir()).
+#: Set this (or pass merge_spill_dir explicitly) to redirect; the
+#: TMPDIR env var works too, via gettempdir().
+AUTO_SPILL_DIR: str | None = None
+
+
+def _mount_fstype(path: str, mounts_file: str = "/proc/mounts") -> str | None:
+    """Filesystem type of the longest mount-point prefix of ``path``
+    (Linux), or None when undeterminable (non-Linux: best effort)."""
+    try:
+        real = os.path.realpath(path)
+        best, fstype = "", None
+        with open(mounts_file) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) < 3:
+                    continue
+                mnt, typ = parts[1], parts[2]
+                if real == mnt or real.startswith(mnt.rstrip("/") + "/") \
+                        or mnt == "/":
+                    if len(mnt) > len(best):
+                        best, fstype = mnt, typ
+        return fstype
+    except OSError:
+        return None
+
+
+def _auto_spill_target() -> str | None:
+    """Directory for automatic spill, or None to stay in-RAM.
+
+    RAM-backed candidates (tmpfs/ramfs — /tmp on many distros) are
+    refused: spilling there moves pages from process RSS into tmpfs,
+    which the OOM killer counts all the same, and a size-capped tmpfs
+    would ENOSPC a job the in-RAM fold finishes. Explicit
+    ``merge_spill_dir`` is never second-guessed.
+    """
+    import tempfile
+
+    cand = AUTO_SPILL_DIR or tempfile.gettempdir()
+    if _mount_fstype(cand) in ("tmpfs", "ramfs"):
+        return None
+    return cand
+
 
 def _available_ram_bytes() -> int | None:
     """MemAvailable from /proc/meminfo (Linux), else total RAM via
@@ -594,6 +646,15 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     folding them into an in-RAM table, merging one level at a time at
     egress (_SpillMerge) — for near-unique-output shapes where the
     merge table itself outgrows RAM. Byte-identical results.
+
+    Without an explicit ``spill_dir`` the job still AUTO-SPILLS (to
+    the system temp dir, or AUTO_SPILL_DIR) once the in-RAM table
+    crosses AUTO_SPILL_ROWS: the running table converts to spill run 0
+    and later chunks spill directly. Measured strictly better past
+    that point (2.8x faster, -3.4 GB at 131M output rows — PERF_NOTES
+    round 3); small-output jobs never touch disk, and a RAM-backed
+    temp dir (tmpfs /tmp) disables auto-spill rather than fake the
+    memory win (_auto_spill_target).
     """
     import queue as queue_mod
     import threading
@@ -613,7 +674,10 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     }
     merged = [dict(empty) for _ in range(n_levels)]
     spill = _SpillMerge(spill_dir, n_levels) if spill_dir is not None else None
-    n_runs = 0
+    spill_runs = 0
+    # Candidate dir for automatic spill; None = RAM-backed temp (or
+    # redirected off) -> keep the in-RAM fold, the pre-round-3 behavior.
+    auto_spill_dir = _auto_spill_target() if spill is None else None
 
     def chunks():
         """Sequential chunk builder: ingest batches, cut at max_points.
@@ -712,19 +776,36 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
-            nonlocal n_runs
+            nonlocal spill, spill_runs
             for i, lvl in enumerate(levels):
                 ts_ids = lvl["slot"] // n_groups
                 g_ids = lvl["slot"] % n_groups
                 if spill is not None:
                     spill.add_level(
-                        n_runs, i, ts_ids, g_ids, lvl["code"], lvl["value"]
+                        spill_runs, i, ts_ids, g_ids, lvl["code"],
+                        lvl["value"],
                     )
                 else:
                     merged[i] = _merge_sorted_level(
                         merged[i], ts_ids, g_ids, lvl["code"], lvl["value"],
                     )
-            n_runs += 1
+            if spill is not None:
+                spill_runs += 1
+            elif (auto_spill_dir is not None
+                  and sum(len(m["code"]) for m in merged) > AUTO_SPILL_ROWS):
+                # The in-RAM fold re-scans this whole table every chunk
+                # — past this size the disk-spill merge is strictly
+                # better (measured 2.8x faster and -3.4 GB, PERF_NOTES
+                # round 3). Convert the accumulated table to spill run
+                # 0; later chunks spill directly. Run order preserves
+                # chunk-order summation, so results stay byte-identical.
+                spill = _SpillMerge(auto_spill_dir, n_levels)
+                for i, m in enumerate(merged):
+                    spill.add_level(
+                        0, i, m["ts"], m["g"], m["code"], m["value"]
+                    )
+                    merged[i] = dict(empty)
+                spill_runs = 1
 
     # Any failure between the first spilled run and egress must still
     # remove the spill tempdir (tens of GB at the shapes spill
@@ -811,7 +892,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 out = None
                 for i in range(n_levels):
                     part = _finish_blobs(
-                        [assemble(spill.merge_level(i, n_runs), i)],
+                        [assemble(spill.merge_level(i, spill_runs), i)],
                         ccfg, slot_names, as_json=True, sink=sink,
                     )
                     if (isinstance(part, dict)
@@ -829,7 +910,8 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             # amplify_all's cross-level recurrence needs every level in
             # hand (cascade._patch_amplified); materialize the merged
             # levels once, like the unbounded path.
-            merged = [spill.merge_level(i, n_runs) for i in range(n_levels)]
+            merged = [spill.merge_level(i, spill_runs)
+                      for i in range(n_levels)]
         elif all(len(m["code"]) == 0 for m in merged):
             return {}
 

@@ -359,6 +359,68 @@ def test_bounded_spill_merge_matches_in_ram(tmp_path, amplify):
     assert list(spill_root.iterdir()) == []
 
 
+def test_bounded_auto_spill_activates_and_matches(monkeypatch):
+    """With AUTO_SPILL_ROWS lowered, a plain bounded run converts its
+    in-RAM table to the spill merge mid-job — same blobs, spill
+    tempdir cleaned up."""
+    import glob
+
+    from heatmap_tpu.pipeline import batch as batch_mod
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2000, seed=7)
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=6)
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                    max_points_in_flight=150)
+
+    created = []
+    real_spill = batch_mod._SpillMerge
+
+    class _Spy(real_spill):
+        def __init__(self, root, n_levels):
+            super().__init__(root, n_levels)
+            created.append(self.dir)
+
+    monkeypatch.setattr(batch_mod, "_SpillMerge", _Spy)
+    monkeypatch.setattr(batch_mod, "AUTO_SPILL_ROWS", 500)
+    # Pin the auto-spill target to a real (disk-backed) dir so the
+    # test is independent of whether the host's /tmp is tmpfs.
+    monkeypatch.setattr(batch_mod, "_auto_spill_target",
+                        lambda: batch_mod.AUTO_SPILL_DIR)
+    monkeypatch.setattr(batch_mod, "AUTO_SPILL_DIR", "/tmp/auto-spill-test")
+    auto = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                   max_points_in_flight=150)
+    assert auto == plain
+    assert len(created) == 1  # activation happened exactly once
+    assert not glob.glob(created[0] + "*")  # tempdir removed
+
+
+def test_auto_spill_target_refuses_tmpfs(tmp_path, monkeypatch):
+    """A RAM-backed temp dir must disable auto-spill (tmpfs pages
+    count against the same memory the spill exists to save)."""
+    from heatmap_tpu.pipeline import batch as batch_mod
+
+    mounts = tmp_path / "mounts"
+    mounts.write_text(
+        "/dev/root / ext4 rw 0 0\n"
+        "tmpfs /ramtmp tmpfs rw 0 0\n"
+        "/dev/sdb /ramtmp/disk ext4 rw 0 0\n"
+    )
+    real_fstype = batch_mod._mount_fstype
+    fstype = lambda p: real_fstype(p, str(mounts))
+    assert fstype("/ramtmp/x") == "tmpfs"
+    assert fstype("/ramtmp/disk/x") == "ext4"  # longest prefix wins
+    assert fstype("/var/spool") == "ext4"
+
+    monkeypatch.setattr(batch_mod, "AUTO_SPILL_DIR", "/ramtmp/x")
+    monkeypatch.setattr(
+        batch_mod, "_mount_fstype", lambda p: fstype(p)
+    )
+    assert batch_mod._auto_spill_target() is None
+    monkeypatch.setattr(batch_mod, "AUTO_SPILL_DIR", "/var/spool")
+    assert batch_mod._auto_spill_target() == "/var/spool"
+
+
 def test_bounded_spill_cleans_up_on_ingest_failure(tmp_path):
     """A source that dies mid-run must not leave spill run files
     behind (they are tens of GB at the shapes spill targets)."""

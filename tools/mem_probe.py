@@ -41,9 +41,14 @@ jax.config.update("jax_enable_x64", True)
 from heatmap_tpu.io.hmpb import HMPBSource
 from heatmap_tpu.io.sinks import LevelArraysSink
 from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+from heatmap_tpu.pipeline import batch as batch_mod
 
 hmpb, out_dir, spill_dir, chunk = sys.argv[1:5]
 chunk = int(chunk)
+if spill_dir == "-":
+    # "ram" mode must measure the pure in-RAM fold: disable the
+    # AUTO_SPILL_ROWS conversion that is now the production default.
+    batch_mod.AUTO_SPILL_ROWS = 1 << 62
 cfg = BatchJobConfig()
 t0 = time.perf_counter()
 stats = run_job_fast(
