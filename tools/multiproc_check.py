@@ -155,6 +155,67 @@ if pid == 0:
             break
     checks["columnar_union_equals_oracle"] = ok
 
+# 4) cross-process COMPUTE collectives: the binning/aggregation
+# kernels on a mesh spanning both processes (1 device each) — psum /
+# psum_scatter / all_gather ride the inter-process transport, exactly
+# the pod layout (DCN instead of gloo, same program).
+from heatmap_tpu.ops import (
+    aggregate_keys, bin_points_window, window_from_bounds,
+)
+from heatmap_tpu.parallel import (
+    aggregate_keys_sharded, bin_points_replicated, bin_points_rowsharded,
+)
+from heatmap_tpu.parallel.multihost import make_hybrid_mesh
+
+mesh = make_hybrid_mesh()
+rng = np.random.default_rng(17)
+n_pts = k * (4096 // k)  # divisible by the k point shards for ANY k
+lats = rng.uniform(35.0, 55.0, n_pts)
+lons = rng.uniform(-5.0, 20.0, n_pts)
+win = window_from_bounds((35.0, 55.0), (-5.0, 20.0), zoom=9,
+                         align_levels=0, pad_multiple=k)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sharding = NamedSharding(mesh, P("data"))
+lo, hi = pid * (n_pts // k), (pid + 1) * (n_pts // k)
+glat = jax.make_array_from_process_local_data(sharding, lats[lo:hi])
+glon = jax.make_array_from_process_local_data(sharding, lons[lo:hi])
+local_raster = np.asarray(bin_points_window(lats, lons, win))
+
+raster = bin_points_replicated(glat, glon, win, mesh)
+got_raster = np.asarray(list(raster.addressable_shards)[0].data)
+checks["crossproc_psum_binning"] = bool(
+    (got_raster == local_raster).all()
+)
+
+# psum_scatter path: the merged raster stays row-sharded — this
+# process's band must equal the oracle's corresponding rows.
+rowsharded = bin_points_rowsharded(glat, glon, win, mesh)
+shard = list(rowsharded.addressable_shards)[0]
+checks["crossproc_psum_scatter_binning"] = bool(
+    (np.asarray(shard.data) == local_raster[shard.index]).all()
+)
+
+keys = rng.integers(0, 500, n_pts).astype(np.int32)
+gkeys = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), keys[lo:hi]
+)
+gu, gs, gn = aggregate_keys_sharded(gkeys, mesh, capacity=512)
+lu, ls, ln = aggregate_keys(keys, capacity=512)
+n_unique = int(np.asarray(list(gn.addressable_shards)[0].data))
+lu_n = int(ln)
+checks["crossproc_aggregate_keys"] = (
+    n_unique == lu_n
+    and bool(
+        (np.asarray(list(gu.addressable_shards)[0].data)[:n_unique]
+         == np.asarray(lu)[:lu_n]).all()
+    )
+    and bool(  # the reduce-by-key SUMS must survive the merge too
+        (np.asarray(list(gs.addressable_shards)[0].data)[:n_unique]
+         == np.asarray(ls)[:lu_n]).all()
+    )
+)
+
 barrier("done")
 print(json.dumps({"pid": pid, "ok": all(checks.values()),
                   "checks": checks}), flush=True)
