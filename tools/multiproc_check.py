@@ -167,13 +167,17 @@ from heatmap_tpu.parallel import (
 )
 from heatmap_tpu.parallel.multihost import make_hybrid_mesh
 
+# The hybrid mesh spans EVERY device of every process (8 local CPU
+# devices per child under the test suite's XLA_FLAGS, 1 otherwise) —
+# the realistic pod shape: intra-process "ICI" + inter-process gloo.
 mesh = make_hybrid_mesh()
+ndev = jax.device_count()  # k * local_device_count
 rng = np.random.default_rng(17)
-n_pts = k * (4096 // k)  # divisible by the k point shards for ANY k
+n_pts = ndev * k * (4096 // (ndev * k))  # divides shards for ANY k/ndev
 lats = rng.uniform(35.0, 55.0, n_pts)
 lons = rng.uniform(-5.0, 20.0, n_pts)
 win = window_from_bounds((35.0, 55.0), (-5.0, 20.0), zoom=9,
-                         align_levels=0, pad_multiple=k)
+                         align_levels=0, pad_multiple=ndev)
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 sharding = NamedSharding(mesh, P("data"))
