@@ -68,6 +68,14 @@ def barrier(tag):
     multihost_utils.process_allgather(np.asarray([pid]))
 
 
+def blobs_equal(got, want):
+    # Decoded equality: re-encoded collision merges may reorder the
+    # inner dicts, so string equality is too strict.
+    return set(got) == set(want) and all(
+        json.loads(got[key]) == json.loads(want[key]) for key in want
+    )
+
+
 cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8)
 src = SyntheticSource(n=n, seed=13)
 batch = 256
@@ -83,11 +91,36 @@ want = run_job(SyntheticSource(n=n, seed=13), config=cfg,
 # key order may differ from the oracle's — compare decoded.
 got = run_job_multihost(src, config=cfg, batch_size=batch,
                         egress="gather")
-checks["gather_equals_oracle"] = (
-    set(got) == set(want)
-    and all(json.loads(got[key]) == json.loads(want[key])
-            for key in want)
-)
+checks["gather_equals_oracle"] = blobs_equal(got, want)
+
+# 1b) weighted job over the same transport: f64 per-point sums must
+# merge across hosts exactly like counts (linearity).
+
+
+class _WSrc:
+    n = n
+
+    def batches(self, batch_size):
+        off = 0
+        for b in SyntheticSource(n=n, seed=13).batches(batch_size):
+            m = len(b["latitude"])
+            b = dict(b)
+            # Deterministic integer-valued weights from the GLOBAL row
+            # position (batches() yields the full stream in order even
+            # when shard_source_rows later filters) -> exact f64 sums.
+            b["value"] = ((np.arange(off, off + m) % 7) + 1).astype(
+                np.float64
+            )
+            off += m
+            yield b
+
+
+wcfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8, weighted=True)
+want_w = run_job(_WSrc(), config=wcfg, batch_size=batch,
+                 max_points_in_flight=0)
+got_w = run_job_multihost(_WSrc(), config=wcfg, batch_size=batch,
+                          egress="gather")
+checks["weighted_gather_equals_oracle"] = blobs_equal(got_w, want_w)
 
 # 2) sharded blob egress over the real all_to_all; per-host JSONL.
 # open_sink(per_process_sink_spec(...)) is exactly the CLI's path —
@@ -192,12 +225,13 @@ checks["crossproc_psum_binning"] = bool(
     (got_raster == local_raster).all()
 )
 
-# psum_scatter path: the merged raster stays row-sharded — this
-# process's band must equal the oracle's corresponding rows.
+# psum_scatter path: the merged raster stays row-sharded — EVERY
+# local band (8 per process under the suite's virtual-device flags)
+# must equal the oracle's corresponding rows.
 rowsharded = bin_points_rowsharded(glat, glon, win, mesh)
-shard = list(rowsharded.addressable_shards)[0]
-checks["crossproc_psum_scatter_binning"] = bool(
-    (np.asarray(shard.data) == local_raster[shard.index]).all()
+checks["crossproc_psum_scatter_binning"] = all(
+    bool((np.asarray(s.data) == local_raster[s.index]).all())
+    for s in rowsharded.addressable_shards
 )
 
 keys = rng.integers(0, 500, n_pts).astype(np.int32)
