@@ -82,20 +82,13 @@ def _check_bench_job(log_path: str) -> bool:
 
 
 def runlist():
+    # VALUE order, not dependency order: if the relay answers late in
+    # a round, the first items to complete are the driver-visible
+    # artifacts (a device:"tpu" bench at the shipped default, then the
+    # end-to-end cascade A/B), with the sweep remainder and the
+    # longer verify matrix behind them.
     py = sys.executable
     return [
-        {
-            "name": "verify_partitioned",
-            "cmd": [py, "tools/verify_partitioned_onchip.py",
-                    "--state", f"{STATE_DIR}/verify.jsonl"],
-            "timeout": 2700,
-        },
-        {
-            "name": "sweep_partitioned",
-            "cmd": [py, "tools/sweep_partitioned.py",
-                    "--state", f"{STATE_DIR}/sweep.jsonl"],
-            "timeout": 3600,
-        },
         {
             "name": "bench",
             # --no-probe: the runner already probed (in a killable
@@ -113,6 +106,18 @@ def runlist():
                     "--cascade-backend", "both"],
             "timeout": 3600,
             "check": _check_bench_job,
+        },
+        {
+            "name": "sweep_partitioned",
+            "cmd": [py, "tools/sweep_partitioned.py",
+                    "--state", f"{STATE_DIR}/sweep.jsonl"],
+            "timeout": 3600,
+        },
+        {
+            "name": "verify_partitioned",
+            "cmd": [py, "tools/verify_partitioned_onchip.py",
+                    "--state", f"{STATE_DIR}/verify.jsonl"],
+            "timeout": 2700,
         },
     ]
 
@@ -140,7 +145,11 @@ def log(msg):
     print(f"[onchip_runner {time.strftime('%H:%M:%S')}] {msg}", flush=True)
 
 
-def probe(timeout_s: float = 75.0) -> bool:
+def probe(timeout_s: float = 150.0) -> bool:
+    # Generous: a relay recovering from an outage can take >75s for
+    # its first backend init (per-call cost varies 2-5x day to day);
+    # false-failing the probe then would keep the queue idle exactly
+    # when the chip finally answers.
     try:
         r = subprocess.run([sys.executable, "-c", PROBE],
                            timeout=timeout_s, capture_output=True, text=True)
