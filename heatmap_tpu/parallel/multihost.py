@@ -158,11 +158,12 @@ def gather_blobs(local_blobs: dict, max_bytes: int = 1 << 30) -> dict:
     """Merge per-process blob dicts across hosts (DCN allgather).
 
     Values must be JSON-serializable (the pipeline emits JSON strings
-    already). Key collisions across hosts are summed when both sides
-    are numeric dicts, else last-process-wins — with process-sharded
+    already). Key collisions across hosts SUM — with process-sharded
     ingest and slot-complete cascades, collisions only occur for
     result tiles whose detail tiles straddle host shards, where the
-    inner dicts are disjoint-or-summable by construction.
+    inner dicts are disjoint-or-summable by construction; a
+    non-summable collision therefore indicates corruption and raises
+    (_merge_blob_values), never resolves last-process-wins.
 
     Single-process: returns ``local_blobs`` unchanged.
     """
@@ -197,16 +198,36 @@ def gather_blobs(local_blobs: dict, max_bytes: int = 1 << 30) -> dict:
 
 
 def _merge_blob_values(a, b):
-    """Sum two blob values that may be JSON strings of {tile: count}."""
+    """Sum two blob values that may be JSON strings of {tile: count}.
+
+    Collisions MUST be summable {tile: number} dicts — that is the
+    only shape this framework's egress emits, so anything else at a
+    merge point is corruption and raises (the loud-overflow
+    convention; round-2 review flagged the old silent
+    last-process-wins resolution).
+    """
     decode = isinstance(a, str)
     da = json.loads(a) if decode else a
     db = json.loads(b) if isinstance(b, str) else b
-    if isinstance(da, dict) and isinstance(db, dict):
-        out = dict(da)
-        for k, v in db.items():
-            out[k] = out.get(k, 0) + v if isinstance(v, (int, float)) else v
-        return json.dumps(out) if decode else out
-    return b
+    if not (isinstance(da, dict) and isinstance(db, dict)):
+        raise ValueError(
+            f"colliding blob values are not mergeable dicts "
+            f"({type(da).__name__} vs {type(db).__name__})"
+        )
+    out = dict(da)
+    for k, v in db.items():
+        if k not in out:  # no collision: shape constraints don't apply
+            out[k] = v
+            continue
+        prev = out[k]
+        if not (isinstance(v, (int, float))
+                and isinstance(prev, (int, float))):
+            raise ValueError(
+                f"non-numeric blob collision for detail tile {k!r} "
+                f"({type(prev).__name__} + {type(v).__name__})"
+            )
+        out[k] = prev + v
+    return json.dumps(out) if decode else out
 
 
 def blob_owner(blob_id: str, process_count: int) -> int:

@@ -141,6 +141,21 @@ def test_merge_blob_values_sums_json_dicts():
     assert _merge_blob_values({"t": 1}, {"t": 2}) == {"t": 3}
 
 
+def test_merge_blob_values_rejects_non_numeric_collisions():
+    """Anything but summable {tile: number} dicts at a merge point is
+    corruption — loud, never last-process-wins (round-2 weak #6)."""
+    with pytest.raises(ValueError, match="non-numeric"):
+        _merge_blob_values({"t": "x"}, {"t": 1.0})
+    with pytest.raises(ValueError, match="not mergeable"):
+        _merge_blob_values(json.dumps([1, 2]), json.dumps({"t": 1.0}))
+    # Disjoint keys never collide, so shape of the VALUE only matters
+    # on actual collisions — including non-numeric new keys.
+    assert _merge_blob_values({"a": 1}, {"b": 2}) == {"a": 1, "b": 2}
+    assert _merge_blob_values({"a": 1}, {"b": "meta"}) == {
+        "a": 1, "b": "meta"
+    }
+
+
 def test_sharded_cascade_merge_equals_global():
     """Per-host run + blob merge == single global run (linearity)."""
     from heatmap_tpu.io.sources import SyntheticSource
