@@ -269,12 +269,20 @@ def pyramid_sparse_morton_sharded(
     the compact per-device results; the full pyramid then rolls up from
     the merged (already sorted) uniques via Morton shifts — replicated,
     since post-merge work is O(levels * capacity), tiny next to binning.
+
+    ``capacity`` may be an int (same for all levels) or a per-level
+    list, as in ops.pyramid.pyramid_sparse_morton — the composite-key
+    cascade passes its zoom-clamped per-level capacities through here
+    (pipeline/cascade.py build_cascade with a mesh). The per-device
+    detail stage is sized by ``min(caps[0], shard rows)``: a shard's
+    distinct keys are a subset of the global distinct keys, so a global
+    capacity that holds the data also holds every shard.
     """
     axes, ndev = _shard_axes(mesh)
     codes = jnp.asarray(codes)
     n = codes.shape[0]
-    capacity = n if capacity is None else capacity
-    local_capacity = min(capacity, n // ndev)
+    caps = pyramid_ops._level_caps(capacity, n, levels)
+    local_capacity = max(1, min(caps[0], n // ndev))
     if acc_dtype is None:
         acc_dtype = jnp.int32 if weights is None else jnp.float32
     w = _ones_like_weights(weights, n, acc_dtype)
@@ -302,7 +310,7 @@ def pyramid_sparse_morton_sharded(
         weights=gs,
         valid=gu != sentinel,
         levels=levels,
-        capacity=capacity,
+        capacity=caps,
         acc_dtype=acc_dtype,
     )
     # Propagate per-device overflow into every level's n_unique so the
@@ -312,9 +320,9 @@ def pyramid_sparse_morton_sharded(
         (
             lu,
             ls,
-            jnp.where(local_overflow, jnp.maximum(ln, capacity + 1), ln),
+            jnp.where(local_overflow, jnp.maximum(ln, caps[lvl] + 1), ln),
         )
-        for (lu, ls, ln) in out
+        for lvl, (lu, ls, ln) in enumerate(out)
     ]
 
 

@@ -57,6 +57,21 @@ class BatchJobConfig:
     #: the on-chip stage balance shows the per-level scatters dominating
     #: enough to pay for the compiles (PERF_NOTES pending item 4).
     adaptive_capacity: bool = False
+    #: Data-parallelize the cascade over the process's LOCAL devices
+    #: (reference scale-out analog: Spark's elastic executors,
+    #: submit-heatmap:10-13). None (default) auto-enables when
+    #: ``jax.local_device_count() > 1`` — a single-process v5e-8 host
+    #: drives all 8 chips from the same ``run_job`` call — and stays
+    #: off single-chip, where the mesh would only add dispatch
+    #: overhead. True forces the mesh path even on one device (the
+    #: sharded kernels are exercised, results unchanged); False pins
+    #: the single-device cascade. Counts and integer-valued weighted
+    #: sums are bit-identical either way; fractional weighted sums
+    #: agree up to f64 summation-order rounding (see
+    #: cascade.build_cascade ``mesh``). Composes with multi-process
+    #: runs (run_job_multihost): each process data-parallelizes its
+    #: slice over its own local devices.
+    data_parallel: bool | None = None
 
     def __post_init__(self):
         if self.cascade_backend not in ("scatter", "partitioned"):
@@ -72,6 +87,20 @@ class BatchJobConfig:
                 "use the scatter backend — rejected at config time so "
                 "the combination fails before ingest"
             )
+        if self.data_parallel:
+            if self.cascade_backend != "scatter":
+                raise ValueError(
+                    "data_parallel=True composes with the scatter "
+                    f"cascade backend only (got "
+                    f"{self.cascade_backend!r}) — rejected at config "
+                    "time so the combination fails before ingest"
+                )
+            if self.adaptive_capacity:
+                raise ValueError(
+                    "data_parallel=True is shape-static; "
+                    "adaptive_capacity reads concrete per-level counts "
+                    "and does not compose — disable one of them"
+                )
 
     def cascade_config(self) -> cascade_mod.CascadeConfig:
         return cascade_mod.CascadeConfig(
@@ -142,6 +171,27 @@ def _project_codes_jit(lat, lon, zoom):
     row, col, valid = mercator.project_points(lat, lon, zoom,
                                               dtype=jnp.float64)
     return morton.morton_encode(row, col, dtype=jnp.int64, zoom=zoom), valid
+
+
+def _dp_mesh(config: BatchJobConfig):
+    """Mesh over the process's local devices for the cascade's
+    data-parallel route, or None for the single-device cascade.
+
+    Auto (``data_parallel=None``) engages only past one local device:
+    the mesh path is bit-identical but adds shard_map dispatch that a
+    single chip gains nothing from. The partitioned backend and
+    adaptive capacities route single-device (True + either is already
+    rejected at config time).
+    """
+    if config.data_parallel is False:
+        return None
+    if config.cascade_backend != "scatter" or config.adaptive_capacity:
+        return None
+    if config.data_parallel is None and jax.local_device_count() < 2:
+        return None
+    from heatmap_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=jax.local_devices())
 
 
 def _cascade_codes(lat, lon, detail_zoom):
@@ -434,7 +484,8 @@ def _estimate_source_points(source) -> int | None:
     return None
 
 
-def _auto_points_in_flight(source, ram_budget: int | None = None) -> int | None:
+def _auto_points_in_flight(source, ram_budget: int | None = None,
+                           shard_count: int = 1) -> int | None:
     """Bounded-path chunk size when the source won't fit RAM, else None.
 
     Half of MemAvailable is the working budget; a source whose
@@ -443,10 +494,15 @@ def _auto_points_in_flight(source, ram_budget: int | None = None) -> int | None:
     ingest + device arrays share the budget). Sources that fit keep
     the faster single-shot path — auto-routing must never slow down
     jobs that were fine.
+
+    ``shard_count``: divide the estimate by the number of processes
+    sharing the source (run_job_multihost ingests ~1/k of the rows per
+    host, so the fit decision is about the slice, not the whole file).
     """
     est = _estimate_source_points(source)
     if est is None:
         return None
+    est = -(-est // max(shard_count, 1))
     if ram_budget is None:
         avail = _available_ram_bytes()
         if avail is None:
@@ -748,6 +804,8 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
         if pending:
             yield cut()
 
+    dp_mesh = _dp_mesh(config)
+
     def process(chunk):
         lat, lon, group_ids, flat_stamps, weights = chunk
         with tracer.span("cascade.chunk", items=len(lat)):
@@ -773,6 +831,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 adaptive=config.adaptive_capacity,
                 jit=False,
                 backend=config.cascade_backend,
+                mesh=dp_mesh,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
@@ -1556,6 +1615,7 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             acc_dtype=jnp.float64 if e_weights is not None else None,
             adaptive=config.adaptive_capacity,
             backend=config.cascade_backend,
+            mesh=_dp_mesh(config),
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

@@ -95,7 +95,8 @@ def decode_level_keys(level_keys: np.ndarray, detail_zoom: int, level: int):
 
 def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                   weights=None, valid=None, capacity=None, acc_dtype=None,
-                  adaptive: bool = False, backend: str = "scatter"):
+                  adaptive: bool = False, backend: str = "scatter",
+                  mesh=None):
     """Device-side cascade: per-level (composite key, sum) aggregates.
 
     Args:
@@ -114,9 +115,34 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     ops/sparse_partitioned.py — route here only after its on-chip
     numbers land, PERF_NOTES pending item 5).
 
-    Returns the list of per-level (keys, sums, n_unique) — level i at
-    detail zoom ``config.detail_zoom - i``.
+    ``mesh``: a jax.sharding.Mesh to data-parallelize the detail-level
+    reduction over (parallel.sharded.pyramid_sparse_morton_sharded):
+    emissions are padded to the shard count and reduced per device,
+    one all_gather merges the compact per-device aggregates, and the
+    rollup runs replicated — composite keys shift exactly like plain
+    Morton codes (the slot bits ride above the code bits), so the
+    shift-preserves-sort property the single-device cascade relies on
+    holds per level unchanged. Counts and integer-valued weighted sums
+    are BIT-IDENTICAL to the single-device cascade (same sorted unique
+    keys, exact integer addition in any order); fractional weighted
+    sums agree up to f64 summation-order rounding — the same contract
+    as the bounded path's cross-chunk merge (pipeline/batch.py
+    run_job). Scatter backend only; ``adaptive`` reads concrete counts
+    and does not compose.
     """
+    if mesh is not None:
+        if backend != "scatter":
+            raise ValueError(
+                f"mesh-parallel cascade supports the scatter backend "
+                f"(got {backend!r}); the partitioned reduction is "
+                "single-device until its on-chip numbers land"
+            )
+        if adaptive:
+            raise ValueError(
+                "mesh-parallel cascade is shape-static; "
+                "adaptive_capacity reads concrete per-level counts and "
+                "does not compose — disable one of them"
+            )
     ck = composite_keys(codes, slots, config.detail_zoom, n_slots)
     # Zoom-clamped per-level capacities: level l's key space is at most
     # n_slots * 4^(detail_zoom - l) — a STATIC bound that no data can
@@ -134,6 +160,11 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             min(base, n_slots << (2 * (config.detail_zoom - lvl)))
             for lvl in range(config.n_levels + 1)
         ]
+    if mesh is not None:
+        return _build_cascade_sharded(
+            ck, config, mesh, weights=weights, valid=valid,
+            capacity=capacity, acc_dtype=acc_dtype,
+        )
     if backend == "partitioned":
         slot_bits = max(1, int(np.ceil(np.log2(max(n_slots, 2)))))
         if 2 * config.detail_zoom + slot_bits > 60:
@@ -174,6 +205,43 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     )
 
 
+def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
+                           weights=None, valid=None, capacity=None,
+                           acc_dtype=None):
+    """Pad composite keys to the mesh shard count and run the sharded
+    pyramid (see build_cascade's ``mesh`` doc). Pad lanes carry
+    valid=False, the masking path every kernel already drops."""
+    # Lazy import: parallel.sharded pulls in the pallas histogram stack,
+    # which cascade-only consumers (spark_adapter, tools) never need.
+    from heatmap_tpu.parallel import sharded as sharded_kernels
+
+    _, ndev = sharded_kernels._shard_axes(mesh)
+    n = int(ck.shape[0])
+    if n == 0:
+        # Zero-row shards would size the per-device stage at zero
+        # capacity; the replicated pyramid handles empty inputs already
+        # and there is nothing to parallelize.
+        return pyramid_ops.pyramid_sparse_morton(
+            ck, weights=weights, valid=valid, levels=config.n_levels,
+            capacity=capacity, acc_dtype=acc_dtype,
+        )
+    pad = (-n) % ndev
+    v = (jnp.ones((n,), bool) if valid is None
+         else jnp.asarray(valid, bool))
+    if pad:
+        ck = jnp.concatenate([ck, jnp.zeros((pad,), ck.dtype)])
+        v = jnp.concatenate([v, jnp.zeros((pad,), bool)])
+        if weights is not None:
+            weights = jnp.asarray(weights)
+            weights = jnp.concatenate(
+                [weights, jnp.zeros((pad,), weights.dtype)]
+            )
+    return sharded_kernels.pyramid_sparse_morton_sharded(
+        ck, mesh, weights=weights, valid=v, levels=config.n_levels,
+        capacity=capacity, acc_dtype=acc_dtype,
+    )
+
+
 #: build_cascade under one jit: a single dispatch instead of ~130
 #: eager op dispatches (each paying relay latency on the axon backend)
 #: and cross-level XLA fusion of the shift/compare/cumsum chains —
@@ -183,32 +251,34 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
 _build_cascade_jit = functools.partial(
     jax.jit,
     static_argnames=("config", "n_slots", "capacity", "acc_dtype",
-                     "backend"),
+                     "backend", "mesh"),
 )(build_cascade)
 
 
 def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 weights=None, valid=None, capacity=None, acc_dtype=None,
                 adaptive: bool = False, jit: bool = True,
-                backend: str = "scatter"):
+                backend: str = "scatter", mesh=None):
     """The production cascade entry: jitted whole, unless ``adaptive``
     (which must read concrete per-level unique counts and therefore
     runs eagerly — see ops.pyramid.pyramid_sparse_morton) or
     ``jit=False`` (callers whose input shapes vary call to call — e.g.
     the bounded chunked path — would recompile the whole graph per
-    call and should stay eager)."""
+    call and should stay eager). ``mesh`` (hashable, a valid static
+    arg) routes the detail reduction through the data-parallel sharded
+    pyramid — see build_cascade."""
     if adaptive or not jit:
         return build_cascade(
             codes, slots, config, n_slots, weights=weights, valid=valid,
             capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
-            backend=backend,
+            backend=backend, mesh=mesh,
         )
     if isinstance(capacity, list):
         capacity = tuple(capacity)  # static args must be hashable
     return _build_cascade_jit(
         codes, slots, config=config, n_slots=n_slots, weights=weights,
         valid=valid, capacity=capacity, acc_dtype=acc_dtype,
-        backend=backend,
+        backend=backend, mesh=mesh,
     )
 
 
