@@ -214,24 +214,26 @@ def cmd_run(args) -> int:
         # job on EVERY host of a per-host launch script, with all of
         # them writing the same output path.
         raise SystemExit("--multihost-egress requires --multihost")
-    if args.merge_spill_dir and (args.multihost or args.checkpoint_dir):
-        # The spill merge lives on the bounded path; those modes never
-        # route there — ignoring the flag would quietly run the
+    if args.merge_spill_dir and args.checkpoint_dir:
+        # The spill merge lives on the bounded path; checkpointing
+        # never routes there — ignoring the flag would quietly run the
         # unbounded in-RAM merge the operator asked to avoid.
+        # (--multihost composes: each process's bounded slice ingest
+        # takes the same spill knob, run_job_multihost validates.)
         raise SystemExit("--merge-spill-dir applies to the bounded "
                          "(chunked) path only; it cannot combine with "
-                         "--multihost or --checkpoint-dir")
+                         "--checkpoint-dir")
     # 0 means "explicitly single-shot", which composes with both
     # checkpointing and multihost; only a positive bound conflicts.
     if args.max_points_in_flight and args.checkpoint_dir:
         raise SystemExit("--max-points-in-flight and --checkpoint-dir are "
                          "mutually exclusive (chunk boundaries are not "
                          "batch boundaries)")
-    if args.multihost and (args.fast or args.checkpoint_dir
-                           or args.max_points_in_flight):
+    if args.multihost and (args.fast or args.checkpoint_dir):
         raise SystemExit("--multihost runs the standard job path only "
-                         "(not --fast / --checkpoint-dir / "
-                         "--max-points-in-flight)")
+                         "(not --fast / --checkpoint-dir); "
+                         "--max-points-in-flight composes (each process "
+                         "streams its slice through the bounded path)")
     fast_source = None
     if args.fast and args.no_fast:
         raise SystemExit("--fast and --no-fast are mutually exclusive")
@@ -338,6 +340,7 @@ def cmd_run(args) -> int:
                     sink, config, batch_size=args.batch_size,
                     max_points_in_flight=args.max_points_in_flight,
                     egress=args.multihost_egress,
+                    merge_spill_dir=args.merge_spill_dir,
                 )
             else:
                 blobs = run_job(open_source(args.input,

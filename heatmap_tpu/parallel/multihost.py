@@ -566,14 +566,51 @@ def shard_source(source, process_count: int | None = None,
 class _CaptureLevels:
     """In-memory ``write_levels`` sink: captures finalized level arrays
     so the multihost columnar path can scatter them before the real
-    sink write."""
+    sink write. Accumulates across calls — the bounded path's spill
+    egress finalizes one level per call, the single-shot path all
+    levels in one."""
 
     def __init__(self):
         self.levels: list[dict] = []
 
     def write_levels(self, levels) -> int:
-        self.levels = list(levels)
-        return sum(len(lvl["value"]) for lvl in self.levels)
+        new = list(levels)
+        self.levels.extend(new)
+        return sum(len(lvl["value"]) for lvl in new)
+
+
+class _SliceSource:
+    """This process's row-sharded slice as a re-cuttable source.
+
+    The batch-index shard assignment is pinned at the CONSTRUCTION
+    batch size: every process must cut the source at the same
+    granularity or the partition drops/duplicates rows, and the
+    bounded path re-reads batches at ``min(batch_size, max_points)``
+    — a per-host value when the chunk size was auto-derived from that
+    host's RAM. So ``batches(bs)`` always shards at the pinned size
+    and re-cuts oversized batches afterwards, host-locally.
+    """
+
+    def __init__(self, source, n_total: int, batch_size: int):
+        self.source = source
+        self.n_total = n_total
+        self.batch_size = batch_size
+
+    def batches(self, bs: int):
+        sliced = shard_source_rows(
+            self.source.batches(self.batch_size), self.n_total,
+            self.batch_size,
+        )
+        if bs >= self.batch_size:
+            yield from sliced
+            return
+        for batch in sliced:
+            n = len(batch["latitude"])
+            if n <= bs:
+                yield batch
+                continue
+            for i in range(0, n, bs):
+                yield {k: v[i:i + bs] for k, v in batch.items()}
 
 
 def run_job_multihost(source, sink=None, config=None,
@@ -581,7 +618,8 @@ def run_job_multihost(source, sink=None, config=None,
                       n_total: int | None = None,
                       egress: str = "auto",
                       max_points_in_flight: int | None = None,
-                      egress_max_bytes: int = 1 << 30):
+                      egress_max_bytes: int = 1 << 30,
+                      merge_spill_dir: str | None = None):
     """Process-sharded ``run_job``: each host ingests its slice of the
     source and aggregates on its local devices; egress then either
 
@@ -613,17 +651,27 @@ def run_job_multihost(source, sink=None, config=None,
     raises (sources must declare their size to shard — SyntheticSource
     has ``n``; files can be pre-counted).
 
-    ``max_points_in_flight`` applies to the single-process fallthrough
-    only (run_job's knob, including its 0 = force-single-shot
-    sentinel); the multi-process ingest is already bounded by the
-    per-process source slice. ``egress_max_bytes`` caps the egress
-    collective's frame (gather_blobs' payload / the sharded
-    all-to-all's dense frame) so a skewed job fails loudly instead of
-    OOMing a device — raise it here when a big job legitimately needs
-    more.
+    ``max_points_in_flight`` composes with multi-process runs: each
+    process streams ITS SLICE through the chunked cascade + host merge
+    (run_job's bounded path, auto-spill included), so per-host memory
+    is O(chunk + unique output keys) instead of the whole slice in one
+    shot — BASELINE config 5's per-host memory story (the Spark
+    analog: executors stream partitions and spill,
+    submit-heatmap:14). ``None`` auto-routes exactly like run_job,
+    with the fit decision made about the 1/k slice; ``0`` forces the
+    single-shot slice ingest. ``merge_spill_dir`` passes through to
+    the bounded path's disk-spill cross-chunk merge (run_job's knob;
+    requires a positive/auto bound, same refusal rule).
+    ``egress_max_bytes`` caps the egress collective's frame
+    (gather_blobs' payload / the sharded all-to-all's dense frame) so
+    a skewed job fails loudly instead of OOMing a device — raise it
+    here when a big job legitimately needs more.
     """
     from heatmap_tpu.pipeline import BatchJobConfig, run_job
-    from heatmap_tpu.pipeline.batch import _run_loaded, ingest_columns
+    from heatmap_tpu.pipeline.batch import (
+        _auto_points_in_flight, _run_job_bounded, _run_loaded,
+        ingest_columns,
+    )
 
     config = config or BatchJobConfig()
     if egress not in ("auto", "gather", "sharded"):
@@ -646,10 +694,11 @@ def run_job_multihost(source, sink=None, config=None,
         egress = "gather"
     if jax.process_count() == 1:
         return run_job(source, sink, config, batch_size=batch_size,
-                       max_points_in_flight=max_points_in_flight)
+                       max_points_in_flight=max_points_in_flight,
+                       merge_spill_dir=merge_spill_dir)
     sharded = shard_source(source)
     if sharded is not None:
-        batches = sharded.batches(batch_size)
+        slice_source = sharded
     else:
         if n_total is None:
             n_total = getattr(source, "n", None)
@@ -658,24 +707,44 @@ def run_job_multihost(source, sink=None, config=None,
                     "multi-host sharding needs n_total (source row count) "
                     "or a range-shardable source"
                 )
-        batches = shard_source_rows(source.batches(batch_size), n_total,
-                                    batch_size)
-    data = ingest_columns(batches, config)
-    if columnar:
-        cap = _CaptureLevels()
+        slice_source = _SliceSource(source, n_total, batch_size)
+    if max_points_in_flight is None:
+        max_points_in_flight = _auto_points_in_flight(
+            source, shard_count=jax.process_count()
+        )
+    if merge_spill_dir is not None and not max_points_in_flight:
+        raise ValueError(
+            "merge_spill_dir lives on the bounded path; pass "
+            "max_points_in_flight > 0 to chunk the per-process slice "
+            "(run_job's refusal rule — silently ignoring the spill "
+            "request would run the in-RAM merge it exists to avoid)"
+        )
+    # Ingest this process's slice into either captured level arrays
+    # (columnar sinks) or local blobs; the egress tail below is shared
+    # by both ingest routes.
+    cap = _CaptureLevels() if columnar else None
+    if max_points_in_flight:
+        # Bounded slice ingest: chunked cascade + host-side merge
+        # (auto-spill / explicit spill included) — blobs equal the
+        # single-shot slice run by the same linearity the bounded path
+        # already guarantees.
+        local = _run_job_bounded(slice_source, cap, config, batch_size,
+                                 max_points_in_flight,
+                                 spill_dir=merge_spill_dir)
+    else:
+        data = ingest_columns(slice_source.batches(batch_size), config)
         if data is not None:
-            _run_loaded(data, config, as_json=False, sink=cap)
+            # Cross-host blob merge sums colliding numeric dicts, which
+            # is exactly the weighted semantics too (f64 sums are
+            # linear across host shards).
+            local = _run_loaded(data, config, as_json=True, sink=cap)
+        else:
+            local = {}
+    if columnar:
         owned = scatter_levels(cap.levels, max_bytes=egress_max_bytes)
         rows = sink.write_levels(owned)
         return {"egress": "levels-sharded", "levels": len(owned),
                 "rows": rows}
-    if data is not None:
-        # Cross-host blob merge sums colliding numeric dicts, which is
-        # exactly the weighted semantics too (f64 sums are linear
-        # across host shards).
-        local = _run_loaded(data, config, as_json=True)
-    else:
-        local = {}
     if egress == "sharded":
         owned = scatter_blobs(local, max_bytes=egress_max_bytes)
         if sink is not None:

@@ -489,3 +489,47 @@ def _multiproc_env():
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
     return env
+
+
+def test_slice_source_recuts_oversized_batches():
+    """_SliceSource pins the shard assignment at the construction batch
+    size and re-cuts locally when the bounded path re-reads at a
+    smaller granularity — rows, order, and column alignment preserved."""
+    from heatmap_tpu.parallel.multihost import _SliceSource
+
+    class _Src:
+        def batches(self, bs):
+            for i in range(0, 250, bs):
+                m = min(bs, 250 - i)
+                yield {
+                    "latitude": np.arange(i, i + m, dtype=np.float64),
+                    "longitude": np.arange(i, i + m, dtype=np.float64),
+                    "user_id": [f"u{j}" for j in range(i, i + m)],
+                    "timestamp": [None] * m,
+                }
+
+    src = _SliceSource(_Src(), n_total=250, batch_size=100)
+    out = list(src.batches(40))
+    assert all(len(b["latitude"]) <= 40 for b in out)
+    lats = np.concatenate([b["latitude"] for b in out])
+    np.testing.assert_array_equal(lats, np.arange(250, dtype=np.float64))
+    users = [u for b in out for u in b["user_id"]]
+    assert users == [f"u{j}" for j in range(250)]
+    # At or above the pinned size: batches pass through untouched.
+    passthrough = list(src.batches(100))
+    assert [len(b["latitude"]) for b in passthrough] == [100, 100, 50]
+
+
+def test_run_job_multihost_bounded_single_process_matches():
+    """max_points_in_flight routes the single-process fallthrough
+    through run_job's bounded path — blobs equal the unbounded run."""
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.parallel.multihost import run_job_multihost
+    from heatmap_tpu.pipeline import BatchJobConfig
+
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=7)
+    want = run_job_multihost(SyntheticSource(n=2000, seed=3), config=cfg,
+                             batch_size=256, max_points_in_flight=0)
+    got = run_job_multihost(SyntheticSource(n=2000, seed=3), config=cfg,
+                            batch_size=256, max_points_in_flight=300)
+    assert got == want and len(got) > 0
