@@ -131,6 +131,14 @@ class HMPBSource:
     contract for the generic (slower) pipeline paths.
     """
 
+    #: Resident host bytes/point under FAST ingest: the mmap'd columns
+    #: are page-cache (reclaimable), and only the per-batch routed
+    #: views materialize (~28 B: f64 coords + i32 group + i64 stamp).
+    #: Consulted by pipeline._auto_points_in_flight(fast=True) so a
+    #: big HMPB file that fits single-shot is not demoted to the
+    #: chunked path by the 160 B string-ingest constant (ADVICE r3).
+    fast_host_bytes_per_point = 30
+
     def __init__(self, path: str):
         self.path = path
         size = os.path.getsize(path)
@@ -275,6 +283,10 @@ class HMPBDirSource:
     run_job_fast contract (routed ids index the cumulative
     ``new_group_names`` stream; ids stay stable across files).
     """
+
+    #: See HMPBSource.fast_host_bytes_per_point (files stream one at a
+    #: time, so the per-point residency matches the single-file case).
+    fast_host_bytes_per_point = 30
 
     path: str
     shard_index: int = 0

@@ -417,16 +417,17 @@ def bin_points_bandsharded(
     with a psum. Returns the (H, W) raster row-sharded over the tile
     axis (replicated over data).
 
-    ``send_capacity`` bounds the per-destination all_to_all buffer
-    (default: the per-device point count, which cannot overflow).
-    Smaller values save memory but drop points past the capacity, so
-    a capacity-bounded call returns ``(band_raster, dropped)`` where
-    ``dropped`` is the replicated global count of points lost to the
-    cap — the ops/sparse.py overflow contract applied to the
-    collective: callers must check ``dropped == 0`` and fail/retry
-    with a larger capacity rather than trust a skew assumption (the
-    pattern is pinned by tests/test_parallel.py's skewed-band test).
-    With the default capacity the raster alone is returned.
+    Returns ``(band_raster, dropped)`` — always a pair, regardless of
+    arguments, so the call site's unpacking cannot depend on which
+    knobs were passed. ``send_capacity`` bounds the per-destination
+    all_to_all buffer (default: the per-device point count, which
+    cannot overflow — ``dropped`` is then structurally zero). Smaller
+    values save memory but drop points past the capacity; ``dropped``
+    is the replicated global count of points lost to the cap — the
+    ops/sparse.py overflow contract applied to the collective: callers
+    must check ``dropped == 0`` and fail/retry with a larger capacity
+    rather than trust a skew assumption (the pattern is pinned by
+    tests/test_parallel.py's skewed-band test).
 
     ``backend`` routes the band binning; unlike the replicated /
     rowsharded kernels it defaults to "xla", not "auto": this function
@@ -531,10 +532,4 @@ def bin_points_bandsharded(
         out_specs=(P(TILE_AXIS, None), P()),
         check_vma=False,
     )
-    band_raster, dropped = fn(latitude, longitude, w, v)
-    if send_capacity is None:
-        # cap == n_local: per-destination counts cannot exceed the
-        # buffer, so the drop channel is structurally zero — keep the
-        # plain-raster return for the common case.
-        return band_raster
-    return band_raster, dropped
+    return fn(latitude, longitude, w, v)

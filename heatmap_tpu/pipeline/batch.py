@@ -60,12 +60,13 @@ class BatchJobConfig:
     #: Data-parallelize the cascade over the process's LOCAL devices
     #: (reference scale-out analog: Spark's elastic executors,
     #: submit-heatmap:10-13). None (default) auto-enables when
-    #: ``jax.local_device_count() > 1`` — a single-process v5e-8 host
-    #: drives all 8 chips from the same ``run_job`` call — and stays
-    #: off single-chip, where the mesh would only add dispatch
-    #: overhead. True forces the mesh path even on one device (the
-    #: sharded kernels are exercised, results unchanged); False pins
-    #: the single-device cascade. Counts and integer-valued weighted
+    #: ``jax.local_device_count() > 1`` AND the call's emission count
+    #: reaches AUTO_DP_MIN_EMISSIONS — a single-process v5e-8 host
+    #: drives all 8 chips from the same ``run_job`` call on real
+    #: workloads, while tiny inputs (and single chips) skip the mesh
+    #: dispatch they'd only lose to. True forces the mesh path at any
+    #: size and device count (the sharded kernels are exercised,
+    #: results unchanged); False pins the single-device cascade. Counts and integer-valued weighted
     #: sums are bit-identical either way; fractional weighted sums
     #: agree up to f64 summation-order rounding (see
     #: cascade.build_cascade ``mesh``). Composes with multi-process
@@ -173,15 +174,27 @@ def _project_codes_jit(lat, lon, zoom):
     return morton.morton_encode(row, col, dtype=jnp.int64, zoom=zoom), valid
 
 
+#: Auto data-parallel engages only past this many emissions (explicit
+#: ``data_parallel=True`` always engages). Below it the per-device
+#: slices are too small for the shard_map dispatch + all_gather merge
+#: to pay for themselves on ANY backend — measured 9x SLOWER on the
+#: 8-device CPU mesh with 150-point bounded chunks (eager per-chunk
+#: dispatch), and a real chip gains nothing from sharding a few
+#: thousand rows eight ways. Auto-routing must never slow down jobs
+#: that were fine (the _auto_points_in_flight rule applied to DP).
+AUTO_DP_MIN_EMISSIONS = 1 << 18
+
+
 def _dp_mesh(config: BatchJobConfig):
     """Mesh over the process's local devices for the cascade's
     data-parallel route, or None for the single-device cascade.
 
-    Auto (``data_parallel=None``) engages only past one local device:
-    the mesh path is bit-identical but adds shard_map dispatch that a
-    single chip gains nothing from. The partitioned backend and
-    adaptive capacities route single-device (True + either is already
-    rejected at config time).
+    Capability gate only — the per-call size gate is
+    :func:`_dp_mesh_for`. Auto (``data_parallel=None``) engages only
+    past one local device: the mesh path is bit-identical but adds
+    shard_map dispatch that a single chip gains nothing from. The
+    partitioned backend and adaptive capacities route single-device
+    (True + either is already rejected at config time).
     """
     if config.data_parallel is False:
         return None
@@ -192,6 +205,16 @@ def _dp_mesh(config: BatchJobConfig):
     from heatmap_tpu.parallel.mesh import make_mesh
 
     return make_mesh(devices=jax.local_devices())
+
+
+def _dp_mesh_for(mesh, config: BatchJobConfig, n_emissions: int):
+    """The mesh to pass this cascade call, or None: auto engages only
+    at AUTO_DP_MIN_EMISSIONS and up; explicit True always engages."""
+    if mesh is None:
+        return None
+    if config.data_parallel is None and n_emissions < AUTO_DP_MIN_EMISSIONS:
+        return None
+    return mesh
 
 
 def _cascade_codes(lat, lon, detail_zoom):
@@ -424,6 +447,41 @@ def _mount_fstype(path: str, mounts_file: str = "/proc/mounts") -> str | None:
         return None
 
 
+def _free_disk_bytes(path: str) -> int | None:
+    """Free bytes available to this process on ``path``'s filesystem,
+    or None when unknowable (the caller keeps its measured default)."""
+    try:
+        st = os.statvfs(path)
+        return st.f_bavail * st.f_frsize
+    except (OSError, AttributeError):
+        return None
+
+
+def _auto_spill_projection_fits(spill_dir: str, table_rows: int,
+                                chunks_done: int,
+                                total_chunks_est: int | None,
+                                max_chunk_rows: int) -> bool:
+    """Will the projected spill volume fit the target filesystem?
+
+    Auto-spill must never convert a job that was finishing fine in RAM
+    into an ENOSPC failure on a small disk-backed temp dir (tmpfs is
+    already refused by _auto_spill_target; SIZE was not checked before
+    this). Projection: the accumulated table spills immediately
+    (24 B/row) and each remaining chunk adds at most the largest
+    chunk's output seen so far; when the source size is unknowable,
+    assume as many chunks remain as have run. 25% headroom — the
+    projection errs conservative, and the write-failure fallback below
+    still catches a filesystem that fills anyway.
+    """
+    free = _free_disk_bytes(spill_dir)
+    if free is None:
+        return True
+    remaining = (chunks_done if total_chunks_est is None
+                 else max(total_chunks_est - chunks_done, 0))
+    projected = 24 * (table_rows + remaining * max_chunk_rows)
+    return projected + projected // 4 <= free
+
+
 def _auto_spill_target() -> str | None:
     """Directory for automatic spill, or None to stay in-RAM.
 
@@ -485,7 +543,9 @@ def _estimate_source_points(source) -> int | None:
 
 
 def _auto_points_in_flight(source, ram_budget: int | None = None,
-                           shard_count: int = 1) -> int | None:
+                           shard_count: int = 1,
+                           fast: bool = False,
+                           n_timespans: int = 1) -> int | None:
     """Bounded-path chunk size when the source won't fit RAM, else None.
 
     Half of MemAvailable is the working budget; a source whose
@@ -498,6 +558,17 @@ def _auto_points_in_flight(source, ram_budget: int | None = None,
     ``shard_count``: divide the estimate by the number of processes
     sharing the source (run_job_multihost ingests ~1/k of the rows per
     host, so the fit decision is about the slice, not the whole file).
+
+    ``fast`` (run_job_fast's auto call): consult the source's
+    ``fast_host_bytes_per_point`` — HMPB mmap ingest is near-zero-copy
+    (~30 B/point of materialized routed columns vs 160 B of string
+    ingest), so a large HMPB file that fits single-shot must not be
+    silently demoted to the chunked path by the string-path constant
+    (ADVICE r3). ``n_timespans`` scales the per-emission share added
+    on top of the declared rate (each timespan doubles the emission
+    arrays). The string path never reads the attribute: the same
+    source consumed through ``batches()`` materializes Python strings
+    at the conservative rate.
     """
     est = _estimate_source_points(source)
     if est is None:
@@ -508,7 +579,19 @@ def _auto_points_in_flight(source, ram_budget: int | None = None,
         if avail is None:
             return None
         ram_budget = avail // 2
-    fits = ram_budget // _HOST_BYTES_PER_POINT
+    bytes_per_point = _HOST_BYTES_PER_POINT
+    if fast:
+        declared = getattr(source, "fast_host_bytes_per_point", None)
+        if declared is not None:
+            # The declared rate covers resident ingest columns only;
+            # the emission/sort arrays (i64 code + i64 slot + valid,
+            # ~2x transiently under the cascade sort ≈ 32 B/emission,
+            # 2 emissions per timespan per point) share the same
+            # budget — on host-memory backends they ARE host RAM, so
+            # the fit check must include them or a "fitting" file can
+            # materialize several times the budget single-shot.
+            bytes_per_point = declared + 64 * max(n_timespans, 1)
+    fits = ram_budget // bytes_per_point
     if est <= fits:
         return None
     # A quarter of what fits (up to 3 chunks resident under
@@ -731,9 +814,18 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     merged = [dict(empty) for _ in range(n_levels)]
     spill = _SpillMerge(spill_dir, n_levels) if spill_dir is not None else None
     spill_runs = 0
+    spill_is_auto = False
     # Candidate dir for automatic spill; None = RAM-backed temp (or
     # redirected off) -> keep the in-RAM fold, the pre-round-3 behavior.
     auto_spill_dir = _auto_spill_target() if spill is None else None
+    # Spill-volume projection inputs (the free-space check at auto
+    # conversion): total chunk count when the source size is estimable.
+    est_points = _estimate_source_points(source)
+    total_chunks_est = (
+        None if est_points is None else -(-est_points // max_points)
+    )
+    chunks_done = 0
+    max_chunk_rows = 0
 
     def chunks():
         """Sequential chunk builder: ingest batches, cut at max_points.
@@ -831,40 +923,126 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 adaptive=config.adaptive_capacity,
                 jit=False,
                 backend=config.cascade_backend,
-                mesh=dp_mesh,
+                mesh=_dp_mesh_for(dp_mesh, config, len(e_codes)),
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
-            nonlocal spill, spill_runs
-            for i, lvl in enumerate(levels):
-                ts_ids = lvl["slot"] // n_groups
-                g_ids = lvl["slot"] % n_groups
-                if spill is not None:
-                    spill.add_level(
-                        spill_runs, i, ts_ids, g_ids, lvl["code"],
-                        lvl["value"],
+            nonlocal spill, spill_runs, spill_is_auto, auto_spill_dir
+            nonlocal chunks_done, max_chunk_rows
+            chunks_done += 1
+            max_chunk_rows = max(
+                max_chunk_rows, sum(len(lvl["code"]) for lvl in levels)
+            )
+            if spill is not None:
+                failed_level = None
+                try:
+                    for i, lvl in enumerate(levels):
+                        failed_level = i
+                        spill.add_level(
+                            spill_runs, i, lvl["slot"] // n_groups,
+                            lvl["slot"] % n_groups, lvl["code"],
+                            lvl["value"],
+                        )
+                except OSError as e:
+                    if not spill_is_auto:
+                        raise  # explicit merge_spill_dir: operator's call
+                    # AUTO spill hit a disk error (ENOSPC and kin) on a
+                    # job the in-RAM fold might still finish: fold every
+                    # spilled run — plus this chunk's unwritten levels —
+                    # back into RAM and carry on diskless. Run order is
+                    # preserved, so results stay byte-identical to the
+                    # never-spilled fold.
+                    import warnings
+
+                    # The level that raised may have all four files
+                    # PRESENT but the last one truncated (ENOSPC mid
+                    # np.save) — existence is not completeness there,
+                    # so drop its files outright and re-merge it from
+                    # the in-memory chunk data.
+                    spill.discard_level(spill_runs, failed_level)
+                    written = spill.complete_levels(spill_runs)
+                    written.discard(failed_level)
+                    for i in range(n_levels):
+                        base = spill.merge_level(i, spill_runs + 1)
+                        if i not in written:
+                            lvl = levels[i]
+                            base = _merge_sorted_level(
+                                base, lvl["slot"] // n_groups,
+                                lvl["slot"] % n_groups, lvl["code"],
+                                lvl["value"],
+                            )
+                        merged[i] = base
+                    spill.cleanup()
+                    spill = None
+                    spill_is_auto = False
+                    auto_spill_dir = None
+                    warnings.warn(
+                        f"auto-spill write failed ({e}); folded spilled "
+                        "runs back into RAM and continuing without disk "
+                        "(set TMPDIR/AUTO_SPILL_DIR to a larger "
+                        "filesystem to re-enable)",
+                        RuntimeWarning, stacklevel=2,
                     )
                 else:
-                    merged[i] = _merge_sorted_level(
-                        merged[i], ts_ids, g_ids, lvl["code"], lvl["value"],
-                    )
-            if spill is not None:
-                spill_runs += 1
-            elif (auto_spill_dir is not None
-                  and sum(len(m["code"]) for m in merged) > AUTO_SPILL_ROWS):
+                    spill_runs += 1
+                return
+            for i, lvl in enumerate(levels):
+                merged[i] = _merge_sorted_level(
+                    merged[i], lvl["slot"] // n_groups,
+                    lvl["slot"] % n_groups, lvl["code"], lvl["value"],
+                )
+            table_rows = sum(len(m["code"]) for m in merged)
+            if auto_spill_dir is not None and table_rows > AUTO_SPILL_ROWS:
                 # The in-RAM fold re-scans this whole table every chunk
                 # — past this size the disk-spill merge is strictly
                 # better (measured 2.8x faster and -3.4 GB, PERF_NOTES
                 # round 3). Convert the accumulated table to spill run
                 # 0; later chunks spill directly. Run order preserves
                 # chunk-order summation, so results stay byte-identical.
-                spill = _SpillMerge(auto_spill_dir, n_levels)
-                for i, m in enumerate(merged):
-                    spill.add_level(
-                        0, i, m["ts"], m["g"], m["code"], m["value"]
+                # But only onto a filesystem the projected volume fits
+                # (ADVICE r3: a small disk-backed /tmp must not ENOSPC
+                # a job that completed fully in RAM before auto-spill
+                # existed); refusal and write failure both fall back to
+                # the in-RAM fold with a warning.
+                import warnings
+
+                if not _auto_spill_projection_fits(
+                        auto_spill_dir, table_rows, chunks_done,
+                        total_chunks_est, max_chunk_rows):
+                    warnings.warn(
+                        f"auto-spill skipped: projected spill volume "
+                        f"does not fit {auto_spill_dir!r}; keeping the "
+                        "in-RAM merge (set TMPDIR/AUTO_SPILL_DIR to a "
+                        "larger filesystem, or pass merge_spill_dir)",
+                        RuntimeWarning, stacklevel=2,
                     )
-                    merged[i] = dict(empty)
-                spill_runs = 1
+                    auto_spill_dir = None
+                    return
+                # Construction (makedirs + mkdtemp) can itself raise on
+                # a full or unwritable filesystem — that too must fall
+                # back to the in-RAM fold, not fail the job.
+                converting = None
+                try:
+                    converting = _SpillMerge(auto_spill_dir, n_levels)
+                    for i, m in enumerate(merged):
+                        converting.add_level(
+                            0, i, m["ts"], m["g"], m["code"], m["value"]
+                        )
+                except OSError as e:
+                    if converting is not None:
+                        converting.cleanup()
+                    auto_spill_dir = None
+                    warnings.warn(
+                        f"auto-spill conversion failed ({e}); keeping "
+                        "the in-RAM merge",
+                        RuntimeWarning, stacklevel=2,
+                    )
+                else:
+                    spill = converting
+                    spill_is_auto = True
+                    for i in range(n_levels):
+                        merged[i] = dict(empty)
+                    spill_runs = 1
 
     # Any failure between the first spilled run and egress must still
     # remove the spill tempdir (tens of GB at the shapes spill
@@ -1023,6 +1201,44 @@ class _SpillMerge:
         np.save(base + "_code.npy", np.asarray(code, np.int64))
         np.save(base + "_value.npy", np.asarray(value, np.float64))
         self.rows_spilled += len(code)
+
+    def discard_level(self, run: int, level: int | None) -> None:
+        """Remove whatever ``(run, level)`` files exist — a save that
+        raised may have left the LAST file truncated-but-present, so
+        the failing level must be dropped by name, not by existence."""
+        if level is None:
+            return
+        base = self._base(run, level)
+        for name in ("ts", "g", "code", "value"):
+            try:
+                os.remove(f"{base}_{name}.npy")
+            except OSError:
+                pass
+
+    def complete_levels(self, run: int) -> set:
+        """Levels of ``run`` whose four column files all exist.
+
+        A save that died mid-write (ENOSPC) leaves a partial file set;
+        partial levels are DELETED here so a later merge_level never
+        reads a half-written run (it keys existence off _code.npy,
+        which may exist while _value.npy does not). Used by the
+        auto-spill write-failure recovery in _run_job_bounded.
+        """
+        done = set()
+        for level in range(self.n_levels):
+            base = self._base(run, level)
+            paths = [f"{base}_{name}.npy"
+                     for name in ("ts", "g", "code", "value")]
+            present = [p for p in paths if os.path.exists(p)]
+            if len(present) == len(paths):
+                done.add(level)
+            else:
+                for p in present:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+        return done
 
     def merge_level(self, level: int, n_runs: int) -> dict:
         cols = {"ts": [], "g": [], "code": [], "value": []}
@@ -1240,7 +1456,11 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
         raise ValueError(f"checkpoint_every must be >= 1, got {checkpoint_every}")
     if (max_points_in_flight is None and checkpoint_dir is None
             and fault_injector is None):
-        max_points_in_flight = _auto_points_in_flight(source)
+        max_points_in_flight = _auto_points_in_flight(
+            source, fast=True,
+            n_timespans=(1 if config.first_timespan_only
+                         else len(config.timespans)),
+        )
     if merge_spill_dir is not None and not max_points_in_flight:
         raise ValueError(
             "merge_spill_dir lives on the bounded path, but this job "
@@ -1615,7 +1835,7 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             acc_dtype=jnp.float64 if e_weights is not None else None,
             adaptive=config.adaptive_capacity,
             backend=config.cascade_backend,
-            mesh=_dp_mesh(config),
+            mesh=_dp_mesh_for(_dp_mesh(config), config, len(e_codes)),
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

@@ -249,10 +249,11 @@ def test_bandsharded_binning_matches_single_device(mesh2d):
         (35.0, 55.0), (-5.0, 20.0), zoom=10, align_levels=3, pad_multiple=8
     )
     (pla, plo), valid = pad_to_multiple([lats, lons], 8)
-    got = bin_points_bandsharded(
+    got, dropped = bin_points_bandsharded(
         jnp.asarray(pla), jnp.asarray(plo), win, mesh2d,
         valid=jnp.asarray(valid),
     )
+    assert int(dropped) == 0  # default capacity: structurally zero
     want = np.asarray(bin_points_window(lats, lons, win))
     np.testing.assert_array_equal(np.asarray(got), want)
     assert got.sharding.spec[0] == "tile"  # rows band-sharded
@@ -267,14 +268,12 @@ def test_bandsharded_weighted(mesh2d):
         (35.0, 55.0), (-5.0, 20.0), zoom=9, align_levels=0, pad_multiple=8
     )
     (pla, plo, pw), valid = pad_to_multiple([lats, lons, w], 8)
-    got = np.asarray(
-        bin_points_bandsharded(
-            jnp.asarray(pla), jnp.asarray(plo), win, mesh2d,
-            weights=jnp.asarray(pw), valid=jnp.asarray(valid),
-        )
+    got, _ = bin_points_bandsharded(
+        jnp.asarray(pla), jnp.asarray(plo), win, mesh2d,
+        weights=jnp.asarray(pw), valid=jnp.asarray(valid),
     )
     want = np.asarray(bin_points_window(lats, lons, win, weights=w))
-    np.testing.assert_allclose(got, want, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
 
 
 def test_bandsharded_under_jit(mesh2d):
@@ -287,7 +286,7 @@ def test_bandsharded_under_jit(mesh2d):
 
     @jax.jit
     def step(la, lo):
-        return bin_points_bandsharded(la, lo, win, mesh2d)
+        return bin_points_bandsharded(la, lo, win, mesh2d)[0]
 
     got = np.asarray(step(jnp.asarray(lats), jnp.asarray(lons)))
     want = np.asarray(bin_points_window(lats, lons, win))

@@ -914,22 +914,40 @@ def test_zoom_clamped_capacities_match_unclamped():
 
 
 def _dp_cfg(**kw):
+    # data_parallel=True: the equivalence tests below must exercise the
+    # mesh route at test-sized inputs, which the auto threshold
+    # (AUTO_DP_MIN_EMISSIONS) deliberately routes single-device.
     base = dict(detail_zoom=12, min_detail_zoom=6,
-                timespans=("alltime", "month"))
+                timespans=("alltime", "month"), data_parallel=True)
     base.update(kw)
     return BatchJobConfig(**base)
 
 
 def test_dp_mesh_auto_routing():
-    """Auto (None) engages on this 8-device env; False pins it off; the
+    """Auto (None) is capable on this 8-device env but engages only at
+    AUTO_DP_MIN_EMISSIONS; True always engages; False pins it off; the
     non-composing configs route single-device instead of raising."""
-    from heatmap_tpu.pipeline.batch import _dp_mesh
+    from heatmap_tpu.pipeline.batch import (
+        AUTO_DP_MIN_EMISSIONS, _dp_mesh, _dp_mesh_for,
+    )
 
-    assert _dp_mesh(_dp_cfg()) is not None
-    assert _dp_mesh(_dp_cfg(data_parallel=True)) is not None
+    auto = _dp_cfg(data_parallel=None)
+    mesh = _dp_mesh(auto)
+    assert mesh is not None
+    assert _dp_mesh(_dp_cfg()) is not None  # True
     assert _dp_mesh(_dp_cfg(data_parallel=False)) is None
-    assert _dp_mesh(_dp_cfg(cascade_backend="partitioned")) is None
-    assert _dp_mesh(_dp_cfg(adaptive_capacity=True)) is None
+    assert _dp_mesh(
+        _dp_cfg(data_parallel=None, cascade_backend="partitioned")
+    ) is None
+    assert _dp_mesh(
+        _dp_cfg(data_parallel=None, adaptive_capacity=True)
+    ) is None
+    # The size gate: auto stays single-device below the threshold
+    # (tiny shards lose to the dispatch), engages at it; explicit True
+    # engages at any size.
+    assert _dp_mesh_for(mesh, auto, AUTO_DP_MIN_EMISSIONS - 1) is None
+    assert _dp_mesh_for(mesh, auto, AUTO_DP_MIN_EMISSIONS) is mesh
+    assert _dp_mesh_for(mesh, _dp_cfg(), 8) is mesh
 
 
 def test_dp_config_rejections():
@@ -1059,3 +1077,181 @@ def test_build_cascade_mesh_rejects_noncomposing():
     with pytest.raises(ValueError, match="adaptive"):
         cascade_mod.build_cascade(codes, slots, cfg, n_slots=1,
                                   adaptive=True, mesh=mesh)
+
+
+# -- auto-spill safety rails (ADVICE r3 medium) ----------------------------
+
+
+def _auto_spill_env(monkeypatch, batch_mod, tmp_path):
+    """Force auto-spill eligibility: tiny threshold, per-test dir (a
+    shared hardcoded dir would let parallel runs see each other's live
+    spill tempdirs)."""
+    monkeypatch.setattr(batch_mod, "AUTO_SPILL_ROWS", 500)
+    monkeypatch.setattr(batch_mod, "_auto_spill_target",
+                        lambda: batch_mod.AUTO_SPILL_DIR)
+    monkeypatch.setattr(batch_mod, "AUTO_SPILL_DIR",
+                        str(tmp_path / "auto-spill"))
+
+
+def test_auto_spill_refused_when_projection_exceeds_free_space(
+        monkeypatch, tmp_path):
+    """A too-small target filesystem must keep the in-RAM fold (with a
+    warning), never convert and then ENOSPC a job that RAM finishes."""
+    import glob
+
+    from heatmap_tpu.pipeline import batch as batch_mod
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2000, seed=7)
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=6)
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                    max_points_in_flight=150)
+
+    _auto_spill_env(monkeypatch, batch_mod, tmp_path)
+    monkeypatch.setattr(batch_mod, "_free_disk_bytes", lambda p: 1024)
+    created = []
+    real_spill = batch_mod._SpillMerge
+
+    class _Spy(real_spill):
+        def __init__(self, root, n_levels):
+            super().__init__(root, n_levels)
+            created.append(self.dir)
+
+    monkeypatch.setattr(batch_mod, "_SpillMerge", _Spy)
+    with pytest.warns(RuntimeWarning, match="auto-spill skipped"):
+        got = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                      max_points_in_flight=150)
+    assert got == plain
+    assert created == []  # never converted
+    assert not glob.glob(str(tmp_path / "auto-spill" / "merge-spill-*"))
+
+
+def test_auto_spill_write_failure_falls_back_to_ram(monkeypatch, tmp_path):
+    """An OSError mid-spill on the AUTO path folds the spilled runs
+    back into RAM and finishes diskless — byte-identical blobs, spill
+    tempdir cleaned up, warning raised (ADVICE r3: auto-spill must not
+    fail a job that previously completed fully in RAM)."""
+    import glob
+
+    from heatmap_tpu.pipeline import batch as batch_mod
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2000, seed=7)
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=6)
+    plain = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                    max_points_in_flight=150)
+
+    _auto_spill_env(monkeypatch, batch_mod, tmp_path)
+    real_spill = batch_mod._SpillMerge
+    state = {"adds": 0, "dirs": []}
+
+    class _Failing(real_spill):
+        def __init__(self, root, n_levels):
+            super().__init__(root, n_levels)
+            state["dirs"].append(self.dir)
+
+        def add_level(self, run, level, ts, g, code, value):
+            # Let the conversion (run 0) through, then die partway
+            # through a later run — some levels written, some not, and
+            # the failing level's last file TRUNCATED-but-present (the
+            # real ENOSPC shape): recovery must drop it by name, not
+            # trust file existence.
+            if run >= 1 and level >= 3:
+                base = self._base(run, level)
+                np.save(base + "_ts.npy", np.asarray(ts, np.int32))
+                np.save(base + "_g.npy", np.asarray(g, np.int32))
+                np.save(base + "_code.npy", np.asarray(code, np.int64))
+                with open(base + "_value.npy", "wb") as f:
+                    f.write(b"\x93NUMPY")  # truncated mid-write
+                raise OSError(28, "No space left on device")
+            return super().add_level(run, level, ts, g, code, value)
+
+    monkeypatch.setattr(batch_mod, "_SpillMerge", _Failing)
+    with pytest.warns(RuntimeWarning, match="auto-spill write failed"):
+        got = run_job(_ColSource(rows), config=cfg, batch_size=128,
+                      max_points_in_flight=150)
+    assert got == plain
+    assert len(state["dirs"]) == 1
+    assert not glob.glob(state["dirs"][0] + "*")  # cleaned up
+
+
+def test_explicit_spill_write_failure_still_raises(monkeypatch, tmp_path):
+    """merge_spill_dir is the operator's explicit choice: a disk error
+    there must fail the job loudly, not silently fall back to the
+    in-RAM merge whose footprint the operator asked to avoid."""
+    from heatmap_tpu.pipeline import batch as batch_mod
+    from heatmap_tpu.pipeline import run_job
+
+    real_spill = batch_mod._SpillMerge
+
+    class _Failing(real_spill):
+        def add_level(self, run, level, ts, g, code, value):
+            if run >= 1:
+                raise OSError(28, "No space left on device")
+            return super().add_level(run, level, ts, g, code, value)
+
+    monkeypatch.setattr(batch_mod, "_SpillMerge", _Failing)
+    rows = _rows(n=2000, seed=7)
+    cfg = BatchJobConfig(detail_zoom=12, min_detail_zoom=6)
+    with pytest.raises(OSError):
+        run_job(_ColSource(rows), config=cfg, batch_size=128,
+                max_points_in_flight=150,
+                merge_spill_dir=str(tmp_path / "spill"))
+    # Cleanup still ran (the ingest-failure cleanup path).
+    spill_root = tmp_path / "spill"
+    assert not spill_root.exists() or list(spill_root.iterdir()) == []
+
+
+def test_auto_spill_projection_math():
+    from heatmap_tpu.pipeline import batch as batch_mod
+
+    fits = batch_mod._auto_spill_projection_fits
+    # Known totals: 1000 table rows + 2 remaining * 500-row chunks
+    # -> 24 * 2000 * 1.25 = 60000 bytes projected.
+    import unittest.mock as mock
+    with mock.patch.object(batch_mod, "_free_disk_bytes",
+                           lambda p: 60_000):
+        assert fits("/x", 1000, 3, 5, 500)
+    with mock.patch.object(batch_mod, "_free_disk_bytes",
+                           lambda p: 59_999):
+        assert not fits("/x", 1000, 3, 5, 500)
+    # Unknown chunk total: assume as many chunks remain as have run.
+    with mock.patch.object(batch_mod, "_free_disk_bytes",
+                           lambda p: 10**12):
+        assert fits("/x", 1000, 3, None, 500)
+    # No free-space signal: keep the measured default (spill).
+    with mock.patch.object(batch_mod, "_free_disk_bytes",
+                           lambda p: None):
+        assert fits("/x", 10**12, 1, None, 10**12)
+
+
+def test_fast_auto_routing_respects_source_bytes_per_point():
+    """HMPB mmap ingest (~30 B/point resident) must not be demoted to
+    the chunked path by the 160 B string-ingest constant (ADVICE r3):
+    the fast auto call consults fast_host_bytes_per_point, the string
+    call ignores it."""
+    from heatmap_tpu.pipeline.batch import _auto_points_in_flight
+
+    class _FakeHMPB:
+        n = 1_000_000
+        fast_host_bytes_per_point = 30
+
+    # Effective fast rate: 30 declared + 64/timespan of emission/sort
+    # arrays = 94 B/pt at one timespan — fits a 100 B/pt budget where
+    # the 160 B string constant would demote.
+    budget = 1_000_000 * 100
+    assert _auto_points_in_flight(_FakeHMPB(), ram_budget=budget,
+                                  fast=True) is None
+    assert _auto_points_in_flight(_FakeHMPB(),
+                                  ram_budget=budget) is not None
+    # More timespans mean more emission arrays per point: the same
+    # source stops fitting (30 + 4*64 = 286 B/pt).
+    assert _auto_points_in_flight(_FakeHMPB(), ram_budget=budget,
+                                  fast=True, n_timespans=4) is not None
+
+    class _Plain:
+        n = 1_000_000
+
+    # No attribute: fast ingest keeps the conservative constant.
+    assert _auto_points_in_flight(_Plain(), ram_budget=budget,
+                                  fast=True) is not None
