@@ -545,7 +545,8 @@ def _estimate_source_points(source) -> int | None:
 def _auto_points_in_flight(source, ram_budget: int | None = None,
                            shard_count: int = 1,
                            fast: bool = False,
-                           n_timespans: int = 1) -> int | None:
+                           n_timespans: int = 1,
+                           weighted: bool = False) -> int | None:
     """Bounded-path chunk size when the source won't fit RAM, else None.
 
     Half of MemAvailable is the working budget; a source whose
@@ -591,6 +592,11 @@ def _auto_points_in_flight(source, ram_budget: int | None = None,
             # the fit check must include them or a "fitting" file can
             # materialize several times the budget single-shot.
             bytes_per_point = declared + 64 * max(n_timespans, 1)
+            if weighted:
+                # Weighted jobs carry an f64 value column (+8 B/pt)
+                # and expand f64 e_weights per emission with the same
+                # 2x transient factor (+32 B/timespan/pt).
+                bytes_per_point += 8 + 32 * max(n_timespans, 1)
     fits = ram_budget // bytes_per_point
     if est <= fits:
         return None
@@ -1460,6 +1466,7 @@ def run_job_fast(source, sink=None, config: BatchJobConfig | None = None,
             source, fast=True,
             n_timespans=(1 if config.first_timespan_only
                          else len(config.timespans)),
+            weighted=config.weighted,
         )
     if merge_spill_dir is not None and not max_points_in_flight:
         raise ValueError(

@@ -122,6 +122,7 @@ class TestEndToEnd:
         assert r2.returncode == 0, r2.stderr
         assert out2.read_bytes() == out.read_bytes()
 
+    @pytest.mark.slow
     def test_run_fast_csv_matches_plain(self, tmp_path):
         import csv
         import numpy as np
@@ -254,6 +255,7 @@ class TestEndToEnd:
         # The checkpoint run actually wrote checkpoints.
         assert any((tmp_path / "ck").iterdir())
 
+    @pytest.mark.slow
     def test_hmpb_auto_routes_fast(self, tmp_path):
         """An hmpb input with no flag must take the fast path and match
         the --no-fast standard path blob-for-blob (mirror of the CSV
@@ -336,6 +338,7 @@ class TestEndToEnd:
         pngs = [f for _, _, fs in os.walk(out) for f in fs]
         assert len(pngs) == stats["tiles"]
 
+    @pytest.mark.slow
     def test_tiles_weighted_csv(self, tmp_path):
         """--weighted sums the input's 'value' column (BASELINE config
         3): non-uniform weights change the rendered pixels, uniform
@@ -390,6 +393,7 @@ class TestEndToEnd:
         assert r2.returncode != 0
         assert "value" in r2.stderr
 
+    @pytest.mark.slow
     def test_run_cascade_backend_flag(self, tmp_path):
         """--cascade-backend partitioned produces byte-identical blobs
         to the default scatter backend, and the count-only rejection
@@ -428,6 +432,7 @@ class TestEndToEnd:
 
 
 class TestRender:
+    @pytest.mark.slow
     def test_render_from_arrays_and_jsonl(self, tmp_path):
         """Stored heatmaps -> PNG tiles from both storage kinds; the
         arrays and jsonl inputs must paint the same tile set for the

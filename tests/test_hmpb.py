@@ -391,6 +391,7 @@ class TestHMPBDirSource:
 
 
 class TestFastBounded:
+    @pytest.mark.slow
     def test_fast_bounded_matches_fast_and_string(self, tmp_path):
         """--fast --max-points-in-flight: chunked cascade with fast
         ingest must produce the exact blobs of both the unbounded fast
@@ -437,6 +438,7 @@ class TestFastBounded:
                          checkpoint_dir=str(tmp_path / "ck"),
                          max_points_in_flight=100)
 
+    @pytest.mark.slow
     def test_fast_bounded_dated_timespans(self, tmp_path):
         import jax
 

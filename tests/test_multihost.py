@@ -350,6 +350,7 @@ def test_scatter_blobs_fake_transport_wiring():
             assert json.loads(val) == {"7_1_1": 6.0}
 
 
+@pytest.mark.slow
 def test_scatter_levels_equals_global_columnar_run(tmp_path):
     """The VERDICT r2 'done' bar: per-host cascade + level scatter +
     per-host columnar writes reassemble to exactly the global columnar
@@ -459,6 +460,7 @@ def test_run_job_multihost_single_process_falls_through():
     assert run_job_multihost(src, config=cfg) == run_job(src, config=cfg)
 
 
+@pytest.mark.slow
 def test_multiproc_end_to_end():
     """REAL 2-process execution of the multihost layer: distributed
     init, process-sharded ingest, gather_blobs' framed allgather and
@@ -520,6 +522,7 @@ def test_slice_source_recuts_oversized_batches():
     assert [len(b["latitude"]) for b in passthrough] == [100, 100, 50]
 
 
+@pytest.mark.slow
 def test_run_job_multihost_bounded_single_process_matches():
     """max_points_in_flight routes the single-process fallthrough
     through run_job's bounded path — blobs equal the unbounded run."""
@@ -533,3 +536,23 @@ def test_run_job_multihost_bounded_single_process_matches():
     got = run_job_multihost(SyntheticSource(n=2000, seed=3), config=cfg,
                             batch_size=256, max_points_in_flight=300)
     assert got == want and len(got) > 0
+
+
+@pytest.mark.slow
+def test_multiproc_skew_exchange():
+    """REAL 4-process gloo run of the skew-proof byte exchange: one
+    payload 100x the rest passes under a max_bytes the old dense
+    (k, global-max) frame would have violated, with chunked ppermute
+    rounds bounding every collective buffer (VERDICT r3 weak #5)."""
+    r = subprocess.run(
+        [sys.executable, "tools/multiproc_check.py", "--skew-only",
+         "--k", "4", "--timeout", "300"],
+        capture_output=True, text=True, cwd=_REPO_ROOT, timeout=360,
+        env=_multiproc_env(),
+    )
+    lines = [ln for ln in r.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no output; stderr: {r.stderr[-1500:]}"
+    verdict = json.loads(lines[-1])
+    assert r.returncode == 0 and verdict["ok"], (
+        f"skew exchange failed: {lines}\nstderr: {r.stderr[-1500:]}"
+    )

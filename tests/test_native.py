@@ -340,6 +340,7 @@ def test_staging_pool_rejects_oversize():
             pool.acquire((1 << 20,), np.float64)
 
 
+@pytest.mark.slow
 def test_tsan_race_detection():
     """Run the native concurrency self-test under ThreadSanitizer
     (SURVEY.md §5 race-detection subsystem). Skips where TSAN can't

@@ -113,6 +113,10 @@ def test_decision_rules_fire_on_synthetic_evidence(tmp_path, capsys, monkeypatch
             {"config": "cascade-pyramid16 partitioned k=4", "ms": 800.0},
             {"config": "partitioned bc=65536 chunk=1024 bf=8 k=8", "ms": 197.0},
             {"config": "partitioned bc=65536 chunk=1024 bf=128 k=8", "ms": 180.0},
+            {"check": "stream", "backend": "auto", "batch": 262144,
+             "device": "tpu", "pts_per_s": 100e6, "steps_per_s": 380.0},
+            {"check": "stream", "backend": "pallas", "batch": 262144,
+             "device": "tpu", "pts_per_s": 150e6, "steps_per_s": 570.0},
         ]:
             f.write(json.dumps(rec) + "\n")
     with open(tmp_path / "verify.jsonl", "w") as f:
@@ -127,6 +131,10 @@ def test_decision_rules_fire_on_synthetic_evidence(tmp_path, capsys, monkeypatch
     assert by["weighted-routing"]["verdict"].startswith("FLIP")
     assert "partitioned k=4" in by["cascade-backend"]["verdict"]
     assert "128" in by["bad-frac-default"]["verdict"]
+    # Stream rule: a pinned backend >10% over auto flips the default;
+    # CPU rows must never count as on-chip evidence.
+    assert "pallas" in by["stream-backend"]["verdict"]
+    assert by["stream-backend"]["onchip_rows"] == 2
 
 
 def test_decision_rules_block_on_failed_verify(tmp_path, capsys, monkeypatch):

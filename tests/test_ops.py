@@ -241,6 +241,7 @@ def test_pyramid_sparse_morton_matches_counters():
         assert int(s.sum()) == 3000
 
 
+@pytest.mark.slow
 def test_pyramid_sparse_morton_adaptive_matches_fixed():
     """adaptive=True shrinks level arrays but the aggregates (and the
     true unique counts overflow detection relies on) are identical."""

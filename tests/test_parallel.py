@@ -54,6 +54,7 @@ def test_pad_to_multiple():
     assert pb.shape == (10,) and mask2.all()
 
 
+@pytest.mark.slow
 def test_replicated_binning_matches_single_device(mesh):
     lats, lons = _points()
     win = window_from_bounds((35.0, 55.0), (-5.0, 20.0), zoom=10, align_levels=3)
@@ -67,6 +68,7 @@ def test_replicated_binning_matches_single_device(mesh):
     assert got.sum() == len(lats)
 
 
+@pytest.mark.slow
 def test_rowsharded_binning_matches_single_device(mesh):
     lats, lons = _points(seed=1)
     win = window_from_bounds(
@@ -81,6 +83,7 @@ def test_rowsharded_binning_matches_single_device(mesh):
     np.testing.assert_array_equal(np.asarray(sharded), want)
 
 
+@pytest.mark.slow
 def test_rowsharded_weighted(mesh):
     lats, lons = _points(seed=2)
     w = np.random.default_rng(3).uniform(0.0, 2.0, len(lats)).astype(np.float32)
@@ -98,6 +101,7 @@ def test_rowsharded_weighted(mesh):
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_pyramid_rowsharded_matches_dense(mesh):
     lats, lons = _points(seed=4)
     win = window_from_bounds(
@@ -116,6 +120,7 @@ def test_pyramid_rowsharded_matches_dense(mesh):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.slow
 def test_aggregate_keys_sharded_matches_local(mesh):
     rng = np.random.default_rng(5)
     keys = rng.integers(0, 500, 8 * 1000).astype(np.int32)
@@ -130,6 +135,7 @@ def test_aggregate_keys_sharded_matches_local(mesh):
     np.testing.assert_allclose(np.asarray(gs[:n]), np.asarray(ls[:n]), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pyramid_sparse_sharded_matches_local(mesh):
     lats, lons = _points(seed=6)
     zoom, levels = 12, 5
@@ -168,6 +174,7 @@ def test_sharded_kernels_under_jit(mesh):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(w))
 
 
+@pytest.mark.slow
 def test_aggregate_keys_sharded_local_overflow_signal(mesh):
     # Review repro: device-local capacity overflow must surface in
     # n_unique even when the merged count looks clean.
@@ -183,6 +190,7 @@ def test_aggregate_keys_sharded_local_overflow_signal(mesh):
     np.testing.assert_array_equal(np.asarray(gu[:6]), np.arange(6))
 
 
+@pytest.mark.slow
 def test_aggregate_keys_sharded_local_capacity_exact(mesh):
     # The knob changes padding, never results.
     rng = np.random.default_rng(8)
@@ -208,6 +216,7 @@ def mesh2d(request):
     return make_mesh(data=data, tile=tile)
 
 
+@pytest.mark.slow
 def test_point_kernels_on_2d_mesh_match_single_device(mesh2d):
     """Existing point-parallel kernels shard over the flattened
     (data, tile) axes — tile > 1 uses all devices, same results."""
@@ -228,6 +237,7 @@ def test_point_kernels_on_2d_mesh_match_single_device(mesh2d):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(w))
 
 
+@pytest.mark.slow
 def test_sparse_kernels_on_2d_mesh_match_local(mesh2d):
     rng = np.random.default_rng(12)
     keys = rng.integers(0, 300, 8 * 512).astype(np.int32)
@@ -239,6 +249,7 @@ def test_sparse_kernels_on_2d_mesh_match_local(mesh2d):
     np.testing.assert_array_equal(np.asarray(gs[:n]), np.asarray(ls[:n]))
 
 
+@pytest.mark.slow
 def test_bandsharded_binning_matches_single_device(mesh2d):
     """The all_to_all tile-space regroup (groupByKey analog): counts
     match the single-device raster exactly, output sharded by band."""
@@ -259,6 +270,7 @@ def test_bandsharded_binning_matches_single_device(mesh2d):
     assert got.sharding.spec[0] == "tile"  # rows band-sharded
 
 
+@pytest.mark.slow
 def test_bandsharded_weighted(mesh2d):
     from heatmap_tpu.parallel import bin_points_bandsharded
 
@@ -276,6 +288,7 @@ def test_bandsharded_weighted(mesh2d):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_bandsharded_under_jit(mesh2d):
     from heatmap_tpu.parallel import bin_points_bandsharded
 
@@ -293,6 +306,7 @@ def test_bandsharded_under_jit(mesh2d):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.slow
 def test_bandsharded_send_capacity_overflow_is_loud(mesh2d):
     """A skewed band (every point in one raster band) past
     send_capacity must be COUNTED, not silently dropped
@@ -342,6 +356,7 @@ def test_bandsharded_rejects_tile1():
         )
 
 
+@pytest.mark.slow
 def test_replicated_binning_partitioned_backend(mesh):
     """Shard-local kernel routing: backend="partitioned" (interpret on
     CPU) under shard_map must match the xla-scatter mesh result — the

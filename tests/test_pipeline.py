@@ -309,6 +309,7 @@ class _ColSource:
             yield out
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("amplify", [False, True])
 def test_run_job_bounded_matches_unbounded(amplify):
     """max_points_in_flight chunks the cascade; linearity of the
@@ -334,6 +335,7 @@ def test_run_job_bounded_matches_unbounded(amplify):
     assert plain == sequential
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("amplify", [False, True])
 def test_bounded_spill_merge_matches_in_ram(tmp_path, amplify):
     """merge_spill_dir replaces the in-RAM cross-chunk table with disk
@@ -359,6 +361,7 @@ def test_bounded_spill_merge_matches_in_ram(tmp_path, amplify):
     assert list(spill_root.iterdir()) == []
 
 
+@pytest.mark.slow
 def test_bounded_auto_spill_activates_and_matches(monkeypatch):
     """With AUTO_SPILL_ROWS lowered, a plain bounded run converts its
     in-RAM table to the spill merge mid-job — same blobs, spill
@@ -457,6 +460,7 @@ def test_spill_requires_bounded_path():
                      max_points_in_flight=0, merge_spill_dir="/tmp/nope")
 
 
+@pytest.mark.slow
 def test_bounded_spill_weighted_and_columnar(tmp_path):
     """Weighted spill sums match the in-RAM merge exactly (chunk-order
     summation), and the streaming per-level egress composes with a
@@ -698,6 +702,7 @@ def test_weighted_fast_without_value_column_raises(tmp_path):
         run_job_fast(HMPBSource(path), config=cfg, max_points_in_flight=10)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("overlap", [False, True])
 def test_weighted_bounded_matches_plain(overlap):
     """Weighted jobs under max_points_in_flight: integer-valued weights
@@ -728,6 +733,7 @@ def test_weighted_bounded_missing_value_column_raises():
         run_job(_ColSource(rows), config=cfg, max_points_in_flight=20)
 
 
+@pytest.mark.slow
 def test_cascade_backend_partitioned_identical_blobs():
     """BatchJobConfig(cascade_backend='partitioned'): the MXU cascade
     reduction produces the same blobs as the scatter backend for count
@@ -766,6 +772,7 @@ def test_cascade_backend_partitioned_identical_blobs():
                       backend="partitioned")
 
 
+@pytest.mark.slow
 def test_adaptive_capacity_identical_results():
     """adaptive_capacity shrinks deep cascade levels to the real
     unique counts; blobs must be identical to the fixed-shape path
@@ -813,6 +820,7 @@ def test_run_job_bounded_propagates_ingest_errors():
                 batch_size=100, max_points_in_flight=120)
 
 
+@pytest.mark.slow
 def test_run_job_bounded_device_arrays_stay_small(monkeypatch):
     """A source 10x larger than the bound never materializes more than
     ~one chunk's emissions on device (the config-5 memory shape)."""
@@ -843,6 +851,7 @@ def test_run_job_bounded_device_arrays_stay_small(monkeypatch):
     assert plain == bounded
 
 
+@pytest.mark.slow
 def test_run_job_bounded_default_zoom_regression():
     """z21 regression: the chunk merge packs (ts, g, code) with
     code_bits = 42, which silently wrapped when the slot columns
@@ -882,6 +891,7 @@ def test_merge_sorted_level_int32_slots_wide_codes():
     assert m["value"].tolist() == [6.0, 2.0, 7.0]
 
 
+@pytest.mark.slow
 def test_zoom_clamped_capacities_match_unclamped():
     """build_cascade's static per-level capacity clamp (n_slots * 4^zoom
     bounds the key space) must not change any aggregate — only array
@@ -959,6 +969,7 @@ def test_dp_config_rejections():
         _dp_cfg(data_parallel=True, adaptive_capacity=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("amplify", [False, True])
 def test_run_job_data_parallel_byte_identical(amplify):
     """The flagship job over the 8-device mesh (VERDICT r3 missing #2):
@@ -990,6 +1001,7 @@ def test_run_job_data_parallel_matches_oracle():
         assert got[key] == want[key], key
 
 
+@pytest.mark.slow
 def test_run_job_data_parallel_bounded_byte_identical():
     """DP composes with the bounded chunked path (per-chunk sharded
     cascade, host merge unchanged)."""
@@ -1003,6 +1015,7 @@ def test_run_job_data_parallel_bounded_byte_identical():
     assert dp == single and len(dp) > 0
 
 
+@pytest.mark.slow
 def test_run_job_data_parallel_weighted_integer_bit_identical():
     """Integer-valued weighted sums are exact in f64 under any
     summation order, so the DP route must match bit-for-bit."""
@@ -1018,6 +1031,7 @@ def test_run_job_data_parallel_weighted_integer_bit_identical():
     assert dp == single and len(dp) > 0
 
 
+@pytest.mark.slow
 def test_run_job_data_parallel_fractional_weights_allclose():
     """Fractional weighted sums agree up to f64 summation-order
     rounding (the documented contract, same as the bounded merge)."""
@@ -1038,6 +1052,7 @@ def test_run_job_data_parallel_fractional_weights_allclose():
             assert a[field] == pytest.approx(b[field], rel=1e-12), key
 
 
+@pytest.mark.slow
 def test_dp_cascade_overflow_detected():
     """An undersized capacity must still raise through the sharded
     route — the per-device overflow flag propagates into every level's
@@ -1248,6 +1263,10 @@ def test_fast_auto_routing_respects_source_bytes_per_point():
     # source stops fitting (30 + 4*64 = 286 B/pt).
     assert _auto_points_in_flight(_FakeHMPB(), ram_budget=budget,
                                   fast=True, n_timespans=4) is not None
+    # Weighted adds the f64 value column + expanded e_weights
+    # (30 + 64 + 8 + 32 = 134 B/pt > the 100 B/pt budget).
+    assert _auto_points_in_flight(_FakeHMPB(), ram_budget=budget,
+                                  fast=True, weighted=True) is not None
 
     class _Plain:
         n = 1_000_000

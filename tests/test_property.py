@@ -54,6 +54,7 @@ def test_morton_roundtrip_random(zoom, data):
     streams=st.sampled_from([1, 2, 4]),
     spread=st.floats(min_value=0.01, max_value=1.0),
 )
+@pytest.mark.slow
 def test_partitioned_matches_scatter_random(seed, n, block_cells, chunk,
                                             streams, spread):
     """Any distribution, any tunables: partitioned == scatter exactly

@@ -37,6 +37,7 @@ def _rows(n, seed):
 CFG = dict(detail_zoom=12, min_detail_zoom=9)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("amplify", [False, True])
 def test_partitioned_equals_global(amplify):
     rows = _rows(1200, seed=1)

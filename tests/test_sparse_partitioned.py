@@ -44,6 +44,7 @@ def test_clustered_runs_good_chunks():
     assert _diff(keys, capacity=1 << 12) == 40
 
 
+@pytest.mark.slow
 def test_mostly_unique_keys():
     """Run length ~1: every chunk spans many segments, but segments are
     dense so chunks still land inside blocks."""
@@ -52,6 +53,7 @@ def test_mostly_unique_keys():
     _diff(keys, capacity=30_000)
 
 
+@pytest.mark.slow
 def test_sentinel_padding_and_drop():
     rng = np.random.default_rng(2)
     keys = np.concatenate([
@@ -61,6 +63,7 @@ def test_sentinel_padding_and_drop():
     _diff(keys, capacity=8192)
 
 
+@pytest.mark.slow
 def test_multi_slab_combine_exact():
     """slab smaller than the stream: per-slab partials must combine to
     the global counts, including segments straddling slab boundaries
@@ -84,6 +87,7 @@ def test_single_hot_key_fanin_beyond_slab():
     assert int(got_u[0]) == 123456789
 
 
+@pytest.mark.slow
 def test_58_bit_keys_reconstruct():
     """Cascade-scale composite keys (58 bits) round-trip through the
     three 20-bit channels."""
@@ -103,6 +107,7 @@ def test_hostile_distribution_falls_back():
     _diff(keys, capacity=1 << 18, block_cells=1 << 12)
 
 
+@pytest.mark.slow
 def test_empty_and_tiny():
     _diff(np.empty(0, np.int64), capacity=64)
     _diff(np.asarray([7]), capacity=64)

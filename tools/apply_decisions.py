@@ -29,6 +29,10 @@ def _load_jsonl(path):
                     continue
                 if "config" in rec:  # sweep rows
                     out[rec["config"]] = rec
+                elif rec.get("check") == "stream":  # bench_stream rows
+                    key = (f"stream {rec.get('backend')} "
+                           f"b={rec.get('batch')} {rec.get('device')}")
+                    out[key] = rec
                 else:  # verify rows: {key: bool}
                     out.update(rec)
     except OSError:
@@ -117,6 +121,38 @@ def main() -> int:
         "decision": "bad-frac-default",
         "verdict": verdict,
         "k8_bf8_ms": k8, "k8_bf32_ms": k8_bf32, "k8_bf128_ms": k8_bf128,
+    })
+
+    # Rule (d): StreamConfig.backend default stays "auto" unless a
+    # pinned backend beats the auto-routed pick by >10% on chip
+    # (BASELINE config 4; rows from tools/bench_stream.py).
+    stream_rows = {
+        k: v for k, v in sweep.items()
+        if k.startswith("stream ") and v.get("device") != "cpu"
+        and "error" not in v
+    }
+    auto_rows = [v for k, v in stream_rows.items() if " auto " in f" {k} "
+                 or k.startswith("stream auto ")]
+    pinned = [(k, v) for k, v in stream_rows.items()
+              if not k.startswith("stream auto ")]
+    if not auto_rows or not pinned:
+        verdict = "insufficient-data"
+        best_pin, auto_pts = None, None
+    else:
+        auto_pts = max(v["pts_per_s"] for v in auto_rows)
+        best_pin = max(pinned, key=lambda kv: kv[1]["pts_per_s"])
+        if best_pin[1]["pts_per_s"] > 1.10 * auto_pts:
+            verdict = (f"FLIP (StreamConfig.backend -> "
+                       f"{best_pin[1]['backend']!r})")
+        else:
+            verdict = "keep auto"
+    decisions.append({
+        "decision": "stream-backend",
+        "verdict": verdict,
+        "auto_pts_per_s": auto_pts,
+        "best_pinned": best_pin[0] if best_pin else None,
+        "best_pinned_pts_per_s": best_pin[1]["pts_per_s"] if best_pin else None,
+        "onchip_rows": len(stream_rows),
     })
 
     for rec in decisions:

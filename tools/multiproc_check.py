@@ -270,6 +270,42 @@ sys.exit(0 if all(checks.values()) else 1)
 """
 
 
+_SKEW_CHILD = r"""
+import json, sys
+import numpy as np
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+jax.config.update("jax_enable_x64", True)
+
+coord, pid, k = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(coord, num_processes=k, process_id=pid)
+
+from heatmap_tpu.parallel.multihost import _alltoall_bytes
+
+
+def payload(src, dst):
+    # ONE hot pair (1 -> 0) 100x the rest: the skew shape that made
+    # the old dense (k, global-max) frame pad every row.
+    n = 200_000 if (src, dst) == (1, 0) else 2_000
+    rng = np.random.default_rng(1000 * src + dst)
+    return rng.integers(0, 256, n).astype(np.uint8).tobytes()
+
+
+dest = [payload(pid, d) for d in range(k)]
+# max_bytes=300k: the dense frame (k x 200_008 = 800k at k=4) would
+# have refused; the shift-decomposed exchange fits because no process
+# RECEIVES more than ~206k, and chunk_bytes=64k keeps every collective
+# buffer small regardless of the hot payload's size.
+got = _alltoall_bytes(dest, max_bytes=300_000, chunk_bytes=64_000)
+ok = all(got[s] == payload(s, pid) for s in range(k))
+print(json.dumps({"pid": pid, "ok": bool(ok),
+                  "checks": {"skew_alltoall": bool(ok)}}), flush=True)
+sys.exit(0 if ok else 1)
+"""
+
+
 def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -281,7 +317,12 @@ def main() -> int:
     ap.add_argument("--k", type=int, default=2)
     ap.add_argument("--n", type=int, default=3000)
     ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--skew-only", action="store_true",
+                    help="run only the skewed byte-exchange check "
+                         "(fast; use --k 4 to exercise several shift "
+                         "rounds)")
     args = ap.parse_args()
+    child_src = _SKEW_CHILD if args.skew_only else _CHILD
 
     import shutil
 
@@ -292,7 +333,7 @@ def main() -> int:
     t0 = time.perf_counter()
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _CHILD, coord, str(i), str(args.k),
+            [sys.executable, "-c", child_src, coord, str(i), str(args.k),
              str(args.n), work],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env,

@@ -76,6 +76,37 @@ def _check_bench(log_path: str) -> bool:
             and "error" not in rec and "note" not in rec)
 
 
+def _check_stream(log_path: str) -> bool:
+    """At least one on-chip streaming cell completed this attempt.
+
+    Scans EVERY row of the current attempt (not just the last): the
+    backend sweep legitimately ends with an error row where pallas
+    does not compile, and that must not fail an attempt whose other
+    cells landed their evidence.
+    """
+    try:
+        with open(log_path) as f:
+            lines = f.readlines()
+    except OSError:
+        return False
+    for i in range(len(lines) - 1, -1, -1):
+        if lines[i].startswith("===== attempt at "):
+            lines = lines[i + 1:]
+            break
+    for line in lines:
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if (rec.get("check") == "stream" and rec.get("device") != "cpu"
+                and "error" not in rec):
+            return True
+    return False
+
+
 def _check_bench_job(log_path: str) -> bool:
     rec = _last_json_with(log_path, "device")
     return rec is not None and rec.get("device") != "cpu"
@@ -118,6 +149,17 @@ def runlist():
             "cmd": [py, "tools/verify_partitioned_onchip.py",
                     "--state", f"{STATE_DIR}/verify.jsonl"],
             "timeout": 2700,
+        },
+        {
+            "name": "bench_stream",
+            # BASELINE config 4 on chip: the decayed streaming update
+            # step at the headline window, per binning backend — the
+            # rows that decide StreamConfig's default backend
+            # (PERF_NOTES decision rules).
+            "cmd": [py, "tools/bench_stream.py",
+                    "--state", f"{STATE_DIR}/sweep.jsonl"],
+            "timeout": 1800,
+            "check": _check_stream,
         },
     ]
 
