@@ -156,6 +156,7 @@ def test_merge_blob_values_rejects_non_numeric_collisions():
     }
 
 
+@pytest.mark.slow
 def test_sharded_cascade_merge_equals_global():
     """Per-host run + blob merge == single global run (linearity)."""
     from heatmap_tpu.io.sources import SyntheticSource
@@ -202,6 +203,7 @@ def test_sharded_cascade_merge_equals_global():
         )
 
 
+@pytest.mark.slow
 def test_sharded_weighted_merge_equals_global():
     """The multihost ingest path with config.weighted: per-host
     weighted runs merged via _merge_blob_values equal one global

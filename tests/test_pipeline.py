@@ -424,6 +424,7 @@ def test_auto_spill_target_refuses_tmpfs(tmp_path, monkeypatch):
     assert batch_mod._auto_spill_target() == "/var/spool"
 
 
+@pytest.mark.slow
 def test_bounded_spill_cleans_up_on_ingest_failure(tmp_path):
     """A source that dies mid-run must not leave spill run files
     behind (they are tens of GB at the shapes spill targets)."""
@@ -642,6 +643,7 @@ def test_weighted_job_missing_value_column_raises():
         run_job(_ColSource(rows), config=cfg)
 
 
+@pytest.mark.slow
 def test_weighted_fast_hmpb_matches_string_path(tmp_path):
     """run_job_fast on an HMPB file with a value section must produce
     the same blobs as the string path over the same weighted rows —
@@ -797,6 +799,7 @@ def test_adaptive_capacity_identical_results():
             assert a == b and len(a) > 0, (weighted, amplify)
 
 
+@pytest.mark.slow
 def test_run_job_bounded_propagates_ingest_errors():
     """A source failure in the prefetch thread must surface as the
     job's exception, not a hang or a silent partial result."""

@@ -36,6 +36,7 @@ def _diff(sorted_keys, capacity, **kw):
     return int(want_n)
 
 
+@pytest.mark.slow
 def test_clustered_runs_good_chunks():
     """Long runs (few segments per chunk) take the matmul path."""
     rng = np.random.default_rng(0)
@@ -75,6 +76,7 @@ def test_multi_slab_combine_exact():
     assert n == 13
 
 
+@pytest.mark.slow
 def test_single_hot_key_fanin_beyond_slab():
     """One segment larger than several slabs: counts must stay exact
     (the f32-per-slab / f64-combine design point)."""
@@ -96,6 +98,7 @@ def test_58_bit_keys_reconstruct():
     _diff(keys, capacity=4096)
 
 
+@pytest.mark.slow
 def test_hostile_distribution_falls_back():
     """capacity-spanning sparse segments make most chunks straddle
     blocks -> the lax.cond scatter fallback must match too."""
@@ -114,6 +117,7 @@ def test_empty_and_tiny():
     _diff(np.asarray([7, 7, 8]), capacity=64)
 
 
+@pytest.mark.slow
 def test_pyramid_partitioned_matches_scatter_pyramid():
     """The full count pyramid: kernel variant == scatter variant at
     every level, including invalid lanes and per-level capacities."""
@@ -176,6 +180,7 @@ def test_matches_cascade_shift_reaggregation():
                                   np.asarray(want[1])[:nw])
 
 
+@pytest.mark.slow
 def test_streams_variant_bit_equal():
     """streams>1 (per-sub-stream output slabs, summed) must be
     bit-identical to streams=1 and to the scatter contract — the

@@ -10,6 +10,8 @@ equivalence checks at configurable scale on the current backend:
                     level arrays (the strongest whole-chain check)
   mesh              sharded reduce-by-key over the device mesh vs the
                     single-device kernel, on skewed keys
+  dp-job            run_job_fast data-parallel over the mesh vs
+                    single-device: byte-equal level arrays at scale
   resume            crash (fault injection) + resume == uninterrupted
   streaming         sharded decayed raster: deterministic replay
   weighted          weighted job linearity (3x values == 3x counts,
@@ -36,7 +38,7 @@ import time
 
 import numpy as np
 
-CHECKS = ("fast-vs-bounded", "mesh", "resume", "streaming", "weighted")
+CHECKS = ("fast-vs-bounded", "mesh", "dp-job", "resume", "streaming", "weighted")
 
 
 def _synth_hmpb(path, n, n_users=300, seed=1, dated=False):
@@ -56,6 +58,22 @@ def _synth_hmpb(path, n, n_users=300, seed=1, dated=False):
     )
 
 
+def _assert_levels_equal(a_dir, b_dir):
+    """Full-column byte equality of two LevelArraysSink dirs;
+    -> (levels, rows)."""
+    from heatmap_tpu.io.sinks import LevelArraysSink
+
+    la, lb = LevelArraysSink.load(a_dir), LevelArraysSink.load(b_dir)
+    assert la.keys() == lb.keys(), (sorted(la), sorted(lb))
+    rows = 0
+    for z in la:
+        for k in ("row", "col", "value", "user", "timespan",
+                  "coarse_row", "coarse_col"):
+            np.testing.assert_array_equal(la[z][k], lb[z][k])
+        rows += len(la[z]["value"])
+    return len(la), rows
+
+
 def check_fast_vs_bounded(n, tmp):
     from heatmap_tpu.io.hmpb import HMPBSource
     from heatmap_tpu.io.sinks import LevelArraysSink
@@ -68,15 +86,8 @@ def check_fast_vs_bounded(n, tmp):
     run_job_fast(HMPBSource(hmpb), LevelArraysSink(a), config=cfg)
     run_job(HMPBSource(hmpb), LevelArraysSink(b), config=cfg,
             max_points_in_flight=max(n // 4, 1000))
-    la, lb = LevelArraysSink.load(a), LevelArraysSink.load(b)
-    assert la.keys() == lb.keys(), (sorted(la), sorted(lb))
-    rows = 0
-    for z in la:
-        for k in ("row", "col", "value", "user", "timespan",
-                  "coarse_row", "coarse_col"):
-            np.testing.assert_array_equal(la[z][k], lb[z][k])
-        rows += len(la[z]["value"])
-    return {"levels": len(la), "rows": rows}
+    levels, rows = _assert_levels_equal(a, b)
+    return {"levels": levels, "rows": rows}
 
 
 def check_mesh(n, tmp):
@@ -117,6 +128,30 @@ def check_mesh(n, tmp):
                                   np.asarray(got_s)[:gn])
     return {"uniques": wn, "devices": len(jax.devices()),
             "mesh": dict(mesh.shape)}
+
+
+def check_dp_job(n, tmp):
+    """Flagship job data-parallel over the virtual mesh vs
+    single-device, at scale: byte-equal level arrays. The unit suite
+    pins small shapes; this drives the padding + zoom-clamped
+    capacities through the sharded cascade at soak size."""
+    import jax
+
+    from heatmap_tpu.io.hmpb import HMPBSource
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs a multi-device mesh (set XLA_FLAGS)"}
+    hmpb = _synth_hmpb(os.path.join(tmp, "dp.hmpb"), n)
+    a, b = os.path.join(tmp, "dp-a"), os.path.join(tmp, "dp-b")
+    run_job_fast(HMPBSource(hmpb), LevelArraysSink(a),
+                 config=BatchJobConfig(data_parallel=True))
+    run_job_fast(HMPBSource(hmpb), LevelArraysSink(b),
+                 config=BatchJobConfig(data_parallel=False))
+    levels, rows = _assert_levels_equal(a, b)
+    return {"levels": levels, "rows": rows,
+            "devices": len(jax.devices())}
 
 
 def check_resume(n, tmp):
@@ -264,6 +299,7 @@ def main():
 
     fns = {"fast-vs-bounded": check_fast_vs_bounded,
            "mesh": check_mesh,
+           "dp-job": check_dp_job,
            "resume": check_resume,
            "streaming": check_streaming,
            "weighted": check_weighted}
