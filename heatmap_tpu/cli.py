@@ -710,6 +710,51 @@ def cmd_convert(args) -> int:
     return 0
 
 
+def cmd_merge(args) -> int:
+    """Merge per-host egress shards into one artifact (no devices)."""
+    import os
+
+    from heatmap_tpu.io.merge import merge_blob_files, merge_level_dirs
+    from heatmap_tpu.io.sinks import LevelArraysSink, open_sink
+
+    dirs = [os.path.isdir(p) for p in args.inputs]
+    columnar_out = args.output.startswith("arrays:")
+    if all(dirs):
+        if not columnar_out:
+            # Writing level arrays through a blob-spec path would
+            # produce a directory of .npz files under a name the
+            # operator believes is a JSONL file.
+            raise SystemExit(
+                "level-array inputs merge into a columnar sink; pass "
+                "--output arrays:DIR (got "
+                f"{args.output!r})"
+            )
+        levels = merge_level_dirs(args.inputs)
+        rows = LevelArraysSink(
+            args.output[len("arrays:"):]
+        ).write_levels(levels)
+        print(json.dumps({"mode": "levels", "inputs": len(args.inputs),
+                          "levels": len(levels), "rows": rows,
+                          "output": args.output}))
+        return 0
+    if any(dirs):
+        raise SystemExit(
+            "merge inputs must be all JSONL blob files or all "
+            "level-array directories, not a mix"
+        )
+    if columnar_out:
+        raise SystemExit(
+            "blob inputs merge into a blob sink (jsonl:/dir:/memory:); "
+            f"arrays: is columnar-only (got {args.output!r})"
+        )
+    blobs = merge_blob_files(args.inputs)
+    with open_sink(args.output) as sink:
+        sink.write((k, json.dumps(v)) for k, v in blobs.items())
+    print(json.dumps({"mode": "blobs", "inputs": len(args.inputs),
+                      "blobs": len(blobs), "output": args.output}))
+    return 0
+
+
 def cmd_info(args) -> int:
     # info reports unreachability as structured JSON (below) rather
     # than the fail-fast SystemExit the job commands want; an explicit
@@ -870,6 +915,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "files of at most this many rows (the "
                         "range-shardable multihost ingest layout)")
     p_conv.set_defaults(fn=cmd_convert)
+
+    p_merge = sub.add_parser(
+        "merge",
+        help="merge egress shards (per-host jsonl blob files or "
+             "level-array dirs) into one artifact; colliding blob ids "
+             "sum, exactly like the cross-host merge",
+    )
+    p_merge.add_argument("--inputs", nargs="+", required=True,
+                         help="JSONL blob files, or level-array dirs "
+                         "(all one kind)")
+    p_merge.add_argument("--output", required=True,
+                         help="blob sink spec (jsonl:/dir:/memory:) for "
+                         "blob inputs; arrays:DIR for level-array "
+                         "inputs")
+    p_merge.set_defaults(fn=cmd_merge)
 
     p_info = sub.add_parser("info", help="resolved config + devices")
     _add_backend_flags(p_info)

@@ -42,6 +42,15 @@ import jax
 import numpy as np
 
 from heatmap_tpu.io.sinks import LevelArraysSink as _LevelArraysSink
+# Merge semantics live in the jax-free io.merge module (the CLI's
+# offline shard merge uses them without an accelerator stack);
+# re-exported here because every distributed egress path and its
+# tests address them through this module.
+from heatmap_tpu.io.merge import (  # noqa: F401
+    _merge_blob_values,
+    merge_blob_parts,
+    merge_level_parts,
+)
 from heatmap_tpu.parallel.mesh import make_mesh
 
 
@@ -197,39 +206,6 @@ def gather_blobs(local_blobs: dict, max_bytes: int = 1 << 30) -> dict:
     return merged
 
 
-def _merge_blob_values(a, b):
-    """Sum two blob values that may be JSON strings of {tile: count}.
-
-    Collisions MUST be summable {tile: number} dicts — that is the
-    only shape this framework's egress emits, so anything else at a
-    merge point is corruption and raises (the loud-overflow
-    convention; round-2 review flagged the old silent
-    last-process-wins resolution).
-    """
-    decode = isinstance(a, str)
-    da = json.loads(a) if decode else a
-    db = json.loads(b) if isinstance(b, str) else b
-    if not (isinstance(da, dict) and isinstance(db, dict)):
-        raise ValueError(
-            f"colliding blob values are not mergeable dicts "
-            f"({type(da).__name__} vs {type(db).__name__})"
-        )
-    out = dict(da)
-    for k, v in db.items():
-        if k not in out:  # no collision: shape constraints don't apply
-            out[k] = v
-            continue
-        prev = out[k]
-        if not (isinstance(v, (int, float))
-                and isinstance(prev, (int, float))):
-            raise ValueError(
-                f"non-numeric blob collision for detail tile {k!r} "
-                f"({type(prev).__name__} + {type(v).__name__})"
-            )
-        out[k] = prev + v
-    return json.dumps(out) if decode else out
-
-
 def blob_owner(blob_id: str, process_count: int) -> int:
     """Deterministic owner process of a blob key (tile-space sharding).
 
@@ -249,18 +225,6 @@ def partition_blobs(local_blobs: dict, process_count: int) -> list[dict]:
     for key, val in local_blobs.items():
         parts[blob_owner(key, process_count)][key] = val
     return parts
-
-
-def merge_blob_parts(parts) -> dict:
-    """Fold per-host blob sub-dicts into one dict, summing collisions
-    (the same linearity as gather_blobs, applied to one owner shard)."""
-    merged: dict = {}
-    for part in parts:
-        for key, val in part.items():
-            merged[key] = (
-                _merge_blob_values(merged[key], val) if key in merged else val
-            )
-    return merged
 
 
 #: Per-collective buffer bound for the byte exchange: a shift round
@@ -453,81 +417,6 @@ def partition_levels(levels, process_count: int) -> list[list[dict]]:
             sub["timespan_names"] = np.asarray(lvl["timespan_names"])
             parts[d].append(sub)
     return parts
-
-
-def merge_level_parts(parts) -> list[dict]:
-    """Merge per-source level subsets into this process's owned levels.
-
-    Re-maps each part's dictionary-encoded user/timespan indices into
-    merged (sorted, deduplicated) name tables, concatenates rows, and
-    re-aggregates collisions — rows of a blob that straddled host
-    ingest shards — by summing ``value`` (counts and weighted sums are
-    both linear). Output rows are sorted by (timespan, user, row, col)
-    for run-to-run determinism.
-    """
-    by_zoom: dict[int, list[dict]] = {}
-    for part in parts:
-        for lvl in part:
-            by_zoom.setdefault(int(lvl["zoom"]), []).append(lvl)
-    merged_levels = []
-    for zoom in sorted(by_zoom, reverse=True):
-        subs = by_zoom[zoom]
-        user_names = np.unique(np.concatenate(
-            [np.asarray(s["user_names"]) for s in subs]
-        )) if subs else np.asarray([], dtype="U1")
-        ts_names = np.unique(np.concatenate(
-            [np.asarray(s["timespan_names"]) for s in subs]
-        )) if subs else np.asarray([], dtype="U1")
-        cols = {}
-        for key in _LEVEL_ROW_COLS:
-            if key == "user_idx":
-                cols[key] = np.concatenate([
-                    np.searchsorted(
-                        user_names, np.asarray(s["user_names"])
-                    )[np.asarray(s["user_idx"])].astype(np.int32)
-                    if len(s["user_idx"]) else
-                    np.asarray([], np.int32)
-                    for s in subs
-                ])
-            elif key == "timespan_idx":
-                cols[key] = np.concatenate([
-                    np.searchsorted(
-                        ts_names, np.asarray(s["timespan_names"])
-                    )[np.asarray(s["timespan_idx"])].astype(np.int32)
-                    if len(s["timespan_idx"]) else
-                    np.asarray([], np.int32)
-                    for s in subs
-                ])
-            else:
-                cols[key] = np.concatenate(
-                    [np.asarray(s[key]) for s in subs]
-                )
-        order = np.lexsort(
-            (cols["col"], cols["row"], cols["user_idx"], cols["timespan_idx"])
-        )
-        for key in _LEVEL_ROW_COLS:
-            cols[key] = cols[key][order]
-        n = len(cols["row"])
-        if n:
-            same = np.zeros(n, bool)
-            same[1:] = (
-                (cols["timespan_idx"][1:] == cols["timespan_idx"][:-1])
-                & (cols["user_idx"][1:] == cols["user_idx"][:-1])
-                & (cols["row"][1:] == cols["row"][:-1])
-                & (cols["col"][1:] == cols["col"][:-1])
-            )
-            starts = np.flatnonzero(~same)
-            sums = np.add.reduceat(cols["value"], starts)
-            for key in _LEVEL_ROW_COLS:
-                cols[key] = cols[key][starts]
-            cols["value"] = sums
-        lvl = dict(cols)
-        lvl["zoom"] = zoom
-        lvl["coarse_zoom"] = int(subs[0]["coarse_zoom"])
-        lvl["user_names"] = user_names
-        lvl["timespan_names"] = ts_names
-        merged_levels.append(lvl)
-    return merged_levels
 
 
 def _levels_to_bytes(levels) -> bytes:
