@@ -1277,3 +1277,33 @@ def test_fast_auto_routing_respects_source_bytes_per_point():
     # No attribute: fast ingest keeps the conservative constant.
     assert _auto_points_in_flight(_Plain(), ram_budget=budget,
                                   fast=True) is not None
+
+
+def test_dp_edge_shapes_byte_identical():
+    """DP padding edges: fewer points than devices, one point, one
+    unique location, all-excluded users — every shape must byte-equal
+    the single-device cascade (pad lanes are valid=False and the
+    per-device capacity floors at 1)."""
+    from heatmap_tpu.pipeline import run_job
+
+    cases = [
+        [dict(r, source="gps") for r in _rows(n=3, seed=1)],  # n < ndev
+        [dict(r, source="gps") for r in _rows(n=1, seed=2)],  # 1 point
+        [dict(r, latitude=50.0, longitude=8.0, source="gps")  # 1 unique
+         for r in _rows(n=40, seed=3)],                       # location
+        [dict(r, user_id="xonly", source="gps")  # all users excluded:
+         for r in _rows(n=24, seed=4)],          # only 'all' slots emit
+    ]
+    for i, rows in enumerate(cases):
+        dp = run_job(_ColSource(rows), config=_dp_cfg())
+        single = run_job(_ColSource(rows),
+                         config=_dp_cfg(data_parallel=False))
+        assert dp == single, f"case {i}"
+        assert len(dp) > 0, f"case {i}"
+
+
+def test_dp_all_background_returns_empty():
+    from heatmap_tpu.pipeline import run_job
+
+    rows = [dict(r, source="background") for r in _rows(n=30, seed=5)]
+    assert run_job(_ColSource(rows), config=_dp_cfg()) == {}
