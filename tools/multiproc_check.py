@@ -131,6 +131,26 @@ got_b = run_job_multihost(src, config=cfg, batch_size=batch,
                           egress="gather", max_points_in_flight=700)
 checks["bounded_gather_equals_oracle"] = blobs_equal(got_b, want)
 
+# 1d) bounded + WEIGHTED (integer-valued f64 weights sum exactly under
+# any chunk/host split) and bounded + SHARDED egress (each process's
+# owned shard carries only its keys, values equal the oracle's).
+got_wb = run_job_multihost(_WSrc(), config=wcfg, batch_size=batch,
+                           egress="gather", max_points_in_flight=700)
+checks["bounded_weighted_equals_oracle"] = blobs_equal(got_wb, want_w)
+owned_b = run_job_multihost(src, config=cfg, batch_size=batch,
+                            egress="sharded", max_points_in_flight=700)
+# Completeness, not just consistency: this process's shard must hold
+# EXACTLY the oracle keys it owns (every process holds the full
+# oracle, so the expected set is computable locally) — a bounded-path
+# regression that drops chunks or invents keys fails the set equality
+# instead of passing vacuously / dying on a KeyError.
+expected_owned = {key for key in want if blob_owner(key, k) == pid}
+checks["bounded_sharded_owned_ok"] = (
+    set(owned_b) == expected_owned
+    and all(json.loads(owned_b[key]) == json.loads(want[key])
+            for key in owned_b)
+)
+
 # 2) sharded blob egress over the real all_to_all; per-host JSONL.
 # open_sink(per_process_sink_spec(...)) is exactly the CLI's path —
 # the tool must exercise the production spec parser, not re-parse.
