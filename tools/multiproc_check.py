@@ -151,6 +151,17 @@ checks["bounded_sharded_owned_ok"] = (
             for key in owned_b)
 )
 
+# 1e) per-host DP INSIDE the multi-process run: each process shards
+# its slice's cascade over its own local devices (8 virtual CPU
+# devices per child under the suite's XLA_FLAGS, 1 otherwise — both
+# legal), then the cross-process gather merges as usual. The v5e-pod
+# layout: DP over local chips x process-sharded ingest.
+dp_cfg = BatchJobConfig(detail_zoom=11, min_detail_zoom=8,
+                        data_parallel=True)
+got_dp = run_job_multihost(src, config=dp_cfg, batch_size=batch,
+                           egress="gather")
+checks["dp_gather_equals_oracle"] = blobs_equal(got_dp, want)
+
 # 2) sharded blob egress over the real all_to_all; per-host JSONL.
 # open_sink(per_process_sink_spec(...)) is exactly the CLI's path —
 # the tool must exercise the production spec parser, not re-parse.
