@@ -41,7 +41,8 @@ import numpy as np
 CHECKS = ("fast-vs-bounded", "mesh", "dp-job", "resume", "streaming", "weighted")
 
 
-def _synth_hmpb(path, n, n_users=300, seed=1, dated=False):
+def _synth_hmpb(path, n, n_users=300, seed=1, dated=False,
+                weighted=False):
     from heatmap_tpu.io.hmpb import write_hmpb
 
     rng = np.random.default_rng(seed)
@@ -55,6 +56,10 @@ def _synth_hmpb(path, n, n_users=300, seed=1, dated=False):
         timestamp=rng.integers(1_500_000_000_000, 1_600_000_000_000, n)
         if dated else None,
         background=(rng.random(n) < 0.02).astype(np.uint8),
+        # Integer-valued f64 weights: exact sums under any split, so
+        # weighted cross-path checks can assert byte equality.
+        value=rng.integers(1, 12, n).astype(np.float64)
+        if weighted else None,
     )
 
 
@@ -150,7 +155,17 @@ def check_dp_job(n, tmp):
     run_job_fast(HMPBSource(hmpb), LevelArraysSink(b),
                  config=BatchJobConfig(data_parallel=False))
     levels, rows = _assert_levels_equal(a, b)
-    return {"levels": levels, "rows": rows,
+    # Weighted variant: integer-valued f64 weights stay bit-exact
+    # through the sharded cascade's merge at scale.
+    whmpb = _synth_hmpb(os.path.join(tmp, "dpw.hmpb"), n, weighted=True)
+    wa, wb = os.path.join(tmp, "dpw-a"), os.path.join(tmp, "dpw-b")
+    wcfg = dict(weighted=True)
+    run_job_fast(HMPBSource(whmpb), LevelArraysSink(wa),
+                 config=BatchJobConfig(data_parallel=True, **wcfg))
+    run_job_fast(HMPBSource(whmpb), LevelArraysSink(wb),
+                 config=BatchJobConfig(data_parallel=False, **wcfg))
+    wlevels, wrows = _assert_levels_equal(wa, wb)
+    return {"levels": levels, "rows": rows, "weighted_rows": wrows,
             "devices": len(jax.devices())}
 
 
