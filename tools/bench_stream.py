@@ -79,7 +79,6 @@ def main():
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
 
     from heatmap_tpu.ops import window_from_bounds
     from heatmap_tpu.streaming import HeatmapStream, StreamConfig
