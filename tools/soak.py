@@ -85,7 +85,14 @@ def check_fast_vs_bounded(n, tmp):
     from heatmap_tpu.pipeline import BatchJobConfig, run_job, run_job_fast
 
     hmpb = _synth_hmpb(os.path.join(tmp, "p.hmpb"), n)
-    cfg = BatchJobConfig()
+    # data_parallel=False: this check is about fast-vs-bounded
+    # equality, and auto-DP at soak sizes trips XLA's CPU collective
+    # rendezvous timeout on low-core hosts (8 virtual devices
+    # SERIALIZE on the cores available; a participant arriving >60s
+    # after the first aborts the process — a CPU-emulation artifact,
+    # not a program property). DP equality has its own check below
+    # with a deliberately bounded per-shard size.
+    cfg = BatchJobConfig(data_parallel=False)
     a = os.path.join(tmp, "a")
     b = os.path.join(tmp, "b")
     run_job_fast(HMPBSource(hmpb), LevelArraysSink(a), config=cfg)
@@ -148,6 +155,11 @@ def check_dp_job(n, tmp):
 
     if len(jax.devices()) < 2:
         return {"skipped": "needs a multi-device mesh (set XLA_FLAGS)"}
+    # Bound the DP size: on a low-core host the virtual devices'
+    # collective participants serialize, and XLA's CPU rendezvous
+    # aborts the process if one arrives >60s late — 500k points keeps
+    # per-shard work far under that while still 10x the unit suite.
+    n = min(n, 500_000)
     hmpb = _synth_hmpb(os.path.join(tmp, "dp.hmpb"), n)
     a, b = os.path.join(tmp, "dp-a"), os.path.join(tmp, "dp-b")
     run_job_fast(HMPBSource(hmpb), LevelArraysSink(a),
@@ -175,7 +187,9 @@ def check_resume(n, tmp):
     from heatmap_tpu.utils.recovery import FaultInjector
 
     hmpb = _synth_hmpb(os.path.join(tmp, "r.hmpb"), n, dated=True)
-    cfg = BatchJobConfig(timespans=("alltime", "day"))
+    # data_parallel=False: see check_fast_vs_bounded's rendezvous note.
+    cfg = BatchJobConfig(timespans=("alltime", "day"),
+                         data_parallel=False)
     bs = max(n // 8, 1)  # always >= 8 batches, so the mid fault fires
     n_batches = -(-n // bs)
     fail_at = n_batches // 2
@@ -268,7 +282,9 @@ def check_weighted(n, tmp):
                     out["value"] = np.full(len(lat[sl]), 3.0)
                 yield out
 
-    cfg = BatchJobConfig(detail_zoom=14, min_detail_zoom=6)
+    # data_parallel=False: see check_fast_vs_bounded's rendezvous note.
+    cfg = BatchJobConfig(detail_zoom=14, min_detail_zoom=6,
+                         data_parallel=False)
     counted = run_job(_Src(False), config=cfg, batch_size=1 << 16)
     weighted = run_job(_Src(True),
                        config=dataclasses.replace(cfg, weighted=True),
