@@ -865,3 +865,37 @@ class TestMergeShards:
         # Private-API probe: if the attribute moves, the line above
         # fails the subprocess and this assert reports it loudly.
         assert "backends_initialized False" in r.stdout, r.stdout
+
+    def test_cli_merge_level_dirs(self, tmp_path):
+        import json as _json
+        import os
+        import subprocess
+        import sys
+
+        from heatmap_tpu.io.sinks import LevelArraysSink
+        from heatmap_tpu.io.sources import SyntheticSource
+        from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+        cfg = BatchJobConfig(detail_zoom=9, min_detail_zoom=7)
+        ref = tmp_path / "ref"
+        run_job(SyntheticSource(n=600, seed=8), LevelArraysSink(str(ref)),
+                config=cfg)
+        want = LevelArraysSink.load(str(ref))
+        # Two "shards": the same dir twice — the merge must double
+        # every value (upsert-sum semantics, easy to assert exactly).
+        out = tmp_path / "merged"
+        r = subprocess.run(
+            [sys.executable, "-m", "heatmap_tpu", "merge",
+             "--inputs", str(ref), str(ref),
+             "--output", f"arrays:{out}"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(os.path.dirname(__file__)),
+        )
+        assert r.returncode == 0, r.stderr[-800:]
+        stats = _json.loads(r.stdout.strip().splitlines()[-1])
+        assert stats["mode"] == "levels" and stats["levels"] == len(want)
+        got = LevelArraysSink.load(str(out))
+        assert got.keys() == want.keys()
+        for z in want:
+            assert np.asarray(got[z]["value"]).sum() == \
+                2 * np.asarray(want[z]["value"]).sum(), z
