@@ -222,6 +222,24 @@ class TestEndToEnd:
                      "--input", "csv:x.csv")
         assert r.returncode != 0 and "standard job path" in r.stderr
 
+    def test_multihost_bounded_flag_accepted(self, tmp_path):
+        """--multihost composes with --max-points-in-flight (and the
+        spill knob) since the bounded slice ingest landed; the old
+        rejection must stay gone."""
+        import json as _json
+
+        out = tmp_path / "mhb.jsonl"
+        r = _run_cli(
+            "run", "--backend", "cpu", "--multihost",
+            "--input", "synthetic:900:2",
+            "--output", f"jsonl:{out}",
+            "--detail-zoom", "10", "--min-detail-zoom", "8",
+            "--max-points-in-flight", "200",
+            "--merge-spill-dir", str(tmp_path / "spill"),
+        )
+        assert r.returncode == 0, r.stderr
+        assert _json.loads(r.stdout.strip().splitlines()[-1])["blobs"] > 0
+
     def test_fast_rejects_non_csv_source(self):
         r = _run_cli("run", "--backend", "cpu", "--fast",
                      "--input", "synthetic:10")
