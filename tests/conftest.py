@@ -5,6 +5,12 @@ session. Note: the environment's axon TPU plugin (sitecustomize) forces
 ``jax_platforms=axon`` via jax.config at interpreter start, so the
 JAX_PLATFORMS env var is ineffective — the override must go through
 ``jax.config.update`` after importing jax.
+
+Sizing caveat for new mesh tests: virtual devices SERIALIZE on the
+host's cores, and XLA's CPU collective rendezvous aborts the process
+when a participant arrives >60s after the first — keep per-shard work
+well under that (docs/DESIGN.md §4 verification-ladder caveat;
+observed at 2M-point DP shapes on a 1-core host).
 """
 
 import os
