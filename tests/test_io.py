@@ -772,6 +772,7 @@ class TestMergeShards:
                     err_msg=f"z{z} {k}",
                 )
 
+    @pytest.mark.slow
     def test_cli_merge_blobs(self, tmp_path):
         import json as _json
         import os
@@ -866,6 +867,7 @@ class TestMergeShards:
         # fails the subprocess and this assert reports it loudly.
         assert "backends_initialized False" in r.stdout, r.stdout
 
+    @pytest.mark.slow
     def test_cli_merge_level_dirs(self, tmp_path):
         import json as _json
         import os
