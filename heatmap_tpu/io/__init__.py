@@ -35,3 +35,7 @@ from heatmap_tpu.io.sinks import (  # noqa: F401
     open_sink,
 )
 from heatmap_tpu.io.png import colorize, png_bytes, raster_to_png  # noqa: F401
+from heatmap_tpu.io.merge import (  # noqa: F401
+    merge_blob_files,
+    merge_level_dirs,
+)
