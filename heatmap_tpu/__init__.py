@@ -8,9 +8,24 @@ full build plan):
 
 - ``tilemath`` — vectorized Web-Mercator projection, integer tile keys,
   Morton codes (replaces reference tile.py's string ids and scalar trig).
-- ``ops`` — dense window-raster histograms, fixed-capacity sparse
-  sort+segment-sum aggregation, and zoom-pyramid rollups (replaces
-  Spark's reduceByKey/groupByKey shuffles, reference heatmap.py:111-112).
+- ``ops`` — dense window-raster histograms (XLA scatter + Pallas MXU
+  kernels), fixed-capacity sparse sort+segment-sum aggregation, and
+  zoom-pyramid rollups (replaces Spark's reduceByKey/groupByKey
+  shuffles, reference heatmap.py:111-112).
+- ``pipeline`` — the batch jobs (plain/fast/resumable/bounded), group
+  and timespan routing, and the single-sort composite-key cascade
+  (reference batchMain, heatmap.py:152-158).
+- ``parallel`` — the (data, tile) device mesh, sharded kernels with
+  collective merges, and multi-host ingest/egress (reference
+  submit-heatmap's Spark scale-out).
+- ``io`` — columnar sources (CSV/JSONL/Parquet/HMPB/synthetic,
+  Cassandra token ranges, CosmosDB partition ranges), blob + columnar
+  sinks, PNG tile trees, offline shard merging (reference
+  get_rows/write_heatmap_dataframes, heatmap.py:131-150).
+- ``streaming`` — decayed micro-batch rasters (BASELINE config 4).
+- ``native`` — C++ host runtime: CSV point codec, cascade-key decoder,
+  blob formatters (the role Spark's JVM machinery played).
+- ``utils`` — tracing, checkpoint/resume, shard recovery.
 """
 
 __version__ = "0.2.0"
