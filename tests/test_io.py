@@ -901,3 +901,29 @@ class TestMergeShards:
         for z in want:
             assert np.asarray(got[z]["value"]).sum() == \
                 2 * np.asarray(want[z]["value"]).sum(), z
+
+    def test_level_dirs_merge_rejects_mismatched_coarse_zoom(self, tmp_path):
+        """Shards that disagree on a level's coarse_zoom are not shards
+        of one job — the merge must refuse, not silently mix result
+        granularities."""
+        from heatmap_tpu.io.merge import merge_level_dirs
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        def lvl(coarse_zoom):
+            return {
+                "zoom": 8, "coarse_zoom": coarse_zoom,
+                "row": np.asarray([1]), "col": np.asarray([2]),
+                "value": np.asarray([1.0]),
+                "user_idx": np.asarray([0], np.int32),
+                "timespan_idx": np.asarray([0], np.int32),
+                "user_names": np.asarray(["all"]),
+                "timespan_names": np.asarray(["alltime"]),
+                "coarse_row": np.asarray([0]),
+                "coarse_col": np.asarray([0]),
+            }
+
+        a, b = tmp_path / "a", tmp_path / "b"
+        LevelArraysSink(str(a)).write_levels([lvl(3)])
+        LevelArraysSink(str(b)).write_levels([lvl(4)])
+        with pytest.raises(ValueError, match="coarse_zoom"):
+            merge_level_dirs([str(a), str(b)])
