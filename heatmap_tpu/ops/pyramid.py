@@ -149,17 +149,23 @@ def pyramid_sparse_morton_partitioned(
     slab: int | None = None,
     interpret: bool | None = None,
     streams: int = 1,
+    weights=None,
+    weight_bound: int | None = None,
 ):
-    """Count-only sparse pyramid on the multi-channel MXU reduction.
+    """Sparse pyramid on the multi-channel MXU reduction.
 
-    Same contract as :func:`pyramid_sparse_morton` with
-    ``weights=None`` (counts in int32, keys int64 with int64-max
-    sentinel padding, per-level capacities), but every level is
-    reduced from the ORIGINAL sorted stream shifted by ``2*level`` —
-    one sort, then ``levels+1`` kernel passes that replace the 2
-    scatters per level (ops/sparse_partitioned.py rationale). Keys
-    must fit 60 bits. Tunables default to sparse_partitioned's
-    DEFAULT_* values.
+    Same contract as :func:`pyramid_sparse_morton` (keys int64 with
+    int64-max sentinel padding, per-level capacities), but every level
+    is reduced from the ORIGINAL sorted stream shifted by ``2*level``
+    — one sort, then ``levels+1`` kernel passes that replace the 2
+    scatters per level (ops/sparse_partitioned.py rationale). Counts
+    (``weights=None``, int32 sums) or bounded-integer weights
+    (``weights`` + static ``weight_bound``: integers in
+    [0, weight_bound], f64 sums, exactness via the shrunk slab;
+    violations poison n_unique — see
+    sparse_partitioned.aggregate_sorted_keys_partitioned). Fractional
+    weights stay on the scatter pyramid. Keys must fit 60 bits.
+    Tunables default to sparse_partitioned's DEFAULT_* values.
     """
     from heatmap_tpu.ops import sparse_partitioned as sp
 
@@ -175,14 +181,22 @@ def pyramid_sparse_morton_partitioned(
 
     sentinel = jnp.iinfo(jnp.int64).max
     keys = codes if valid is None else jnp.where(valid, codes, sentinel)
-    skeys = jnp.sort(keys, stable=False)
+    if weights is None:
+        skeys = jnp.sort(keys, stable=False)
+        sw = None
+    else:
+        # Weights ride the same order as their keys (integer sums are
+        # order-free, so the unstable argsort is fine).
+        order = jnp.argsort(keys, stable=False)
+        skeys = keys[order]
+        sw = jnp.asarray(weights)[order]
 
     out = []
     for lvl in range(levels + 1):
         # Right shifts preserve the sort; the shifted sentinel
         # (intmax >> 2*lvl) still exceeds every real (< 2^60) key at
         # the shifted width, so it keeps sorting last and masking out.
-        uniq, counts, n_unique = sp.aggregate_sorted_keys_partitioned(
+        uniq, sums, n_unique = sp.aggregate_sorted_keys_partitioned(
             skeys >> (2 * lvl),
             caps[lvl],
             sentinel=sentinel >> (2 * lvl),
@@ -191,11 +205,16 @@ def pyramid_sparse_morton_partitioned(
             slab=slab,
             interpret=interpret,
             streams=streams,
+            sorted_weights=sw,
+            weight_bound=weight_bound,
         )
         # Normalize padding to the repo-wide int64-max sentinel (the
         # per-level call pads with its SHIFTED sentinel, which a
         # `uniq != intmax` consumer mask would let through as phantom
-        # zero-count cells).
-        uniq = jnp.where(counts > 0, uniq, sentinel)
-        out.append((uniq, counts, n_unique))
+        # zero-count cells). The kernel already sentinels zero-sum
+        # segments via its presence channel, so masking on the sums
+        # here would be wrong for weighted zero totals — mask on the
+        # SHIFTED sentinel instead.
+        uniq = jnp.where(uniq == (sentinel >> (2 * lvl)), sentinel, uniq)
+        out.append((uniq, sums, n_unique))
     return out

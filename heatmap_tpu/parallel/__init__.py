@@ -28,6 +28,7 @@ from heatmap_tpu.parallel.sharded import (  # noqa: F401
     bin_points_replicated,
     bin_points_rowsharded,
     pyramid_rowsharded,
+    pyramid_sparse_morton_prefix_sharded,
     pyramid_sparse_morton_sharded,
     splat_rowsharded,
 )

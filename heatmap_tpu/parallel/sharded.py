@@ -326,6 +326,207 @@ def pyramid_sparse_morton_sharded(
     ]
 
 
+def pyramid_sparse_morton_prefix_sharded(
+    codes,
+    mesh: Mesh,
+    weights=None,
+    valid=None,
+    levels: int = 0,
+    capacity=None,
+    acc_dtype=None,
+    send_capacity: int | None = None,
+    prefix_levels: int | None = None,
+):
+    """Sharded sparse pyramid with a coarse-prefix regrouped merge.
+
+    The O(n/k)-per-stage formulation of
+    :func:`pyramid_sparse_morton_sharded` (docs/DESIGN.md §4; the
+    replicated variant re-reduces the gathered partials on EVERY
+    device, O(global uniques) replicated — fine for clustered data,
+    the scaling wall for unique-heavy data). Reference analog: Spark's
+    hash-partitioned reducers never replicate the keyspace
+    (reference heatmap.py:112).
+
+    Stages, all inside one shard_map:
+
+    1. per-device detail reduction: local sort + segment-sum to compact
+       (key, sum) partials — unchanged from the replicated variant;
+    2. range splitters by regular sampling (the PSRS bound: with k
+       evenly-spaced samples per device, no range holds more than
+       2·n/k of the partials), each splitter rounded DOWN to a
+       multiple of ``4^prefix_levels`` so a key and its first
+       ``prefix_levels`` rollup ancestors (``key >> 2i``) land in the
+       same range — cross-device parents are impossible through those
+       levels;
+    3. one ``lax.all_to_all`` regroups the compact partials to their
+       range owner;
+    4. each device merges (sort + segment-sum) and rolls up its
+       keyspace range through ``prefix_levels`` levels — each stays
+       O(uniques/k) per device;
+    5. per-level results return range-sharded; the host-side
+       compaction concatenates the (disjoint, ascending) range
+       segments with a searchsorted gather — no sort, no re-reduce.
+       Levels past ``prefix_levels`` roll up replicated from the
+       compacted arrays — the same cheap tail the replicated merge
+       runs for EVERY level, kept only where zoom-clamped capacities
+       (or collapsed unique counts) have already made it small.
+
+    ``prefix_levels`` trades locality depth against range balance:
+    rounding a splitter down moves at most the ``4^prefix_levels``
+    distinct keys of one block (times their <= k cross-device copies)
+    into the lower range, so per-range load is bounded by
+    ``2*local_capacity + k*4^prefix_levels``. The default picks the
+    deepest value whose skew term stays within ``local_capacity``
+    (and caps it at ``levels``) — full-depth locality for shallow
+    pyramids, bounded-skew hybrid for the z21 cascade, where a
+    ``4^15`` block could otherwise swallow a whole metro area's keys
+    (measured: the hot-cluster bench overflowed exactly there).
+
+    Results match the replicated merge EXACTLY for counts and
+    integer-valued weighted sums (same sorted uniques, integer
+    addition in any order); fractional weighted sums agree to f64
+    summation-order rounding — the same contract as the replicated
+    variant vs the single-device cascade.
+
+    ``send_capacity`` bounds the per-(source, destination) all_to_all
+    rows. The default (the per-device partial capacity) can NEVER
+    drop entries; tightening it shrinks the exchange and the merge
+    sort toward true O(n/k) but makes extreme skew (one source
+    holding most of one range) overflow. Every overflow — send drop,
+    range-buffer, or local-stage — is detected and propagated into
+    every level's ``n_unique`` per the ops/sparse.py contract, never
+    silent.
+    """
+    axes, ndev = _shard_axes(mesh)
+    codes = jnp.asarray(codes)
+    n = codes.shape[0]
+    caps = pyramid_ops._level_caps(capacity, n, levels)
+    local_capacity = max(1, min(caps[0], n // ndev))
+    if prefix_levels is None:
+        prefix_levels = 0
+        while (prefix_levels < levels
+               and ndev * (4 ** (prefix_levels + 1)) <= local_capacity):
+            prefix_levels += 1
+    prefix_levels = max(0, min(prefix_levels, levels))
+    # PSRS bound + the rounding skew term (one 4^prefix_levels block's
+    # distinct keys, each on up to ndev devices); a range can never
+    # hold more uniques than the whole level either.
+    slack = ndev * (4 ** prefix_levels)
+    range_caps = [min(caps[lvl], 2 * local_capacity + slack)
+                  for lvl in range(prefix_levels + 1)]
+    send_cap = (local_capacity if send_capacity is None
+                else max(1, min(send_capacity, local_capacity)))
+    if acc_dtype is None:
+        acc_dtype = jnp.int32 if weights is None else jnp.float32
+    w = _ones_like_weights(weights, n, acc_dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+    sentinel = jnp.iinfo(codes.dtype).max
+    prefix_bits = 2 * prefix_levels
+
+    def body(k, w, v):
+        u, s, ln = sparse_ops.aggregate_keys(
+            k, weights=w, valid=v, capacity=local_capacity,
+            acc_dtype=acc_dtype,
+        )
+        # Regular sampling: ndev evenly-spaced picks from my sorted
+        # valid partials (sentinel when fewer than sampled — empty
+        # shards push their splitters to the top, shrinking their
+        # influence instead of corrupting ranges).
+        pos = (jnp.arange(ndev, dtype=jnp.int32)
+               * jnp.minimum(ln, local_capacity)) // ndev
+        samp = u[jnp.clip(pos, 0, local_capacity - 1)]
+        all_samp = lax.all_gather(samp, axes, tiled=True)
+        spl = jnp.sort(all_samp)[(jnp.arange(ndev - 1) + 1) * ndev]
+        # Round each splitter down to a 4^levels block boundary so a
+        # range owns whole rollup subtrees (sentinel splitters stay
+        # above every real 58-bit key even after rounding).
+        spl = (spl >> prefix_bits) << prefix_bits
+        # Partition my (sorted) partials: dest is non-decreasing, so
+        # per-destination runs are contiguous; sentinel pad lanes get
+        # dest=ndev and fall out of the send buffers via mode="drop".
+        lane_ok = u != sentinel
+        dest = jnp.searchsorted(spl, u, side="right").astype(jnp.int32)
+        dest = jnp.where(lane_ok, dest, ndev)
+        bounds = jnp.searchsorted(
+            dest, jnp.arange(ndev + 1, dtype=jnp.int32), side="left"
+        )
+        starts = bounds[:ndev]
+        per_dest = bounds[1:] - bounds[:ndev]
+        dropped = jnp.maximum(per_dest - send_cap, 0).sum().astype(jnp.int32)
+        slot = (jnp.arange(local_capacity, dtype=jnp.int32)
+                - starts[jnp.clip(dest, 0, ndev - 1)])
+        send_u = jnp.full((ndev, send_cap), sentinel, u.dtype).at[
+            dest, slot].set(u, mode="drop")
+        send_s = jnp.zeros((ndev, send_cap), s.dtype).at[
+            dest, slot].set(s, mode="drop")
+        # The regroup "shuffle": row d goes to range owner d; row j of
+        # the result came from source j (ascending ranges = ascending
+        # device ids, which the host-side concatenation relies on).
+        recv_u = lax.all_to_all(send_u, axes, 0, 0, tiled=True)
+        recv_s = lax.all_to_all(send_s, axes, 0, 0, tiled=True)
+        ru = recv_u.reshape(-1)
+        mu, ms, mn = sparse_ops.aggregate_keys(
+            ru, weights=recv_s.reshape(-1), valid=ru != sentinel,
+            capacity=range_caps[0], acc_dtype=acc_dtype,
+        )
+        outs = [(mu, ms, mn[None])]
+        for lvl in range(1, prefix_levels + 1):
+            parents = jnp.where(mu == sentinel, sentinel, mu >> 2)
+            mu, ms, mn = sparse_ops.aggregate_sorted_keys(
+                parents, ms, range_caps[lvl], sentinel=sentinel
+            )
+            outs.append((mu, ms, mn[None]))
+        return tuple(outs), ln[None], dropped[None]
+
+    level_specs = tuple((P(axes), P(axes), P(axes))
+                        for _ in range(prefix_levels + 1))
+    fn = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axes), P(axes), P(axes)),
+        out_specs=(level_specs, P(axes), P(axes)),
+    )
+    level_parts, gln, gdrop = fn(codes, w, v)
+    # Anything lost BEFORE the range merge (local-stage overflow or a
+    # tightened send_capacity dropping entries) poisons every level:
+    # keys are already missing from the merged totals.
+    pre_overflow = (gln > local_capacity).any() | (gdrop > 0).any()
+    out = []
+    any_range_over = pre_overflow
+    for lvl, (gu, gs, gn) in enumerate(level_parts):
+        rc = range_caps[lvl]
+        cap = caps[lvl]
+        any_range_over = any_range_over | (gn > rc).any()
+        cn = jnp.minimum(gn, rc)
+        csum = jnp.cumsum(cn)
+        total = csum[-1]
+        # Concatenate the k disjoint ascending range segments, skipping
+        # each segment's sentinel pad: output slot j maps to (device,
+        # local) via one searchsorted over the k segment offsets.
+        j = jnp.arange(cap, dtype=jnp.int32)
+        dev = jnp.clip(jnp.searchsorted(csum, j, side="right"), 0, ndev - 1)
+        local = j - (csum[dev] - cn[dev])
+        idx = jnp.clip(dev * rc + local, 0, gu.shape[0] - 1)
+        u = jnp.where(j < total, gu[idx], sentinel)
+        s = jnp.where(j < total, gs[idx], jnp.zeros((), gs.dtype))
+        n_l = jnp.where(any_range_over, jnp.maximum(total, cap + 1), total)
+        out.append((u, s, n_l))
+    # Replicated tail: levels past the prefix-local depth roll up from
+    # the compacted (sorted, sentinel-padded) arrays — identical math
+    # to the replicated merge's rollup, paid only where capacities are
+    # already small.
+    u, s, _ = out[-1]
+    for lvl in range(prefix_levels + 1, levels + 1):
+        parents = jnp.where(u == sentinel, sentinel, u >> 2)
+        u, s, n_l = sparse_ops.aggregate_sorted_keys(
+            parents, s, caps[lvl], sentinel=sentinel
+        )
+        n_l = jnp.where(any_range_over,
+                        jnp.maximum(n_l, caps[lvl] + 1), n_l)
+        out.append((u, s, n_l))
+    return out
+
+
 def splat_rowsharded(raster, kernel_1d, mesh: Mesh):
     """Gaussian splat over a row-sharded raster via halo exchange.
 
