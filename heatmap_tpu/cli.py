@@ -142,6 +142,37 @@ def _add_run_flags(p):
                    "the heatmaps instead of counting points (works with "
                    "--fast on HMPB inputs converted from a weighted "
                    "source, and with --max-points-in-flight)")
+    p.add_argument("--weight-bound", type=int, default=None, metavar="W",
+                   help="declare the bounded-integer weight contract "
+                   "(every 'value' an integer in [0, W]) — unlocks the "
+                   "partitioned cascade backend for weighted jobs; "
+                   "violations surface as capacity overflow, never a "
+                   "rounded sum")
+    p.add_argument("--data-parallel", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="cascade data-parallelism over this process's "
+                   "local devices: auto (default) engages past "
+                   "--dp-min-emissions when >1 device is visible; on "
+                   "forces the mesh path at any size; off pins the "
+                   "single-device cascade. Blobs are identical either "
+                   "way (counts bit-exact; fractional weighted sums up "
+                   "to f64 summation order)")
+    p.add_argument("--dp-merge", choices=("replicated", "prefix"),
+                   default="replicated",
+                   help="data-parallel cascade merge: replicated "
+                   "(default; every device re-reduces the gathered "
+                   "partials) or prefix (coarse-prefix all_to_all "
+                   "regroup; each device merges and rolls up only its "
+                   "keyspan range — O(uniques/k) per stage, the shape "
+                   "for unique-heavy data). Blobs identical either way")
+    p.add_argument("--dp-min-emissions", type=int, default=None,
+                   metavar="N",
+                   help="auto-DP engagement threshold (emissions per "
+                   "cascade call; default batch.AUTO_DP_MIN_EMISSIONS "
+                   "= 2^18, calibrated on a CPU mesh only). Measure "
+                   "the real crossover on your hardware with the "
+                   "docs/OPERATIONS.md 'Calibrating auto-DP' recipe; "
+                   "auto mode only")
     p.add_argument("--fast", action="store_true",
                    help="force the integer-only native-decoder path "
                    "(csv/hmpb sources; dated timespans use the i64 "
@@ -205,7 +236,12 @@ def cmd_run(args) -> int:
             first_timespan_only=args.first_timespan_only,
             capacity=args.capacity,
             weighted=args.weighted,
+            weight_bound=args.weight_bound,
             cascade_backend=args.cascade_backend,
+            data_parallel={"auto": None, "on": True, "off": False}[
+                args.data_parallel],
+            dp_merge=args.dp_merge,
+            dp_min_emissions=args.dp_min_emissions,
         )
     except ValueError as e:
         raise SystemExit(str(e)) from e
@@ -536,6 +572,7 @@ def cmd_stream(args) -> int:
         half_life_s=args.half_life,
         proj_dtype=proj_dtype,
         pad_to=args.batch_points,
+        backend=args.bin_backend,
     )
     stream = HeatmapStream(config)
     mgr = None
@@ -862,6 +899,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "('' = none)")
     p_stream.add_argument("--batch-points", type=int, default=1 << 16,
                           help="points per micro-batch (one compiled step)")
+    p_stream.add_argument("--bin-backend", default="auto",
+                          choices=("auto", "xla", "pallas", "partitioned"),
+                          help="binning backend for the update step "
+                          "(StreamConfig.backend); pin per "
+                          "tools/bench_stream.py measurements — CPU "
+                          "rows in onchip_state/sweep.jsonl show xla "
+                          "winning there; the on-chip default flip is "
+                          "decision rule (d), PERF_NOTES.md")
     p_stream.add_argument("--interval", type=float, default=60.0,
                           help="stream seconds advanced per micro-batch")
     p_stream.add_argument("--half-life", type=float, default=3600.0,

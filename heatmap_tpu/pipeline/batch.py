@@ -46,9 +46,20 @@ class BatchJobConfig:
     #: are a capability extension, not a parity surface.
     weighted: bool = False
     #: Cascade reduction backend: "scatter" (default) or "partitioned"
-    #: (count-only multi-channel MXU reduction; enable after its
-    #: on-chip numbers land — PERF_NOTES pending item 5).
+    #: (multi-channel MXU reduction; enable after its on-chip numbers
+    #: land — PERF_NOTES pending item 5). Weighted jobs may route
+    #: partitioned only under the bounded-integer contract
+    #: (``weight_bound``).
     cascade_backend: str = "scatter"
+    #: Bounded-integer weight contract for weighted partitioned jobs:
+    #: every 'value' is an integer in [0, weight_bound]. Lifts the
+    #: weighted lockout on the partitioned backend (the exactness slab
+    #: shrinks to 2^24 // bound; violations are detected on device and
+    #: surface as capacity overflow — ops/sparse_partitioned.py).
+    #: Fractional weights CANNOT take this contract (f32 products
+    #: round before accumulation; no slab restores exactness) — they
+    #: stay on the scatter backend.
+    weight_bound: int | None = None
     #: Shrink deep cascade levels to the real unique counts (one scalar
     #: sync per level; identical results — see
     #: ops.pyramid.pyramid_sparse_morton). Measured on CPU: ~1.1x warm,
@@ -73,21 +84,92 @@ class BatchJobConfig:
     #: runs (run_job_multihost): each process data-parallelizes its
     #: slice over its own local devices.
     data_parallel: bool | None = None
+    #: Cross-device merge for the data-parallel cascade: "replicated"
+    #: (default — all_gather compact partials, merge + roll up on every
+    #: device; O(global uniques) replicated, measured fine for
+    #: clustered data) or "prefix" (coarse-prefix all_to_all regroup —
+    #: each device merges and rolls up only its keyspan range,
+    #: O(uniques/k) per stage; the scaling shape for unique-heavy data
+    #: — docs/DESIGN.md §4, reference heatmap.py:112's hash-partitioned
+    #: reducers). Blobs identical either way (counts and integer
+    #: weighted sums bit-exact; fractional weighted to f64 summation
+    #: order). Ignored off the mesh path.
+    dp_merge: str = "replicated"
+    #: Auto-DP engagement threshold override (emission count at which
+    #: ``data_parallel=None`` engages the mesh). None uses the module
+    #: default ``AUTO_DP_MIN_EMISSIONS``, which is calibrated from a
+    #: CPU-mesh data point only — a v5e-8 operator should measure the
+    #: real crossover (docs/OPERATIONS.md "Calibrating auto-DP") and
+    #: set this (CLI ``--dp-min-emissions``). Meaningful for auto mode
+    #: only; explicit True/False ignore the threshold, so combining is
+    #: rejected at config time.
+    dp_min_emissions: int | None = None
 
     def __post_init__(self):
+        if self.dp_merge not in ("replicated", "prefix"):
+            raise ValueError(
+                f"unknown dp_merge {self.dp_merge!r} (valid: "
+                "replicated, prefix) — rejected at config time so a "
+                "typo fails before a multi-hour ingest"
+            )
+        if self.dp_min_emissions is not None:
+            if self.data_parallel is not None:
+                raise ValueError(
+                    "dp_min_emissions tunes AUTO data-parallel routing "
+                    "only; data_parallel=True/False ignore the "
+                    "threshold — rejected at config time so a "
+                    "calibration flag that silently does nothing "
+                    "cannot ship"
+                )
+            if self.dp_min_emissions < 0:
+                raise ValueError(
+                    f"dp_min_emissions must be >= 0, got "
+                    f"{self.dp_min_emissions}"
+                )
         if self.cascade_backend not in ("scatter", "partitioned"):
             raise ValueError(
                 f"unknown cascade backend {self.cascade_backend!r} "
                 "(valid: scatter, partitioned) — rejected at config "
                 "time so a typo fails before a multi-hour ingest"
             )
-        if self.weighted and self.cascade_backend == "partitioned":
+        if (self.weighted and self.cascade_backend == "partitioned"
+                and self.weight_bound is None):
             raise ValueError(
-                "cascade backend 'partitioned' is count-only (its "
-                "exactness slabs assume unit weights); weighted jobs "
-                "use the scatter backend — rejected at config time so "
-                "the combination fails before ingest"
+                "cascade backend 'partitioned' takes weighted jobs "
+                "only under the bounded-integer contract: set "
+                "weight_bound (every 'value' an integer in "
+                "[0, weight_bound]); fractional weights use the "
+                "scatter backend — rejected at config time so the "
+                "combination fails before ingest"
             )
+        if self.weight_bound is not None:
+            if not self.weighted:
+                raise ValueError(
+                    "weight_bound declares the weighted integer "
+                    "contract and needs weighted=True — rejected at "
+                    "config time so a silently ignored bound cannot "
+                    "ship"
+                )
+            if self.weight_bound < 1:
+                raise ValueError(
+                    f"weight_bound must be >= 1, got {self.weight_bound}"
+                )
+            # The partitioned cascade runs at the kernel's default
+            # geometry (chunk=1024, streams=1), where the f32
+            # exactness slab 2^24 // bound must hold at least one
+            # chunk row — beyond that NO slab size keeps weighted
+            # sums exact (ops/sparse_partitioned.py refuses too, but
+            # a config-time rejection beats a mid-job one).
+            max_bound = (1 << 24) // 1024
+            if (self.cascade_backend == "partitioned"
+                    and self.weight_bound > max_bound):
+                raise ValueError(
+                    f"weight_bound {self.weight_bound} exceeds the "
+                    f"partitioned backend's exactness limit "
+                    f"{max_bound} (slab 2^24 // bound must hold one "
+                    "1024-element chunk) — use the scatter backend "
+                    "for larger weights"
+                )
         if self.data_parallel:
             if self.cascade_backend != "scatter":
                 raise ValueError(
@@ -112,28 +194,64 @@ class BatchJobConfig:
         )
 
 
+def _row_get(row, key, default=None):
+    """Mapping-style ``.get`` for dicts AND pyspark-Row-shaped rows.
+
+    ``pyspark.sql.Row`` is a tuple subclass with ``__getitem__`` by
+    field name but NO ``.get`` method — the exact objects a
+    ``df.rdd.mapPartitions`` body receives (the Spark-boundary
+    contract of spark_adapter.py; the reference's mappers indexed Rows
+    by name the same way, reference heatmap.py:27-35). Missing fields
+    raise ValueError there, KeyError on mappings — both mean
+    ``default``.
+    """
+    getter = getattr(row, "get", None)
+    if getter is not None:
+        return getter(key, default)
+    try:
+        return row[key]
+    except (KeyError, ValueError, IndexError, TypeError):
+        return default
+
+
 def load_rows(rows):
     """Ingest filter + column extraction (reference dataframe_loader,
     heatmap.py:25-36): drops ``source == "background"`` rows, keeps
-    (latitude, longitude, user_id, timestamp), count 1.0 each.
+    (latitude, longitude, user_id, timestamp) and, when any row
+    carries one, the optional ``value`` weight column (absent values
+    default 1.0 — the reference counts 1.0 per row, heatmap.py:35).
 
-    ``rows``: iterable of dicts with the reference's column names.
-    Returns dict of host arrays/lists.
+    ``rows``: iterable of dicts OR pyspark-Row-shaped objects with the
+    reference's column names. Returns dict of host arrays/lists.
     """
-    lats, lons, users, stamps = [], [], [], []
+    lats, lons, users, stamps, vals = [], [], [], [], []
+    _missing = object()
+    any_value = False
     for row in rows:
-        if row.get("source") == BACKGROUND_SOURCE:
+        if _row_get(row, "source") == BACKGROUND_SOURCE:
             continue
         lats.append(row["latitude"])
         lons.append(row["longitude"])
         users.append(row["user_id"])
-        stamps.append(row.get("timestamp"))
-    return {
+        stamps.append(_row_get(row, "timestamp"))
+        # Keyed on field PRESENCE, not non-None values: a partition
+        # whose rows all carry value=None must still emit the column
+        # (nulls default 1.0) — otherwise the same weighted job fails
+        # or succeeds depending on partition placement.
+        v = _row_get(row, "value", _missing)
+        any_value = any_value or v is not _missing
+        vals.append(None if v is _missing else v)
+    out = {
         "latitude": np.asarray(lats, np.float64),
         "longitude": np.asarray(lons, np.float64),
         "user_id": users,
         "timestamp": stamps,
     }
+    if any_value:
+        out["value"] = np.asarray(
+            [1.0 if v is None else float(v) for v in vals], np.float64
+        )
+    return out
 
 
 def project_detail_codes(lat: np.ndarray, lon: np.ndarray, detail_zoom: int,
@@ -212,7 +330,9 @@ def _dp_mesh_for(mesh, config: BatchJobConfig, n_emissions: int):
     at AUTO_DP_MIN_EMISSIONS and up; explicit True always engages."""
     if mesh is None:
         return None
-    if config.data_parallel is None and n_emissions < AUTO_DP_MIN_EMISSIONS:
+    threshold = (AUTO_DP_MIN_EMISSIONS if config.dp_min_emissions is None
+                 else config.dp_min_emissions)
+    if config.data_parallel is None and n_emissions < threshold:
         return None
     return mesh
 
@@ -930,6 +1050,8 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 jit=False,
                 backend=config.cascade_backend,
                 mesh=_dp_mesh_for(dp_mesh, config, len(e_codes)),
+                merge=config.dp_merge,
+                weight_bound=config.weight_bound,
             )
             levels = cascade_mod.decode_levels(level_data, ccfg)
         with tracer.span("merge.chunk"):
@@ -1843,6 +1965,8 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             adaptive=config.adaptive_capacity,
             backend=config.cascade_backend,
             mesh=_dp_mesh_for(_dp_mesh(config), config, len(e_codes)),
+            merge=config.dp_merge,
+            weight_bound=config.weight_bound,
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

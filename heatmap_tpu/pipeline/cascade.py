@@ -96,7 +96,8 @@ def decode_level_keys(level_keys: np.ndarray, detail_zoom: int, level: int):
 def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                   weights=None, valid=None, capacity=None, acc_dtype=None,
                   adaptive: bool = False, backend: str = "scatter",
-                  mesh=None):
+                  mesh=None, merge: str = "replicated",
+                  weight_bound: int | None = None):
     """Device-side cascade: per-level (composite key, sum) aggregates.
 
     Args:
@@ -129,7 +130,20 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     as the bounded path's cross-chunk merge (pipeline/batch.py
     run_job). Scatter backend only; ``adaptive`` reads concrete counts
     and does not compose.
+
+    ``merge`` selects the mesh path's cross-device merge:
+    "replicated" (default — all_gather the compact partials, re-reduce
+    and roll up on every device; O(global uniques) replicated) or
+    "prefix" (coarse-prefix all_to_all regroup — each device merges
+    and rolls up only its keyspace range, O(uniques/k) per stage;
+    parallel.sharded.pyramid_sparse_morton_prefix_sharded). Same
+    results either way (counts/integer weights bit-identical,
+    fractional weighted to f64 summation order).
     """
+    if merge not in ("replicated", "prefix"):
+        raise ValueError(
+            f"unknown mesh merge {merge!r} (valid: replicated, prefix)"
+        )
     if mesh is not None:
         if backend != "scatter":
             raise ValueError(
@@ -163,7 +177,7 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     if mesh is not None:
         return _build_cascade_sharded(
             ck, config, mesh, weights=weights, valid=valid,
-            capacity=capacity, acc_dtype=acc_dtype,
+            capacity=capacity, acc_dtype=acc_dtype, merge=merge,
         )
     if backend == "partitioned":
         slot_bits = max(1, int(np.ceil(np.log2(max(n_slots, 2)))))
@@ -175,11 +189,14 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 f"{2 * config.detail_zoom + slot_bits} bits — use the "
                 "scatter backend"
             )
-        if weights is not None:
+        if weights is not None and weight_bound is None:
             raise ValueError(
-                "cascade backend 'partitioned' is count-only (the MXU "
-                "reduction's exactness slabs assume unit weights); "
-                "weighted jobs use the scatter backend"
+                "cascade backend 'partitioned' takes weighted jobs "
+                "only under the bounded-integer contract (weights "
+                "integer in [0, weight_bound]; exactness slab = "
+                "2^24 // bound — ops/sparse_partitioned.py): pass "
+                "weight_bound, or use the scatter backend (required "
+                "for fractional weights)"
             )
         if adaptive:
             raise ValueError(
@@ -191,6 +208,8 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             valid=valid,
             levels=config.n_levels,
             capacity=capacity,
+            weights=weights,
+            weight_bound=weight_bound if weights is not None else None,
         )
     if backend != "scatter":
         raise ValueError(f"unknown cascade backend {backend!r}")
@@ -207,7 +226,7 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
 
 def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
                            weights=None, valid=None, capacity=None,
-                           acc_dtype=None):
+                           acc_dtype=None, merge: str = "replicated"):
     """Pad composite keys to the mesh shard count and run the sharded
     pyramid (see build_cascade's ``mesh`` doc). Pad lanes carry
     valid=False, the masking path every kernel already drops."""
@@ -236,7 +255,10 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
             weights = jnp.concatenate(
                 [weights, jnp.zeros((pad,), weights.dtype)]
             )
-    return sharded_kernels.pyramid_sparse_morton_sharded(
+    kernel = (sharded_kernels.pyramid_sparse_morton_prefix_sharded
+              if merge == "prefix"
+              else sharded_kernels.pyramid_sparse_morton_sharded)
+    return kernel(
         ck, mesh, weights=weights, valid=v, levels=config.n_levels,
         capacity=capacity, acc_dtype=acc_dtype,
     )
@@ -251,14 +273,16 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
 _build_cascade_jit = functools.partial(
     jax.jit,
     static_argnames=("config", "n_slots", "capacity", "acc_dtype",
-                     "backend", "mesh"),
+                     "backend", "mesh", "merge", "weight_bound"),
 )(build_cascade)
 
 
 def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 weights=None, valid=None, capacity=None, acc_dtype=None,
                 adaptive: bool = False, jit: bool = True,
-                backend: str = "scatter", mesh=None):
+                backend: str = "scatter", mesh=None,
+                merge: str = "replicated",
+                weight_bound: int | None = None):
     """The production cascade entry: jitted whole, unless ``adaptive``
     (which must read concrete per-level unique counts and therefore
     runs eagerly — see ops.pyramid.pyramid_sparse_morton) or
@@ -271,14 +295,16 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         return build_cascade(
             codes, slots, config, n_slots, weights=weights, valid=valid,
             capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
-            backend=backend, mesh=mesh,
+            backend=backend, mesh=mesh, merge=merge,
+            weight_bound=weight_bound,
         )
     if isinstance(capacity, list):
         capacity = tuple(capacity)  # static args must be hashable
     return _build_cascade_jit(
         codes, slots, config=config, n_slots=n_slots, weights=weights,
         valid=valid, capacity=capacity, acc_dtype=acc_dtype,
-        backend=backend, mesh=mesh,
+        backend=backend, mesh=mesh, merge=merge,
+        weight_bound=weight_bound,
     )
 
 

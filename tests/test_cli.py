@@ -339,6 +339,30 @@ class TestEndToEnd:
         assert stats2["batches"] == stats["batches"]
         assert stats2["live_mass"] == pytest.approx(stats["live_mass"])
 
+    @pytest.mark.slow
+    def test_stream_bin_backend_flag(self, tmp_path):
+        """--bin-backend pins the update step's binning kernel; xla and
+        the auto route must produce identical live mass (same points,
+        bit-exact count kernels either way)."""
+        masses = {}
+        for be in ("auto", "xla"):
+            r = _run_cli(
+                "stream", "--backend", "cpu",
+                "--input", "synthetic:8000:4",
+                "--output", "",
+                "--batch-points", "2048",
+                "--interval", "600", "--half-life", "1200",
+                "--zoom", "10", "--pixel-delta", "6",
+                "--lat-min", "46", "--lat-max", "49",
+                "--lon-min", "-124", "--lon-max", "-120",
+                "--bin-backend", be,
+            )
+            assert r.returncode == 0, r.stderr
+            masses[be] = json.loads(
+                r.stdout.strip().splitlines()[-1]
+            )["live_mass"]
+        assert masses["auto"] == pytest.approx(masses["xla"])
+
     def test_tiles_synthetic_to_png_tree(self, tmp_path):
         out = tmp_path / "tiles"
         r = _run_cli(
@@ -414,7 +438,7 @@ class TestEndToEnd:
     @pytest.mark.slow
     def test_run_cascade_backend_flag(self, tmp_path):
         """--cascade-backend partitioned produces byte-identical blobs
-        to the default scatter backend, and the count-only rejection
+        to the default scatter backend, and the unbounded-weighted rejection
         proves the flag actually reaches the config (byte-equality
         alone would pass even if the plumbing silently dropped it)."""
         outs = {}
@@ -438,7 +462,35 @@ class TestEndToEnd:
             "--cascade-backend", "partitioned", "--weighted",
         )
         assert r2.returncode != 0
-        assert "count-only" in r2.stderr
+        assert "bounded-integer" in r2.stderr
+        assert "Traceback" not in r2.stderr
+
+    @pytest.mark.slow
+    def test_run_data_parallel_flag(self, tmp_path):
+        """--data-parallel on/off produce byte-identical blobs, and the
+        rejection of --dp-min-emissions with an explicit mode proves
+        both flags reach BatchJobConfig (byte-equality alone would pass
+        if the plumbing silently dropped them)."""
+        outs = {}
+        for dp in ("on", "off", "auto"):
+            out = tmp_path / f"dp_{dp}.jsonl"
+            r = _run_cli(
+                "run", "--backend", "cpu",
+                "--input", "synthetic:4000:6",
+                "--output", f"jsonl:{out}",
+                "--detail-zoom", "11", "--min-detail-zoom", "5",
+                "--data-parallel", dp,
+            )
+            assert r.returncode == 0, r.stderr
+            outs[dp] = out.read_bytes()
+        assert outs["on"] == outs["off"] == outs["auto"]
+        r2 = _run_cli(
+            "run", "--backend", "cpu",
+            "--input", "synthetic:10", "--output", "memory:",
+            "--data-parallel", "on", "--dp-min-emissions", "1000",
+        )
+        assert r2.returncode != 0
+        assert "AUTO" in r2.stderr
         assert "Traceback" not in r2.stderr
 
     def test_info_reports_platform(self):

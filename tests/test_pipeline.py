@@ -753,7 +753,7 @@ def test_cascade_backend_partitioned_identical_blobs():
                 batch_size=256)
     assert a == b and len(a) > 0
     wrows = [dict(r, value=2.0) for r in rows]
-    with pytest.raises(ValueError, match="count-only"):
+    with pytest.raises(ValueError, match="bounded-integer"):
         run_job(_ColSource(wrows),
                 config=dataclasses.replace(cfg, weighted=True),
                 batch_size=256)
@@ -761,6 +761,39 @@ def test_cascade_backend_partitioned_identical_blobs():
     bounded = run_job(_ColSource(rows), config=cfg, batch_size=256,
                       max_points_in_flight=300)
     assert bounded == a
+    # Bounded-integer weighted contract: weight_bound unlocks the
+    # partitioned backend for integer-weighted jobs, byte-identical
+    # to the scatter backend (VERDICT r4 #7).
+    rng = np.random.default_rng(32)
+    for r in wrows:
+        r["value"] = float(rng.integers(0, 100))
+    wp = run_job(_ColSource(wrows),
+                 config=dataclasses.replace(cfg, weighted=True,
+                                            weight_bound=100),
+                 batch_size=256)
+    ws = run_job(_ColSource(wrows),
+                 config=BatchJobConfig(detail_zoom=13, min_detail_zoom=6,
+                                       weighted=True),
+                 batch_size=256)
+    assert wp == ws and len(wp) > 0
+    # A weight outside the declared bound surfaces as overflow, not a
+    # silently rounded sum.
+    bad = [dict(r, value=250.75) for r in wrows[:4]] + wrows
+    with pytest.raises(ValueError, match="overflowed capacity"):
+        run_job(_ColSource(bad),
+                config=dataclasses.replace(cfg, weighted=True,
+                                           weight_bound=100),
+                batch_size=256)
+    # The contract knob is rejected where it would silently no-op.
+    with pytest.raises(ValueError, match="weighted=True"):
+        BatchJobConfig(weight_bound=10)
+    # A bound past the kernel's exactness limit fails at config time,
+    # not mid-job (no slab can keep f32 sums exact there).
+    with pytest.raises(ValueError, match="exactness limit"):
+        BatchJobConfig(weighted=True, weight_bound=20_000,
+                       cascade_backend="partitioned")
+    # Scatter has no such limit — big integer weights are fine there.
+    BatchJobConfig(weighted=True, weight_bound=20_000)
     # Typos die at config construction, not after a full ingest.
     with pytest.raises(ValueError, match="unknown cascade backend"):
         BatchJobConfig(cascade_backend="partioned")
@@ -972,6 +1005,28 @@ def test_dp_config_rejections():
         _dp_cfg(data_parallel=True, adaptive_capacity=True)
 
 
+def test_dp_min_emissions_override():
+    """The calibration knob moves the auto threshold; combining it with
+    an explicit on/off (where it would silently do nothing) is rejected
+    at config time."""
+    from heatmap_tpu.pipeline.batch import _dp_mesh, _dp_mesh_for
+
+    tuned = _dp_cfg(data_parallel=None, dp_min_emissions=1000)
+    mesh = _dp_mesh(tuned)
+    assert mesh is not None
+    assert _dp_mesh_for(mesh, tuned, 999) is None
+    assert _dp_mesh_for(mesh, tuned, 1000) is mesh
+    # 0 engages auto at any size (the "my hardware always wins" pin).
+    always = _dp_cfg(data_parallel=None, dp_min_emissions=0)
+    assert _dp_mesh_for(mesh, always, 1) is mesh
+    with pytest.raises(ValueError, match="AUTO"):
+        _dp_cfg(data_parallel=True, dp_min_emissions=1000)
+    with pytest.raises(ValueError, match="AUTO"):
+        _dp_cfg(data_parallel=False, dp_min_emissions=1000)
+    with pytest.raises(ValueError, match=">= 0"):
+        _dp_cfg(data_parallel=None, dp_min_emissions=-1)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("amplify", [False, True])
 def test_run_job_data_parallel_byte_identical(amplify):
@@ -987,6 +1042,70 @@ def test_run_job_data_parallel_byte_identical(amplify):
         config=_dp_cfg(amplify_all=amplify, data_parallel=False),
     )
     assert dp == single and len(dp) > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("amplify", [False, True])
+def test_run_job_dp_prefix_merge_byte_identical(amplify):
+    """The coarse-prefix regrouped merge (VERDICT r4 missing #4) emits
+    blobs byte-identical to BOTH the replicated-merge DP job and the
+    single-device cascade, in both compat modes — same bar as the
+    replicated route."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2500, seed=42)
+    prefix = run_job(
+        _ColSource(rows),
+        config=_dp_cfg(amplify_all=amplify, dp_merge="prefix"),
+    )
+    replicated = run_job(
+        _ColSource(rows), config=_dp_cfg(amplify_all=amplify)
+    )
+    single = run_job(
+        _ColSource(rows),
+        config=_dp_cfg(amplify_all=amplify, data_parallel=False),
+    )
+    assert prefix == replicated == single and len(prefix) > 0
+
+
+@pytest.mark.slow
+def test_run_job_dp_prefix_merge_weighted_integer_bit_identical():
+    """Integer weighted sums through the prefix merge stay bit-exact
+    (integer f64 addition is order-free; the regroup only changes the
+    order)."""
+    from heatmap_tpu.pipeline import run_job
+
+    rng = np.random.default_rng(15)
+    rows = _rows(n=1500, seed=15)
+    for r in rows:
+        r["value"] = float(rng.integers(1, 12))
+    prefix = run_job(_ColSource(rows),
+                     config=_dp_cfg(weighted=True, dp_merge="prefix"))
+    single = run_job(_ColSource(rows),
+                     config=_dp_cfg(weighted=True, data_parallel=False))
+    assert prefix == single and len(prefix) > 0
+
+
+@pytest.mark.slow
+def test_run_job_dp_prefix_merge_bounded_byte_identical():
+    """The prefix merge composes with the bounded chunked path exactly
+    like the replicated merge does."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2000, seed=9)
+    prefix = run_job(_ColSource(rows),
+                     config=_dp_cfg(dp_merge="prefix"),
+                     batch_size=128, max_points_in_flight=300)
+    single = run_job(_ColSource(rows),
+                     config=_dp_cfg(data_parallel=False),
+                     batch_size=128, max_points_in_flight=300)
+    assert prefix == single and len(prefix) > 0
+
+
+def test_dp_merge_config_rejection():
+    """A dp_merge typo fails at config time, before ingest."""
+    with pytest.raises(ValueError, match="dp_merge"):
+        BatchJobConfig(dp_merge="sharded")
 
 
 def test_run_job_data_parallel_matches_oracle():

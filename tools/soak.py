@@ -177,8 +177,17 @@ def check_dp_job(n, tmp):
     run_job_fast(HMPBSource(whmpb), LevelArraysSink(wb),
                  config=BatchJobConfig(data_parallel=False, **wcfg))
     wlevels, wrows = _assert_levels_equal(wa, wb)
+    # Coarse-prefix merge at soak size: the O(uniques/k) route must
+    # match the single-device arrays byte-for-byte too (drives the
+    # PSRS splitters + hybrid prefix depth on real clustered z21 data,
+    # where the first full-depth build overflowed).
+    p = os.path.join(tmp, "dp-p")
+    run_job_fast(HMPBSource(hmpb), LevelArraysSink(p),
+                 config=BatchJobConfig(data_parallel=True,
+                                       dp_merge="prefix"))
+    _assert_levels_equal(p, b)
     return {"levels": levels, "rows": rows, "weighted_rows": wrows,
-            "devices": len(jax.devices())}
+            "prefix_merge": "ok", "devices": len(jax.devices())}
 
 
 def check_resume(n, tmp):
