@@ -160,8 +160,12 @@ def _partition_kernel_weighted(base_ref, good_ref, first_ref, last_ref,
     # arbitrary f32), and the f32/bf16 gap measured ~0 at >= 256x256.
     row_onehot = (r_ids == rloc[None, :]).astype(jnp.float32)
     col_w = (c_ids == cloc[:, None]).astype(jnp.float32) * w_ref[0, 0, :][:, None]
+    # HIGHEST: the default f32 matmul may execute as one bf16 pass on
+    # the MXU (8 mantissa bits), which would round the weights — the
+    # same contract as the small-window kernel (pallas_kernels.py).
     acc_ref[0] += jnp.dot(
-        row_onehot, col_w, preferred_element_type=jnp.float32
+        row_onehot, col_w, preferred_element_type=jnp.float32,
+        precision=lax.Precision.HIGHEST,
     )
 
     @pl.when(last_ref[i] == 1)

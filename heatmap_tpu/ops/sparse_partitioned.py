@@ -100,9 +100,15 @@ def _segment_kernel(base_ref, good_ref, first_v_ref, last_v_ref,
     row_onehot = (r_ids == rloc[None, :]).astype(jnp.float32)
     col_onehot = (c_ids == cloc[:, None]).astype(jnp.float32)
     for ch in range(n_channels):  # static unroll; one-hots shared
+        # HIGHEST, not the MXU default: channel values run to 2^20 (key
+        # pieces) and the default f32 matmul may execute as one bf16
+        # pass (8 mantissa bits — observed on-chip 2026-08-02, keys
+        # truncated to 1024-multiples at slab=2^20). The one-hot factor
+        # is exact in any precision; the VALUE factor is not.
         acc_ref[0, ch] += jnp.dot(
             row_onehot, col_onehot * w_ref[0, ch, :][:, None],
             preferred_element_type=jnp.float32,
+            precision=lax.Precision.HIGHEST,
         )
 
     @pl.when(last_v_ref[i] == 1)

@@ -119,9 +119,13 @@ def test_decision_rules_fire_on_synthetic_evidence(tmp_path, capsys, monkeypatch
              "device": "tpu", "pts_per_s": 150e6, "steps_per_s": 570.0},
         ]:
             f.write(json.dumps(rec) + "\n")
+    epoch = dec._verify_epoch()
     with open(tmp_path / "verify.jsonl", "w") as f:
-        f.write(json.dumps({"seg-clustered|{}": True}) + "\n")
-        f.write(json.dumps({"seg-pileup|{}": True}) + "\n")
+        # Current-epoch verdicts gate the flip; a legacy un-prefixed
+        # FALSE line must be ignored as stale rather than blocking.
+        f.write(json.dumps({f"{epoch}|seg-clustered|{{}}": True}) + "\n")
+        f.write(json.dumps({f"{epoch}|seg-pileup|{{}}": True}) + "\n")
+        f.write(json.dumps({"seg-clustered|{}": False}) + "\n")
     monkeypatch.setattr(sys, "argv",
                         ["apply_decisions", "--state-dir", str(tmp_path)])
     dec.main()
@@ -144,8 +148,9 @@ def test_decision_rules_block_on_failed_verify(tmp_path, capsys, monkeypatch):
                             "ms": 5000.0}) + "\n")
         f.write(json.dumps({"config": "cascade-pyramid16 partitioned",
                             "ms": 1000.0}) + "\n")
+    epoch = dec._verify_epoch()
     with open(tmp_path / "verify.jsonl", "w") as f:
-        f.write(json.dumps({"seg-clustered|{}": False}) + "\n")
+        f.write(json.dumps({f"{epoch}|seg-clustered|{{}}": False}) + "\n")
     monkeypatch.setattr(sys, "argv",
                         ["apply_decisions", "--state-dir", str(tmp_path)])
     dec.main()

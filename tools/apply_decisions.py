@@ -14,8 +14,24 @@ temptation to flip on a misremembered number).
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
 import os
+
+
+def _verify_epoch():
+    """The CURRENT kernel epoch from the verify tool: seg-* verdicts
+    recorded under any other epoch (or the legacy un-prefixed keys) are
+    stale — produced by a different kernel or reference — and must not
+    gate a routing flip."""
+    spec = importlib.util.spec_from_file_location(
+        "verify_partitioned_onchip",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "verify_partitioned_onchip.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.EPOCH
 
 
 def _load_jsonl(path):
@@ -72,7 +88,8 @@ def main() -> int:
     # Rule (b): cascade_backend default flips to partitioned for count
     # jobs only if the pyramid16 A/B wins AND the seg-* verify cases
     # are bit-exact under Mosaic.
-    seg_keys = [k for k in verify if k.startswith("seg-")]
+    epoch = _verify_epoch()
+    seg_keys = [k for k in verify if k.startswith(f"{epoch}|seg-")]
     seg_ok = bool(seg_keys) and all(verify[k] is True for k in seg_keys)
     c_scatter = ms("cascade-pyramid16 scatter")
     candidates = {
@@ -101,6 +118,7 @@ def main() -> int:
         "pyramid16_partitioned_k4_ms": candidates["partitioned k=4"],
         "seg_verify_count": len(seg_keys),
         "seg_verify_all_ok": seg_ok,
+        "seg_verify_epoch": epoch,
     })
 
     # Rule (c): bad_frac default if the tail-cap win composes with k=8.

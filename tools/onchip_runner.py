@@ -149,6 +149,11 @@ def runlist():
             "cmd": [py, "tools/verify_partitioned_onchip.py",
                     "--state", f"{STATE_DIR}/verify.jsonl"],
             "timeout": 2700,
+            # rc 3 = every combo settled, none bit-INEXACT, but some
+            # recorded deterministic compile errors (e.g. the x64
+            # toolchain regression): the run is complete — retrying
+            # cannot change it. rc 1 (mismatch) stays a failure.
+            "ok_rcs": (0, 3),
         },
         {
             "name": "bench_stream",
@@ -253,7 +258,8 @@ def main() -> int:
         rc = run_item(item, env)
         log_path = os.path.join(STATE_DIR, f"{item['name']}.log")
         check = item.get("check")
-        ok = rc == 0 and (check is None or check(log_path))
+        ok = (rc in item.get("ok_rcs", (0,))
+              and (check is None or check(log_path)))
         if ok:
             done[item["name"]] = {"at": time.strftime("%F %T")}
             save_done(done)
