@@ -132,11 +132,12 @@ def _add_run_flags(p):
     p.add_argument("--first-timespan-only", action="store_true",
                    help="reproduce the reference's early-return timespan "
                    "quirk (SURVEY.md §8.2)")
-    p.add_argument("--cascade-backend", default="scatter",
-                   choices=("scatter", "partitioned"),
-                   help="cascade reduction: scatter (default) or the "
-                   "count-only partitioned MXU kernel (enable once its "
-                   "on-chip numbers land; see PERF_NOTES.md)")
+    p.add_argument("--cascade-backend", default="auto",
+                   choices=("auto", "scatter", "partitioned"),
+                   help="cascade reduction: auto (default — partitioned "
+                   "MXU kernel for count jobs, 1.8x the scatter kernel "
+                   "on chip; scatter for weighted jobs), or pin either "
+                   "backend explicitly (see PERF_NOTES.md round 5)")
     p.add_argument("--weighted", action="store_true",
                    help="sum the source's per-point 'value' column into "
                    "the heatmaps instead of counting points (works with "

@@ -156,11 +156,11 @@ def _pick_backend(backend: str, window: Window, weighted: bool = False) -> str:
         return "pallas"
     # Large windows: sort-partitioned MXU binning wins big for counts
     # (measured 149 M vs 67 M pts/s on the ~1024x1280 z15 headline
-    # window, v5e-1, same session). The weighted variant (pair-sorted
-    # weights + weight-scaled one-hots) exists but stays off auto until
-    # its on-chip win is measured (PERF_NOTES pending runlist) — request
-    # backend="partitioned" explicitly meanwhile.
-    return "xla" if weighted else "partitioned"
+    # window, v5e-1, same session) AND for weighted sums (pair-sorted
+    # weights + weight-scaled one-hots: 340.6 ms vs 432.5 ms XLA
+    # scatter at the z15 headline window, k=8, v5e-1 round-5 sweep —
+    # PERF_NOTES.md round 5).
+    return "partitioned"
 
 
 def bin_rowcol_window(row, col, window: Window, weights=None, valid=None,

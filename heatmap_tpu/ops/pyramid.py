@@ -29,6 +29,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from heatmap_tpu.ops import sparse as sparse_ops
+from heatmap_tpu.utils import trace
 
 
 def coarsen_raster(raster):
@@ -130,11 +131,14 @@ def pyramid_sparse_morton(
         # must stay sentinel (a plain shift would corrupt them into
         # plausible-looking codes).
         parents = jnp.where(uniq == sentinel, sentinel, uniq >> 2)
-        uniq, sums, count = sparse_ops.aggregate_sorted_keys(
-            parents, sums, min(caps[lvl], uniq.shape[0]) if adaptive
-            else caps[lvl],
-            sentinel=sentinel,
-        )
+        with trace.stage_span("cascade.segment-reduce",
+                              items=int(uniq.shape[0])):
+            uniq, sums, count = trace.stage_block(
+                sparse_ops.aggregate_sorted_keys(
+                    parents, sums, min(caps[lvl], uniq.shape[0]) if adaptive
+                    else caps[lvl],
+                    sentinel=sentinel,
+                ))
         out.append((uniq, sums, count))
     return out
 
@@ -181,33 +185,36 @@ def pyramid_sparse_morton_partitioned(
 
     sentinel = jnp.iinfo(jnp.int64).max
     keys = codes if valid is None else jnp.where(valid, codes, sentinel)
-    if weights is None:
-        skeys = jnp.sort(keys, stable=False)
-        sw = None
-    else:
-        # Weights ride the same order as their keys (integer sums are
-        # order-free, so the unstable argsort is fine).
-        order = jnp.argsort(keys, stable=False)
-        skeys = keys[order]
-        sw = jnp.asarray(weights)[order]
+    with trace.stage_span("cascade.sort", items=n):
+        if weights is None:
+            skeys = trace.stage_block(jnp.sort(keys, stable=False))
+            sw = None
+        else:
+            # Weights ride the same order as their keys (integer sums
+            # are order-free, so the unstable argsort is fine).
+            order = jnp.argsort(keys, stable=False)
+            skeys = keys[order]
+            sw = trace.stage_block(jnp.asarray(weights)[order])
 
     out = []
     for lvl in range(levels + 1):
         # Right shifts preserve the sort; the shifted sentinel
         # (intmax >> 2*lvl) still exceeds every real (< 2^60) key at
         # the shifted width, so it keeps sorting last and masking out.
-        uniq, sums, n_unique = sp.aggregate_sorted_keys_partitioned(
-            skeys >> (2 * lvl),
-            caps[lvl],
-            sentinel=sentinel >> (2 * lvl),
-            chunk=chunk,
-            block_cells=block_cells,
-            slab=slab,
-            interpret=interpret,
-            streams=streams,
-            sorted_weights=sw,
-            weight_bound=weight_bound,
-        )
+        with trace.stage_span("cascade.segment-reduce", items=n):
+            uniq, sums, n_unique = trace.stage_block(
+                sp.aggregate_sorted_keys_partitioned(
+                    skeys >> (2 * lvl),
+                    caps[lvl],
+                    sentinel=sentinel >> (2 * lvl),
+                    chunk=chunk,
+                    block_cells=block_cells,
+                    slab=slab,
+                    interpret=interpret,
+                    streams=streams,
+                    sorted_weights=sw,
+                    weight_bound=weight_bound,
+                ))
         # Normalize padding to the repo-wide int64-max sentinel (the
         # per-level call pads with its SHIFTED sentinel, which a
         # `uniq != intmax` consumer mask would let through as phantom

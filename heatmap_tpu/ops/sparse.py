@@ -15,6 +15,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from heatmap_tpu.utils import trace
+
 
 def _sentinel_for(dtype):
     return jnp.iinfo(jnp.dtype(dtype)).max
@@ -66,10 +68,12 @@ def aggregate_keys(keys, weights=None, valid=None, capacity=None, acc_dtype=None
     # Counts (uniform weights) are exact under any summation order, so
     # the sort can be unstable; float weights keep the stable order so
     # results are reproducible against host-order oracles bit-for-bit.
-    order = jnp.argsort(keys, stable=weights is not None)
-    return aggregate_sorted_keys(
-        keys[order], w[order], capacity, sentinel=sentinel
-    )
+    with trace.stage_span("cascade.sort", items=n):
+        order = jnp.argsort(keys, stable=weights is not None)
+        sk, sw = trace.stage_block((keys[order], w[order]))
+    with trace.stage_span("cascade.segment-reduce", items=n):
+        return trace.stage_block(
+            aggregate_sorted_keys(sk, sw, capacity, sentinel=sentinel))
 
 
 def aggregate_sorted_keys(sorted_keys, sorted_weights, capacity, sentinel=None):

@@ -13,6 +13,23 @@ DATA_AXIS = "data"
 TILE_AXIS = "tile"
 
 
+def shard_map(body, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions.
+
+    jax promoted shard_map out of jax.experimental and renamed its
+    replication-check knob (``check_rep`` -> ``check_vma``) along the
+    way; every mesh kernel in this package routes through this shim so
+    the kernels run on both sides of that line unchanged.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def make_mesh(data: int | None = None, tile: int = 1, devices=None) -> Mesh:
     """Build a (data, tile) mesh over ``devices``.
 

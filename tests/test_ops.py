@@ -300,3 +300,26 @@ def test_aggregate_keys_sentinel_reservation_documented():
 def test_window_from_bounds_rejects_impossible_alignment():
     with pytest.raises(ValueError):
         window_from_bounds((30, 60), (-10, 30), zoom=3, align_levels=5)
+
+
+def test_pick_backend_weighted_large_window_routes_partitioned(monkeypatch):
+    """On TPU, auto routes large-window WEIGHTED binning to the
+    partitioned MXU path (340.6 ms vs 432.5 ms XLA scatter at the z15
+    headline window, k=8, v5e-1 round-5 sweep — PERF_NOTES.md). The
+    platform is faked: the routing decision is host-side and must not
+    need a chip to be testable."""
+    import types
+
+    from heatmap_tpu.ops import histogram
+
+    monkeypatch.setattr(
+        jax, "devices",
+        lambda *a, **k: [types.SimpleNamespace(platform="tpu")])
+    big = histogram.Window(zoom=15, row0=0, col0=0, height=1024, width=1280)
+    assert big.height * big.width > histogram.PALLAS_AUTO_MAX_CELLS
+    assert histogram._pick_backend("auto", big, weighted=True) == "partitioned"
+    assert histogram._pick_backend("auto", big, weighted=False) == "partitioned"
+    # Small windows keep the pallas route; explicit backends pass through.
+    small = histogram.Window(zoom=10, row0=0, col0=0, height=64, width=64)
+    assert histogram._pick_backend("auto", small, weighted=True) == "pallas"
+    assert histogram._pick_backend("xla", big, weighted=True) == "xla"

@@ -156,6 +156,56 @@ def test_pyramid_sparse_sharded_matches_local(mesh):
         np.testing.assert_array_equal(np.asarray(gs[:n]), np.asarray(ws[:n]))
 
 
+@pytest.mark.slow
+def test_pyramid_sparse_sharded_partitioned_matches_local(mesh):
+    """DP x partitioned composition: the MXU segment reduction runs
+    INSIDE each device's shard_map body; counts are exact integers in
+    any summation order, so the bar is bit-equality against the
+    single-device scatter pyramid — not allclose."""
+    lats, lons = _points(seed=6)
+    zoom, levels = 12, 5
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    row, col, pvalid = mercator.project_points(pla, plo, zoom)
+    codes = morton.morton_encode(row, col, dtype=jnp.int32, zoom=zoom)
+    v = jnp.asarray(valid) & pvalid
+
+    got = pyramid_sparse_morton_sharded(
+        codes, mesh, valid=v, levels=levels, capacity=16384,
+        backend="partitioned",
+    )
+    want = pyramid_sparse_morton(codes, valid=v, levels=levels,
+                                 capacity=len(pla))
+    assert len(got) == len(want)
+    for (gu, gs, gn), (wu, ws, wn) in zip(got, want):
+        n = int(wn)
+        assert int(gn) == n
+        np.testing.assert_array_equal(np.asarray(gu[:n]), np.asarray(wu[:n]))
+        np.testing.assert_array_equal(np.asarray(gs[:n]), np.asarray(ws[:n]))
+
+
+@pytest.mark.slow
+def test_pyramid_sparse_sharded_partitioned_weighted_bit_exact(mesh):
+    """Bounded-integer weights through the sharded partitioned detail
+    stage: integer f64 sums are order-free, so the sharded result is
+    bit-identical to the local scatter pyramid."""
+    rng = np.random.default_rng(23)
+    n = 8 * 1024
+    codes = jnp.asarray(rng.integers(0, 4000, n), jnp.int32)
+    w = jnp.asarray(rng.integers(0, 100, n), jnp.float64)
+    got = pyramid_sparse_morton_sharded(
+        codes, mesh, weights=w, levels=3, capacity=4096,
+        acc_dtype=jnp.float64, backend="partitioned", weight_bound=100,
+    )
+    want = pyramid_sparse_morton(codes, weights=w, levels=3, capacity=n,
+                                 acc_dtype=jnp.float64)
+    assert len(got) == len(want)
+    for (gu, gs, gn), (wu, ws, wn) in zip(got, want):
+        k = int(wn)
+        assert int(gn) == k
+        np.testing.assert_array_equal(np.asarray(gu[:k]), np.asarray(wu[:k]))
+        np.testing.assert_array_equal(np.asarray(gs[:k]), np.asarray(ws[:k]))
+
+
 # -- coarse-prefix regrouped merge (O(uniques/k) per stage) ----------------
 
 
@@ -195,6 +245,26 @@ def test_pyramid_prefix_sharded_matches_local(mesh):
 
     got = _prefix_kernel()(codes, mesh, valid=v, levels=levels,
                            capacity=16384)
+    want = pyramid_sparse_morton(codes, valid=v, levels=levels,
+                                 capacity=len(pla))
+    _assert_levels_equal(got, want)
+
+
+@pytest.mark.slow
+def test_pyramid_prefix_sharded_partitioned_matches_local(mesh):
+    """The partitioned detail stage under the coarse-prefix regrouped
+    merge: same bit-equality bar as the replicated merge — the backend
+    choice changes only each device's local reduction, never what
+    crosses the collective."""
+    lats, lons = _points(seed=16)
+    zoom, levels = 12, 5
+    (pla, plo), valid = pad_to_multiple([lats, lons], 8)
+    row, col, pvalid = mercator.project_points(pla, plo, zoom)
+    codes = morton.morton_encode(row, col, dtype=jnp.int32, zoom=zoom)
+    v = jnp.asarray(valid) & pvalid
+
+    got = _prefix_kernel()(codes, mesh, valid=v, levels=levels,
+                           capacity=16384, backend="partitioned")
     want = pyramid_sparse_morton(codes, valid=v, levels=levels,
                                  capacity=len(pla))
     _assert_levels_equal(got, want)

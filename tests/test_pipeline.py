@@ -982,9 +982,12 @@ def test_dp_mesh_auto_routing():
     assert mesh is not None
     assert _dp_mesh(_dp_cfg()) is not None  # True
     assert _dp_mesh(_dp_cfg(data_parallel=False)) is None
+    # The partitioned cascade composes with DP (the per-device detail
+    # reduction swaps kernels inside the shard_map body), so it no
+    # longer forces the single-device route.
     assert _dp_mesh(
         _dp_cfg(data_parallel=None, cascade_backend="partitioned")
-    ) is None
+    ) is not None
     assert _dp_mesh(
         _dp_cfg(data_parallel=None, adaptive_capacity=True)
     ) is None
@@ -998,11 +1001,34 @@ def test_dp_mesh_auto_routing():
 
 def test_dp_config_rejections():
     """data_parallel=True with a non-composing knob fails at config
-    time, not mid-job."""
-    with pytest.raises(ValueError, match="scatter"):
-        _dp_cfg(data_parallel=True, cascade_backend="partitioned")
+    time, not mid-job; the partitioned cascade now composes and is
+    accepted."""
+    cfg = _dp_cfg(data_parallel=True, cascade_backend="partitioned")
+    assert cfg.resolved_cascade_backend == "partitioned"
     with pytest.raises(ValueError, match="adaptive"):
         _dp_cfg(data_parallel=True, adaptive_capacity=True)
+
+
+def test_cascade_backend_auto_resolution(monkeypatch):
+    """"auto" routes count jobs to the partitioned MXU kernel ON TPU
+    only (off TPU the pallas kernel would run in interpret mode,
+    orders slower than native scatter — same gate as
+    ops/histogram._pick_backend); weighted jobs stay on scatter;
+    explicit choices are honored on any platform."""
+    import types
+
+    import jax
+
+    assert BatchJobConfig().resolved_cascade_backend == "scatter"  # CPU
+    assert (BatchJobConfig(cascade_backend="partitioned")
+            .resolved_cascade_backend == "partitioned")
+    monkeypatch.setattr(jax, "devices",
+                        lambda: [types.SimpleNamespace(platform="tpu")])
+    assert BatchJobConfig().resolved_cascade_backend == "partitioned"
+    assert (BatchJobConfig(weighted=True).resolved_cascade_backend
+            == "scatter")
+    assert (BatchJobConfig(cascade_backend="scatter")
+            .resolved_cascade_backend == "scatter")
 
 
 def test_dp_min_emissions_override():
@@ -1066,6 +1092,44 @@ def test_run_job_dp_prefix_merge_byte_identical(amplify):
         config=_dp_cfg(amplify_all=amplify, data_parallel=False),
     )
     assert prefix == replicated == single and len(prefix) > 0
+
+
+@pytest.mark.slow
+def test_run_job_dp_partitioned_cascade_byte_identical():
+    """DP x partitioned composition at the blob level: the MXU segment
+    reduction inside each device's shard_map body must emit blobs
+    byte-identical to BOTH the DP scatter cascade and the single-device
+    partitioned cascade. Counts are exact integers in any summation
+    order, so the bar is equality — the same bar the scatter DP route
+    passes."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2500, seed=42)
+    dp_part = run_job(_ColSource(rows),
+                      config=_dp_cfg(cascade_backend="partitioned"))
+    dp_scat = run_job(_ColSource(rows),
+                      config=_dp_cfg(cascade_backend="scatter"))
+    single = run_job(_ColSource(rows),
+                     config=_dp_cfg(cascade_backend="partitioned",
+                                    data_parallel=False))
+    assert dp_part == dp_scat == single and len(dp_part) > 0
+
+
+@pytest.mark.slow
+def test_run_job_dp_prefix_merge_partitioned_byte_identical():
+    """The partitioned cascade under the coarse-prefix regrouped merge:
+    the backend choice changes only each device's local reduction, so
+    blobs stay byte-identical to the single-device job."""
+    from heatmap_tpu.pipeline import run_job
+
+    rows = _rows(n=2000, seed=9)
+    prefix = run_job(_ColSource(rows),
+                     config=_dp_cfg(cascade_backend="partitioned",
+                                    dp_merge="prefix"))
+    single = run_job(_ColSource(rows),
+                     config=_dp_cfg(cascade_backend="partitioned",
+                                    data_parallel=False))
+    assert prefix == single and len(prefix) > 0
 
 
 @pytest.mark.slow
@@ -1197,8 +1261,10 @@ def test_dp_cascade_overflow_detected():
 
 
 def test_build_cascade_mesh_rejects_noncomposing():
-    """mesh + partitioned / adaptive raise at the cascade layer too
-    (covers callers that bypass BatchJobConfig)."""
+    """mesh + adaptive still raises at the cascade layer (covers
+    callers that bypass BatchJobConfig); mesh + partitioned now
+    composes — the segment reduction runs inside the shard_map body —
+    and must match the sharded scatter cascade exactly."""
     from heatmap_tpu.parallel.mesh import make_mesh
     from heatmap_tpu.pipeline import cascade as cascade_mod
     import jax
@@ -1208,12 +1274,21 @@ def test_build_cascade_mesh_rejects_noncomposing():
     codes = np.arange(64, dtype=np.int64)
     slots = np.zeros(64, np.int64)
     mesh = make_mesh(devices=jax.devices())
-    with pytest.raises(ValueError, match="scatter"):
-        cascade_mod.build_cascade(codes, slots, cfg, n_slots=1,
-                                  backend="partitioned", mesh=mesh)
     with pytest.raises(ValueError, match="adaptive"):
         cascade_mod.build_cascade(codes, slots, cfg, n_slots=1,
                                   adaptive=True, mesh=mesh)
+    part = cascade_mod.build_cascade(codes, slots, cfg, n_slots=1,
+                                     backend="partitioned", mesh=mesh)
+    scat = cascade_mod.build_cascade(codes, slots, cfg, n_slots=1,
+                                     backend="scatter", mesh=mesh)
+    assert len(part) == len(scat)
+    for (pu, ps, pn), (su, ss, sn) in zip(part, scat):
+        n = int(sn)
+        assert int(pn) == n
+        np.testing.assert_array_equal(np.asarray(pu)[:n],
+                                      np.asarray(su)[:n])
+        np.testing.assert_array_equal(np.asarray(ps)[:n],
+                                      np.asarray(ss)[:n])
 
 
 # -- auto-spill safety rails (ADVICE r3 medium) ----------------------------

@@ -1,0 +1,60 @@
+"""Kernel-epoch hashing shared by the on-chip tooling.
+
+The "kernel epoch" is a short content hash over every source file whose
+edit invalidates recorded on-chip verdicts: the kernels under test AND
+the reference implementations the expected values come from. The verify
+tool prefixes its checkpoint keys with it, the runner records it in
+done.json (so a kernel edit re-queues verification), and apply_decisions
+refuses to act on seg-* verdicts from a stale epoch.
+
+Deliberately dependency-free (stdlib only): the runner imports it on
+hosts where jax/numpy may be absent or broken, and it must never
+trigger package imports just to compute a hash. tools/ is not a
+package, so consumers load it by path:
+
+    spec = importlib.util.spec_from_file_location(
+        "_epoch", os.path.join(TOOLS_DIR, "_epoch.py"))
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+#: Files (relative to the heatmap_tpu package root) hashed into the
+#: epoch. Both sides of every on-chip comparison: partitioned kernels,
+#: the scatter/aggregate references, and the projection feeding them.
+KERNEL_FILES = (
+    "ops/partitioned.py",
+    "ops/sparse_partitioned.py",
+    "ops/pallas_kernels.py",
+    "parallel/sharded.py",
+    "ops/histogram.py",
+    "ops/sparse.py",
+    "tilemath/mercator.py",
+)
+
+
+def package_root() -> str:
+    """Path of the heatmap_tpu package, resolved relative to tools/."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                        "heatmap_tpu")
+
+
+def kernel_epoch(extra_paths=()) -> str:
+    """10-hex content hash over KERNEL_FILES plus ``extra_paths``.
+
+    ``extra_paths`` lets a consumer fold its own source into the epoch
+    (the verify script hashes itself: changing its cases/shapes/rng
+    must also invalidate old verdicts — they were produced by the old
+    inputs).
+    """
+    root = package_root()
+    h = hashlib.sha256()
+    for rel in KERNEL_FILES:
+        with open(os.path.join(root, rel), "rb") as f:
+            h.update(f.read())
+    for p in extra_paths:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:10]

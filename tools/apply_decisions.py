@@ -20,18 +20,60 @@ import os
 
 
 def _verify_epoch():
-    """The CURRENT kernel epoch from the verify tool: seg-* verdicts
-    recorded under any other epoch (or the legacy un-prefixed keys) are
-    stale — produced by a different kernel or reference — and must not
-    gate a routing flip."""
+    """The CURRENT kernel epoch as the verify tool computes it
+    (tools/_epoch.py over the kernel sources, plus the verify script
+    itself): seg-* verdicts recorded under any other epoch (or the
+    legacy un-prefixed keys) are stale — produced by a different kernel
+    or reference — and must not gate a routing flip."""
+    d = os.path.dirname(os.path.abspath(__file__))
     spec = importlib.util.spec_from_file_location(
-        "verify_partitioned_onchip",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     "verify_partitioned_onchip.py"),
-    )
+        "_epoch", os.path.join(d, "_epoch.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return mod.EPOCH
+    return mod.kernel_epoch(
+        extra_paths=(os.path.join(d, "verify_partitioned_onchip.py"),))
+
+
+def _repo_defaults():
+    """What the repo currently ships, for each decided knob — so a
+    measured winner that has already been committed reports "applied"
+    instead of a stale "FLIP" that reads like unfinished work. Returns
+    None when the package will not import here (decision evaluation
+    must still run on a bare host)."""
+    try:
+        import inspect
+        import types
+
+        import jax
+
+        from heatmap_tpu.ops import histogram, partitioned
+        from heatmap_tpu.pipeline.batch import BatchJobConfig
+
+        # Behavioral probe of _pick_backend's weighted large-window
+        # routing: fake a TPU platform (the routing is platform-gated)
+        # and ask it about a window above PALLAS_AUTO_MAX_CELLS.
+        big = histogram.Window(zoom=15, row0=0, col0=0,
+                               height=1024, width=1280)
+        orig = jax.devices
+        jax.devices = lambda *a, **k: [types.SimpleNamespace(platform="tpu")]
+        try:
+            weighted_route = histogram._pick_backend("auto", big,
+                                                     weighted=True)
+            # Read under the fake TPU too: the cascade "auto" route is
+            # platform-gated (scatter off TPU, where pallas only
+            # interprets), and the decision is about what ships ON the
+            # chip.
+            cascade_default = BatchJobConfig().resolved_cascade_backend
+        finally:
+            jax.devices = orig
+        sig = inspect.signature(partitioned.bin_rowcol_window_partitioned)
+        return {
+            "weighted_route": weighted_route,
+            "bad_frac": sig.parameters["bad_frac"].default,
+            "cascade_backend": cascade_default,
+        }
+    except Exception:  # noqa: BLE001 — introspection is best-effort
+        return None
 
 
 def _load_jsonl(path):
@@ -68,14 +110,22 @@ def main() -> int:
         return rec.get("ms") if rec else None
 
     decisions = []
+    defaults = _repo_defaults()
 
     # Rule (a): weighted large-window routing flips to partitioned only
     # if weighted k=8 beats the weighted scatter (k=1 already lost).
+    # Once the repo routes it, the verdict reads "applied" — a stale
+    # "FLIP" would look like an unlanded decision forever.
     w_scatter, w_part8 = ms("xla-scatter weighted"), ms("partitioned weighted k=8")
     if w_scatter is None or w_part8 is None:
         verdict = "insufficient-data"
     elif w_part8 < w_scatter:
-        verdict = "FLIP (_pick_backend: route weighted large windows to partitioned)"
+        if defaults and defaults["weighted_route"] == "partitioned":
+            verdict = ("applied (_pick_backend routes weighted large "
+                       "windows to partitioned)")
+        else:
+            verdict = ("FLIP (_pick_backend: route weighted large "
+                       "windows to partitioned)")
     else:
         verdict = "keep scatter"
     decisions.append({
@@ -83,6 +133,7 @@ def main() -> int:
         "verdict": verdict,
         "weighted_scatter_ms": w_scatter,
         "weighted_partitioned_k8_ms": w_part8,
+        "repo_default": defaults["weighted_route"] if defaults else None,
     })
 
     # Rule (b): cascade_backend default flips to partitioned for count
@@ -106,8 +157,13 @@ def main() -> int:
         verdict = ("blocked: seg-* verify cases not all bit-exact"
                    if seg_keys else "blocked: no seg-* verify results")
     elif best_ms < c_scatter:
-        verdict = (f"FLIP (BatchJobConfig.cascade_backend -> "
-                   f"'{best_name}' for count jobs)")
+        if (defaults and defaults["cascade_backend"] == "partitioned"
+                and best_name.startswith("partitioned")):
+            verdict = ("applied (count jobs resolve cascade_backend to "
+                       f"'partitioned'; measured best: '{best_name}')")
+        else:
+            verdict = (f"FLIP (BatchJobConfig.cascade_backend -> "
+                       f"'{best_name}' for count jobs)")
     else:
         verdict = "keep scatter"
     decisions.append({
@@ -119,6 +175,7 @@ def main() -> int:
         "seg_verify_count": len(seg_keys),
         "seg_verify_all_ok": seg_ok,
         "seg_verify_epoch": epoch,
+        "repo_default": defaults["cascade_backend"] if defaults else None,
     })
 
     # Rule (c): bad_frac default if the tail-cap win composes with k=8.
@@ -129,8 +186,11 @@ def main() -> int:
     for bf, val in ((32, k8_bf32), (128, k8_bf128)):
         if val is not None and best_bf_ms is not None and val < best_bf_ms:
             best_bf, best_bf_ms = bf, val
+    cur_bf = defaults["bad_frac"] if defaults else None
     if k8 is None or (k8_bf32 is None and k8_bf128 is None):
         verdict = "insufficient-data"
+    elif best_bf == cur_bf:
+        verdict = f"applied (partitioned default bad_frac = {best_bf})"
     elif best_bf != 8:
         verdict = f"FLIP (partitioned default bad_frac -> {best_bf})"
     else:
@@ -139,6 +199,7 @@ def main() -> int:
         "decision": "bad-frac-default",
         "verdict": verdict,
         "k8_bf8_ms": k8, "k8_bf32_ms": k8_bf32, "k8_bf128_ms": k8_bf128,
+        "repo_default": cur_bf,
     })
 
     # Rule (d): StreamConfig.backend default stays "auto" unless a

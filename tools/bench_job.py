@@ -2,14 +2,30 @@
 """End-to-end batch-job benchmark: HMPB ingest -> cascade -> egress.
 
 Generates an HMPB file of synthetic GPS points (hot cluster + fringe,
-multiple users incl. rt-/x- routing), runs run_job_fast end to end on
-the default backend, and prints the tracer's stage balance plus a
-points/sec headline. Unlike bench.py (the isolated projection+binning
-kernel), this measures the full production job: mmap ingest, group
-routing, the z21 composite-key cascade, decode/finalize, and egress.
+multiple users incl. rt-/x- routing), runs run_job_fast end to end, and
+prints the tracer's stage balance plus a points/sec headline. Unlike
+bench.py (the isolated projection+binning kernel), this measures the
+full production job: mmap ingest, group routing, the z21 composite-key
+cascade, decode/finalize, and egress.
+
+Each measurement runs in a SUBPROCESS (``--single`` re-exec of this
+script): the round-5 A/B died to one
+``UNAVAILABLE: TPU worker process crashed or restarted`` raised from
+the decode device_get at n=20M, taking both backends' rows with it. A
+child crash now costs only that measurement, its stderr lands in
+``onchip_state/bj_stderr.log``, and the driver AUTO-BISECTS ``--n``
+downward (halving, same regenerated input for both backends at each
+size) until a row lands — a smaller measured row beats a dead run.
 
     PYTHONPATH=.:$PYTHONPATH python tools/bench_job.py [--n 20000000]
-        [--egress arrays|json|none] [--runs 1]
+        [--egress arrays|json|none] [--runs 1] [--cascade-backend both]
+        [--state onchip_state/sweep.jsonl] [--trace-stages]
+
+``--state`` appends one sweep row per on-chip measurement in
+tools/sweep_partitioned.py's format — ``cascade-pyramid16 scatter`` /
+``cascade-pyramid16 partitioned`` — the rows apply_decisions rule (b)
+reads. ``--trace-stages`` adds sort / segment-reduce attribution to the
+stage report (runs the cascade eagerly — see utils/trace.py).
 """
 
 from __future__ import annotations
@@ -17,10 +33,14 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
+import sys
 import tempfile
 import time
 
 import numpy as np
+
+STDERR_LOG = os.path.join("onchip_state", "bj_stderr.log")
 
 
 def synth_hmpb(path: str, n: int, seed: int = 0) -> str:
@@ -43,7 +63,125 @@ def synth_hmpb(path: str, n: int, seed: int = 0) -> str:
                       timestamp=ts, background=background)
 
 
-def main():
+def run_single(args) -> int:
+    """One measurement in THIS process: ingest the prepared HMPB, run
+    the job once, print the result JSON line. The subprocess unit the
+    driver resurrects from."""
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)  # int64 keys + exact z21
+
+    from heatmap_tpu.io.hmpb import HMPBSource
+    from heatmap_tpu.io.sinks import LevelArraysSink, MemorySink
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
+    from heatmap_tpu.utils.trace import enable_stage_tracing, get_tracer
+
+    if args.trace_stages:
+        enable_stage_tracing(True)
+    backend = args.cascade_backend
+    config = (BatchJobConfig() if backend is None
+              else BatchJobConfig(cascade_backend=backend))
+    tracer = get_tracer()
+    tracer.reset()
+    if args.egress == "arrays":
+        sink = LevelArraysSink(os.path.join(
+            os.path.dirname(args.hmpb), f"levels{args.run}-{backend}"))
+    elif args.egress == "json":
+        sink = MemorySink()
+    else:
+        sink = None
+    t0 = time.perf_counter()
+    out = run_job_fast(HMPBSource(args.hmpb), sink=sink, config=config)
+    dt = time.perf_counter() - t0
+    stages = {
+        name: round(r["total_s"], 3)
+        for name, r in sorted(tracer.report().items())
+    }
+    print(json.dumps({
+        "run": args.run,
+        "device": jax.devices()[0].platform,
+        "n_points": args.n,
+        "cascade_backend": backend or "default",
+        "egress": args.egress,
+        "total_s": round(dt, 2),
+        "pts_per_s": round(args.n / dt),
+        "stages": stages,
+        "out": (len(out) if hasattr(out, "__len__") else str(out)[:80]),
+    }), flush=True)
+    return 0
+
+
+def _append_sweep_row(state_path: str, rec: dict):
+    """One sweep.jsonl row per landed on-chip measurement, in
+    tools/sweep_partitioned.py's report format (apply_decisions keys
+    rows by "config"; flush+fsync so a later crash cannot tear it)."""
+    n, dt = rec["n_points"], rec["total_s"]
+    row = {
+        "config": f"cascade-pyramid16 {rec['cascade_backend']}",
+        "ms": round(dt * 1e3, 1),
+        "mpts_per_s": round(n / dt / 1e6, 1) if dt else None,
+        "n": n,
+        "egress": rec["egress"],
+        "device": rec["device"],
+        "end_to_end": True,
+    }
+    os.makedirs(os.path.dirname(state_path) or ".", exist_ok=True)
+    with open(state_path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    print(json.dumps({"sweep_row": row["config"], "ms": row["ms"]}),
+          flush=True)
+
+
+def _drive_one(args, hmpb: str, n: int, run: int, backend: str | None):
+    """Run one measurement in a subprocess; return its result record or
+    None. Child stdout passes through (teed for the result line); child
+    stderr — where the TPU runtime prints its crash backtraces —
+    appends to onchip_state/bj_stderr.log."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--single",
+           "--hmpb", hmpb, "--n", str(n), "--run", str(run),
+           "--egress", args.egress]
+    if backend is not None:
+        cmd += ["--cascade-backend", backend]
+    if args.cpu:
+        cmd.append("--cpu")
+    if args.trace_stages:
+        cmd.append("--trace-stages")
+    os.makedirs(os.path.dirname(STDERR_LOG), exist_ok=True)
+    with open(STDERR_LOG, "a") as ef:
+        ef.write(f"\n===== bench_job attempt at {time.strftime('%F %T')} "
+                 f"backend={backend} n={n} =====\n")
+        ef.flush()
+        try:
+            r = subprocess.run(cmd, timeout=args.child_timeout,
+                               stdout=subprocess.PIPE, stderr=ef, text=True)
+        except subprocess.TimeoutExpired:
+            ef.write(f"[driver] child timed out after "
+                     f"{args.child_timeout}s\n")
+            return None
+    rec = None
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            print(line, flush=True)
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "pts_per_s" in parsed:
+                rec = parsed
+    if r.returncode != 0:
+        print(json.dumps({"crashed": True, "rc": r.returncode,
+                          "backend": backend, "n": n,
+                          "stderr_log": STDERR_LOG}), flush=True)
+        return None
+    return rec
+
+
+def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=20_000_000)
     ap.add_argument("--egress", choices=("arrays", "json", "none"),
@@ -56,68 +194,77 @@ def main():
                     "overrides JAX_PLATFORMS, so the env var is not enough)")
     ap.add_argument("--cascade-backend", default=None,
                     choices=("scatter", "partitioned", "both"),
-                    help="cascade reduction backend; 'both' runs every "
-                    "run twice and prints one result line per backend — "
-                    "the on-chip A/B that decides the "
-                    "BatchJobConfig.cascade_backend default")
+                    help="cascade reduction backend; 'both' measures each "
+                    "backend on the same input file — the on-chip A/B "
+                    "that decides the BatchJobConfig.cascade_backend "
+                    "default")
+    ap.add_argument("--state", default=None,
+                    help="append a sweep.jsonl row per on-chip "
+                    "measurement (cascade-pyramid16 <backend>)")
+    ap.add_argument("--trace-stages", action="store_true",
+                    help="per-stage cascade attribution (sort / "
+                    "segment-reduce / decode / host egress) in the "
+                    "stage report; runs the cascade eagerly")
+    ap.add_argument("--child-timeout", type=float, default=1500.0)
+    ap.add_argument("--min-n", type=int, default=None,
+                    help="bisect floor (default --n // 16)")
+    # --single: internal re-exec mode (one measurement, in-process).
+    ap.add_argument("--single", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--hmpb", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--run", type=int, default=0, help=argparse.SUPPRESS)
     args = ap.parse_args()
 
-    import jax
+    if args.single:
+        if args.cascade_backend == "both":
+            ap.error("--single takes one backend")
+        if not args.hmpb:
+            ap.error("--single needs --hmpb")
+        return run_single(args)
 
-    if args.cpu:
-        jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)  # int64 composite keys + exact z21
-
-    from heatmap_tpu.io.hmpb import HMPBSource
-    from heatmap_tpu.io.sinks import LevelArraysSink, MemorySink
-    from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
-    from heatmap_tpu.utils.trace import get_tracer
+    backends = (("scatter", "partitioned")
+                if args.cascade_backend == "both"
+                else (args.cascade_backend,))
+    min_n = args.min_n if args.min_n is not None else max(args.n // 16, 1)
 
     tmpdir = tempfile.mkdtemp(prefix="benchjob-")
+    landed = {be: False for be in backends}
     try:
-        hmpb = os.path.join(tmpdir, "points.hmpb")
-        t0 = time.perf_counter()
-        synth_hmpb(hmpb, args.n)
-        gen_s = time.perf_counter() - t0
-        print(json.dumps({"stage": "synth+write_hmpb", "s": round(gen_s, 2),
-                          "path": hmpb,
-                          "bytes": os.path.getsize(hmpb)}), flush=True)
-
-        backends = (("scatter", "partitioned")
-                    if args.cascade_backend == "both"
-                    else (args.cascade_backend,))
-        tracer = get_tracer()
-        for run in range(args.runs):
-            for backend in backends:
-                config = (BatchJobConfig() if backend is None
-                          else BatchJobConfig(cascade_backend=backend))
-                tracer.reset()
-                if args.egress == "arrays":
-                    sink = LevelArraysSink(
-                        os.path.join(tmpdir, f"levels{run}-{backend}"))
-                elif args.egress == "json":
-                    sink = MemorySink()
-                else:
-                    sink = None
+        n = args.n
+        hmpb = None
+        while n >= min_n:
+            if hmpb is None:
+                hmpb = os.path.join(tmpdir, f"points-{n}.hmpb")
                 t0 = time.perf_counter()
-                out = run_job_fast(HMPBSource(hmpb), sink=sink, config=config)
-                dt = time.perf_counter() - t0
-                stages = {
-                    name: round(r["total_s"], 3)
-                    for name, r in sorted(tracer.report().items())
-                }
+                synth_hmpb(hmpb, n)
                 print(json.dumps({
-                    "run": run,
-                    "device": jax.devices()[0].platform,
-                    "n_points": args.n,
-                    "cascade_backend": backend or "default",
-                    "egress": args.egress,
-                    "total_s": round(dt, 2),
-                    "pts_per_s": round(args.n / dt),
-                    "stages": stages,
-                    "out": (len(out) if hasattr(out, "__len__")
-                            else str(out)[:80]),
-                }), flush=True)
+                    "stage": "synth+write_hmpb",
+                    "s": round(time.perf_counter() - t0, 2),
+                    "path": hmpb, "n": n,
+                    "bytes": os.path.getsize(hmpb)}), flush=True)
+            for run in range(args.runs):
+                for be in backends:
+                    if landed[be] and n != args.n:
+                        # Bisected sizes only chase the backends that
+                        # never landed; a full-size row already beat
+                        # anything a smaller rerun could add.
+                        continue
+                    rec = _drive_one(args, hmpb, n, run, be)
+                    if rec is None:
+                        continue
+                    landed[be] = True
+                    if args.state and rec.get("device") != "cpu":
+                        _append_sweep_row(args.state, rec)
+            if all(landed.values()):
+                break
+            # Bisect: halve n and retry the backends that never landed
+            # (same fresh file for every backend at the new size).
+            n //= 2
+            hmpb = None
+            if n >= min_n:
+                print(json.dumps({"bisect": True, "next_n": n,
+                                  "pending": [b for b, ok in landed.items()
+                                              if not ok]}), flush=True)
     finally:
         if args.keep:
             print(json.dumps({"kept": tmpdir}), flush=True)
@@ -125,7 +272,14 @@ def main():
             import shutil
 
             shutil.rmtree(tmpdir, ignore_errors=True)
+    if not all(landed.values()):
+        print(json.dumps({"error": "no measurement landed",
+                          "pending": [b for b, ok in landed.items()
+                                      if not ok],
+                          "min_n": min_n}), flush=True)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -36,6 +36,40 @@ import time
 
 STATE_DIR = "onchip_state"
 
+
+def current_epoch() -> str:
+    """Kernel epoch as the verify tool computes it (tools/_epoch.py over
+    the kernel sources, plus the verify script itself). Epoch-tagged
+    done.json entries are compared against this on restart: a kernel
+    edit silently staling every recorded verdict must re-queue
+    verification, not skip it as already done (the round-5 relay window
+    was lost to exactly that)."""
+    import importlib.util
+
+    d = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "_epoch", os.path.join(d, "_epoch.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.kernel_epoch(
+        extra_paths=(os.path.join(d, "verify_partitioned_onchip.py"),))
+
+
+def build_queue(items, done, epoch):
+    """Pending items: never recorded, or recorded under a different
+    kernel epoch (epoch-sensitive items only). Permanently-failed
+    entries also re-queue on an epoch change — the kernel edit may be
+    the fix."""
+    out = []
+    for it in items:
+        entry = done.get(it["name"])
+        if not entry:
+            out.append(it)
+        elif it.get("epoch") and entry.get("epoch") != epoch:
+            out.append(it)
+    return out
+
+
 PROBE = (
     "import jax, jax.numpy as jnp;"
     "d = jax.devices();"
@@ -132,9 +166,14 @@ def runlist():
         {
             "name": "bench_job",
             # Both cascade backends in one item: the A/B that decides
-            # the BatchJobConfig.cascade_backend default.
+            # the BatchJobConfig.cascade_backend default. --state lands
+            # the cascade-pyramid16 rows apply_decisions rule (b)
+            # reads; bench_job subprocesses each measurement and
+            # auto-bisects --n on a TPU-worker crash, so a partial row
+            # set survives a mid-run relay death.
             "cmd": [py, "tools/bench_job.py", "--n", "20000000",
-                    "--cascade-backend", "both"],
+                    "--cascade-backend", "both",
+                    "--state", f"{STATE_DIR}/sweep.jsonl"],
             "timeout": 3600,
             "check": _check_bench_job,
         },
@@ -152,8 +191,15 @@ def runlist():
             # rc 3 = every combo settled, none bit-INEXACT, but some
             # recorded deterministic compile errors (e.g. the x64
             # toolchain regression): the run is complete — retrying
-            # cannot change it. rc 1 (mismatch) stays a failure.
+            # cannot change it. rc 1 (mismatch) stays a failure, and
+            # rc 4 (combos skipped on transient relay failures —
+            # UNVERIFIED under the current epoch) deliberately is NOT
+            # ok: the item re-queues and the next attempt retries just
+            # the unsettled combos via --state.
             "ok_rcs": (0, 3),
+            # The done.json entry records the kernel epoch; a kernel
+            # edit re-queues this item on the next runner start.
+            "epoch": True,
         },
         {
             "name": "bench_stream",
@@ -245,7 +291,8 @@ def main() -> int:
 
     deadline = time.time() + args.deadline_min * 60
     done = load_done()
-    queue = [it for it in runlist() if not done.get(it["name"])]
+    epoch = current_epoch()
+    queue = build_queue(runlist(), done, epoch)
     attempts = {it["name"]: 0 for it in queue}
     while time.time() < deadline:
         if not queue:
@@ -261,7 +308,10 @@ def main() -> int:
         ok = (rc in item.get("ok_rcs", (0,))
               and (check is None or check(log_path)))
         if ok:
-            done[item["name"]] = {"at": time.strftime("%F %T")}
+            entry = {"at": time.strftime("%F %T")}
+            if item.get("epoch"):
+                entry["epoch"] = epoch
+            done[item["name"]] = entry
             save_done(done)
             queue.pop(0)
             log(f"{item['name']} DONE")
@@ -271,6 +321,8 @@ def main() -> int:
         if attempts[item["name"]] >= args.max_attempts:
             done[item["name"]] = {"failed": why,
                                   "at": time.strftime("%F %T")}
+            if item.get("epoch"):
+                done[item["name"]]["epoch"] = epoch
             save_done(done)
             queue.pop(0)
             log(f"{item['name']} FAILED permanently ({why})")

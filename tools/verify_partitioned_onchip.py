@@ -26,36 +26,39 @@ import sys
 import numpy as np
 
 
-def _kernel_epoch():
-    """Hash of the kernel sources under verification. State keys are
-    prefixed with this, so editing ANY verified kernel invalidates every
-    recorded verdict — the script's contract ("after any kernel change
-    this must pass on the TPU") cannot be satisfied by stale entries
-    from the pre-change kernel (round-5 review finding)."""
-    import hashlib
+def _load_epoch_mod():
+    """Load tools/_epoch.py by path (tools/ is not a package)."""
+    import importlib.util
 
-    root = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                        "heatmap_tpu")
-    h = hashlib.sha256()
-    # Both sides of every comparison: the kernels under test AND the
-    # reference implementations the expected values come from
-    # (histogram.py scatter, sparse.py aggregate, mercator projection).
-    for rel in ("ops/partitioned.py", "ops/sparse_partitioned.py",
-                "ops/pallas_kernels.py", "parallel/sharded.py",
-                "ops/histogram.py", "ops/sparse.py",
-                "tilemath/mercator.py"):
-        with open(os.path.join(root, rel), "rb") as f:
-            h.update(f.read())
-    # ... and this script itself: changing the cases/shapes/rng here
-    # must also invalidate old verdicts — they were produced by the old
-    # inputs.
-    with open(os.path.abspath(__file__), "rb") as f:
-        h.update(f.read())
-    return h.hexdigest()[:10]
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_epoch.py")
+    spec = importlib.util.spec_from_file_location("_epoch", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _kernel_epoch():
+    """Hash of the kernel sources under verification (tools/_epoch.py).
+    State keys are prefixed with this, so editing ANY verified kernel
+    invalidates every recorded verdict — the script's contract ("after
+    any kernel change this must pass on the TPU") cannot be satisfied
+    by stale entries from the pre-change kernel (round-5 review
+    finding). This script hashes itself in too: changing the
+    cases/shapes/rng here must also invalidate old verdicts — they
+    were produced by the old inputs."""
+    return _load_epoch_mod().kernel_epoch(
+        extra_paths=(os.path.abspath(__file__),))
 
 
 EPOCH = _kernel_epoch()
 RETRY_ERRORS = False
+
+#: Combos skipped this run because their failure was classified
+#: transient: they are NOT settled into state, so they stay UNVERIFIED
+#: under the current epoch and the exit code must say so (the round-5
+#: relay run "passed" with rc 0 while whole sections had silently
+#: skipped — automation read partial coverage as verified).
+TRANSIENT_SKIPS = 0
 
 
 def _ek(key):
@@ -101,25 +104,46 @@ def _settled(state, key):
             and isinstance(v, str) and v.startswith("error:"))
 
 
-#: Substrings that mark a chip-side failure as TRANSIENT (relay death,
-#: worker restart, network): these are NOT settled into state — the next
-#: resume simply retries the combo. Only deterministic failures (the
-#: compile helper rejecting the program) are worth remembering.
-_TRANSIENT_MARKERS = (
-    "UNAVAILABLE", "worker process crashed", "DEADLINE",
-    "Connection", "connection", "timed out", "socket",
-)
+#: Exception types that mark a chip-side failure as TRANSIENT (relay
+#: death, worker restart, network): these are NOT settled into state —
+#: the next resume simply retries the combo. Only deterministic
+#: failures (the compile helper rejecting the program) are worth
+#: remembering.
+_TRANSIENT_EXC_TYPES = (ConnectionError, TimeoutError, OSError)
+
+#: gRPC status codes the runtime wraps transient transport failures in.
+#: jax surfaces them as XlaRuntimeError/JaxRuntimeError whose message
+#: STARTS with the status name (e.g. "UNAVAILABLE: TPU worker process
+#: crashed or restarted" — the observed bench_job killer), so the code
+#: is parsed from the message prefix rather than substring-matched
+#: anywhere in the text (a kernel asserting about a "connection matrix"
+#: must not read as a network blip).
+_TRANSIENT_GRPC_CODES = frozenset({
+    "UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED",
+})
+
+
+def _is_transient(e: BaseException) -> bool:
+    """Transient = retry-worthy: a transport/availability exception
+    type, or a runtime error carrying a transient gRPC status code as
+    its message prefix."""
+    if isinstance(e, _TRANSIENT_EXC_TYPES):
+        return True
+    head = str(e).lstrip().split(":", 1)[0].strip().upper()
+    return head in _TRANSIENT_GRPC_CODES
 
 
 def _run_combo(state_path, state, key, fn):
     """Run one combo's device computation; a compile/runtime failure is
     recorded and reported instead of killing the run. Returns the result
     or None on failure."""
+    global TRANSIENT_SKIPS
     try:
         return fn()
     except Exception as e:  # noqa: BLE001 — record any chip-side failure
         msg = f"{type(e).__name__}: {str(e)[:300]}"
-        if any(m in msg for m in _TRANSIENT_MARKERS):
+        if _is_transient(e):
+            TRANSIENT_SKIPS += 1
             print(json.dumps({"combo": key, "transient": msg}), flush=True)
             return None
         _append_state(state_path, state, key, f"error:{msg}")
@@ -145,6 +169,30 @@ def _epoch_tally(state):
         elif isinstance(v, str) and v.startswith("error:"):
             err += 1
     return ok, fail, err
+
+
+def _verdict(fail_n: int, err_n: int, transients: int) -> str:
+    if fail_n:
+        return "MISMATCH"
+    if transients:
+        return "UNSETTLED"
+    return "BIT-EXACT+ERRORS" if err_n else "BIT-EXACT"
+
+
+def _final_rc(fail_n: int, err_n: int, transients: int) -> int:
+    """1: bit-exactness mismatch (kernel wrong); 4: combos skipped on
+    transient failures — they remain UNVERIFIED under this epoch, so
+    the run is incomplete, not passed (the round-5 relay run exited 0
+    with silent skips and automation read partial coverage as verified;
+    4 is deliberately outside the runner's ok_rcs so it retries); 3:
+    combos that never ran (deterministic compile/runtime error) —
+    automation must not read "every combo that ran passed" as
+    "verified" when whole sections errored."""
+    if fail_n:
+        return 1
+    if transients:
+        return 4
+    return 3 if err_n else 0
 
 
 def main() -> int:
@@ -460,15 +508,11 @@ def main() -> int:
         "bit_exact": ok_n,
         "failures": fail_n,
         "errors": err_n,
+        "transient_skips": TRANSIENT_SKIPS,
         "combos_done": done,
-        "verdict": ("MISMATCH" if fail_n
-                    else "BIT-EXACT+ERRORS" if err_n
-                    else "BIT-EXACT"),
+        "verdict": _verdict(fail_n, err_n, TRANSIENT_SKIPS),
     }), flush=True)
-    # 1: bit-exactness mismatch (kernel wrong); 3: combos that never
-    # ran (compile/runtime error) — automation must not read "every
-    # combo that ran passed" as "verified" when whole sections errored.
-    return 1 if fail_n else (3 if err_n else 0)
+    return _final_rc(fail_n, err_n, TRANSIENT_SKIPS)
 
 
 if __name__ == "__main__":
