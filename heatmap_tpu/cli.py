@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -191,6 +192,21 @@ def _add_run_flags(p):
     p.add_argument("--profile", default=None, metavar="LOGDIR",
                    help="capture a jax.profiler trace into LOGDIR and "
                    "print the span/throughput report to stderr")
+    p.add_argument("--metrics-dir", default=None, metavar="DIR",
+                   help="enable the metrics registry and write a "
+                   "Prometheus-text dump to DIR/metrics.prom at job end "
+                   "(docs/observability.md)")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="append structured run events to PATH (JSONL: "
+                   "run_start manifest, stage_end timings with backend "
+                   "attribution, backend_resolved, device_memory, "
+                   "run_end — docs/observability.md)")
+    p.add_argument("--report", nargs="?", const="run_report.json",
+                   default=None, metavar="PATH",
+                   help="fold tracer + metrics + events into a "
+                   "run_report.json artifact at PATH (default "
+                   "run_report.json) and print the span/throughput "
+                   "table to stderr — no --profile required")
     p.add_argument("--multihost", action="store_true",
                    help="SPMD multi-host job: jax.distributed init, "
                    "per-process ingest shard (connector ranges or batch "
@@ -349,46 +365,104 @@ def cmd_run(args) -> int:
         from heatmap_tpu.io.sinks import per_process_sink_spec
 
         output_spec = per_process_sink_spec(args.output, jax.process_index())
+    # Telemetry (all opt-in; with every flag off the job path is
+    # untouched and blobs are byte-identical — pinned by
+    # tests/test_obs.py). --events installs the process event log,
+    # --metrics-dir/--report enable the registry; the run report folds
+    # whatever was collected at the end.
+    telemetry = bool(args.metrics_dir or args.events
+                     or args.report is not None)
+    ev_log = None
+    if telemetry:
+        from heatmap_tpu import obs
+
+        obs.enable_metrics(True)
+        if args.events:
+            ev_log = obs.EventLog(args.events)
+            obs.set_event_log(ev_log)
+            import dataclasses as _dc
+
+            manifest = {k: (list(v) if isinstance(v, tuple) else v)
+                        for k, v in _dc.asdict(config).items()}
+            obs.emit("run_start", config=manifest, backend=args.backend,
+                     devices=obs.device_topology(), argv=sys.argv[1:])
     t0 = time.perf_counter()
     prof = jax_profile(args.profile) if args.profile else contextlib.nullcontext()
-    with prof:
-        with open_sink(output_spec) as sink:
-            if fast_source is not None:
-                blobs = run_job_fast(
-                    fast_source, sink, config,
-                    batch_size=args.batch_size,
-                    checkpoint_dir=args.checkpoint_dir,
-                    checkpoint_every=args.checkpoint_every,
-                    max_points_in_flight=args.max_points_in_flight,
-                    merge_spill_dir=args.merge_spill_dir,
-                )
-            elif args.checkpoint_dir:
-                blobs = run_job_resumable(
-                    open_source(args.input, read_value=args.weighted),
-                    args.checkpoint_dir, sink,
-                    config, batch_size=args.batch_size,
-                    checkpoint_every=args.checkpoint_every,
-                )
-            elif args.multihost:
-                from heatmap_tpu.parallel import run_job_multihost
+    job_error = None
+    blobs = None
+    try:
+        with prof:
+            with open_sink(output_spec) as sink:
+                if fast_source is not None:
+                    blobs = run_job_fast(
+                        fast_source, sink, config,
+                        batch_size=args.batch_size,
+                        checkpoint_dir=args.checkpoint_dir,
+                        checkpoint_every=args.checkpoint_every,
+                        max_points_in_flight=args.max_points_in_flight,
+                        merge_spill_dir=args.merge_spill_dir,
+                    )
+                elif args.checkpoint_dir:
+                    blobs = run_job_resumable(
+                        open_source(args.input, read_value=args.weighted),
+                        args.checkpoint_dir, sink,
+                        config, batch_size=args.batch_size,
+                        checkpoint_every=args.checkpoint_every,
+                    )
+                elif args.multihost:
+                    from heatmap_tpu.parallel import run_job_multihost
 
-                blobs = run_job_multihost(
-                    open_source(args.input, read_value=args.weighted),
-                    sink, config, batch_size=args.batch_size,
-                    max_points_in_flight=args.max_points_in_flight,
-                    egress=args.multihost_egress,
-                    merge_spill_dir=args.merge_spill_dir,
-                )
-            else:
-                blobs = run_job(open_source(args.input,
-                                            read_value=args.weighted),
-                                sink, config,
-                                batch_size=args.batch_size,
-                                max_points_in_flight=args.max_points_in_flight,
-                                merge_spill_dir=args.merge_spill_dir)
+                    blobs = run_job_multihost(
+                        open_source(args.input, read_value=args.weighted),
+                        sink, config, batch_size=args.batch_size,
+                        max_points_in_flight=args.max_points_in_flight,
+                        egress=args.multihost_egress,
+                        merge_spill_dir=args.merge_spill_dir,
+                    )
+                else:
+                    blobs = run_job(open_source(args.input,
+                                                read_value=args.weighted),
+                                    sink, config,
+                                    batch_size=args.batch_size,
+                                    max_points_in_flight=args.max_points_in_flight,
+                                    merge_spill_dir=args.merge_spill_dir)
+    except BaseException as e:  # noqa: BLE001 — run_end must record it
+        if not telemetry:
+            raise
+        job_error = e
     dt = time.perf_counter() - t0
     if args.profile:
         print(get_tracer().format_report(), file=sys.stderr)
+    if telemetry:
+        from heatmap_tpu import obs
+
+        obs.sample_device_memory()
+        if ev_log is not None:
+            end = {"status": "error" if job_error is not None else "ok",
+                   "seconds": round(dt, 3)}
+            if job_error is not None:
+                end["error"] = repr(job_error)
+            elif isinstance(blobs, dict) and str(
+                    blobs.get("egress", "")).startswith("levels"):
+                end["levels"] = blobs["levels"]
+                end["rows"] = blobs["rows"]
+            else:
+                end["blobs"] = len(blobs)
+                end["checksum"] = obs.blob_checksum(blobs)
+            obs.emit("run_end", **end)
+            obs.set_event_log(None)
+            ev_log.close()
+        if args.metrics_dir:
+            obs.get_registry().write_prometheus(
+                os.path.join(args.metrics_dir, "metrics.prom"))
+        if args.report is not None:
+            report = obs.build_run_report(
+                tracer=get_tracer(), registry=obs.get_registry(),
+                events_path=args.events)
+            obs.write_run_report(args.report, report)
+            print(obs.format_run_report(report), file=sys.stderr)
+        if job_error is not None:
+            raise job_error
     summary = {"seconds": round(dt, 3), "output": output_spec,
                "ingest": "fast" if fast_source is not None else "standard"}
     if isinstance(blobs, dict) and str(

@@ -18,17 +18,23 @@ from typing import Iterable
 
 import numpy as np
 
+from heatmap_tpu import obs
 from heatmap_tpu.io.png import raster_to_png
 
 
 class BlobSink:
     """Base: consumes (id, heatmap-dict-or-json) records."""
 
+    #: Metric label for sink_blobs_written_total{sink=...}.
+    KIND = "blob"
+
     def write(self, records: Iterable[tuple]) -> int:
         n = 0
         for blob_id, heatmap in records:
             self.write_one(blob_id, heatmap)
             n += 1
+        if n and obs.metrics_enabled():
+            obs.SINK_BLOBS.inc(n, sink=self.KIND)
         return n
 
     def write_one(self, blob_id: str, heatmap) -> None:
@@ -51,6 +57,8 @@ def _as_json(heatmap) -> str:
 class MemorySink(BlobSink):
     """Dict-backed sink (tests, small jobs). Upsert-by-id."""
 
+    KIND = "memory"
+
     def __init__(self):
         self.blobs: dict[str, str] = {}
 
@@ -68,6 +76,8 @@ class JSONLBlobSink(BlobSink):
 
     path: str
     _f: object = dataclasses.field(default=None, repr=False)
+
+    KIND = "jsonl"
 
     def _open(self):
         if self._f is None:
@@ -90,16 +100,25 @@ class JSONLBlobSink(BlobSink):
         bodies are large."""
         f = self._open()
         n = 0
+        nbytes = 0
+        counting = obs.metrics_enabled()
         lines = []
         for blob_id, heatmap in records:
             lines.append(self._line(blob_id, heatmap) + "\n")
             if len(lines) >= 16384:
                 f.writelines(lines)
                 n += len(lines)
+                if counting:
+                    nbytes += sum(len(ln) for ln in lines)
                 lines.clear()
         if lines:
             f.writelines(lines)
             n += len(lines)
+            if counting:
+                nbytes += sum(len(ln) for ln in lines)
+        if n and counting:
+            obs.SINK_BLOBS.inc(n, sink=self.KIND)
+            obs.SINK_BYTES.inc(nbytes, sink=self.KIND)
         return n
 
     def close(self):
@@ -125,6 +144,8 @@ class DirectoryBlobSink(BlobSink):
 
     root: str
 
+    KIND = "dir"
+
     def write_one(self, blob_id, heatmap):
         os.makedirs(self.root, exist_ok=True)
         fname = blob_id.replace(os.sep, "_") + ".json"
@@ -143,6 +164,7 @@ class CassandraBlobSink(BlobSink):
     session: object = None
     keyspace: str = "rhom"  # reference heatmap.py:150
     table: str = "heatmaps"  # reference heatmap.py:150
+    KIND = "cassandra"
     concurrency: int = 128
     _pending: list = dataclasses.field(default_factory=list, repr=False)
 
@@ -255,6 +277,9 @@ class LevelArraysSink:
                     save(f, **out)
             os.replace(tmp, final)
             rows += len(out["value"])
+            if obs.metrics_enabled():
+                obs.SINK_ROWS.inc(len(out["value"]), sink="arrays")
+                obs.SINK_BYTES.inc(os.path.getsize(final), sink="arrays")
         return rows
 
     def write(self, records):

@@ -19,11 +19,14 @@ from __future__ import annotations
 
 import csv
 import dataclasses
+import functools
 import json
 import os
 from typing import Iterator
 
 import numpy as np
+
+from heatmap_tpu import obs
 
 #: Column names of the reference's ``rhom.locations`` table
 #: (reference heatmap.py:25-36).
@@ -66,6 +69,26 @@ def _finalize_with_value(cols, vals):
     if vals is not None:
         out[VALUE_COLUMN] = np.asarray(vals, np.float64)
     return out
+
+
+def _count_rows(kind: str):
+    """Decorator for ``batches`` impls: attribute every yielded row to
+    the ``source_rows_read_total{source=<kind>}`` counter. Free when
+    metrics are off (one flag read per batch); the wrapper re-yields, so
+    mid-stream errors still propagate from the underlying reader."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, batch_size: int = DEFAULT_BATCH):
+            for batch in fn(self, batch_size):
+                if obs.metrics_enabled():
+                    obs.SOURCE_ROWS.inc(len(batch["latitude"]),
+                                        source=kind)
+                yield batch
+
+        return wrapper
+
+    return deco
 
 
 class Source:
@@ -112,6 +135,7 @@ class SyntheticSource(Source):
     #: (seed, chunk index), so any ``batch_size`` yields the same points.
     CHUNK = 1 << 16
 
+    @_count_rows("synthetic")
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         pending = _empty_batch()
         for chunk in self._chunks():
@@ -175,6 +199,7 @@ class CSVSource(Source):
     use_native: bool = True
     read_value: bool | None = None
 
+    @_count_rows("csv")
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         has_value = (self.read_value is not False
                      and self.has_value_column())
@@ -235,6 +260,7 @@ class JSONLSource(Source):
     path: str
     read_value: bool | None = None
 
+    @_count_rows("jsonl")
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         cols = {k: [] for k in COLUMNS}
         weighted = self.read_value  # None -> first data row decides
@@ -283,6 +309,7 @@ class ParquetSource(Source):
     path: str
     read_value: bool | None = None
 
+    @_count_rows("parquet")
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         import pyarrow.parquet as pq
 
@@ -447,6 +474,7 @@ class CassandraSource(Source):
                 for v in cols.values():
                     v.clear()
 
+    @_count_rows("cassandra")
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         session, cluster = self._session()
         try:
@@ -557,6 +585,7 @@ class CosmosDBSource(Source):
             if i % self.shard_count == self.shard_index
         ]
 
+    @_count_rows("cosmosdb")
     def batches(self, batch_size: int = DEFAULT_BATCH) -> Iterator[dict]:
         client = self._client()
         cols = {k: [] for k in COLUMNS}

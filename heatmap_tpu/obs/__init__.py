@@ -1,0 +1,191 @@
+"""Telemetry subsystem: metrics registry, structured events, run report.
+
+Three pillars (see docs/observability.md):
+
+- ``obs.metrics``  — process-wide counters/gauges/histograms with labels
+  and a Prometheus-text writer (``--metrics-dir``);
+- ``obs.events``   — append-only JSONL run events with a pinned schema
+  (``--events``);
+- ``obs.report``   — folds tracer + registry + events into
+  ``run_report.json`` and a human table (``--report``).
+
+This module owns the shared metric handles (created once on the default
+registry — ``registry.reset()`` clears values but keeps these objects
+valid) and the convenience recorders instrumentation sites call. Every
+recorder is a no-op when neither the registry is enabled nor an event
+log installed, so the pipeline pays near-zero cost with telemetry off —
+the same discipline as ``utils.trace.stage_span``. Keep all timing and
+stdout inside this package (or utils/trace.py): a tier-1 test greps the
+instrumented modules for raw ``print(`` / ``time.perf_counter(``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from heatmap_tpu.obs import events, metrics
+from heatmap_tpu.obs.events import (EVENT_SCHEMA, EventLog, emit,
+                                    get_event_log, read_events,
+                                    set_event_log, validate_event)
+from heatmap_tpu.obs.metrics import (MetricsRegistry, enable_metrics,
+                                     get_registry, metrics_enabled)
+from heatmap_tpu.obs.report import (blob_checksum, build_run_report,
+                                    format_run_report, write_run_report)
+
+_T0 = time.monotonic()  # heartbeat uptime origin (~process start)
+
+_registry = get_registry()
+
+# -- shared metric handles (one definition per series, reused everywhere) --
+STAGE_SECONDS = _registry.histogram(
+    "stage_duration_seconds", "Host wall-clock per tracer span",
+    labelnames=("stage",))
+STAGE_ITEMS = _registry.counter(
+    "stage_items_total", "Items attributed to tracer spans",
+    labelnames=("stage",))
+POINTS_BINNED = _registry.counter(
+    "points_binned_total", "Emissions routed into the cascade",
+    labelnames=("backend",))
+SOURCE_ROWS = _registry.counter(
+    "source_rows_read_total", "Rows yielded by io sources",
+    labelnames=("source",))
+SINK_BLOBS = _registry.counter(
+    "sink_blobs_written_total", "Blobs written by io sinks",
+    labelnames=("sink",))
+SINK_ROWS = _registry.counter(
+    "sink_rows_written_total", "Tile rows written by level-array sinks",
+    labelnames=("sink",))
+SINK_BYTES = _registry.counter(
+    "sink_bytes_written_total", "Bytes written by io sinks",
+    labelnames=("sink",))
+SHARD_RETRIES = _registry.counter(
+    "shard_retries_total", "Shard attempts that raised and were retried")
+STREAM_POINTS = _registry.counter(
+    "stream_points_total", "Points ingested by HeatmapStream.update")
+STREAM_BATCHES = _registry.counter(
+    "stream_batches_total", "Batches ingested by HeatmapStream.update")
+STREAM_TIME = _registry.gauge(
+    "stream_time_seconds", "Decay clock of the live stream state")
+STREAM_TICKS = _registry.counter(
+    "stream_ticks_total", "run_stream decay ticks observed by the hook")
+HOST_PHASE_SECONDS = _registry.gauge(
+    "multihost_phase_uptime_seconds",
+    "Per-host uptime at each job phase (straggler gap = max-min)",
+    labelnames=("phase", "process"))
+HOST_LAST_HEARTBEAT = _registry.gauge(
+    "multihost_last_heartbeat_ts", "Unix time of each host's last heartbeat",
+    labelnames=("process",))
+DEVICE_BYTES = _registry.gauge(
+    "device_bytes_in_use", "Last sampled device memory in use",
+    labelnames=("device",))
+
+
+def telemetry_enabled() -> bool:
+    """True when any sink (registry or event log) is live."""
+    return _registry.enabled or events._current is not None
+
+
+def record_stage(stage: str, wall_s: float, items=None, **attrs):
+    """Span-close hook: tracer spans feed the registry and event log.
+
+    Called from utils/trace.py on every span exit; must stay cheap when
+    telemetry is off (two global reads).
+    """
+    enabled = _registry.enabled
+    log = events._current
+    if not enabled and log is None:
+        return
+    if enabled:
+        STAGE_SECONDS.observe(wall_s, stage=stage)
+        if items:
+            STAGE_ITEMS.inc(int(items), stage=stage)
+    if log is not None:
+        fields = {k: v for k, v in attrs.items() if v is not None}
+        if items:
+            fields["items"] = int(items)
+        log.emit("stage_end", stage=stage, wall_s=round(wall_s, 6),
+                 **fields)
+
+
+def device_topology() -> dict:
+    """Device manifest for run_start (initialises jax if needed)."""
+    import jax
+
+    devices = jax.devices()
+    kinds: dict = {}
+    for d in devices:
+        kinds[d.device_kind] = kinds.get(d.device_kind, 0) + 1
+    return {"platform": devices[0].platform,
+            "n_devices": len(devices),
+            "n_local_devices": jax.local_device_count(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+            "device_kinds": kinds}
+
+
+def sample_device_memory() -> list:
+    """Sample memory_stats() from every local device; emits a
+    device_memory event (empty samples list on backends without stats,
+    e.g. CPU) and sets the per-device gauge."""
+    if not telemetry_enabled():
+        return []
+    import jax
+
+    samples = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        samples.append({
+            "device": int(d.id),
+            "platform": str(d.platform),
+            "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+            "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+        })
+        DEVICE_BYTES.set(samples[-1]["bytes_in_use"],
+                         device=str(samples[-1]["device"]))
+    emit("device_memory", samples=samples)
+    return samples
+
+
+def heartbeat(phase: str):
+    """Per-host liveness mark for multihost phases. Timing lives here so
+    parallel/multihost.py stays free of raw clocks."""
+    if not telemetry_enabled():
+        return
+    import jax
+
+    pi = jax.process_index()
+    uptime = time.monotonic() - _T0
+    HOST_PHASE_SECONDS.set(uptime, phase=phase, process=str(pi))
+    HOST_LAST_HEARTBEAT.set(time.time(), process=str(pi))
+    emit("heartbeat", process_index=pi, process_count=jax.process_count(),
+         phase=phase, uptime_s=round(uptime, 3))
+
+
+def record_retry(shard: int, attempt: int, error: BaseException):
+    if not telemetry_enabled():
+        return
+    SHARD_RETRIES.inc()
+    emit("retry", shard=int(shard), attempt=int(attempt),
+         error=repr(error))
+
+
+def record_recovery(shard: int, attempts: int):
+    if not telemetry_enabled():
+        return
+    emit("recovery", shard=int(shard), attempts=int(attempts))
+
+
+__all__ = [
+    "EVENT_SCHEMA", "EventLog", "MetricsRegistry",
+    "blob_checksum", "build_run_report", "device_topology", "emit",
+    "enable_metrics", "events", "format_run_report", "get_event_log",
+    "get_registry", "heartbeat", "metrics", "metrics_enabled",
+    "read_events", "record_recovery", "record_retry", "record_stage",
+    "sample_device_memory", "set_event_log", "telemetry_enabled",
+    "validate_event", "write_run_report",
+]

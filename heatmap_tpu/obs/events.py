@@ -1,0 +1,162 @@
+"""Structured run events: append-only JSONL with a checked-in schema.
+
+Every record carries the envelope ``{run_id, seq, ts, event}`` — ``seq``
+is monotonic per log (assigned under the writer lock, so concurrent
+producer threads cannot collide) and ``ts`` is Unix wall-clock. The
+payload fields allowed per event type are pinned in ``EVENT_SCHEMA``;
+``validate_event`` rejects unknown fields and missing required ones, so
+the log a run emits is exactly the catalog docs/observability.md
+documents — an instrumentation site cannot invent an ad-hoc field
+without also widening the schema (and its tests).
+
+Emission is a module-level ``emit(event, **fields)`` that no-ops when no
+log is installed (``set_event_log``), mirroring the zero-cost stance of
+the metrics registry: hot paths pay one global read when events are off.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+# event -> {"required": (...), "optional": (...)}. The envelope fields
+# (run_id/seq/ts/event) are implicit on every record.
+EVENT_SCHEMA = {
+    # Job manifest: resolved config, CLI backend, device topology.
+    "run_start": {"required": ("config", "backend", "devices"),
+                  "optional": ("argv",)},
+    # One per closed tracer span when an event log is installed.
+    "stage_end": {"required": ("stage", "wall_s"),
+                  "optional": ("items", "bytes", "backend", "level",
+                               "window")},
+    # Job-level routing decision: how cascade_backend="auto" resolved.
+    "backend_resolved": {"required": ("requested", "resolved"),
+                         "optional": ("reason", "weighted", "data_parallel",
+                                      "n_emissions")},
+    # Per-call cascade dispatch record (the audit trail behind
+    # backend_resolved: what run_cascade actually executed).
+    "cascade_dispatch": {"required": ("backend",),
+                         "optional": ("jit", "mesh", "merge", "n_emissions",
+                                      "n_slots")},
+    # jax.local_devices()[i].memory_stats() snapshot (empty on CPU).
+    "device_memory": {"required": ("samples",), "optional": ()},
+    # utils/recovery.py shard retry loop.
+    "retry": {"required": ("shard", "attempt", "error"), "optional": ()},
+    "recovery": {"required": ("shard", "attempts"), "optional": ()},
+    # parallel/multihost.py per-host phase heartbeats.
+    "heartbeat": {"required": ("process_index", "process_count", "phase"),
+                  "optional": ("uptime_s",)},
+    # utils/trace.py jax_profile failed to start (satellite fix).
+    "profiler_unavailable": {"required": ("error",), "optional": ("logdir",)},
+    # Terminal record: exit status + output fingerprint.
+    "run_end": {"required": ("status",),
+                "optional": ("blobs", "rows", "levels", "checksum",
+                             "seconds", "error")},
+}
+
+ENVELOPE_FIELDS = ("run_id", "seq", "ts", "event")
+
+
+def validate_event(rec: dict):
+    """Raise ValueError unless ``rec`` is a well-formed event record."""
+    if not isinstance(rec, dict):
+        raise ValueError(f"event record must be a dict, got {type(rec)}")
+    for field in ENVELOPE_FIELDS:
+        if field not in rec:
+            raise ValueError(f"event record missing envelope field {field!r}")
+    if not isinstance(rec["run_id"], str) or not rec["run_id"]:
+        raise ValueError("run_id must be a non-empty string")
+    if not isinstance(rec["seq"], int) or rec["seq"] < 0:
+        raise ValueError("seq must be a non-negative integer")
+    if not isinstance(rec["ts"], (int, float)):
+        raise ValueError("ts must be numeric")
+    event = rec["event"]
+    spec = EVENT_SCHEMA.get(event)
+    if spec is None:
+        raise ValueError(f"unknown event type {event!r}")
+    payload = {k for k in rec if k not in ENVELOPE_FIELDS}
+    missing = set(spec["required"]) - payload
+    if missing:
+        raise ValueError(f"{event}: missing required field(s) "
+                         f"{sorted(missing)}")
+    unknown = payload - set(spec["required"]) - set(spec["optional"])
+    if unknown:
+        raise ValueError(f"{event}: unknown field(s) {sorted(unknown)}")
+
+
+class EventLog:
+    """Append-only JSONL writer with per-run id and monotonic seq.
+
+    Lines are flushed as written so a crash loses at most the record in
+    flight; ``seq`` gaps in a recovered log therefore mean lost tail,
+    never reordering.
+    """
+
+    def __init__(self, path: str, run_id: str | None = None):
+        self.path = path
+        self.run_id = run_id or uuid.uuid4().hex[:12]
+        self._lock = threading.Lock()
+        self._seq = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "a")
+
+    def emit(self, event: str, **fields) -> dict:
+        rec = {"run_id": self.run_id, "seq": 0, "ts": time.time(),
+               "event": event, **fields}
+        with self._lock:
+            if self._fh is None:
+                raise ValueError(f"event log {self.path} is closed")
+            rec["seq"] = self._seq
+            validate_event(rec)
+            self._seq += 1
+            self._fh.write(json.dumps(rec, sort_keys=False,
+                                      default=str) + "\n")
+            self._fh.flush()
+        return rec
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_current: EventLog | None = None
+
+
+def set_event_log(log: EventLog | None):
+    """Install (or clear, with None) the process-wide event log."""
+    global _current
+    _current = log
+
+
+def get_event_log() -> EventLog | None:
+    return _current
+
+
+def emit(event: str, **fields) -> dict | None:
+    """Emit to the installed log; no-op (returns None) when none is set."""
+    log = _current
+    if log is None:
+        return None
+    return log.emit(event, **fields)
+
+
+def read_events(path: str) -> list:
+    """Parse a JSONL event log back into records (no validation)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                records.append(json.loads(line))
+    return records

@@ -41,6 +41,7 @@ import zlib
 import jax
 import numpy as np
 
+from heatmap_tpu import obs
 from heatmap_tpu.io.sinks import LevelArraysSink as _LevelArraysSink
 # Merge semantics live in the jax-free io.merge module (the CLI's
 # offline shard merge uses them without an accelerator stack);
@@ -659,7 +660,11 @@ def run_job_multihost(source, sink=None, config=None,
         )
     # Ingest this process's slice into either captured level arrays
     # (columnar sinks) or local blobs; the egress tail below is shared
-    # by both ingest routes.
+    # by both ingest routes. Each phase boundary heartbeats (per-host
+    # liveness + uptime gauges, obs.heartbeat): the spread of the
+    # multihost_phase_uptime_seconds gauge across processes at one
+    # phase IS the straggler gap.
+    obs.heartbeat("ingest_start")
     cap = _CaptureLevels() if columnar else None
     if max_points_in_flight:
         # Bounded slice ingest: chunked cascade + host-side merge
@@ -678,17 +683,21 @@ def run_job_multihost(source, sink=None, config=None,
             local = _run_loaded(data, config, as_json=True, sink=cap)
         else:
             local = {}
+    obs.heartbeat("ingest_done")
     if columnar:
         owned = scatter_levels(cap.levels, max_bytes=egress_max_bytes)
         rows = sink.write_levels(owned)
+        obs.heartbeat("egress_done")
         return {"egress": "levels-sharded", "levels": len(owned),
                 "rows": rows}
     if egress == "sharded":
         owned = scatter_blobs(local, max_bytes=egress_max_bytes)
         if sink is not None:
             sink.write(owned.items())
+        obs.heartbeat("egress_done")
         return owned
     blobs = gather_blobs(local, max_bytes=egress_max_bytes)
     if sink is not None and jax.process_index() == 0:
         sink.write(blobs.items())
+    obs.heartbeat("egress_done")
     return blobs

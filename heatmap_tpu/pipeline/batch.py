@@ -19,6 +19,7 @@ import os
 import jax
 import numpy as np
 
+from heatmap_tpu import obs
 from heatmap_tpu.pipeline import cascade as cascade_mod
 from heatmap_tpu.tilemath import mercator, morton
 from heatmap_tpu.pipeline.groups import ALL_GROUP, EXCLUDED, UserVocab
@@ -902,6 +903,36 @@ def _fast_batches_for(source, batch_size, checkpointing=False):
     )
 
 
+def _resolve_backend(config: BatchJobConfig, n_emissions: int | None = None,
+                     data_parallel: bool = False) -> str:
+    """Resolve the cascade backend once per job and leave an audit
+    trail: a ``backend_resolved`` event recording how ``"auto"`` routed
+    (and why), plus the ``points_binned_total`` ingress counter when the
+    emission count is known at resolution time. Pure pass-through of
+    ``config.resolved_cascade_backend`` when telemetry is off."""
+    resolved = config.resolved_cascade_backend
+    if not obs.telemetry_enabled():
+        return resolved
+    if config.cascade_backend != "auto":
+        reason = "explicit request"
+    elif config.weighted:
+        reason = ("weighted jobs stay on scatter (the bounded-integer "
+                  "partitioned contract is an explicit opt-in)")
+    elif resolved == "partitioned":
+        reason = "count job on tpu -> partitioned MXU kernel"
+    else:
+        reason = "non-tpu platform -> xla scatter"
+    if n_emissions is not None:
+        obs.POINTS_BINNED.inc(int(n_emissions), backend=resolved)
+    fields = {"requested": config.cascade_backend, "resolved": resolved,
+              "reason": reason, "weighted": bool(config.weighted),
+              "data_parallel": bool(data_parallel)}
+    if n_emissions is not None:
+        fields["n_emissions"] = int(n_emissions)
+    obs.emit("backend_resolved", **fields)
+    return resolved
+
+
 def _run_job_bounded(source, sink, config: BatchJobConfig,
                      batch_size: int, max_points: int,
                      overlap_ingest: bool = True, fast: bool = False,
@@ -1042,10 +1073,16 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             yield cut()
 
     dp_mesh = _dp_mesh(config)
+    # Resolved ONCE for the whole job (the property probes jax.devices()
+    # on every read) and audited via backend_resolved; per-chunk
+    # dispatch details land in cascade_dispatch events.
+    resolved_backend = _resolve_backend(
+        config, data_parallel=dp_mesh is not None)
 
     def process(chunk):
         lat, lon, group_ids, flat_stamps, weights = chunk
-        with tracer.span("cascade.chunk", items=len(lat)):
+        with tracer.span("cascade.chunk", items=len(lat),
+                         backend=resolved_backend):
             import jax.numpy as jnp
 
             codes, valid = _cascade_codes(lat, lon, config.detail_zoom)
@@ -1055,6 +1092,9 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                     ts_vocab=ts_vocab, weights=weights,
                 )
             )
+            if obs.metrics_enabled():
+                obs.POINTS_BINNED.inc(int(len(e_codes)),
+                                      backend=resolved_backend)
             # jit=False: chunk emission shapes (and sometimes
             # n_slots) vary call to call on the bounded path, so the
             # jitted entry would recompile the whole cascade per chunk.
@@ -1067,7 +1107,7 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 acc_dtype=jnp.float64 if e_weights is not None else None,
                 adaptive=config.adaptive_capacity,
                 jit=False,
-                backend=config.resolved_cascade_backend,
+                backend=resolved_backend,
                 mesh=_dp_mesh_for(dp_mesh, config, len(e_codes)),
                 merge=config.dp_merge,
                 weight_bound=config.weight_bound,
@@ -1966,7 +2006,10 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
     n_slots = len(ts_vocab) * n_groups
 
     ccfg = config.cascade_config()
-    with tracer.span("cascade.device"):
+    dp_mesh = _dp_mesh_for(_dp_mesh(config), config, len(e_codes))
+    backend = _resolve_backend(config, n_emissions=len(e_codes),
+                               data_parallel=dp_mesh is not None)
+    with tracer.span("cascade.device", backend=backend):
         import jax.numpy as jnp
 
         from heatmap_tpu.utils.trace import stage_tracing_enabled
@@ -1984,8 +2027,8 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             # int32 path, SURVEY.md §8.8).
             acc_dtype=jnp.float64 if e_weights is not None else None,
             adaptive=config.adaptive_capacity,
-            backend=config.resolved_cascade_backend,
-            mesh=_dp_mesh_for(_dp_mesh(config), config, len(e_codes)),
+            backend=backend,
+            mesh=dp_mesh,
             merge=config.dp_merge,
             weight_bound=config.weight_bound,
             # Stage tracing needs the cascade EAGER: under the fused jit

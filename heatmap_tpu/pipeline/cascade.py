@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from heatmap_tpu.obs import events as obs_events
 from heatmap_tpu.ops import pyramid as pyramid_ops
 from heatmap_tpu.tilemath.morton import morton_decode_np
 
@@ -298,6 +299,16 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     call and should stay eager). ``mesh`` (hashable, a valid static
     arg) routes the detail reduction through the data-parallel sharded
     pyramid — see build_cascade."""
+    if obs_events._current is not None:
+        # Audit every dispatch: what the cascade actually executed
+        # (shape info is static even on tracers, so this is safe in
+        # eager AND pre-jit contexts). backend_resolved in batch.py
+        # records the routing *decision*; this records each execution.
+        obs_events.emit(
+            "cascade_dispatch", backend=backend,
+            jit=bool(jit and not adaptive), mesh=mesh is not None,
+            merge=merge, n_emissions=int(codes.shape[0]),
+            n_slots=int(n_slots))
     if adaptive or not jit:
         return build_cascade(
             codes, slots, config, n_slots, weights=weights, valid=valid,

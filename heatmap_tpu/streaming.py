@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from heatmap_tpu import obs
 from heatmap_tpu.ops import Window, bin_points_window
 from heatmap_tpu.parallel.mesh import DATA_AXIS
 
@@ -170,6 +171,10 @@ class HeatmapStream:
         )
         self.t = t
         self.n_batches += 1
+        if obs.metrics_enabled():
+            obs.STREAM_POINTS.inc(int(n))
+            obs.STREAM_BATCHES.inc()
+            obs.STREAM_TIME.set(float(t))
         return self
 
     def snapshot(self) -> np.ndarray:
@@ -257,19 +262,33 @@ class HeatmapStream:
         return self
 
 
+def default_stream_hook(stream: HeatmapStream, t: float):
+    """The default ``on_batch``: per-tick telemetry. No-op unless a
+    metrics sink is enabled (``HeatmapStream.update`` already keeps the
+    ingest counters; this adds the decay-tick view the run_stream loop
+    owns). Deliberately does NOT snapshot the raster — that is a
+    device->host copy per tick; pass a custom hook for that."""
+    if not obs.metrics_enabled():
+        return
+    obs.STREAM_TICKS.inc()
+    obs.STREAM_TIME.set(float(t))
+
+
 def run_stream(stream: HeatmapStream, timed_batches, *, on_batch=None):
     """Drive a stream from an iterable of ``(t_seconds, batch)`` pairs,
     where ``batch`` is a columnar point batch (heatmap_tpu.io layout;
     background rows dropped like the batch path, reference
-    heatmap.py:28-29). ``on_batch(stream, t)`` fires after each step
-    (metrics/snapshot hook)."""
+    heatmap.py:28-29). ``on_batch(stream, t)`` fires after each step;
+    the default is ``default_stream_hook`` (decay-tick and ingest
+    gauges, free when telemetry is off)."""
     from heatmap_tpu.pipeline import load_columns
 
+    if on_batch is None:
+        on_batch = default_stream_hook
     for t, batch in timed_batches:
         cols = load_columns(batch)
         stream.update(cols["latitude"], cols["longitude"], t)
-        if on_batch is not None:
-            on_batch(stream, t)
+        on_batch(stream, t)
     return stream
 
 

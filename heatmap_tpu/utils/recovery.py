@@ -77,6 +77,8 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
     be called concurrently and must be thread-safe.
     """
 
+    from heatmap_tpu import obs
+
     def run_one(i, shard):
         attempt = 0
         while True:
@@ -85,16 +87,24 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
                     fault_injector.check(i)
                 if tracer is not None:
                     with tracer.span("shard"):
-                        return process(shard)
-                return process(shard)
+                        result = process(shard)
+                else:
+                    result = process(shard)
             except Exception as e:  # noqa: BLE001 — retry boundary
                 attempt += 1
+                obs.record_retry(i, attempt, e)
                 if on_retry is not None:
                     on_retry(i, attempt, e)
                 if attempt > retries:
                     raise ShardFailure(i, attempt, e) from e
                 if backoff_s:
                     time.sleep(backoff_s * attempt)
+            else:
+                if attempt:
+                    # The shard landed after at least one failure —
+                    # the recovery event the retry events pair with.
+                    obs.record_recovery(i, attempt)
+                return result
 
     shards = list(shards)
     if max_workers <= 1:

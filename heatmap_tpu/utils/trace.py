@@ -16,6 +16,11 @@ greenfield replacement:
 Spans measure *host* wall-clock. For device work inside a span, call
 ``block_until_ready`` on the result before the span closes, or the
 span records only dispatch time (XLA is async).
+
+Closed spans also feed the telemetry subsystem (heatmap_tpu/obs): a
+``stage_duration_seconds`` histogram sample plus a ``stage_end`` event —
+both no-ops unless a metrics sink or event log is configured, so the
+tracer stays usable standalone.
 """
 
 from __future__ import annotations
@@ -23,6 +28,17 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+
+_obs = None  # lazily imported so importing trace never pulls in obs/jax
+
+
+def _obs_record(name: str, wall_s: float, items, attrs: dict):
+    global _obs
+    if _obs is None:
+        from heatmap_tpu import obs
+
+        _obs = obs
+    _obs.record_stage(name, wall_s, items=items, **attrs)
 
 
 class _SpanStats:
@@ -41,6 +57,9 @@ class Tracer:
     def __init__(self):
         self._lock = threading.Lock()
         self._stats: dict[str, _SpanStats] = {}
+        # Set by jax_profile when the profiler cannot start; surfaced
+        # in obs.report.build_run_report's warnings.
+        self.profiler_warning: str | None = None
 
     def _stat(self, name: str) -> _SpanStats:
         s = self._stats.get(name)
@@ -49,7 +68,9 @@ class Tracer:
         return s
 
     @contextlib.contextmanager
-    def span(self, name: str, items: int | None = None):
+    def span(self, name: str, items: int | None = None, **attrs):
+        """Extra keyword attrs (e.g. ``backend="partitioned"``) ride
+        along on the stage_end event when an event log is installed."""
         t0 = time.perf_counter()
         try:
             yield self
@@ -62,6 +83,8 @@ class Tracer:
                 s.max_s = max(s.max_s, dt)
                 if items:
                     s.items += int(items)
+            if self is _default:
+                _obs_record(name, dt, items, attrs)
 
     def add_items(self, name: str, n: int):
         """Attribute ``n`` processed items to ``name`` (throughput)."""
@@ -86,6 +109,7 @@ class Tracer:
     def reset(self):
         with self._lock:
             self._stats.clear()
+            self.profiler_warning = None
 
     def format_report(self) -> str:
         lines = []
@@ -110,9 +134,9 @@ def get_tracer() -> Tracer:
     return _default
 
 
-def span(name: str, items: int | None = None):
+def span(name: str, items: int | None = None, **attrs):
     """Span on the default tracer: ``with span("binning", items=n): ...``"""
-    return _default.span(name, items=items)
+    return _default.span(name, items=items, **attrs)
 
 
 # -- per-stage cascade attribution (opt-in diagnostic) ---------------------
@@ -137,12 +161,12 @@ def stage_tracing_enabled() -> bool:
     return _stage_tracing
 
 
-def stage_span(name: str, items: int | None = None):
+def stage_span(name: str, items: int | None = None, **attrs):
     """A tracer span only under stage tracing; nullcontext otherwise
     (kernels call this on hot paths — it must cost nothing when off)."""
     if not _stage_tracing:
         return contextlib.nullcontext()
-    return _default.span(name, items=items)
+    return _default.span(name, items=items, **attrs)
 
 
 def stage_block(x):
@@ -164,16 +188,28 @@ def stage_block(x):
 def jax_profile(logdir: str):
     """Capture a jax.profiler trace (XLA timeline) into ``logdir``.
 
-    No-op (with a warning attribute on the tracer) when the profiler is
-    unavailable on the current backend.
+    No-op when the profiler is unavailable on the current backend: the
+    failure is recorded on ``get_tracer().profiler_warning`` and, when
+    an event log is installed, as a ``profiler_unavailable`` event —
+    both surface in the run report's warnings.
     """
     import jax
 
     try:
         jax.profiler.start_trace(logdir)
         started = True
-    except Exception:
+    except Exception as e:
         started = False
+        _default.profiler_warning = (
+            f"jax profiler unavailable ({type(e).__name__}: {e}); "
+            f"no trace written to {logdir}")
+        try:
+            from heatmap_tpu.obs import events as _events
+
+            _events.emit("profiler_unavailable", error=repr(e),
+                         logdir=str(logdir))
+        except Exception:
+            pass
     try:
         yield
     finally:

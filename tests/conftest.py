@@ -39,3 +39,24 @@ def _assert_cpu_mesh():
 @pytest.fixture(scope="session")
 def devices():
     return jax.devices()
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """Isolate process-wide telemetry state between tests: the default
+    tracer, the metrics registry (values + enabled flag), the installed
+    event log, and the stage-tracing global — so a test that flips
+    ``enable_stage_tracing(True)`` (or enables metrics) cannot leak
+    instrumentation cost or state into later hot-path tests."""
+    yield
+    from heatmap_tpu import obs
+    from heatmap_tpu.utils import trace
+
+    trace.get_tracer().reset()
+    trace.enable_stage_tracing(False)
+    obs.enable_metrics(False)
+    obs.get_registry().reset()
+    log = obs.get_event_log()
+    if log is not None:
+        log.close()
+        obs.set_event_log(None)

@@ -73,6 +73,7 @@ def run_single(args) -> int:
         jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_enable_x64", True)  # int64 keys + exact z21
 
+    from heatmap_tpu import obs
     from heatmap_tpu.io.hmpb import HMPBSource
     from heatmap_tpu.io.sinks import LevelArraysSink, MemorySink
     from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
@@ -80,6 +81,10 @@ def run_single(args) -> int:
 
     if args.trace_stages:
         enable_stage_tracing(True)
+    # Metrics ride along on every measurement (counters/gauges only —
+    # no event log, so no per-span I/O in the timed region); the folded
+    # run report lands in the bench record below.
+    obs.enable_metrics(True)
     backend = args.cascade_backend
     config = (BatchJobConfig() if backend is None
               else BatchJobConfig(cascade_backend=backend))
@@ -99,6 +104,7 @@ def run_single(args) -> int:
         name: round(r["total_s"], 3)
         for name, r in sorted(tracer.report().items())
     }
+    obs.sample_device_memory()
     print(json.dumps({
         "run": args.run,
         "device": jax.devices()[0].platform,
@@ -109,7 +115,12 @@ def run_single(args) -> int:
         "pts_per_s": round(args.n / dt),
         "stages": stages,
         "out": (len(out) if hasattr(out, "__len__") else str(out)[:80]),
-    }), flush=True)
+        # Full per-stage attribution + io/cascade counters for the
+        # decision evaluator: BENCH rows carry the same artifact
+        # `cli run --report` writes (obs.report schema).
+        "run_report": obs.build_run_report(tracer=tracer,
+                                           registry=obs.get_registry()),
+    }, default=str), flush=True)
     return 0
 
 
