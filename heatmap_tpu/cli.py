@@ -92,11 +92,24 @@ def _init_backend(args):
     return jax
 
 
+def _sink_spec(spec: str) -> str:
+    """argparse type= wrapper: reject a typo'd --output kind at parse
+    time (one-line error listing the valid kinds) instead of after
+    backend init and ingest."""
+    from heatmap_tpu.io.sinks import validate_sink_spec
+
+    try:
+        return validate_sink_spec(spec)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e)) from e
+
+
 def _add_run_flags(p):
     p.add_argument("--input", required=True,
                    help="source spec: synthetic:N[:seed] | csv:P | jsonl:P "
                    "| parquet:P | hmpb:P | cassandra:[ENDPOINT] | cosmosdb:")
     p.add_argument("--output", default="jsonl:heatmaps.jsonl",
+                   type=_sink_spec,
                    help="sink spec: jsonl:P | dir:P | memory: | "
                    "cassandra: | arrays:DIR (columnar per-level npz)")
     p.add_argument("--detail-zoom", type=int, default=21,
@@ -699,6 +712,129 @@ def cmd_stream(args) -> int:
     return 0
 
 
+def _parse_layers(arg: str | None):
+    """``--layers name=user|timespan,...`` -> {name: selector} or None
+    (= expose every slice + the 'default' alias)."""
+    if not arg:
+        return None
+    layers = {}
+    for part in arg.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, sel = part.partition("=")
+        layers[name.strip()] = (sel if sep else name).strip()
+    return layers or None
+
+
+def _follow_stream(args, app):
+    """Live mode: pump micro-batches from --follow-stream into a
+    LiveLayer on a daemon thread; each tick invalidates only the cache
+    keys of tiles the batch touched. Returns a stop() callback."""
+    _init_backend(args)
+    import threading
+
+    import jax.numpy as jnp
+
+    from heatmap_tpu.io import open_source
+    from heatmap_tpu.ops import window_from_bounds
+    from heatmap_tpu.pipeline import load_columns
+    from heatmap_tpu.serve import LiveLayer
+    from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+
+    window = window_from_bounds(
+        (args.lat_min, args.lat_max),
+        (args.lon_min, args.lon_max),
+        zoom=args.zoom,
+    )
+    config = StreamConfig(
+        window=window,
+        half_life_s=args.half_life,
+        proj_dtype=jnp.float32 if args.no_x64 else jnp.float64,
+        pad_to=args.batch_points,
+    )
+    layer = LiveLayer(HeatmapStream(config), name=args.live_layer)
+    app.attach_layer(args.live_layer, layer)
+    done = threading.Event()
+
+    def _pump():
+        t_stream = 0.0
+        source = open_source(args.follow_stream, read_value=False)
+        for batch in source.batches(args.batch_points):
+            if done.is_set():
+                break
+            cols = load_columns(batch)
+            t_stream += args.interval
+            keys = layer.tick(cols["latitude"], cols["longitude"], t_stream)
+            app.cache.invalidate_keys(keys)
+            if args.tick_seconds > 0:
+                done.wait(args.tick_seconds)
+
+    thread = threading.Thread(target=_pump, name="serve-stream", daemon=True)
+    thread.start()
+
+    def stop():
+        done.set()
+        thread.join(timeout=5)
+
+    return stop
+
+
+def cmd_serve(args) -> int:
+    """Tile HTTP server over a batch egress artifact (docs/serving.md).
+
+    Numpy-only unless --follow-stream is given: serving a finished job
+    never initializes a jax backend, so the server stays up next to a
+    dead accelerator relay.
+    """
+    from heatmap_tpu import obs
+    from heatmap_tpu.serve import ServeApp, TileCache, TileStore, make_server
+
+    # /metrics is a first-class endpoint here, not an opt-in artifact.
+    obs.enable_metrics(True)
+    ev_log = None
+    if args.events:
+        ev_log = obs.EventLog(args.events)
+        obs.set_event_log(ev_log)
+    ttl = args.ttl
+    if args.follow_stream and not (ttl and ttl > 0):
+        # Targeted invalidation only drops tiles a batch touched; decay
+        # drifts every OTHER cached tile, so live mode needs its
+        # staleness bounded by a finite TTL (serve/live.py).
+        ttl = max(1.0, args.interval / 2)
+    try:
+        store = TileStore(args.store, layers=_parse_layers(args.layers))
+    except (ValueError, OSError) as e:
+        raise SystemExit(str(e)) from e
+    cache = TileCache(max_bytes=args.cache_bytes,
+                      ttl_s=ttl if (ttl and ttl > 0) else None)
+    app = ServeApp(store, cache)
+    stop_stream = None
+    if args.follow_stream:
+        stop_stream = _follow_stream(args, app)
+    server = make_server(app, host=args.host, port=args.port)
+    host, port = server.server_address[:2]
+    print(json.dumps({
+        "serving": f"http://{host}:{port}",
+        "store": args.store,
+        "layers": app.layer_names(),
+        "cache_bytes": cache.max_bytes,
+        "ttl_s": cache.ttl_s,
+    }), file=sys.stderr, flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if stop_stream is not None:
+            stop_stream()
+        server.server_close()
+        if ev_log is not None:
+            obs.set_event_log(None)
+            ev_log.close()
+    return 0
+
+
 def cmd_render(args) -> int:
     """Stored heatmaps -> z/x/y PNG tile tree.
 
@@ -1004,6 +1140,58 @@ def build_parser() -> argparse.ArgumentParser:
                           "into the decayed raster instead of counting")
     p_stream.set_defaults(fn=cmd_stream)
 
+    p_serve = sub.add_parser(
+        "serve",
+        help="tile HTTP server over stored heatmaps: "
+        "GET /tiles/{layer}/{z}/{x}/{y}.png|.json (docs/serving.md)",
+    )
+    _add_backend_flags(p_serve)  # used only by --follow-stream
+    p_serve.add_argument("--store", required=True,
+                         help="arrays:DIR (incl. multihost host*/ shard "
+                         "dirs) | jsonl:PATH | dir:PATH — any batch "
+                         "egress artifact")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--port", type=int, default=8000,
+                         help="listen port (0 = ephemeral; the bound "
+                         "address is printed to stderr)")
+    p_serve.add_argument("--cache-bytes", type=int, default=256 << 20,
+                         help="tile cache budget in bytes (LRU past it; "
+                         "0 disables caching but keeps single-flight "
+                         "render dedup)")
+    p_serve.add_argument("--ttl", type=float, default=None,
+                         help="tile cache TTL seconds (default: none for "
+                         "static stores; live mode defaults to "
+                         "interval/2 to bound decay drift)")
+    p_serve.add_argument("--layers", default=None,
+                         help="comma list of name=user|timespan layer "
+                         "mounts (default: every slice in the artifact "
+                         "plus 'default' -> all|alltime)")
+    p_serve.add_argument("--events", default=None, metavar="PATH",
+                         help="append http_request events to PATH (JSONL, "
+                         "docs/observability.md)")
+    p_serve.add_argument("--follow-stream", default=None, metavar="SPEC",
+                         help="live mode: consume this source spec as "
+                         "micro-batches into a decayed stream layer "
+                         "(name via --live-layer); ticks invalidate "
+                         "only the affected tile keys")
+    p_serve.add_argument("--live-layer", default="live",
+                         help="layer name the --follow-stream raster is "
+                         "served under")
+    p_serve.add_argument("--batch-points", type=int, default=1 << 16)
+    p_serve.add_argument("--interval", type=float, default=60.0,
+                         help="stream seconds advanced per micro-batch")
+    p_serve.add_argument("--tick-seconds", type=float, default=1.0,
+                         help="wall-clock pause between micro-batch "
+                         "ticks (0 = consume as fast as possible)")
+    p_serve.add_argument("--half-life", type=float, default=3600.0)
+    p_serve.add_argument("--zoom", type=int, default=12,
+                         help="live window detail zoom")
+    p_serve.add_argument("--lat-min", type=float, default=45.0)
+    p_serve.add_argument("--lat-max", type=float, default=50.0)
+    p_serve.add_argument("--lon-min", type=float, default=-125.0)
+    p_serve.add_argument("--lon-max", type=float, default=-119.0)
+    p_serve.set_defaults(fn=cmd_serve)
+
     p_render = sub.add_parser(
         "render",
         help="stored heatmaps (arrays:DIR / jsonl:PATH) -> PNG tile tree",
@@ -1045,7 +1233,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_merge.add_argument("--inputs", nargs="+", required=True,
                          help="JSONL blob files, or level-array dirs "
                          "(all one kind)")
-    p_merge.add_argument("--output", required=True,
+    p_merge.add_argument("--output", required=True, type=_sink_spec,
                          help="blob sink spec (jsonl:/dir:/memory:) for "
                          "blob inputs; arrays:DIR for level-array "
                          "inputs")

@@ -33,6 +33,7 @@ from heatmap_tpu.io.sinks import (  # noqa: F401
     MemorySink,
     PNGTileSink,
     open_sink,
+    validate_sink_spec,
 )
 from heatmap_tpu.io.png import colorize, png_bytes, raster_to_png  # noqa: F401
 from heatmap_tpu.io.merge import (  # noqa: F401

@@ -416,10 +416,32 @@ def per_process_sink_spec(spec: str, process_index: int) -> str:
     raise ValueError(f"unrecognized sink spec {spec!r}")
 
 
+#: Sink spec kinds ``open_sink`` accepts, in help order.
+SINK_KINDS = ("jsonl", "arrays", "arrays-parquet", "dir", "memory",
+              "cassandra")
+
+
+def validate_sink_spec(spec: str) -> str:
+    """Reject an unknown sink kind with a one-line error naming the
+    valid ones. Meant for argument-parse time: a typo like ``josnl:x``
+    must fail before backend init and ingest, not after the job has
+    already run for minutes. Returns ``spec`` so it can wrap an
+    argparse ``type=``."""
+    kind, sep, _ = spec.partition(":")
+    if (sep and kind in SINK_KINDS) or spec.endswith((".jsonl", ".ndjson")):
+        return spec
+    raise ValueError(
+        f"unrecognized sink spec {spec!r}: kind must be one of "
+        f"{', '.join(SINK_KINDS)} (e.g. jsonl:blobs.jsonl), or a bare "
+        f".jsonl/.ndjson path"
+    )
+
+
 def open_sink(spec: str) -> BlobSink:
     """CLI sink spec: ``jsonl:PATH``, ``dir:PATH``, ``memory:``,
     ``cassandra:``, ``arrays:DIR`` (columnar per-level npz) or a bare
     ``.jsonl`` path."""
+    validate_sink_spec(spec)
     kind, _, rest = spec.partition(":")
     if kind == "jsonl":
         return JSONLBlobSink(rest)
@@ -433,6 +455,4 @@ def open_sink(spec: str) -> BlobSink:
         return MemorySink()
     if kind == "cassandra":
         return CassandraBlobSink()
-    if spec.endswith((".jsonl", ".ndjson")):
-        return JSONLBlobSink(spec)
-    raise ValueError(f"unrecognized sink spec {spec!r}")
+    return JSONLBlobSink(spec)

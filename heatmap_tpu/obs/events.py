@@ -51,6 +51,10 @@ EVENT_SCHEMA = {
                   "optional": ("uptime_s",)},
     # utils/trace.py jax_profile failed to start (satellite fix).
     "profiler_unavailable": {"required": ("error",), "optional": ("logdir",)},
+    # serve/http.py per-request record (route is the coarse family,
+    # e.g. "tiles"; path the concrete URL; cache "hit"/"miss" on tiles).
+    "http_request": {"required": ("route", "status"),
+                     "optional": ("path", "ms", "bytes", "cache")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",

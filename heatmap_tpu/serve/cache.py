@@ -1,0 +1,195 @@
+"""TileCache: thread-safe LRU (byte cap) + TTL + single-flight renders.
+
+Serving semantics drive the three mechanisms:
+
+- **LRU by bytes, not entries** — tile payloads span two orders of
+  magnitude (a 4-cell JSON doc vs a dense 256px PNG), so an entry-count
+  cap would let a few hot dense tiles evict thousands of cheap ones.
+- **TTL** — a decayed live layer (serve/live.py) and operators pointing
+  the store at a directory another job is rewriting both need staleness
+  bounded by wall-clock, not only by explicit invalidation.
+- **Single-flight** — N concurrent misses on one cold tile must render
+  ONCE: the first requester becomes the flight leader, the rest block
+  on its event and share the result (or its exception). Without this, a
+  popular tile going cold stampedes the renderer with N identical
+  renders — the classic cache-stampede failure under map-client load.
+
+Invalidation is generation-based: every entry is stamped with the
+store generation it was rendered from; ``store.reload()`` bumps the
+generation and stale entries die lazily on next touch (no O(cache)
+sweep on the serving path). Live-stream ticks instead call
+``invalidate_keys`` with just the affected tile keys.
+
+Instrumented on the existing obs registry:
+``tile_cache_{hits,misses,evictions}_total`` and the
+``tile_render_seconds`` histogram (observed around the leader's render
+only — follower waits are not renders).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+from heatmap_tpu import obs
+
+_registry = obs.get_registry()
+CACHE_HITS = _registry.counter(
+    "tile_cache_hits_total", "Tile requests served from the cache")
+CACHE_MISSES = _registry.counter(
+    "tile_cache_misses_total", "Tile requests that required a render")
+CACHE_EVICTIONS = _registry.counter(
+    "tile_cache_evictions_total", "Cache entries dropped",
+    labelnames=("reason",))
+RENDER_SECONDS = _registry.histogram(
+    "tile_render_seconds", "Wall-clock of on-demand tile renders",
+    labelnames=("format",),
+    buckets=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "generation", "expires")
+
+    def __init__(self, value, nbytes, generation, expires):
+        self.value = value
+        self.nbytes = nbytes
+        self.generation = generation
+        self.expires = expires
+
+
+class _Flight:
+    """One in-progress render; followers wait on ``done``."""
+
+    __slots__ = ("done", "value", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.value = None
+        self.error = None
+
+
+class TileCache:
+    """Keys are opaque hashables (the server uses
+    ``(layer, z, x, y, fmt)``); values are bytes-like (sized via
+    ``len``). ``max_bytes <= 0`` disables caching but keeps
+    single-flight dedup — concurrent identical renders still coalesce.
+    """
+
+    def __init__(self, max_bytes: int = 256 << 20,
+                 ttl_s: float | None = None, clock=time.monotonic):
+        self.max_bytes = int(max_bytes)
+        self.ttl_s = ttl_s if (ttl_s is None or ttl_s > 0) else None
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict" = OrderedDict()
+        self._flights: dict = {}
+        self._bytes = 0
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def nbytes(self) -> int:
+        return self._bytes
+
+    def __len__(self):
+        return len(self._entries)
+
+    # -- core --------------------------------------------------------------
+
+    def get_or_render(self, key, generation: int, render_fn, *,
+                      fmt: str = "tile"):
+        """Cached value for ``key`` at ``generation``, rendering at most
+        once across concurrent callers. ``render_fn()`` runs OUTSIDE the
+        cache lock. Returns ``(value, hit)``; render errors propagate to
+        every waiter of that flight (and are not cached)."""
+        while True:
+            with self._lock:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    if entry.generation != generation or (
+                            entry.expires is not None
+                            and self._clock() >= entry.expires):
+                        reason = ("stale" if entry.generation != generation
+                                  else "ttl")
+                        self._drop(key, entry, reason)
+                    else:
+                        self._entries.move_to_end(key)
+                        if obs.metrics_enabled():
+                            CACHE_HITS.inc()
+                        return entry.value, True
+                flight = self._flights.get(key)
+                if flight is None:
+                    flight = self._flights[key] = _Flight()
+                    leader = True
+                else:
+                    leader = False
+            if not leader:
+                flight.done.wait()
+                if flight.error is not None:
+                    raise flight.error
+                if obs.metrics_enabled():
+                    CACHE_HITS.inc()
+                return flight.value, True
+            # Flight leader: render outside the lock, publish, insert.
+            if obs.metrics_enabled():
+                CACHE_MISSES.inc()
+            t0 = self._clock()
+            try:
+                value = render_fn()
+            except BaseException as e:
+                flight.error = e
+                with self._lock:
+                    self._flights.pop(key, None)
+                flight.done.set()
+                raise
+            if obs.metrics_enabled():
+                RENDER_SECONDS.observe(self._clock() - t0, format=fmt)
+            flight.value = value
+            with self._lock:
+                self._flights.pop(key, None)
+                if value is not None and self.max_bytes > 0:
+                    self._insert(key, value, generation)
+            flight.done.set()
+            return value, False
+
+    def _insert(self, key, value, generation):
+        nbytes = len(value)
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old.nbytes
+        if nbytes > self.max_bytes:
+            return  # a single over-cap tile must not flush everything
+        expires = (self._clock() + self.ttl_s
+                   if self.ttl_s is not None else None)
+        self._entries[key] = _Entry(value, nbytes, generation, expires)
+        self._bytes += nbytes
+        while self._bytes > self.max_bytes and self._entries:
+            k, e = next(iter(self._entries.items()))
+            self._drop(k, e, "lru")
+
+    def _drop(self, key, entry, reason: str):
+        # Caller holds the lock.
+        self._entries.pop(key, None)
+        self._bytes -= entry.nbytes
+        if obs.metrics_enabled():
+            CACHE_EVICTIONS.inc(reason=reason)
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate_keys(self, keys) -> int:
+        """Drop specific entries (live-stream ticks: only the tiles a
+        batch touched). Returns how many were present."""
+        n = 0
+        with self._lock:
+            for key in keys:
+                entry = self._entries.get(key)
+                if entry is not None:
+                    self._drop(key, entry, "invalidated")
+                    n += 1
+        return n
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0

@@ -1,0 +1,207 @@
+"""Stdlib HTTP frontend: ThreadingHTTPServer over store + cache.
+
+Routes:
+
+- ``GET /tiles/{layer}/{z}/{x}/{y}.png``  — colormapped tile image
+- ``GET /tiles/{layer}/{z}/{x}/{y}.json`` — reference-compatible counts
+- ``GET /healthz``                        — store/cache stats (JSON)
+- ``GET /metrics``                        — Prometheus 0.0.4 text from
+  the process-wide obs registry (so serving metrics sit next to any
+  pipeline metrics the same process produced)
+- ``POST /reload``                        — re-read the store artifact;
+  the bumped generation lazily invalidates every cached tile
+
+Tiles carry **strong ETags** (crc32 of the payload — cheap, and tile
+payloads are small enough that collision risk is irrelevant for cache
+revalidation); a matching ``If-None-Match`` short-circuits to 304 with
+no body. The ETag comes from the cached bytes, so revalidation is a
+cache hit, not a re-render.
+
+One ServeApp is shared by every handler thread: TileStore swaps are
+atomic, TileCache is internally locked, and the obs registry is
+thread-safe — the handler itself holds no mutable state. Request
+logging goes to the obs event log (``http_request`` events), never
+stdout: ``log_message`` is overridden because the serve tree is under
+the raw-print grep guard (tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from heatmap_tpu import obs
+from heatmap_tpu.serve.cache import TileCache
+from heatmap_tpu.serve.render import tile_json_bytes, tile_png_bytes
+from heatmap_tpu.serve.store import TileStore
+
+_registry = obs.get_registry()
+HTTP_REQUESTS = _registry.counter(
+    "http_requests_total", "HTTP requests served",
+    labelnames=("route", "status"))
+
+_TILE_RE = re.compile(
+    r"^/tiles/(?P<layer>[^/]+)/(?P<z>\d{1,2})/(?P<x>\d+)/(?P<y>\d+)"
+    r"\.(?P<fmt>png|json)$")
+
+_CONTENT_TYPES = {"png": "image/png", "json": "application/json"}
+
+
+def _etag(body: bytes) -> str:
+    return f'"{zlib.crc32(body):08x}"'
+
+
+class ServeApp:
+    """Transport-free request core: ``handle()`` maps (method, path,
+    if_none_match) -> (status, content_type, body, etag). The HTTP
+    handler below is a thin shell around it, which is what makes the
+    serving logic testable without sockets."""
+
+    def __init__(self, store: TileStore, cache: TileCache | None = None):
+        self.store = store
+        self.cache = cache if cache is not None else TileCache()
+        self._extra_layers: dict = {}
+
+    # -- layers ------------------------------------------------------------
+
+    def attach_layer(self, name: str, layer) -> None:
+        """Mount a non-store layer (live mode). Attached layers survive
+        ``/reload`` — that re-reads the artifact only."""
+        self._extra_layers[name] = layer
+
+    def layer(self, name: str):
+        found = self._extra_layers.get(name)
+        return found if found is not None else self.store.layer(name)
+
+    def layer_names(self) -> list:
+        return sorted(set(self.store.layer_names()) | set(self._extra_layers))
+
+    # -- request core ------------------------------------------------------
+
+    def handle(self, method: str, path: str,
+               if_none_match: str | None = None):
+        """Returns ``(status, content_type, body, etag, route, cache)``;
+        ``body`` is b"" for 304s, ``cache`` is "hit"/"miss"/None."""
+        m = _TILE_RE.match(path)
+        if method == "GET" and m is not None:
+            return self._handle_tile(m, if_none_match)
+        if method == "GET" and path == "/healthz":
+            body = json.dumps(self._health(), indent=2).encode()
+            return 200, "application/json", body, None, "healthz", None
+        if method == "GET" and path == "/metrics":
+            body = _registry.render_prometheus().encode()
+            return (200, "text/plain; version=0.0.4", body, None,
+                    "metrics", None)
+        if method == "POST" and path == "/reload":
+            generation = self.store.reload()
+            body = json.dumps({"generation": generation}).encode()
+            return 200, "application/json", body, None, "reload", None
+        body = json.dumps({"error": "not found", "path": path}).encode()
+        return 404, "application/json", body, None, "other", None
+
+    def _handle_tile(self, m, if_none_match):
+        layer_name = m["layer"]
+        z, x, y = int(m["z"]), int(m["x"]), int(m["y"])
+        fmt = m["fmt"]
+        layer = self.layer(layer_name)
+        if layer is None or not (0 <= x < (1 << z) and 0 <= y < (1 << z)):
+            body = json.dumps({
+                "error": "unknown layer" if layer is None else "off-grid tile",
+                "layers": self.layer_names(),
+            }).encode()
+            return 404, "application/json", body, None, "tiles", None
+        render = tile_png_bytes if fmt == "png" else tile_json_bytes
+        body, hit = self.cache.get_or_render(
+            (layer_name, z, x, y, fmt), self.store.generation,
+            lambda: render(layer, z, x, y), fmt=fmt)
+        cache = "hit" if hit else "miss"
+        if body is None:
+            payload = json.dumps({"error": "empty tile"}).encode()
+            return 404, "application/json", payload, None, "tiles", cache
+        etag = _etag(body)
+        if if_none_match is not None and etag in if_none_match:
+            return 304, _CONTENT_TYPES[fmt], b"", etag, "tiles", cache
+        return 200, _CONTENT_TYPES[fmt], body, etag, "tiles", cache
+
+    def _health(self) -> dict:
+        stats = self.store.stats()
+        for name, layer in sorted(self._extra_layers.items()):
+            stats["layers"][name] = {
+                "user": layer.user,
+                "timespan": layer.timespan,
+                "detail_zooms": layer.detail_zooms,
+                "result_delta": layer.result_delta,
+                "rows": int(sum(len(l) for l in layer.levels.values())),
+                "live": True,
+            }
+        stats["cache"] = {"entries": len(self.cache),
+                          "bytes": self.cache.nbytes}
+        stats["status"] = "ok"
+        return stats
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    # Keep-alive + small responses otherwise hit the Nagle/delayed-ACK
+    # interaction: every cached tile pays a ~40ms ACK stall.
+    disable_nagle_algorithm = True
+    app: ServeApp  # bound by make_server
+
+    def _dispatch(self, method: str):
+        t0 = time.monotonic()
+        try:
+            status, ctype, body, etag, route, cache = self.app.handle(
+                method, self.path, self.headers.get("If-None-Match"))
+        except Exception as e:  # defensive: a render bug must not kill serving
+            status, ctype, route, cache = 500, "application/json", "error", None
+            body = json.dumps({"error": repr(e)}).encode()
+            etag = None
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        if etag is not None:
+            self.send_header("ETag", etag)
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+        if obs.metrics_enabled():
+            HTTP_REQUESTS.inc(route=route, status=str(status))
+        obs.emit("http_request", route=route, status=int(status),
+                 path=self.path, ms=round((time.monotonic() - t0) * 1e3, 3),
+                 bytes=len(body), **({"cache": cache} if cache else {}))
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def log_message(self, format, *args):  # noqa: A002 - stdlib signature
+        pass  # request logging goes through obs events, never stdout
+
+
+def make_server(app: ServeApp, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bound-but-not-serving ThreadingHTTPServer (port 0 = ephemeral;
+    read the real one from ``server.server_address[1]``). Caller runs
+    ``serve_forever()`` — inline (CLI) or in a thread (tests/bench)."""
+    handler = type("Handler", (_Handler,), {"app": app})
+    server = ThreadingHTTPServer((host, port), handler)
+    server.daemon_threads = True
+    return server
+
+
+def serve_in_thread(app: ServeApp, host: str = "127.0.0.1", port: int = 0):
+    """Test/bench helper: returns ``(server, base_url)`` with
+    serve_forever running on a daemon thread; ``server.shutdown()``
+    stops it."""
+    server = make_server(app, host, port)
+    thread = threading.Thread(target=server.serve_forever,
+                              name="serve-http", daemon=True)
+    thread.start()
+    h, p = server.server_address[:2]
+    return server, f"http://{h}:{p}"

@@ -1,0 +1,89 @@
+"""LiveLayer: serve a decaying HeatmapStream window as a tile layer.
+
+Replaces the write-PNGs-to-a-directory stream output (``stream
+--output live_tiles``) as the real-time path: the stream's HBM raster
+is snapshotted once per micro-batch tick and indexed like any stored
+level, so the HTTP frontend serves it through the exact same
+store/cache/render machinery as batch layers.
+
+Invalidation is **targeted**: each tick reports only the coarse tile
+keys the batch's points actually landed in (per zoom, both formats),
+and the server drops just those cache entries. Exponential decay does
+drift every *other* cached tile between renders — that staleness is
+bounded by the cache TTL, which is why ``cmd_serve`` forces a finite
+TTL in live mode instead of flushing the whole cache per tick.
+
+This is the only serve module that touches jax (through HeatmapStream);
+importing it is gated behind ``--follow-stream``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from heatmap_tpu.serve.store import Layer, Level
+from heatmap_tpu.tilemath.mercator import project_points_np
+from heatmap_tpu.tilemath.morton import morton_encode_np
+
+#: Tile formats the HTTP layer caches under — one invalidation key per
+#: (zoom, tile, format).
+TILE_FORMATS = ("png", "json")
+
+
+class LiveLayer(Layer):
+    """A Layer whose single level is the stream's current window raster.
+
+    ``tick(lat, lon, t)`` advances the stream one micro-batch, rebuilds
+    the level from a fresh snapshot, and returns the cache keys to
+    invalidate. Rebuild-on-tick (not on read) keeps the serving path
+    lock-free: readers always see a complete, immutable Level; the swap
+    is a single attribute store under ``_swap_lock``.
+    """
+
+    def __init__(self, stream, name: str = "live",
+                 result_delta: int | None = None):
+        window = stream.config.window
+        delta = (min(5, int(window.zoom)) if result_delta is None
+                 else int(result_delta))
+        super().__init__(user=name, timespan="live", result_delta=delta)
+        self.name = name
+        self.stream = stream
+        self.window = window
+        self._swap_lock = threading.Lock()
+        self._refresh()
+
+    def _refresh(self):
+        raster = self.stream.snapshot()
+        rr, cc = np.nonzero(raster)
+        level = Level(
+            self.window.zoom,
+            morton_encode_np(rr.astype(np.int64) + int(self.window.row0),
+                             cc.astype(np.int64) + int(self.window.col0)),
+            raster[rr, cc].astype(np.float64),
+        )
+        with self._swap_lock:
+            self.levels = {int(self.window.zoom): level}
+
+    def tick(self, lat, lon, t: float, weights=None) -> set:
+        """One micro-batch; returns the affected cache keys:
+        ``(layer_name, z, x, y, fmt)`` for every coarse tile (at every
+        zoom up to the window zoom) containing a batch point."""
+        self.stream.update(lat, lon, t, weights=weights)
+        self._refresh()
+        return self.affected_keys(lat, lon)
+
+    def affected_keys(self, lat, lon) -> set:
+        zoom = int(self.window.zoom)
+        row, col, valid = project_points_np(lat, lon, zoom)
+        row, col = row[valid], col[valid]
+        keys: set = set()
+        for z in range(zoom + 1):
+            shift = zoom - z
+            tiles = np.unique(np.stack([row >> shift, col >> shift], 1),
+                              axis=0)
+            for r, c in tiles:
+                for fmt in TILE_FORMATS:
+                    keys.add((self.name, z, int(c), int(r), fmt))
+        return keys

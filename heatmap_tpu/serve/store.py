@@ -1,0 +1,318 @@
+"""TileStore: batch egress -> read-optimized per-zoom tile index.
+
+Loads any batch egress artifact the job side writes —
+
+- ``arrays:DIR``   columnar per-level npz (LevelArraysSink), including
+                   a directory of multihost ``host*/`` shards, merged
+                   through the existing io/merge.py level mergers;
+- ``jsonl:PATH``   blob records (JSONLBlobSink lines);
+- ``dir:PATH``     one blob JSON file per id (DirectoryBlobSink);
+
+— into per-layer, per-detail-zoom **Morton-keyed sorted arrays**
+(tilemath/morton.py): a tile request at coarse tile (z, row, col) is a
+single ``searchsorted`` range probe, because every detail tile under a
+coarse tile is a contiguous Morton range ``[code << 2d, (code+1) << 2d)``.
+
+Layers map the reference's blob-id prefix (``user|timespan``) to URL
+path segments. By default every (user, timespan) pair present in the
+artifact becomes a layer named ``user|timespan``, and ``default``
+aliases ``all|alltime`` when present — so a fresh count job serves at
+``/tiles/default/...`` with zero configuration.
+
+``reload()`` re-reads the artifact and atomically swaps the index,
+bumping ``generation`` — the cache invalidation token — so a newer job
+run is picked up without restarting the server.
+
+Numpy-only on purpose: no jax import, no backend init (the io/merge.py
+offline discipline) — a tile server must keep serving when the
+accelerator relay is down.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+
+from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.tilemath.keys import parse_tile_id
+from heatmap_tpu.tilemath.morton import morton_encode_np
+
+#: Store spec kinds ``TileStore`` accepts (subset of the sink kinds —
+#: the batch egress surfaces that persist to disk).
+STORE_KINDS = ("arrays", "jsonl", "dir")
+
+
+class Level:
+    """One detail-zoom slice of a layer: sorted Morton codes + values."""
+
+    __slots__ = ("zoom", "codes", "values", "vmax")
+
+    def __init__(self, zoom: int, codes: np.ndarray, values: np.ndarray):
+        order = np.argsort(codes, kind="stable")
+        self.zoom = int(zoom)
+        self.codes = np.asarray(codes, np.int64)[order]
+        self.values = np.asarray(values, np.float64)[order]
+        self.vmax = float(self.values.max()) if len(self.values) else 0.0
+
+    def range(self, lo: int, hi: int):
+        """(codes, values) with codes in ``[lo, hi)`` — one searchsorted
+        pair; Morton contiguity makes this the whole spatial query."""
+        i = np.searchsorted(self.codes, lo, side="left")
+        j = np.searchsorted(self.codes, hi, side="left")
+        return self.codes[i:j], self.values[i:j]
+
+    def lookup(self, code: int) -> float:
+        """Single-cell probe (ancestor fills); 0.0 on miss."""
+        i = int(np.searchsorted(self.codes, code, side="left"))
+        if i < len(self.codes) and int(self.codes[i]) == code:
+            return float(self.values[i])
+        return 0.0
+
+    def __len__(self):
+        return len(self.codes)
+
+
+class Layer:
+    """One (user, timespan) slice: detail levels + raw blob documents.
+
+    ``blob_json`` holds the verbatim on-disk JSON document per coarse
+    tile for blob-record stores (jsonl:/dir:), so the JSON endpoint
+    serves byte-identical bytes to the artifact. Columnar stores carry
+    no document form; render.py rebuilds it in stored-row order.
+    """
+
+    __slots__ = ("user", "timespan", "levels", "result_delta", "blob_json")
+
+    def __init__(self, user: str, timespan: str, result_delta: int | None):
+        self.user = user
+        self.timespan = timespan
+        self.levels: dict[int, Level] = {}
+        self.result_delta = result_delta
+        self.blob_json: dict[tuple, str] = {}
+
+    @property
+    def detail_zooms(self) -> list[int]:
+        return sorted(self.levels)
+
+    def source_zoom(self, detail_zoom: int) -> int | None:
+        """Nearest stored detail zoom for a wanted one: exact when
+        stored; else the closest FINER level (rollup is exact), else
+        the closest coarser (quadrant upsample)."""
+        if detail_zoom in self.levels:
+            return detail_zoom
+        finer = [z for z in self.levels if z > detail_zoom]
+        if finer:
+            return min(finer)
+        coarser = [z for z in self.levels if z < detail_zoom]
+        return max(coarser) if coarser else None
+
+
+def _parse_store_spec(spec: str) -> tuple[str, str]:
+    kind, sep, rest = spec.partition(":")
+    if sep and kind in STORE_KINDS:
+        return kind, rest
+    # Bare paths: sniff like open_source/open_sink do.
+    if spec.endswith((".jsonl", ".ndjson")):
+        return "jsonl", spec
+    if os.path.isdir(spec):
+        names = os.listdir(spec)
+        if any(n.startswith("level_z") for n in names) or any(
+                n.startswith("host") and
+                os.path.isdir(os.path.join(spec, n)) for n in names):
+            return "arrays", spec
+        return "dir", spec
+    raise ValueError(
+        f"unrecognized store spec {spec!r}: kind must be one of "
+        f"{', '.join(STORE_KINDS)} (e.g. arrays:levels/)"
+    )
+
+
+def _load_levels(path: str) -> dict[int, dict]:
+    """``arrays:`` loader: plain LevelArraysSink dir, or a directory of
+    multihost ``host*/`` shards merged through io/merge.py."""
+    names = sorted(os.listdir(path))
+    shard_dirs = [os.path.join(path, n) for n in names
+                  if n.startswith("host")
+                  and os.path.isdir(os.path.join(path, n))]
+    if shard_dirs and not any(n.startswith("level_z") for n in names):
+        from heatmap_tpu.io.merge import merge_level_dirs
+
+        merged = merge_level_dirs(shard_dirs)
+        out = {}
+        for lvl in merged:
+            # Finalized (dictionary-encoded) -> loaded (string columns),
+            # the shape LevelArraysSink.load returns.
+            cols = dict(lvl)
+            cols["user"] = lvl["user_names"][lvl["user_idx"]]
+            cols["timespan"] = lvl["timespan_names"][lvl["timespan_idx"]]
+            out[int(lvl["zoom"])] = cols
+        return out
+    return LevelArraysSink.load(path)
+
+
+def _iter_blob_records(kind: str, path: str):
+    """Yield (blob_id, raw_json_str) with last-write-wins per id —
+    JSONLBlobSink.load upsert semantics, raw strings preserved."""
+    if kind == "jsonl":
+        out: dict[str, str] = {}
+        with open(path) as f:
+            for line in f:
+                if line.strip():
+                    rec = json.loads(line)
+                    out[rec["id"]] = rec["heatmap"]
+        yield from out.items()
+        return
+    for name in sorted(os.listdir(path)):
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(path, name)) as f:
+            yield name[: -len(".json")], f.read()
+
+
+class TileStore:
+    """The serving index over one batch-egress artifact.
+
+    ``layers`` (optional) maps exposed layer names to ``"user|timespan"``
+    selectors; by default every pair found in the artifact is exposed
+    under its own ``user|timespan`` name plus the ``default`` alias for
+    ``all|alltime``. Unknown selectors raise at load time — a typo'd
+    ``--layers`` must not 404 forever at runtime.
+    """
+
+    def __init__(self, spec: str, layers: dict[str, str] | None = None):
+        self.spec = spec
+        self.kind, self.path = _parse_store_spec(spec)
+        self._layer_spec = dict(layers) if layers else None
+        self._lock = threading.Lock()
+        self.generation = 0
+        self._layers: dict[str, Layer] = {}
+        self.reload(_initial=True)
+
+    # -- queries -----------------------------------------------------------
+
+    @property
+    def layers(self) -> dict[str, Layer]:
+        return self._layers
+
+    def layer(self, name: str) -> Layer | None:
+        return self._layers.get(name)
+
+    def layer_names(self) -> list[str]:
+        return sorted(self._layers)
+
+    # -- (re)loading -------------------------------------------------------
+
+    def reload(self, _initial: bool = False) -> int:
+        """Re-read the artifact and atomically swap the index; returns
+        the new generation (the cache-invalidation token)."""
+        built = self._build()
+        with self._lock:
+            self._layers = built
+            if not _initial:
+                self.generation += 1
+            return self.generation
+
+    def _build(self) -> dict[str, Layer]:
+        if self.kind == "arrays":
+            by_pair = self._build_from_levels(_load_levels(self.path))
+        else:
+            by_pair = self._build_from_blobs(
+                _iter_blob_records(self.kind, self.path))
+        named: dict[str, Layer] = {}
+        if self._layer_spec is None:
+            for (user, ts), layer in by_pair.items():
+                named[f"{user}|{ts}"] = layer
+            if ("all", "alltime") in by_pair:
+                named.setdefault("default", by_pair[("all", "alltime")])
+        else:
+            for name, sel in self._layer_spec.items():
+                user, _, ts = sel.partition("|")
+                layer = by_pair.get((user, ts or "alltime"))
+                if layer is None:
+                    raise ValueError(
+                        f"layer {name!r}: no ({user!r}, {ts or 'alltime'!r}) "
+                        f"slice in {self.spec}; available: "
+                        f"{sorted('|'.join(p) for p in by_pair)}"
+                    )
+                named[name] = layer
+        return named
+
+    def _build_from_levels(self, levels: dict[int, dict]) -> dict:
+        by_pair: dict[tuple, Layer] = {}
+        for zoom in sorted(levels):
+            cols = levels[zoom]
+            users = np.asarray(cols["user"], str)
+            tss = np.asarray(cols["timespan"], str)
+            delta = int(cols["zoom"]) - int(cols["coarse_zoom"])
+            codes = morton_encode_np(
+                np.asarray(cols["row"], np.int64),
+                np.asarray(cols["col"], np.int64),
+            )
+            values = np.asarray(cols["value"], np.float64)
+            # One pass per (user, timespan) pair present at this level.
+            pair_key = np.char.add(np.char.add(users, "|"), tss)
+            for pk in np.unique(pair_key):
+                sel = pair_key == pk
+                user, _, ts = str(pk).partition("|")
+                layer = by_pair.setdefault((user, ts),
+                                           Layer(user, ts, delta))
+                layer.levels[int(zoom)] = Level(zoom, codes[sel],
+                                                values[sel])
+        return by_pair
+
+    def _build_from_blobs(self, records) -> dict:
+        staged: dict[tuple, dict[int, list]] = {}
+        by_pair: dict[tuple, Layer] = {}
+        for blob_id, raw in records:
+            try:
+                user, ts, coarse_id = blob_id.split("|", 2)
+            except ValueError:
+                continue  # not a heatmap blob id; skip like parse_tile_id
+            coarse = parse_tile_id(coarse_id)
+            if coarse is None:
+                continue
+            heat = json.loads(raw)
+            layer = by_pair.get((user, ts))
+            if layer is None:
+                layer = by_pair[(user, ts)] = Layer(user, ts, None)
+            layer.blob_json[coarse] = raw
+            buckets = staged.setdefault((user, ts), {})
+            for tid, value in heat.items():
+                parsed = parse_tile_id(tid)
+                if parsed is None:
+                    continue
+                z, r, c = parsed
+                buckets.setdefault(z, []).append((r, c, float(value)))
+                if layer.result_delta is None:
+                    layer.result_delta = z - coarse[0]
+        for pair, buckets in staged.items():
+            layer = by_pair[pair]
+            for zoom, rows in buckets.items():
+                arr = np.asarray(rows, np.float64)
+                layer.levels[zoom] = Level(
+                    zoom,
+                    morton_encode_np(arr[:, 0].astype(np.int64),
+                                     arr[:, 1].astype(np.int64)),
+                    arr[:, 2],
+                )
+        return by_pair
+
+    def stats(self) -> dict:
+        """Small JSON-ready summary for /healthz."""
+        return {
+            "spec": self.spec,
+            "generation": self.generation,
+            "layers": {
+                name: {
+                    "user": layer.user,
+                    "timespan": layer.timespan,
+                    "detail_zooms": layer.detail_zooms,
+                    "result_delta": layer.result_delta,
+                    "rows": int(sum(len(l) for l in layer.levels.values())),
+                }
+                for name, layer in sorted(self._layers.items())
+            },
+        }

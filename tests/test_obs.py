@@ -35,6 +35,9 @@ _PAYLOADS = {
     "heartbeat": {"process_index": 0, "process_count": 1,
                   "phase": "ingest_done", "uptime_s": 1.5},
     "profiler_unavailable": {"error": "RuntimeError('no profiler')"},
+    "http_request": {"route": "tiles", "status": 200,
+                     "path": "/tiles/default/7/20/44.json", "ms": 1.2,
+                     "bytes": 512, "cache": "hit"},
     "run_end": {"status": "ok", "blobs": 42, "checksum": "crc32:00000000",
                 "seconds": 1.0},
 }
@@ -438,3 +441,17 @@ class TestNoRawInstrumentation:
             "raw print()/time.perf_counter() outside obs//trace.py — "
             "route instrumentation through heatmap_tpu.obs: "
             + ", ".join(offenders))
+
+    def test_serve_tree_is_guarded(self):
+        """The serve/ package is the layer MOST tempted to print (HTTP
+        request logging) and to time ad hoc (render latency): pin that
+        it exists, is scanned by the walk above, and is not allowed."""
+        serve = os.path.join(REPO, "heatmap_tpu", "serve")
+        assert os.path.isdir(serve)
+        scanned = [f for f in os.listdir(serve) if f.endswith(".py")]
+        assert "http.py" in scanned and "cache.py" in scanned
+        assert not any(a.startswith("heatmap_tpu/serve")
+                       for a in self.ALLOWED)
+        # And the guard pattern does bite on what serve must not do.
+        assert self.PATTERN.search("print('GET /tiles 200')")
+        assert self.PATTERN.search("t0 = time.perf_counter()")

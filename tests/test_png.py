@@ -1,0 +1,121 @@
+"""Golden-vector tests for the stdlib PNG encoder (io/png.py).
+
+The encoder is zero-dependency by design, so the decoder here is too:
+chunk walking + CRC verification + zlib inflate + filter-byte strip,
+all stdlib. Pixel round-trips pin the wire format for every supported
+color type; the colormap tests pin the perceptual contract the serving
+path relies on (more mass never renders darker, empty renders clear).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.io.png import colorize, png_bytes, raster_to_png
+
+SIGNATURE = b"\x89PNG\r\n\x1a\n"
+CHANNELS = {0: 1, 2: 3, 6: 4}  # gray, RGB, RGBA
+
+
+def iter_chunks(data: bytes):
+    """Yield (tag, payload), verifying EVERY chunk CRC against the spec
+    definition: crc32 over tag+payload."""
+    assert data[:8] == SIGNATURE, "bad PNG signature"
+    off = 8
+    while off < len(data):
+        (length,) = struct.unpack(">I", data[off:off + 4])
+        tag = data[off + 4:off + 8]
+        payload = data[off + 8:off + 8 + length]
+        (crc,) = struct.unpack(
+            ">I", data[off + 8 + length:off + 12 + length])
+        assert crc == (zlib.crc32(tag + payload) & 0xFFFFFFFF), (
+            f"CRC mismatch in {tag!r} chunk")
+        yield tag, payload
+        off += 12 + length
+
+
+def decode_png(data: bytes) -> np.ndarray:
+    """Minimal stdlib decoder for images png_bytes produces (8-bit,
+    filter 0, no interlace)."""
+    chunks = list(iter_chunks(data))
+    tags = [t for t, _ in chunks]
+    assert tags[0] == b"IHDR" and tags[-1] == b"IEND"
+    w, h, depth, color_type, comp, filt, interlace = struct.unpack(
+        ">IIBBBBB", chunks[0][1])
+    assert (depth, comp, filt, interlace) == (8, 0, 0, 0)
+    ch = CHANNELS[color_type]
+    raw = zlib.decompress(
+        b"".join(p for t, p in chunks if t == b"IDAT"))
+    rows = np.frombuffer(raw, np.uint8).reshape(h, 1 + w * ch)
+    assert (rows[:, 0] == 0).all(), "png_bytes writes filter type 0 only"
+    img = rows[:, 1:].reshape(h, w, ch)
+    return img[..., 0] if ch == 1 else img
+
+
+class TestWireFormat:
+    def test_signature_ihdr_and_chunk_order(self):
+        data = png_bytes(np.arange(6, dtype=np.uint8).reshape(2, 3))
+        tags = [t for t, _ in iter_chunks(data)]
+        assert tags == [b"IHDR", b"IDAT", b"IEND"]
+        _, ihdr = next(iter_chunks(data))
+        w, h, depth, color_type = struct.unpack(">IIBB", ihdr[:10])
+        assert (w, h, depth, color_type) == (3, 2, 8, 0)
+
+    def test_corruption_is_detected(self):
+        data = bytearray(png_bytes(np.zeros((4, 4), np.uint8)))
+        data[40] ^= 0xFF  # somewhere inside IDAT payload
+        with pytest.raises(AssertionError, match="CRC mismatch"):
+            list(iter_chunks(bytes(data)))
+
+    @pytest.mark.parametrize("shape,color_type", [
+        ((5, 7), 0), ((4, 3, 3), 2), ((3, 4, 4), 6)])
+    def test_pixel_roundtrip(self, shape, color_type):
+        rng = np.random.default_rng(sum(shape))
+        img = rng.integers(0, 256, shape, dtype=np.uint8)
+        # Pin the extremes explicitly: filter-0 rows must carry 0x00
+        # and 0xFF through compression untouched.
+        img.flat[0], img.flat[-1] = 0, 255
+        out = decode_png(png_bytes(img))
+        np.testing.assert_array_equal(out, img)
+
+    def test_rejects_non_uint8_and_bad_shapes(self):
+        with pytest.raises(ValueError, match="uint8"):
+            png_bytes(np.zeros((2, 2), np.float32))
+        with pytest.raises(ValueError, match="shape"):
+            png_bytes(np.zeros((2, 2, 2), np.uint8))
+
+
+class TestColormap:
+    def test_monotone_brightness(self):
+        """Higher count must never render darker (at fixed vmax) — the
+        invariant that makes adjacent served tiles comparable."""
+        counts = np.arange(0, 1001, dtype=np.float64)[None, :]
+        rgba = colorize(counts, vmax=1000.0)
+        brightness = rgba[0, :, :3].astype(np.int64).sum(axis=1)
+        assert (np.diff(brightness) >= 0).all()
+        assert brightness[-1] > brightness[1]  # actually spans the ramp
+
+    def test_alpha_marks_empty_cells(self):
+        raster = np.array([[0.0, 1.0], [3.0, 0.0]])
+        rgba = colorize(raster)
+        np.testing.assert_array_equal(
+            rgba[..., 3], np.where(raster > 0, 255, 0))
+        assert colorize(raster, alpha=False)[..., 3].min() == 255
+
+    def test_vmax_pins_the_scale_across_tiles(self):
+        """The same count must colorize identically whatever else is in
+        the tile — the shared-vmax contract serve/render.py uses."""
+        a = colorize(np.array([[5.0, 50.0]]), vmax=100.0)
+        b = colorize(np.array([[5.0, 100.0]]), vmax=100.0)
+        np.testing.assert_array_equal(a[0, 0], b[0, 0])
+
+    def test_raster_to_png_roundtrip(self):
+        raster = np.array([[0.0, 2.0], [7.0, 0.0]])
+        img = decode_png(raster_to_png(raster))
+        assert img.shape == (2, 2, 4)
+        np.testing.assert_array_equal(
+            img[..., 3], np.where(raster > 0, 255, 0))

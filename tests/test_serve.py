@@ -1,0 +1,499 @@
+"""Tile-serving subsystem tests: store, cache, render, HTTP, live.
+
+Tier-1 throughout: CPU backend, loopback sockets only (in-process
+ThreadingHTTPServer on an ephemeral port), and artifacts produced by
+the real batch pipeline so the serving path is tested against exactly
+what jobs write — including the byte-identity contract between
+``GET .../{z}/{x}/{y}.json`` and the blob-sink JSON for the same tile.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.serve import ServeApp, TileCache, TileStore, serve_in_thread
+from heatmap_tpu.serve.render import tile_array, tile_json_bytes, tile_png_bytes
+from heatmap_tpu.serve.store import Layer, Level
+from heatmap_tpu.tilemath.morton import morton_encode_np
+
+
+@pytest.fixture(scope="module")
+def artifacts(tmp_path_factory):
+    """One small batch job, egressed BOTH ways: columnar arrays and
+    jsonl blobs (same points, so the two stores must serve identical
+    JSON documents)."""
+    from heatmap_tpu.io import open_sink, open_source
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    root = tmp_path_factory.mktemp("serve_artifacts")
+    config = BatchJobConfig(detail_zoom=10, min_detail_zoom=5)
+    blobs = None
+    for spec in (f"arrays:{root}/levels", f"jsonl:{root}/blobs.jsonl"):
+        with open_sink(spec) as sink:
+            out = run_job(open_source("synthetic:3000:7"), sink, config)
+            if spec.startswith("jsonl:"):
+                blobs = out
+    assert blobs
+    return {"arrays": f"arrays:{root}/levels",
+            "jsonl": f"jsonl:{root}/blobs.jsonl",
+            "path": root}
+
+
+def _blob_docs(jsonl_path):
+    docs = {}
+    with open(jsonl_path) as f:
+        for line in f:
+            if line.strip():
+                rec = json.loads(line)
+                docs[rec["id"]] = rec["heatmap"]
+    return docs
+
+
+class TestTileStore:
+    def test_layers_and_default_alias(self, artifacts):
+        sa = TileStore(artifacts["arrays"])
+        sj = TileStore(artifacts["jsonl"])
+        assert sa.layer_names() == sj.layer_names()
+        assert "default" in sa.layer_names()
+        assert sa.layer("default").user == "all"
+        assert sa.layer("default").timespan == "alltime"
+        # default is an alias, not a copy
+        assert sa.layer("default") is sa.layer("all|alltime")
+
+    def test_layer_selection_and_unknown_selector(self, artifacts):
+        store = TileStore(artifacts["arrays"],
+                          layers={"heat": "all|alltime"})
+        assert store.layer_names() == ["heat"]
+        with pytest.raises(ValueError, match="no-such-user"):
+            TileStore(artifacts["arrays"], layers={"x": "no-such-user"})
+
+    def test_unknown_store_kind_is_one_line_error(self, tmp_path):
+        with pytest.raises(ValueError, match="arrays, jsonl, dir"):
+            TileStore(f"arras:{tmp_path}")
+
+    def test_reload_bumps_generation(self, artifacts):
+        store = TileStore(artifacts["arrays"])
+        g0 = store.generation
+        assert store.reload() == g0 + 1
+        assert store.generation == g0 + 1
+
+    def test_level_range_is_the_morton_contract(self, artifacts):
+        """Every value under a coarse tile is in [code<<2d,(code+1)<<2d)
+        — the searchsorted range must reproduce a brute-force scan."""
+        layer = TileStore(artifacts["arrays"]).layer("default")
+        d = layer.detail_zooms[-1]
+        level = layer.levels[d]
+        delta = layer.result_delta
+        coarse = int(level.codes[len(level) // 2]) >> (2 * delta)
+        codes, values = level.range(coarse << (2 * delta),
+                                    (coarse + 1) << (2 * delta))
+        mask = (level.codes >> (2 * delta)) == coarse
+        np.testing.assert_array_equal(codes, level.codes[mask])
+        np.testing.assert_array_equal(values, level.values[mask])
+        assert len(codes) > 0
+
+    def test_multihost_shard_dirs_merge(self, tmp_path):
+        """arrays: pointed at a dir of host*/ shards loads the merged
+        pyramid — total mass is the sum of the shards'."""
+        from heatmap_tpu.io import open_sink, open_source
+        from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+        config = BatchJobConfig(detail_zoom=8, min_detail_zoom=5)
+        masses = []
+        for host in ("host000", "host001"):
+            with open_sink(f"arrays:{tmp_path}/{host}") as sink:
+                run_job(open_source(f"synthetic:500:{len(masses)}"),
+                        sink, config)
+            masses.append(sum(
+                TileStore(f"arrays:{tmp_path}/{host}")
+                .layer("default").levels[8].values.sum() for _ in (0,)))
+        merged = TileStore(f"arrays:{tmp_path}")
+        got = merged.layer("default").levels[8].values.sum()
+        assert got == pytest.approx(sum(masses))
+
+
+class TestRenderJSON:
+    def test_every_blob_byte_matches_both_stores(self, artifacts):
+        """THE serving parity contract: the JSON endpoint's bytes for a
+        stored tile equal the blob-sink JSON document, whether the
+        store loaded columnar arrays or the blob records themselves."""
+        sa = TileStore(artifacts["arrays"])
+        sj = TileStore(artifacts["jsonl"])
+        docs = _blob_docs(f"{artifacts['path']}/blobs.jsonl")
+        assert docs
+        checked = 0
+        for blob_id, raw in docs.items():
+            user, ts, tid = blob_id.split("|", 2)
+            z, r, c = map(int, tid.split("_"))
+            for store in (sa, sj):
+                got = tile_json_bytes(store.layer(f"{user}|{ts}"), z, c, r)
+                assert got == raw.encode(), (blob_id, store.kind)
+            checked += 1
+        assert checked == len(docs)
+
+    def test_empty_tile_is_none(self, artifacts):
+        layer = TileStore(artifacts["arrays"]).layer("default")
+        # zoom-5 coarse grid corner: synthetic data is a Seattle-ish
+        # cluster, so tile (5,0,0) (Arctic/antimeridian) is empty.
+        assert tile_json_bytes(layer, 5, 0, 0) is None
+        assert tile_png_bytes(layer, 5, 0, 0) is None
+
+
+def _layer_with_level(zoom, rows, cols, values, delta=2):
+    layer = Layer("u", "t", result_delta=delta)
+    layer.levels[zoom] = Level(
+        zoom,
+        morton_encode_np(np.asarray(rows, np.int64),
+                         np.asarray(cols, np.int64)),
+        np.asarray(values, np.float64),
+    )
+    return layer
+
+
+class TestSynthesizedZooms:
+    """Hand-built single-level layers make every synthesis path exact
+    and checkable: rollup (finer source), quadrant upsample (coarser
+    source), ancestor fill (tile inside one stored cell)."""
+
+    def test_rollup_conserves_and_places_mass(self):
+        # Stored detail zoom 6; request tile (z=2, x=1, y=1) at delta 2
+        # -> want zoom 4, rollup shift 2 zooms. Zoom-6 rows/cols 16..31
+        # live under zoom-2 tile (1,1), whose 4x4 want-zoom raster
+        # covers zoom-4 rows/cols 4..7.
+        layer = _layer_with_level(
+            6, rows=[16, 17, 21], cols=[16, 16, 21], values=[1.0, 2.0, 4.0])
+        raster, src = tile_array(layer, 2, 1, 1)
+        assert src == 6
+        # zoom-6 (16..17,16)>>2 -> zoom-4 (4,4) -> raster (0,0);
+        # zoom-6 (21,21)>>2    -> zoom-4 (5,5) -> raster (1,1)
+        expected = np.zeros((4, 4))
+        expected[0, 0] = 3.0
+        expected[1, 1] = 4.0
+        np.testing.assert_array_equal(raster, expected)
+
+    def test_exact_zoom_matches_rollup_of_itself(self):
+        layer = _layer_with_level(
+            4, rows=[8, 9], cols=[8, 11], values=[5.0, 7.0])
+        raster, src = tile_array(layer, 2, 2, 2)
+        assert src == 4
+        expected = np.zeros((4, 4))
+        expected[0, 0] = 5.0
+        expected[1, 3] = 7.0
+        np.testing.assert_array_equal(raster, expected)
+
+    def test_quadrant_upsample_paints_blocks(self):
+        # Stored zoom 4 only; request (z=1, x=0, y=0) -> want zoom 3,
+        # source coarser path: side=2^(4-1)=8 > px=4? No: src>=z and
+        # src<want requires src in (z, want); use delta 2, z=1, want=3,
+        # src=... stored 2: side=2, k=2.
+        layer = _layer_with_level(
+            2, rows=[0, 1], cols=[0, 1], values=[3.0, 9.0])
+        raster, src = tile_array(layer, 1, 0, 0)
+        assert src == 2
+        expected = np.kron(np.array([[3.0, 0.0], [0.0, 9.0]]),
+                           np.ones((2, 2)))
+        np.testing.assert_array_equal(raster, expected)
+
+    def test_ancestor_fill(self):
+        # Stored zoom 1; request z=3 (finer than stored): the whole
+        # requested tile sits inside one stored cell.
+        layer = _layer_with_level(1, rows=[1], cols=[0], values=[6.0])
+        raster, src = tile_array(layer, 3, 1, 5)  # (3,5,1)>>2 == (1,1,0)
+        assert src == 1
+        assert (raster == 6.0).all()
+        empty, _ = tile_array(layer, 3, 7, 1)  # under empty cell (1,0,1)
+        assert empty is None
+
+
+class TestTileCache:
+    def test_lru_evicts_by_bytes(self):
+        cache = TileCache(max_bytes=100)
+        for i, key in enumerate(("a", "b", "c")):
+            cache.get_or_render(key, 0, lambda: b"x" * 40)
+        # 3*40 > 100 -> "a" (least recent) evicted
+        assert len(cache) == 2
+        _, hit = cache.get_or_render("b", 0, lambda: b"new")
+        assert hit  # b survived
+        _, hit = cache.get_or_render("a", 0, lambda: b"re-rendered")
+        assert not hit
+
+    def test_ttl_expiry_with_fake_clock(self):
+        now = [0.0]
+        cache = TileCache(max_bytes=1000, ttl_s=10.0, clock=lambda: now[0])
+        cache.get_or_render("k", 0, lambda: b"v")
+        now[0] = 9.9
+        assert cache.get_or_render("k", 0, lambda: b"v2")[1] is True
+        now[0] = 10.1
+        value, hit = cache.get_or_render("k", 0, lambda: b"v2")
+        assert (value, hit) == (b"v2", False)
+
+    def test_generation_invalidates_lazily(self):
+        cache = TileCache(max_bytes=1000)
+        cache.get_or_render("k", 1, lambda: b"gen1")
+        value, hit = cache.get_or_render("k", 2, lambda: b"gen2")
+        assert (value, hit) == (b"gen2", False)
+
+    def test_invalidate_keys_is_targeted(self):
+        cache = TileCache(max_bytes=1000)
+        for key in ("keep", "drop"):
+            cache.get_or_render(key, 0, lambda: b"v")
+        assert cache.invalidate_keys(["drop", "absent"]) == 1
+        assert cache.get_or_render("keep", 0, lambda: b"")[1] is True
+        assert cache.get_or_render("drop", 0, lambda: b"")[1] is False
+
+    def test_single_flight_8_concurrent_first_requests_render_once(self):
+        cache = TileCache(max_bytes=1000)
+        renders = []
+        gate = threading.Event()
+
+        def render():
+            renders.append(1)
+            gate.wait(5)
+            return b"tile-bytes"
+
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(
+                cache.get_or_render("tile", 0, render)))
+            for _ in range(8)]
+        for t in threads:
+            t.start()
+        # All 8 in flight against a cold key before the render finishes.
+        for _ in range(100):
+            if len(renders) == 1:
+                break
+            threading.Event().wait(0.01)
+        gate.set()
+        for t in threads:
+            t.join(10)
+        assert len(renders) == 1, "N concurrent misses must render once"
+        assert len(results) == 8
+        assert all(v == b"tile-bytes" for v, _ in results)
+        assert sum(1 for _, hit in results if not hit) == 1
+
+    def test_single_flight_error_propagates_and_is_not_cached(self):
+        cache = TileCache(max_bytes=1000)
+
+        def boom():
+            raise RuntimeError("render failed")
+
+        with pytest.raises(RuntimeError, match="render failed"):
+            cache.get_or_render("k", 0, boom)
+        value, hit = cache.get_or_render("k", 0, lambda: b"recovered")
+        assert (value, hit) == (b"recovered", False)
+
+    def test_zero_budget_disables_storage_not_dedup(self):
+        cache = TileCache(max_bytes=0)
+        cache.get_or_render("k", 0, lambda: b"v")
+        assert len(cache) == 0
+        assert cache.get_or_render("k", 0, lambda: b"v")[1] is False
+
+
+@pytest.fixture()
+def served(artifacts):
+    from heatmap_tpu import obs
+
+    obs.enable_metrics(True)  # /metrics is part of the surface under test
+    store = TileStore(artifacts["arrays"])
+    app = ServeApp(store, TileCache(max_bytes=1 << 20, ttl_s=None))
+    server, base = serve_in_thread(app)
+    yield app, base
+    server.shutdown()
+    server.server_close()
+
+
+def _get(url, **headers):
+    req = urllib.request.Request(url, headers=headers)
+    try:
+        resp = urllib.request.urlopen(req)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _pick_tile(app):
+    layer = app.store.layer("default")
+    d = layer.detail_zooms[-1]
+    delta = layer.result_delta
+    code = int(layer.levels[d].codes[0]) >> (2 * delta)
+    from heatmap_tpu.tilemath.morton import morton_decode_np
+
+    r, c = morton_decode_np(np.asarray([code], np.int64))
+    return d - delta, int(c[0]), int(r[0])
+
+
+@pytest.mark.usefixtures("served")
+class TestHTTP:
+    def test_json_200_etag_304_and_metrics(self, served):
+        app, base = served
+        z, x, y = _pick_tile(app)
+        url = f"{base}/tiles/default/{z}/{x}/{y}.json"
+        status, headers, body = _get(url)
+        assert status == 200
+        assert headers["Content-Type"] == "application/json"
+        assert body == tile_json_bytes(app.store.layer("default"), z, x, y)
+        etag = headers["ETag"]
+        # ETag is stable across requests...
+        status2, headers2, _ = _get(url)
+        assert (status2, headers2["ETag"]) == (200, etag)
+        # ...and revalidation is a 304 with an empty body.
+        status3, headers3, body3 = _get(url, **{"If-None-Match": etag})
+        assert (status3, body3) == (304, b"")
+        assert headers3["ETag"] == etag
+        # The revalidation shows up as a cache hit on /metrics.
+        _, _, metrics = _get(f"{base}/metrics")
+        text = metrics.decode()
+        assert 'http_requests_total{route="tiles",status="304"} 1' in text
+        hits = [l for l in text.splitlines()
+                if l.startswith("tile_cache_hits_total")]
+        assert hits and float(hits[0].split()[-1]) >= 2
+
+    def test_png_bytes_match_direct_render(self, served):
+        app, base = served
+        z, x, y = _pick_tile(app)
+        status, headers, body = _get(f"{base}/tiles/default/{z}/{x}/{y}.png")
+        assert status == 200
+        assert headers["Content-Type"] == "image/png"
+        assert body[:8] == b"\x89PNG\r\n\x1a\n"
+        assert body == tile_png_bytes(app.store.layer("default"), z, x, y)
+
+    def test_404s(self, served):
+        _, base = served
+        for path in ("/tiles/nope/3/1/1.json",   # unknown layer
+                     "/tiles/default/3/900/1.json",  # off-grid
+                     "/tiles/default/5/0/0.json",    # empty tile
+                     "/nothing-here"):
+            status, _, body = _get(base + path)
+            assert status == 404, path
+            json.loads(body)  # error bodies are JSON
+
+    def test_metrics_scrape_parses(self, served):
+        import re
+
+        app, base = served
+        z, x, y = _pick_tile(app)
+        _get(f"{base}/tiles/default/{z}/{x}/{y}.json")  # produce samples
+        status, headers, body = _get(f"{base}/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        line_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})?"
+            r"\s[-+]?([0-9.eE+-]+|Inf|NaN)$")
+        lines = body.decode().splitlines()
+        assert lines
+        for line in lines:
+            if line.startswith("#"):
+                assert line.startswith(("# HELP", "# TYPE"))
+            else:
+                assert line_re.match(line), line
+
+    def test_healthz_and_reload(self, served):
+        app, base = served
+        status, _, body = _get(f"{base}/healthz")
+        assert status == 200
+        health = json.loads(body)
+        assert health["status"] == "ok"
+        assert "default" in health["layers"]
+        assert health["generation"] == 0
+        req = urllib.request.Request(f"{base}/reload", method="POST",
+                                     data=b"")
+        resp = urllib.request.urlopen(req)
+        assert json.loads(resp.read())["generation"] == 1
+        assert app.store.generation == 1
+
+
+class TestLiveLayer:
+    def test_tick_serves_and_invalidates_targeted_keys(self, artifacts):
+        from heatmap_tpu.ops import Window
+        from heatmap_tpu.serve import LiveLayer
+        from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+        from heatmap_tpu.tilemath.mercator import (latitude_from_row,
+                                                   longitude_from_column)
+
+        window = Window(zoom=8, row0=80, col0=40, height=8, width=8)
+        stream = HeatmapStream(StreamConfig(window=window, half_life_s=60.0))
+        layer = LiveLayer(stream, name="live")
+        assert layer.result_delta == 5
+
+        store = TileStore(artifacts["arrays"])
+        app = ServeApp(store, TileCache(max_bytes=1 << 20))
+        app.attach_layer("live", layer)
+        assert "live" in app.layer_names()
+
+        # Cold layer: the live tile over the window is empty (404-path).
+        z, x, y = 3, 40 >> 5, 80 >> 5
+        assert tile_json_bytes(layer, z, x, y) is None
+        # Prime the cache with the empty result's sibling... then tick.
+        lat = np.full(6, float(latitude_from_row(80.5, 8)))
+        lon = np.full(6, float(longitude_from_column(40.5, 8)))
+        keys = layer.tick(lat, lon, t=0.0)
+        assert ("live", z, x, y, "json") in keys
+        assert ("live", 8, 40, 80, "png") in keys
+        # Zooms/tiles the batch never touched are not invalidated.
+        assert not any(k[1] == 3 and (k[2], k[3]) != (x, y) for k in keys)
+        app.cache.invalidate_keys(keys)
+        body = tile_json_bytes(layer, z, x, y)
+        doc = json.loads(body)
+        assert doc == {"8_80_40": 6.0}
+        # Attached layers survive a store reload...
+        app.store.reload()
+        assert app.layer("live") is layer
+        # ...and serve through the HTTP app core.
+        status, _, served_body, _, route, _ = app.handle(
+            "GET", f"/tiles/live/{z}/{x}/{y}.json")
+        assert (status, route) == (200, "tiles")
+        assert served_body == body
+
+    def test_decay_between_ticks(self):
+        from heatmap_tpu.ops import Window
+        from heatmap_tpu.serve import LiveLayer
+        from heatmap_tpu.streaming import HeatmapStream, StreamConfig
+        from heatmap_tpu.tilemath.mercator import (latitude_from_row,
+                                                   longitude_from_column)
+
+        window = Window(zoom=8, row0=80, col0=40, height=8, width=8)
+        stream = HeatmapStream(StreamConfig(window=window, half_life_s=60.0))
+        layer = LiveLayer(stream, name="live")
+        lat = np.full(4, float(latitude_from_row(80.5, 8)))
+        lon = np.full(4, float(longitude_from_column(40.5, 8)))
+        layer.tick(lat, lon, t=0.0)
+        layer.tick(lat[:0], lon[:0], t=60.0)  # one half-life, no points
+        value = layer.levels[8].lookup(
+            int(morton_encode_np(np.int64(80), np.int64(40))))
+        assert value == pytest.approx(2.0, rel=1e-5)
+
+
+class TestSinkSpecValidation:
+    def test_typo_kind_is_one_line_valueerror(self):
+        from heatmap_tpu.io import validate_sink_spec
+        from heatmap_tpu.io.sinks import open_sink
+
+        for fn in (validate_sink_spec, open_sink):
+            with pytest.raises(ValueError) as ei:
+                fn("josnl:x")
+            msg = str(ei.value)
+            assert "\n" not in msg
+            for kind in ("jsonl", "arrays", "dir", "memory", "cassandra"):
+                assert kind in msg
+
+    def test_valid_specs_pass(self, tmp_path):
+        from heatmap_tpu.io import validate_sink_spec
+
+        for spec in ("jsonl:a.out", "arrays:d/", "dir:d/", "memory:",
+                     "cassandra:", "bare.jsonl", "x.ndjson"):
+            assert validate_sink_spec(spec) == spec
+
+    def test_cli_rejects_at_parse_time(self, capsys):
+        from heatmap_tpu.cli import build_parser
+
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(
+                ["run", "--input", "synthetic:10", "--output", "josnl:x"])
+        err = capsys.readouterr().err
+        assert "jsonl, arrays" in err

@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""Closed-loop load generator for the tile server: BENCH_serve.json.
+
+Spins up the serving stack IN PROCESS (ServeApp on an ephemeral-port
+ThreadingHTTPServer — same code path as ``heatmap_tpu serve``), then
+drives it with N closed-loop worker threads over a Zipf-skewed tile
+universe sampled from the store itself. Closed loop = each worker
+issues its next request only after the previous one returns, so
+concurrency is exactly ``--workers`` and the measured RPS is the
+server's, not the generator's offered rate.
+
+Phases: a warmup pass touches the working set (cold renders populate
+the cache), then the measured window runs against warmed state —
+the acceptance gate is hit-rate > 0.95 there. Latency is whole-request
+wall time at the client (connect reused via keep-alive).
+
+The record mirrors tools/bench_job.py: one JSON object with the
+headline numbers plus the same folded ``run_report`` block
+(obs.build_run_report over the shared in-process registry), so serve
+benches land in the bench trajectory schema-compatible with job
+benches.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/load_gen.py \
+        [--store arrays:levels/] [--workers 8] [--duration 10] \
+        [--out BENCH_serve.json]
+
+Without --store it generates its own small synthetic artifact through
+the real batch pipeline first (requires a jax backend; serving itself
+is numpy-only).
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+
+def synth_store(tmpdir: str, n_points: int) -> str:
+    """Run the real batch job on synthetic points into arrays egress."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu.io import open_sink, open_source
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    path = os.path.join(tmpdir, "levels")
+    config = BatchJobConfig(detail_zoom=12, min_detail_zoom=5)
+    with open_sink(f"arrays:{path}") as sink:
+        run_job(open_source(f"synthetic:{n_points}"), sink, config)
+    return f"arrays:{path}"
+
+
+def tile_universe(store, max_tiles: int, seed: int = 0) -> list:
+    """(layer, z, x, y, fmt) population: every blob-bearing coarse tile
+    of the default layer (fallback: first layer), both formats."""
+    from heatmap_tpu.tilemath.morton import morton_decode_np
+
+    name = "default" if store.layer("default") else store.layer_names()[0]
+    layer = store.layer(name)
+    delta = layer.result_delta
+    tiles = []
+    for d in layer.detail_zooms:
+        z = d - delta
+        if z < 0:
+            continue
+        coarse = np.unique(layer.levels[d].codes >> np.int64(2 * delta))
+        rows, cols = morton_decode_np(coarse)
+        tiles += [(name, z, int(c), int(r), fmt)
+                  for r, c in zip(rows, cols)
+                  for fmt in ("json", "png")]
+    random.Random(seed).shuffle(tiles)
+    return tiles[:max_tiles]
+
+
+class Worker(threading.Thread):
+    """One closed-loop client: Zipf-ish sampling over the universe,
+    keep-alive connection, per-request wall latency."""
+
+    def __init__(self, host, port, universe, stop_at, seed):
+        super().__init__(daemon=True)
+        self.host, self.port = host, port
+        self.universe = universe
+        self.stop_at = stop_at
+        self.rng = random.Random(seed)
+        self.latencies_ms: list = []
+        self.statuses: dict = {}
+        self.errors = 0
+
+    def _pick(self):
+        # 80% of traffic on the first 20% of the (shuffled) universe —
+        # the hot-set skew a map viewport produces.
+        n = len(self.universe)
+        if self.rng.random() < 0.8:
+            return self.universe[self.rng.randrange(max(1, n // 5))]
+        return self.universe[self.rng.randrange(n)]
+
+    def run(self):
+        conn = http.client.HTTPConnection(self.host, self.port, timeout=30)
+        while time.monotonic() < self.stop_at:
+            layer, z, x, y, fmt = self._pick()
+            t0 = time.monotonic()
+            try:
+                conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+            except Exception:
+                self.errors += 1
+                conn.close()
+                conn = http.client.HTTPConnection(self.host, self.port,
+                                                  timeout=30)
+                continue
+            self.latencies_ms.append((time.monotonic() - t0) * 1e3)
+            self.statuses[status] = self.statuses.get(status, 0) + 1
+        conn.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--store", default=None,
+                    help="serve store spec (default: generate a "
+                    "synthetic arrays artifact first)")
+    ap.add_argument("--n-points", type=int, default=200_000,
+                    help="synthetic points when generating the store")
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="measured seconds (after warmup)")
+    ap.add_argument("--tiles", type=int, default=512,
+                    help="tile universe size (layer/z/x/y/fmt combos)")
+    ap.add_argument("--cache-bytes", type=int, default=256 << 20)
+    ap.add_argument("--ttl", type=float, default=None)
+    ap.add_argument("--out", default="BENCH_serve.json")
+    args = ap.parse_args()
+
+    from heatmap_tpu import obs
+    from heatmap_tpu.serve import ServeApp, TileCache, TileStore, serve_in_thread
+    from heatmap_tpu.utils.trace import get_tracer
+
+    obs.enable_metrics(True)
+    tmpdir = None
+    spec = args.store
+    if spec is None:
+        tmpdir = tempfile.mkdtemp(prefix="loadgen-")
+        t0 = time.perf_counter()
+        spec = synth_store(tmpdir, args.n_points)
+        print(json.dumps({"stage": "synth_store", "spec": spec,
+                          "s": round(time.perf_counter() - t0, 2)}),
+              flush=True)
+
+    store = TileStore(spec)
+    cache = TileCache(max_bytes=args.cache_bytes, ttl_s=args.ttl)
+    app = ServeApp(store, cache)
+    server, base = serve_in_thread(app)
+    host, port = server.server_address[:2]
+    universe = tile_universe(store, args.tiles)
+    if not universe:
+        print(json.dumps({"error": "store has no blob-bearing tiles",
+                          "store": spec}), flush=True)
+        return 1
+
+    # Warmup: touch the whole universe once (cold renders fill the
+    # cache), then snapshot the counters so the measured window's
+    # hit-rate excludes the mandatory first-touch misses.
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    t0 = time.perf_counter()
+    for layer, z, x, y, fmt in universe:
+        conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
+        conn.getresponse().read()
+    conn.close()
+    warm_s = time.perf_counter() - t0
+
+    from heatmap_tpu.serve.cache import CACHE_HITS, CACHE_MISSES
+
+    hits0, misses0 = CACHE_HITS.value(), CACHE_MISSES.value()
+    stop_at = time.monotonic() + args.duration
+    workers = [Worker(host, port, universe, stop_at, seed=i)
+               for i in range(args.workers)]
+    t0 = time.perf_counter()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    measured_s = time.perf_counter() - t0
+    server.shutdown()
+
+    lat = np.sort(np.concatenate(
+        [np.asarray(w.latencies_ms) for w in workers]
+        or [np.zeros(0)]))
+    statuses: dict = {}
+    for w in workers:
+        for s, c in w.statuses.items():
+            statuses[str(s)] = statuses.get(str(s), 0) + c
+    hits = CACHE_HITS.value() - hits0
+    misses = CACHE_MISSES.value() - misses0
+    total = hits + misses
+
+    def pct(p):
+        return round(float(lat[min(len(lat) - 1, int(p * len(lat)))]), 3) \
+            if len(lat) else None
+
+    record = {
+        "bench": "serve",
+        "store": spec,
+        "workers": args.workers,
+        "tiles": len(universe),
+        "warmup_s": round(warm_s, 2),
+        "duration_s": round(measured_s, 2),
+        "requests": int(len(lat)),
+        "errors": int(sum(w.errors for w in workers)),
+        "statuses": statuses,
+        "rps": round(len(lat) / measured_s, 1) if measured_s else None,
+        "latency_ms": {"p50": pct(0.50), "p90": pct(0.90),
+                       "p99": pct(0.99),
+                       "max": round(float(lat[-1]), 3) if len(lat) else None},
+        "hit_rate": round(hits / total, 4) if total else None,
+        "cache": {"entries": len(cache), "bytes": cache.nbytes},
+        # Same folded block bench_job.py embeds: serve benches stay
+        # schema-compatible with job benches in the bench trajectory.
+        "run_report": obs.build_run_report(tracer=get_tracer(),
+                                           registry=obs.get_registry()),
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, default=str)
+        f.write("\n")
+    headline = {k: record[k] for k in
+                ("rps", "latency_ms", "hit_rate", "requests", "errors")}
+    print(json.dumps(headline, default=str), flush=True)
+    print(json.dumps({"wrote": args.out}), flush=True)
+    if tmpdir:
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
