@@ -1003,6 +1003,204 @@ def cmd_merge(args) -> int:
     return 0
 
 
+def _add_update_flags(p):
+    p.add_argument("--journal", required=True, metavar="ROOT",
+                   help="delta store root (journal/ + base + delta "
+                   "artifacts; created on first use — "
+                   "docs/incremental.md)")
+    p.add_argument("--input", default=None,
+                   help="source spec of NEW points to apply as one "
+                   "journaled delta batch")
+    p.add_argument("--retractions", default=None,
+                   help="source spec of points to RETRACT (a signed "
+                   "delta batch: their counts are subtracted)")
+    p.add_argument("--base", default=None, type=_sink_spec,
+                   metavar="arrays:DIR",
+                   help="adopt an existing columnar artifact as the "
+                   "store's initial base pyramid (copied in; only "
+                   "valid once)")
+    p.add_argument("--compact-after", type=int, default=None, metavar="N",
+                   help="fold the delta stack into a new base when "
+                   "more than N live deltas remain after this update "
+                   "(0 = compact whenever any delta is live)")
+    p.add_argument("--retention", type=int, default=2,
+                   help="journal entries kept after compaction as the "
+                   "idempotency window (size to the upstream's "
+                   "redelivery horizon)")
+    p.add_argument("--detail-zoom", type=int, default=21)
+    p.add_argument("--min-detail-zoom", type=int, default=5)
+    p.add_argument("--result-delta", type=int, default=5)
+    p.add_argument("--timespans", default="alltime")
+    p.add_argument("--batch-size", type=int, default=1 << 20)
+    p.add_argument("--weighted", action="store_true",
+                   help="sum the source's per-point 'value' column "
+                   "instead of counting points")
+    p.add_argument("--cascade-backend", default="auto",
+                   choices=("auto", "scatter", "partitioned"))
+    p.add_argument("--data-parallel", choices=("auto", "on", "off"),
+                   default="auto")
+    p.add_argument("--metrics-dir", default=None, metavar="DIR",
+                   help="enable the metrics registry and write "
+                   "DIR/metrics.prom at command end")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="append structured events to PATH (delta_applied, "
+                   "compaction_start/end — docs/observability.md)")
+    p.add_argument("--report", nargs="?", const="run_report.json",
+                   default=None, metavar="PATH",
+                   help="fold tracer + metrics + events into a run "
+                   "report at PATH and print the span table to stderr")
+
+
+def cmd_update(args) -> int:
+    """Incremental update: journaled delta applies + optional
+    compaction against a delta store (heatmap_tpu.delta). The applied
+    batches run the full cascade (auto routing included) over just the
+    new points; the serving tier mounts the same root as ``serve
+    --store delta:ROOT``."""
+    from heatmap_tpu.pipeline.timespan import VALID_TYPES
+
+    requested = tuple(t.strip() for t in args.timespans.split(",")
+                      if t.strip())
+    bad = [t for t in requested if t not in VALID_TYPES]
+    if bad:
+        raise SystemExit(
+            f"--timespans: unknown type(s) {bad}; valid: "
+            f"{', '.join(VALID_TYPES)}"
+        )
+    if not (args.input or args.retractions or args.base
+            or args.compact_after is not None):
+        raise SystemExit("nothing to do: pass --input and/or "
+                         "--retractions, --base, or --compact-after")
+    base_dir = None
+    if args.base:
+        # _sink_spec already validated the kind list; the store adopts
+        # columnar artifacts only (that is the mergeable level format).
+        if not args.base.startswith("arrays:"):
+            raise SystemExit("--base must be a columnar arrays:DIR "
+                             f"artifact, got {args.base!r}")
+        base_dir = args.base[len("arrays:"):]
+        if not os.path.isdir(base_dir):
+            raise SystemExit(f"--base: {base_dir!r} is not a directory")
+    config = None
+    if args.input or args.retractions:
+        _init_backend(args)
+        from heatmap_tpu.pipeline import BatchJobConfig
+
+        try:
+            config = BatchJobConfig(
+                detail_zoom=args.detail_zoom,
+                min_detail_zoom=args.min_detail_zoom,
+                result_delta=args.result_delta,
+                timespans=requested,
+                weighted=args.weighted,
+                cascade_backend=args.cascade_backend,
+                data_parallel={"auto": None, "on": True, "off": False}[
+                    args.data_parallel],
+            )
+        except ValueError as e:
+            raise SystemExit(str(e)) from e
+    from heatmap_tpu import delta as delta_mod
+
+    # Same opt-in telemetry contract as cmd_run: with every flag off
+    # the update path emits/records nothing.
+    telemetry = bool(args.metrics_dir or args.events
+                     or args.report is not None)
+    ev_log = None
+    if telemetry:
+        from heatmap_tpu import obs
+
+        obs.enable_metrics(True)
+        if args.events:
+            ev_log = obs.EventLog(args.events)
+            obs.set_event_log(ev_log)
+            manifest = {}
+            if config is not None:
+                import dataclasses as _dc
+
+                manifest = {k: (list(v) if isinstance(v, tuple) else v)
+                            for k, v in _dc.asdict(config).items()}
+            obs.emit("run_start", config=manifest, backend=args.backend,
+                     devices=obs.device_topology(), argv=sys.argv[1:])
+    t0 = time.perf_counter()
+    job_error = None
+    summary = {"journal": args.journal}
+    try:
+        if base_dir is not None:
+            delta_mod.init_store(args.journal, base_dir)
+            summary["base_adopted"] = args.base
+        applied = []
+        if args.input or args.retractions:
+            from heatmap_tpu.io import open_source
+
+            jobs = [(args.input, 1)] if args.input else []
+            if args.retractions:
+                jobs.append((args.retractions, -1))
+            for spec, sign in jobs:
+                res = delta_mod.apply_batch(
+                    args.journal,
+                    open_source(spec, read_value=args.weighted),
+                    config, sign=sign, batch_size=args.batch_size)
+                applied.append({
+                    "input": spec, "epoch": res.epoch,
+                    "points": res.points, "sign": res.sign,
+                    "duplicate": res.duplicate, "rows": res.rows,
+                    "affected_keys": len(res.affected_keys),
+                })
+        if applied:
+            summary["applied"] = applied
+        live = len(delta_mod.live_entries(args.journal))
+        if args.compact_after is not None and live > args.compact_after:
+            comp = delta_mod.compact(args.journal,
+                                     retention=args.retention)
+            summary["compaction"] = {
+                k: comp.get(k) for k in ("status", "base",
+                                         "applied_through", "rows",
+                                         "pruned_entries")}
+            live = len(delta_mod.live_entries(args.journal))
+        summary["live_deltas"] = live
+    except ValueError as e:
+        # Config mismatch / double --base: operator errors, one line.
+        if not telemetry:
+            raise SystemExit(str(e)) from e
+        job_error = e
+    except BaseException as e:  # noqa: BLE001 — run_end must record it
+        if not telemetry:
+            raise
+        job_error = e
+    dt = time.perf_counter() - t0
+    if telemetry:
+        from heatmap_tpu import obs
+        from heatmap_tpu.utils.trace import get_tracer
+
+        if ev_log is not None:
+            end = {"status": "error" if job_error is not None else "ok",
+                   "seconds": round(dt, 3)}
+            if job_error is not None:
+                end["error"] = repr(job_error)
+            else:
+                end["rows"] = int(sum(a["rows"] for a in
+                                      summary.get("applied", [])))
+            obs.emit("run_end", **end)
+            obs.set_event_log(None)
+            ev_log.close()
+        if args.metrics_dir:
+            obs.get_registry().write_prometheus(
+                os.path.join(args.metrics_dir, "metrics.prom"))
+        if args.report is not None:
+            report = obs.build_run_report(
+                tracer=get_tracer(), registry=obs.get_registry(),
+                events_path=args.events)
+            obs.write_run_report(args.report, report)
+            print(obs.format_run_report(report), file=sys.stderr)
+        if job_error is not None:
+            if isinstance(job_error, ValueError):
+                raise SystemExit(str(job_error)) from job_error
+            raise job_error
+    summary["seconds"] = round(dt, 3)
+    print(json.dumps(summary))
+    return 0
+
+
 def cmd_info(args) -> int:
     # info reports unreachability as structured JSON (below) rather
     # than the fail-fast SystemExit the job commands want; an explicit
@@ -1238,6 +1436,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "blob inputs; arrays:DIR for level-array "
                          "inputs")
     p_merge.set_defaults(fn=cmd_merge)
+
+    p_update = sub.add_parser(
+        "update",
+        help="incremental update: journaled delta apply + compaction "
+        "against a delta store (serve mounts it as delta:ROOT)")
+    _add_backend_flags(p_update)
+    _add_update_flags(p_update)
+    p_update.set_defaults(fn=cmd_update)
 
     p_info = sub.add_parser("info", help="resolved config + devices")
     _add_backend_flags(p_info)

@@ -1,0 +1,146 @@
+"""Incremental update engine: journaled deltas over an additive pyramid.
+
+The reference job recomputes all 16 levels from source on every run
+(reference heatmap.py:152-158); because tile counts are pure sums, the
+pyramid is an additively mergeable sketch, so new points only need to
+touch the tiles they land in. This package turns the one-shot batch
+job into a journaled, compacting pipeline:
+
+- ``journal.py``  — content-hashed, epoch-numbered ingest journal
+  (idempotent re-submits, signed entries for retractions).
+- ``compute.py``  — a delta artifact is the ordinary cascade run over
+  just the new points, in the columnar level format io/merge.py
+  already merges.
+- ``compact.py``  — base + delta stack overlaid on read; compaction
+  folds deltas into a new base behind an atomic pointer flip and
+  prunes behind a retention window.
+
+``apply_batch`` is the ingest entry; ``refresh_serving`` brings a live
+``serve.TileStore``/``TileCache`` up to date by rebuilding the overlay
+index without a generation bump and invalidating only the affected
+tile keys (the serve/live.py mechanism) — untouched tiles keep their
+cache entries because an additive delta cannot change their bytes.
+
+Correctness anchor (pinned in tests/test_delta.py): base ⊕ deltas is
+byte-identical — at the served-blob level — to a full recompute over
+the union of surviving points, before and after compaction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from heatmap_tpu import obs
+from heatmap_tpu.delta import compact as compact_mod
+from heatmap_tpu.delta.compact import (check_config, compact, init_store,
+                                       live_entries, load_overlay_levels,
+                                       overlay_dirs, read_current)
+from heatmap_tpu.delta.compute import (ColumnsSource, affected_tile_keys,
+                                       compute_delta, read_columns)
+from heatmap_tpu.delta.journal import DeltaJournal, batch_content_hash
+from heatmap_tpu.delta.metrics import (COMPACTION_SECONDS,
+                                       DELTA_APPLY_SECONDS, DELTA_POINTS)
+from heatmap_tpu.io.sinks import LevelArraysSink
+
+
+@dataclasses.dataclass
+class DeltaResult:
+    """Outcome of one apply_batch call."""
+
+    epoch: int
+    points: int
+    sign: int
+    duplicate: bool
+    artifact: str | None
+    rows: int
+    seconds: float
+    affected_keys: set = dataclasses.field(default_factory=set)
+
+
+def _watermark(cols) -> float | None:
+    stamps = cols.get("timestamp")
+    if stamps is None or not len(stamps):
+        return None
+    try:
+        return max(float(t) for t in stamps if t is not None)
+    except (TypeError, ValueError):
+        return None
+
+
+def apply_batch(root: str, source, config, *, sign: int = 1,
+                batch_size: int = 1 << 20) -> DeltaResult:
+    """Journal + compute one incremental batch against a delta store.
+
+    Idempotent: a batch whose content hash is already journaled is a
+    no-op (no new epoch, no artifact written, no bytes changed).
+    ``sign=-1`` retracts the batch's points — an exact correction by
+    linearity (the artifact carries negated counts).
+    """
+    if sign not in (1, -1):
+        raise ValueError("sign must be +1 (insert) or -1 (retraction)")
+    t0 = time.monotonic()
+    init_store(root)
+    cols = read_columns(source, batch_size=batch_size)
+    content_hash = batch_content_hash(cols, sign=sign)
+    journal = DeltaJournal(compact_mod.journal_dir(root))
+    existing = journal.find(content_hash)
+    if existing is not None:
+        seconds = time.monotonic() - t0
+        obs.emit("delta_applied", epoch=existing["epoch"],
+                 points=existing["points"], sign=existing["sign"],
+                 seconds=round(seconds, 6), duplicate=True,
+                 content_hash=content_hash)
+        return DeltaResult(epoch=existing["epoch"],
+                           points=existing["points"],
+                           sign=existing["sign"], duplicate=True,
+                           artifact=existing.get("artifact"), rows=0,
+                           seconds=seconds)
+    check_config(root, config)
+    n_points = int(len(cols["latitude"]))
+    epoch = journal.next_epoch()
+    artifact = f"delta-{epoch:06d}"
+    out_dir = os.path.join(root, artifact)
+    stats = compute_delta(ColumnsSource(cols), out_dir, config, sign=sign,
+                          batch_size=batch_size)
+    rows = int(stats.get("rows", 0)) if isinstance(stats, dict) else 0
+    watermark = _watermark(cols)
+    journal.append(content_hash=content_hash, points=n_points, sign=sign,
+                   artifact=artifact, watermark=watermark)
+    keys = affected_tile_keys(LevelArraysSink.load(out_dir))
+    seconds = time.monotonic() - t0
+    DELTA_POINTS.inc(n_points, kind="insert" if sign > 0 else "retract")
+    DELTA_APPLY_SECONDS.observe(seconds)
+    obs.emit("delta_applied", epoch=epoch, points=n_points, sign=sign,
+             seconds=round(seconds, 6), content_hash=content_hash,
+             artifact=artifact, rows=rows, watermark=watermark,
+             keys_invalidated=len(keys))
+    return DeltaResult(epoch=epoch, points=n_points, sign=sign,
+                       duplicate=False, artifact=artifact, rows=rows,
+                       seconds=seconds, affected_keys=keys)
+
+
+def refresh_serving(result: DeltaResult, store, cache=None) -> int:
+    """Bring a live TileStore (mounted on this store's ``delta:`` spec)
+    up to date after ``apply_batch`` — the targeted alternative to
+    ``store.reload()``: the overlay index is rebuilt WITHOUT a
+    generation bump (an additive delta cannot change untouched tiles'
+    bytes, so their cache entries stay valid) and only the affected
+    tile keys are invalidated. Returns the number of cache entries
+    dropped."""
+    if result.duplicate:
+        return 0
+    store.refresh_layers()
+    if cache is None:
+        return 0
+    return cache.invalidate_keys(result.affected_keys)
+
+
+__all__ = [
+    "COMPACTION_SECONDS", "ColumnsSource", "DELTA_APPLY_SECONDS",
+    "DELTA_POINTS", "DeltaJournal", "DeltaResult", "affected_tile_keys",
+    "apply_batch", "batch_content_hash", "check_config", "compact",
+    "compute_delta", "init_store", "live_entries", "load_overlay_levels",
+    "overlay_dirs", "read_columns", "read_current", "refresh_serving",
+]

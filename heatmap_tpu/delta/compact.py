@@ -1,0 +1,250 @@
+"""LSM-style maintenance for the delta store.
+
+Store layout (one directory, self-describing):
+
+    root/
+      CURRENT            atomic JSON pointer {base, applied_through,
+                         config} — the only mutable cell
+      base-XXXXXX/       compacted base pyramid (LevelArraysSink dir),
+                         named by the last epoch folded into it
+      delta-XXXXXX/      one delta artifact per journaled epoch
+      journal/           ckpt-<epoch>.npz entries (delta/journal.py)
+
+Reads overlay base + live deltas (journal entries newer than
+``applied_through``) through ``io.merge.merge_level_parts`` — the same
+re-aggregation the multihost shard merge uses — then prune exact-zero
+cells left by retractions, so the overlay is indistinguishable from a
+full recompute over the surviving points.
+
+Compaction writes the merged pyramid to a ``.tmp`` dir, renames it to
+its final ``base-XXXXXX`` name, then atomically rewrites CURRENT (the
+``save_checkpoint`` crash-safety contract: tmp + fsync + os.replace).
+A crash at any point leaves either the old pointer with the old base
+intact, or the new pointer with the new base complete — never a
+half-merged store. Superseded bases and journal entries older than the
+retention window are pruned afterwards; an orphan dir from a crashed
+pass is overwritten by the next one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+
+import numpy as np
+
+from heatmap_tpu.delta.journal import DeltaJournal
+from heatmap_tpu.io.merge import merge_level_dirs
+from heatmap_tpu.io.sinks import LevelArraysSink
+
+CURRENT_SCHEMA = "heatmap-tpu.delta_store.v1"
+JOURNAL_DIRNAME = "journal"
+
+#: Config fields that change pyramid bytes: every batch applied to a
+#: store must agree on them or base ⊕ delta is meaningless. Runtime
+#: knobs (cascade_backend, data_parallel, chunking) are byte-neutral
+#: and deliberately excluded.
+CONFIG_FIELDS = ("detail_zoom", "min_detail_zoom", "result_delta",
+                 "timespans", "weighted", "amplify_all",
+                 "first_timespan_only")
+
+
+def journal_dir(root: str) -> str:
+    return os.path.join(root, JOURNAL_DIRNAME)
+
+
+def read_current(root: str) -> dict:
+    """The store pointer; a missing CURRENT is an empty store."""
+    try:
+        with open(os.path.join(root, "CURRENT")) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {"schema": CURRENT_SCHEMA, "base": None,
+                "applied_through": 0, "config": None}
+
+
+def write_current(root: str, cur: dict):
+    """Atomic pointer flip: tmp + fsync + os.replace, the
+    save_checkpoint contract."""
+    fd, tmp = tempfile.mkstemp(dir=root, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(cur, f, indent=2, sort_keys=True)
+            f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(root, "CURRENT"))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def init_store(root: str, base_dir: str | None = None) -> dict:
+    """Create (or no-op on) a delta store root; optionally adopt an
+    existing arrays artifact as the initial base (copied in, so the
+    store owns its files and compaction can prune them)."""
+    os.makedirs(root, exist_ok=True)
+    os.makedirs(journal_dir(root), exist_ok=True)
+    cur = read_current(root)
+    if base_dir is not None:
+        if cur.get("base"):
+            raise ValueError(
+                f"delta store {root} already has base {cur['base']!r}; "
+                "refusing to overwrite it with --base")
+        name = "base-000000"
+        shutil.copytree(base_dir, os.path.join(root, name),
+                        dirs_exist_ok=True)
+        cur["base"] = name
+    write_current(root, cur)
+    return cur
+
+
+def config_fingerprint(config) -> dict:
+    out = {}
+    for field in CONFIG_FIELDS:
+        v = getattr(config, field, None)
+        out[field] = list(v) if isinstance(v, tuple) else v
+    return out
+
+
+def check_config(root: str, config) -> dict:
+    """Pin the byte-affecting config on first apply; later applies must
+    match it exactly (mixing zooms/timespans would corrupt the sums)."""
+    cur = read_current(root)
+    fp = config_fingerprint(config)
+    if cur.get("config") is None:
+        cur["config"] = fp
+        write_current(root, cur)
+    elif cur["config"] != fp:
+        raise ValueError(
+            f"delta store {root} was built with config {cur['config']}; "
+            f"refusing to apply a batch with {fp}")
+    return cur
+
+
+def live_entries(root: str) -> list[dict]:
+    """Journal entries not yet folded into the base, oldest first."""
+    cur = read_current(root)
+    journal = DeltaJournal(journal_dir(root))
+    applied_through = int(cur.get("applied_through", 0))
+    return [e for e in journal.entries() if e["epoch"] > applied_through]
+
+
+def overlay_dirs(root: str) -> list[str]:
+    """Level dirs the read path merges: current base + live deltas.
+    Driven by CURRENT + the journal, never by globbing — an orphan
+    artifact from a crashed apply (dir written, journal append lost)
+    is invisible until its batch is retried."""
+    cur = read_current(root)
+    dirs = []
+    if cur.get("base"):
+        base = os.path.join(root, cur["base"])
+        if os.path.isdir(base):
+            dirs.append(base)
+    for entry in live_entries(root):
+        d = os.path.join(root, entry["artifact"])
+        if os.path.isdir(d):
+            dirs.append(d)
+    return dirs
+
+
+def drop_zero_rows(levels: list) -> list:
+    """Remove exact-zero cells left by retractions.
+
+    A full recompute over the surviving points never emits these rows,
+    and the serve tier's JSON docs would otherwise carry spurious 0.0
+    entries — breaking the byte-identity anchor. Counts cancel exactly
+    in f64 (small integers), so ``== 0.0`` is precise, and it also
+    catches -0.0.
+    """
+    out = []
+    for lvl in levels:
+        value = np.asarray(lvl["value"])
+        keep = value != 0.0
+        if keep.all():
+            out.append(lvl)
+            continue
+        pruned = dict(lvl)
+        for k in LevelArraysSink.COLUMNS:
+            if k in pruned:
+                pruned[k] = np.asarray(pruned[k])[keep]
+        out.append(pruned)
+    return out
+
+
+def load_overlay_levels(root: str) -> list:
+    """base ⊕ live deltas as finalized level dicts (write_levels input
+    format); [] for an empty store."""
+    dirs = overlay_dirs(root)
+    if not dirs:
+        return []
+    return drop_zero_rows(merge_level_dirs(dirs))
+
+
+def compact(root: str, *, retention: int = 2) -> dict:
+    """Fold the live delta stack into a new base and prune.
+
+    Returns a summary dict; a store with no live deltas is a no-op
+    (compacting nothing would only rewrite the base it already has).
+    """
+    from heatmap_tpu import obs
+    from heatmap_tpu.delta.metrics import COMPACTION_SECONDS
+
+    cur = read_current(root)
+    journal = DeltaJournal(journal_dir(root))
+    live = live_entries(root)
+    base_name = cur.get("base")
+    if not live:
+        return {"status": "noop", "base": base_name, "deltas": 0,
+                "applied_through": int(cur.get("applied_through", 0))}
+    obs.emit("compaction_start", root=root, deltas=len(live),
+             base=base_name)
+    t0 = time.monotonic()
+    try:
+        dirs = overlay_dirs(root)
+        merged = drop_zero_rows(merge_level_dirs(dirs)) if dirs else []
+        new_epoch = max(e["epoch"] for e in live)
+        new_name = f"base-{new_epoch:06d}"
+        new_path = os.path.join(root, new_name)
+        tmp_path = new_path + ".tmp"
+        if os.path.isdir(tmp_path):
+            shutil.rmtree(tmp_path)
+        rows = LevelArraysSink(tmp_path).write_levels(merged)
+        if os.path.isdir(new_path):  # orphan of a crashed pass
+            shutil.rmtree(new_path)
+        os.rename(tmp_path, new_path)
+        cur = dict(cur)
+        cur["base"] = new_name
+        cur["applied_through"] = int(new_epoch)
+        write_current(root, cur)  # the atomic commit point
+        pruned = journal.prune(applied_through=new_epoch,
+                               retention=retention)
+        for entry in pruned:
+            shutil.rmtree(os.path.join(root, entry["artifact"]),
+                          ignore_errors=True)
+        for name in os.listdir(root):
+            if (name.startswith("base-") and name != new_name
+                    and os.path.isdir(os.path.join(root, name))):
+                shutil.rmtree(os.path.join(root, name),
+                              ignore_errors=True)
+        seconds = time.monotonic() - t0
+        COMPACTION_SECONDS.observe(seconds)
+        obs.emit("compaction_end", root=root, seconds=round(seconds, 6),
+                 status="ok", base=new_name, levels=len(merged),
+                 rows=int(rows), pruned_entries=len(pruned))
+        return {"status": "ok", "base": new_name,
+                "applied_through": int(new_epoch),
+                "deltas": len(live), "levels": len(merged),
+                "rows": int(rows), "pruned_entries": len(pruned),
+                "seconds": seconds}
+    except BaseException as exc:
+        obs.emit("compaction_end", root=root,
+                 seconds=round(time.monotonic() - t0, 6),
+                 status="error", error=repr(exc))
+        raise

@@ -1,0 +1,159 @@
+"""Delta pyramid computation: only the new points through the cascade.
+
+No new kernels: a delta artifact is the ordinary batch job
+(``pipeline.run_job`` — auto-routing included, so count batches take
+the partitioned MXU path and compose with data parallelism exactly as
+a full job does) run over just the incremental batch, written in the
+same columnar level format (``io.sinks.LevelArraysSink``) that
+``io/merge.py`` already merges. Because tile counts are pure sums,
+base ⊕ delta is exact.
+
+Retractions ride the same path with the sign flipped at egress: the
+retraction points cascade normally (positive counts — the int32 MXU
+route stays valid) and the finalized level values are negated before
+the sink writes them. By linearity that equals cascading negative
+weights, without teaching the device path about signs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from heatmap_tpu.io.sinks import LevelArraysSink
+
+#: Rendered formats a cached tile can exist in (serve/http.py routes).
+#: Kept local so importing the delta engine never drags the serve
+#: package in; pinned equal to serve.live.TILE_FORMATS in tests.
+TILE_FORMATS = ("png", "json")
+
+
+class ColumnsSource:
+    """In-memory point columns as a batch source.
+
+    The ingest path already holds the whole batch in hand (it is
+    hashed for the journal before anything runs), so the cascade can
+    read it back without a round-trip through a file. Slicing works on
+    both ndarray and list columns, matching io.sources batch layout.
+    """
+
+    COLUMNS = ("latitude", "longitude", "user_id", "source",
+               "timestamp", "value")
+
+    def __init__(self, cols: dict):
+        self.cols = {k: cols[k] for k in self.COLUMNS if k in cols}
+        if "latitude" not in self.cols or "user_id" not in self.cols:
+            raise ValueError("point columns need latitude/longitude/user_id")
+        n = len(self.cols["latitude"])
+        for k, v in self.cols.items():
+            if len(v) != n:
+                raise ValueError(
+                    f"column {k!r} has {len(v)} rows, expected {n}")
+        self._n = n
+
+    def __len__(self) -> int:
+        return self._n
+
+    def batches(self, batch_size: int = 1 << 20):
+        for lo in range(0, self._n, batch_size):
+            yield {k: v[lo:lo + batch_size] for k, v in self.cols.items()}
+
+
+def read_columns(source, batch_size: int = 1 << 20) -> dict:
+    """Drain a source into one concatenated column dict (the delta
+    batch must be materialized anyway to content-hash it)."""
+    lat, lon, value = [], [], []
+    obj: dict = {"user_id": [], "source": [], "timestamp": []}
+    seen: set = set()
+    for b in source.batches(batch_size):
+        lat.append(np.asarray(b["latitude"], np.float64))
+        lon.append(np.asarray(b["longitude"], np.float64))
+        for k in obj:
+            if k in b:
+                seen.add(k)
+                obj[k].extend(list(b[k]))
+        if "value" in b:
+            seen.add("value")
+            value.append(np.asarray(b["value"], np.float64))
+    cols = {
+        "latitude": np.concatenate(lat) if lat else np.zeros(0),
+        "longitude": np.concatenate(lon) if lon else np.zeros(0),
+        "user_id": obj["user_id"],
+    }
+    for k in ("source", "timestamp"):
+        if k in seen:
+            cols[k] = obj[k]
+    if "value" in seen:
+        cols["value"] = np.concatenate(value)
+    return cols
+
+
+class _NegatingLevels:
+    """Sink adapter for retraction deltas: negate finalized level
+    values on the way into the columnar sink (run_job routes to
+    ``write_levels`` by presence, so this slots in transparently —
+    including the spill path's per-level calls)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def write_levels(self, levels) -> int:
+        return self._inner.write_levels([
+            {**lvl, "value": np.negative(np.asarray(lvl["value"]))}
+            for lvl in levels
+        ])
+
+
+def compute_delta(source, out_dir: str, config, *, sign: int = 1,
+                  batch_size: int = 1 << 20):
+    """Run ``source`` through the full batch cascade into a delta
+    artifact dir (LevelArraysSink format). Returns run_job's stats."""
+    from heatmap_tpu.pipeline import run_job  # defers the jax import
+
+    if sign not in (1, -1):
+        raise ValueError("sign must be +1 (insert) or -1 (retraction)")
+    sink = LevelArraysSink(out_dir)
+    if sign == -1:
+        sink = _NegatingLevels(sink)
+    return run_job(source, sink, config, batch_size=batch_size)
+
+
+def affected_tile_keys(levels: dict,
+                       alias: tuple = ("all|alltime", "default")) -> set:
+    """Cache keys whose rendered bytes this delta can change.
+
+    Mirrors serve/live.py ``LiveLayer.affected_keys``: every changed
+    cell of the FINEST delta level (coarser delta cells are exactly
+    its ancestors, by the cascade rollup), projected to every tile at
+    request zooms 0..finest, per affected ``user|timespan`` layer
+    (plus the ``default`` alias when the all|alltime pair changes),
+    both formats. Requests finer than the stored detail zoom are not
+    enumerated — the same bound live.py uses; give the cache a TTL if
+    you serve those.
+
+    ``levels`` is ``LevelArraysSink.load`` output: {zoom: columns with
+    materialized string user/timespan}.
+    """
+    if not levels:
+        return set()
+    finest = int(max(levels))
+    cols = levels[finest]
+    row = np.asarray(cols["row"], np.int64)
+    col = np.asarray(cols["col"], np.int64)
+    if not len(row):
+        return set()
+    user = np.asarray(cols["user"]).astype(str)
+    tspan = np.asarray(cols["timespan"]).astype(str)
+    pair = np.char.add(np.char.add(user, "|"), tspan)
+    keys: set = set()
+    for name in np.unique(pair):
+        names = [str(name)] + ([alias[1]] if str(name) == alias[0] else [])
+        m = pair == name
+        r, c = row[m], col[m]
+        for z in range(finest + 1):
+            shift = finest - z
+            tiles = np.unique(np.stack([r >> shift, c >> shift], 1), axis=0)
+            for tr, tc in tiles:
+                for nm in names:
+                    for fmt in TILE_FORMATS:
+                        keys.add((nm, z, int(tc), int(tr), fmt))
+    return keys

@@ -1,0 +1,139 @@
+"""Append-only ingest journal: content-hashed, epoch-numbered batches.
+
+Every accepted batch is one journal entry — an empty-array checkpoint
+written through ``utils/checkpoint.save_checkpoint`` (the atomic
+tmp-write + rename contract) whose JSON meta carries the batch's
+content hash, point count, timestamp watermark, monotonic epoch and
+sign (+1 insert, -1 retraction). This extends the checkpoint module's
+recovery model from "resume a partial cascade" to "replay-proof
+ingest": re-submitting an already-journaled batch finds its hash and
+is a no-op, so an at-least-once upstream (a retried queue consumer, a
+re-run cron) converges to exactly-once pyramid updates.
+
+The files are ``ckpt-<epoch>.npz`` under the journal directory —
+``CheckpointManager``'s own naming — so epoch listing, latest-epoch
+and the retention prune are all the manager's hardened code paths,
+not a parallel implementation.
+
+Idempotency is scoped to the retention window: once a compaction has
+folded an entry into the base AND the retention pass has pruned it,
+its hash is forgotten and a re-submit would double-count. Size the
+retention window to cover the upstream's maximum redelivery horizon.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+
+import numpy as np
+
+from heatmap_tpu.utils.checkpoint import CheckpointManager, save_checkpoint
+
+#: Columns hashed (when present) to derive a batch identity. Floats are
+#: hashed as raw little-endian f64 bytes, strings NUL-joined — the hash
+#: is a pure function of the point data, independent of batch chunking.
+HASH_FLOAT_COLUMNS = ("latitude", "longitude", "value")
+HASH_OBJECT_COLUMNS = ("user_id", "source", "timestamp")
+
+
+def batch_content_hash(cols: dict, sign: int = 1) -> str:
+    """Deterministic identity of a point batch (+ its sign).
+
+    The sign participates so that retracting a batch is a different
+    journal entry from inserting it — submitting both is the intended
+    way to express a correction, not a duplicate.
+    """
+    h = hashlib.sha256()
+    h.update(f"sign={int(sign)}".encode())
+    for name in HASH_FLOAT_COLUMNS:
+        if name in cols:
+            arr = np.ascontiguousarray(np.asarray(cols[name], np.float64))
+            h.update(name.encode())
+            h.update(arr.tobytes())
+    for name in HASH_OBJECT_COLUMNS:
+        if name in cols and len(cols[name]):
+            h.update(name.encode())
+            h.update("\x00".join(str(v) for v in cols[name]).encode())
+    return "sha256:" + h.hexdigest()
+
+
+class DeltaJournal:
+    """Epoch-numbered journal entries in a directory.
+
+    Appends never prune (``save_checkpoint`` is called directly, not
+    ``CheckpointManager.save`` — the manager's keep-N would eat live
+    entries); retention is an explicit post-compaction pass.
+    """
+
+    def __init__(self, directory: str):
+        self._mgr = CheckpointManager(directory, keep=1)
+
+    @property
+    def directory(self) -> str:
+        return self._mgr.directory
+
+    def epochs(self) -> list[int]:
+        return self._mgr.steps()
+
+    def latest_epoch(self) -> int:
+        return self._mgr.latest_step() or 0
+
+    def next_epoch(self) -> int:
+        return self.latest_epoch() + 1
+
+    def entries(self) -> list[dict]:
+        """All journal entry metas, oldest epoch first. An entry pruned
+        between the listing and the read is skipped (same concurrent-
+        maintenance stance as CheckpointManager.prune)."""
+        out = []
+        for epoch in self.epochs():
+            try:
+                _, meta = self._mgr.load(epoch)
+            except FileNotFoundError:
+                continue
+            out.append(meta)
+        return out
+
+    def find(self, content_hash: str) -> dict | None:
+        for meta in self.entries():
+            if meta.get("content_hash") == content_hash:
+                return meta
+        return None
+
+    def append(self, *, content_hash: str, points: int, sign: int,
+               artifact: str, watermark: float | None = None) -> dict:
+        """Record an accepted batch; returns the existing entry
+        unchanged if the hash is already journaled (idempotent)."""
+        existing = self.find(content_hash)
+        if existing is not None:
+            return existing
+        epoch = self.next_epoch()
+        meta = {
+            "epoch": epoch,
+            "content_hash": content_hash,
+            "points": int(points),
+            "sign": int(sign),
+            "artifact": artifact,
+            "watermark": watermark,
+            "ts": time.time(),
+        }
+        save_checkpoint(self._mgr._path(epoch), {}, meta)
+        return meta
+
+    def prune(self, *, applied_through: int, retention: int) -> list[dict]:
+        """Drop entries already folded into a compacted base, keeping
+        the newest ``retention`` of them as the idempotency window.
+        Live entries (epoch > ``applied_through``) are always kept.
+        Returns the pruned entries (the caller owns their artifacts).
+        """
+        if retention < 0:
+            raise ValueError("retention must be >= 0")
+        entries = self.entries()
+        applied = [e for e in entries if e["epoch"] <= applied_through]
+        doomed = applied[:-retention] if retention else applied
+        # Entries are epoch-ordered and live ones are the newest, so
+        # "keep all but the oldest len(doomed)" is exactly the
+        # manager's hardened keep-N prune.
+        self._mgr.prune(keep=len(entries) - len(doomed))
+        return doomed

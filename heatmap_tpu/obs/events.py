@@ -55,6 +55,25 @@ EVENT_SCHEMA = {
     # e.g. "tiles"; path the concrete URL; cache "hit"/"miss" on tiles).
     "http_request": {"required": ("route", "status"),
                      "optional": ("path", "ms", "bytes", "cache")},
+    # serve/store.py full index rebuild (TileStore.reload): every
+    # cached tile is invalidated by the generation bump — the
+    # heavyweight counterpart to a targeted delta apply.
+    "store_reload": {"required": ("old_generation", "generation",
+                                  "levels", "seconds"),
+                     "optional": ("spec", "layers", "initial")},
+    # delta/: one journaled batch applied (sign -1 = retraction).
+    # duplicate=True means the content hash was already journaled and
+    # the apply was an idempotent no-op (epoch is the existing one).
+    "delta_applied": {"required": ("epoch", "points", "sign", "seconds"),
+                      "optional": ("content_hash", "artifact", "rows",
+                                   "duplicate", "watermark",
+                                   "keys_invalidated")},
+    # delta/compact.py: fold the live delta stack into a new base.
+    "compaction_start": {"required": ("root", "deltas"),
+                         "optional": ("base",)},
+    "compaction_end": {"required": ("root", "seconds", "status"),
+                       "optional": ("base", "levels", "rows",
+                                    "pruned_entries", "error")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",

@@ -7,6 +7,9 @@ Loads any batch egress artifact the job side writes —
                    through the existing io/merge.py level mergers;
 - ``jsonl:PATH``   blob records (JSONLBlobSink lines);
 - ``dir:PATH``     one blob JSON file per id (DirectoryBlobSink);
+- ``delta:ROOT``   an incremental delta store (heatmap_tpu.delta):
+                   the current base pyramid overlaid with the live
+                   delta stack, additively merged on read;
 
 — into per-layer, per-detail-zoom **Morton-keyed sorted arrays**
 (tilemath/morton.py): a tile request at coarse tile (z, row, col) is a
@@ -21,7 +24,11 @@ aliases ``all|alltime`` when present — so a fresh count job serves at
 
 ``reload()`` re-reads the artifact and atomically swaps the index,
 bumping ``generation`` — the cache invalidation token — so a newer job
-run is picked up without restarting the server.
+run is picked up without restarting the server. ``refresh_layers()``
+is the targeted sibling for delta stores: it swaps the index WITHOUT
+the bump, so only the tile keys a delta actually touched need explicit
+invalidation (heatmap_tpu.delta.refresh_serving) and the rest of the
+cache survives.
 
 Numpy-only on purpose: no jax import, no backend init (the io/merge.py
 offline discipline) — a tile server must keep serving when the
@@ -33,16 +40,19 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
 import numpy as np
 
+from heatmap_tpu import obs
 from heatmap_tpu.io.sinks import LevelArraysSink
 from heatmap_tpu.tilemath.keys import parse_tile_id
 from heatmap_tpu.tilemath.morton import morton_encode_np
 
 #: Store spec kinds ``TileStore`` accepts (subset of the sink kinds —
-#: the batch egress surfaces that persist to disk).
-STORE_KINDS = ("arrays", "jsonl", "dir")
+#: the batch egress surfaces that persist to disk — plus the delta
+#: store overlay).
+STORE_KINDS = ("arrays", "jsonl", "dir", "delta")
 
 
 class Level:
@@ -119,6 +129,8 @@ def _parse_store_spec(spec: str) -> tuple[str, str]:
         return "jsonl", spec
     if os.path.isdir(spec):
         names = os.listdir(spec)
+        if "CURRENT" in names or "journal" in names:
+            return "delta", spec
         if any(n.startswith("level_z") for n in names) or any(
                 n.startswith("host") and
                 os.path.isdir(os.path.join(spec, n)) for n in names):
@@ -128,6 +140,19 @@ def _parse_store_spec(spec: str) -> tuple[str, str]:
         f"unrecognized store spec {spec!r}: kind must be one of "
         f"{', '.join(STORE_KINDS)} (e.g. arrays:levels/)"
     )
+
+
+def _finalized_to_loaded(merged) -> dict[int, dict]:
+    """Finalized (dictionary-encoded) -> loaded (string columns), the
+    shape LevelArraysSink.load returns."""
+    out = {}
+    for lvl in merged:
+        cols = dict(lvl)
+        cols["user"] = np.asarray(lvl["user_names"])[lvl["user_idx"]]
+        cols["timespan"] = np.asarray(
+            lvl["timespan_names"])[lvl["timespan_idx"]]
+        out[int(lvl["zoom"])] = cols
+    return out
 
 
 def _load_levels(path: str) -> dict[int, dict]:
@@ -140,16 +165,7 @@ def _load_levels(path: str) -> dict[int, dict]:
     if shard_dirs and not any(n.startswith("level_z") for n in names):
         from heatmap_tpu.io.merge import merge_level_dirs
 
-        merged = merge_level_dirs(shard_dirs)
-        out = {}
-        for lvl in merged:
-            # Finalized (dictionary-encoded) -> loaded (string columns),
-            # the shape LevelArraysSink.load returns.
-            cols = dict(lvl)
-            cols["user"] = lvl["user_names"][lvl["user_idx"]]
-            cols["timespan"] = lvl["timespan_names"][lvl["timespan_idx"]]
-            out[int(lvl["zoom"])] = cols
-        return out
+        return _finalized_to_loaded(merge_level_dirs(shard_dirs))
     return LevelArraysSink.load(path)
 
 
@@ -208,16 +224,43 @@ class TileStore:
     def reload(self, _initial: bool = False) -> int:
         """Re-read the artifact and atomically swap the index; returns
         the new generation (the cache-invalidation token)."""
+        t0 = time.monotonic()
         built = self._build()
         with self._lock:
+            old = self.generation
             self._layers = built
             if not _initial:
                 self.generation += 1
+            generation = self.generation
+        # Full reloads invalidate every cached tile via the generation
+        # bump; the event makes them distinguishable from targeted
+        # delta refreshes in the log.
+        obs.emit("store_reload", old_generation=old, generation=generation,
+                 levels=sum(len(layer.levels) for layer in built.values()),
+                 seconds=round(time.monotonic() - t0, 6), spec=self.spec,
+                 layers=len(built), initial=bool(_initial))
+        return generation
+
+    def refresh_layers(self) -> int:
+        """Re-read the artifact and swap the index WITHOUT bumping the
+        generation — the delta-apply path: an additive delta cannot
+        change untouched tiles' bytes, so their cache entries stay
+        valid and the caller invalidates only the affected keys
+        (heatmap_tpu.delta.refresh_serving). Returns the (unchanged)
+        generation."""
+        built = self._build()
+        with self._lock:
+            self._layers = built
             return self.generation
 
     def _build(self) -> dict[str, Layer]:
         if self.kind == "arrays":
             by_pair = self._build_from_levels(_load_levels(self.path))
+        elif self.kind == "delta":
+            from heatmap_tpu.delta.compact import load_overlay_levels
+
+            by_pair = self._build_from_levels(
+                _finalized_to_loaded(load_overlay_levels(self.path)))
         else:
             by_pair = self._build_from_blobs(
                 _iter_blob_records(self.kind, self.path))

@@ -78,8 +78,14 @@ class CheckpointManager:
         return os.path.join(self.directory, f"ckpt-{step}.npz")
 
     def steps(self) -> list[int]:
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            # Directory removed by a concurrent maintenance pass —
+            # same answer as an empty directory.
+            return []
         out = []
-        for name in os.listdir(self.directory):
+        for name in names:
             m = _STEP_RE.match(name)
             if m:
                 out.append(int(m.group(1)))
@@ -108,10 +114,29 @@ class CheckpointManager:
                 )
         return load_checkpoint(self._path(step))
 
-    def _prune(self):
+    def prune(self, keep: int | None = None):
+        """Delete all but the newest ``keep`` checkpoints (default:
+        the manager's retention).
+
+        Robust to a concurrent maintenance pass racing us: a file that
+        vanishes between the listing and the unlink is somebody else's
+        successful deletion, not a failure — skip it and keep pruning
+        the rest. ``keep=0`` deletes everything (the delta journal's
+        retention pass uses this once every entry has been folded into
+        a compacted base).
+        """
+        keep = self.keep if keep is None else keep
+        if keep < 0:
+            raise ValueError("keep must be >= 0")
         steps = self.steps()
-        for s in steps[: -self.keep]:
+        doomed = steps[:-keep] if keep else steps
+        for s in doomed:
             try:
                 os.unlink(self._path(s))
+            except FileNotFoundError:
+                continue  # concurrently deleted — keep pruning
             except OSError:
-                pass
+                continue
+
+    def _prune(self):
+        self.prune()

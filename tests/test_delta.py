@@ -1,0 +1,291 @@
+"""Incremental update engine tests (heatmap_tpu/delta/).
+
+The anchor everything hangs on: **base ⊕ deltas is byte-identical to a
+full recompute over the union of surviving points** — at the
+served-blob level, before AND after compaction, including a retraction
+batch. Plus the two operational contracts: idempotent re-submits (same
+bytes, no new epoch) and serve-side targeted invalidation (a delta
+apply drops only the affected tile keys; untouched cache entries
+survive with no generation bump).
+
+Tier-1: CPU backend, real cascade runs (small shapes), no network.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import delta
+from heatmap_tpu.delta.compute import ColumnsSource, read_columns
+from heatmap_tpu.delta.journal import DeltaJournal, batch_content_hash
+from heatmap_tpu.io import open_source
+from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.pipeline import BatchJobConfig, run_job
+from heatmap_tpu.serve import TileCache, TileStore
+from heatmap_tpu.serve.render import tile_json_bytes
+from heatmap_tpu.tilemath.mercator import project_points_np
+from heatmap_tpu.tilemath.morton import morton_decode_np
+
+BASE_SPEC = "synthetic:3000:7"
+DELTA_SPEC = "synthetic:300:11"
+RETRACT_ROWS = 500  # first N base rows get retracted
+
+
+class _Chain:
+    def __init__(self, *sources):
+        self.sources = sources
+
+    def batches(self, batch_size: int = 1 << 20):
+        for src in self.sources:
+            yield from src.batches(batch_size)
+
+
+def _slice_cols(cols: dict, sl: slice) -> dict:
+    return {k: v[sl] for k, v in cols.items()}
+
+
+def _collect_docs(store: TileStore) -> dict:
+    """Every servable JSON tile of every layer: {(layer, z, x, y):
+    bytes}. Enumerates stored zooms from the level Morton codes, so the
+    two stores must agree on which tiles exist, not just their
+    contents."""
+    docs = {}
+    for name, layer in store.layers.items():
+        if name == "default":  # alias of all|alltime, not a new layer
+            continue
+        shift = 2 * layer.result_delta
+        for want, level in layer.levels.items():
+            z = want - layer.result_delta
+            if z < 0:
+                continue
+            rows, cols = morton_decode_np(np.unique(level.codes >> shift))
+            for r, c in zip(rows, cols):
+                docs[(name, z, int(c), int(r))] = tile_json_bytes(
+                    layer, z, int(c), int(r))
+    return docs
+
+
+def _tree_digest(root: str) -> str:
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            path = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+@pytest.fixture(scope="module")
+def scenario(tmp_path_factory):
+    """One full store lifecycle, snapshotted at every contract point:
+
+    epoch 1  base batch        (synthetic:3000:7)
+    epoch 2  insert delta      (synthetic:300:11)
+    dup      re-apply epoch 2  (must be a no-op)
+    epoch 3  retraction        (first 500 base rows, sign=-1)
+    compact  retention=2       (folds 1-3 into base-000003)
+
+    The reference pyramid is a single full recompute over the union of
+    surviving points (base rows 500.. plus the delta batch).
+    """
+    root = str(tmp_path_factory.mktemp("delta_store") / "store")
+    config = BatchJobConfig(detail_zoom=10, min_detail_zoom=5)
+
+    r1 = delta.apply_batch(root, open_source(BASE_SPEC), config)
+    r2 = delta.apply_batch(root, open_source(DELTA_SPEC), config)
+
+    digest_before_dup = _tree_digest(root)
+    epochs_before_dup = DeltaJournal(delta.compact_mod.journal_dir(root)).epochs()
+    r2_dup = delta.apply_batch(root, open_source(DELTA_SPEC), config)
+    digest_after_dup = _tree_digest(root)
+    epochs_after_dup = DeltaJournal(delta.compact_mod.journal_dir(root)).epochs()
+
+    base_cols = read_columns(open_source(BASE_SPEC))
+    retract = ColumnsSource(_slice_cols(base_cols, slice(0, RETRACT_ROWS)))
+    r3 = delta.apply_batch(root, retract, config, sign=-1)
+
+    # The reference: one job over exactly the surviving points.
+    survivors = ColumnsSource(_slice_cols(base_cols,
+                                          slice(RETRACT_ROWS, None)))
+    full_dir = str(tmp_path_factory.mktemp("delta_full") / "levels")
+    run_job(_Chain(survivors, open_source(DELTA_SPEC)),
+            LevelArraysSink(full_dir), config)
+
+    docs_full = _collect_docs(TileStore(f"arrays:{full_dir}"))
+    docs_before = _collect_docs(TileStore(f"delta:{root}"))
+
+    summary = delta.compact(root, retention=2)
+    docs_after = _collect_docs(TileStore(f"delta:{root}"))
+
+    return {
+        "root": root, "config": config,
+        "r1": r1, "r2": r2, "r2_dup": r2_dup, "r3": r3,
+        "digest_before_dup": digest_before_dup,
+        "digest_after_dup": digest_after_dup,
+        "epochs_before_dup": epochs_before_dup,
+        "epochs_after_dup": epochs_after_dup,
+        "docs_full": docs_full, "docs_before": docs_before,
+        "docs_after": docs_after, "compact_summary": summary,
+    }
+
+
+class TestEquivalence:
+    def test_blob_identity_before_compaction(self, scenario):
+        """base ⊕ deltas (incl. the retraction) serves byte-identical
+        JSON docs to the full recompute — same tile set, same bytes."""
+        assert scenario["docs_before"].keys() == scenario["docs_full"].keys()
+        assert scenario["docs_before"] == scenario["docs_full"]
+        assert len(scenario["docs_full"]) > 50  # non-trivial pyramid
+
+    def test_blob_identity_after_compaction(self, scenario):
+        assert scenario["docs_after"] == scenario["docs_full"]
+
+    def test_compaction_summary_and_pointer(self, scenario):
+        cur = delta.read_current(scenario["root"])
+        assert scenario["compact_summary"]["status"] == "ok"
+        assert cur["base"] == "base-000003"
+        assert cur["applied_through"] == 3
+        # folded artifacts outside the retention window are gone
+        assert not os.path.isdir(
+            os.path.join(scenario["root"], "delta-000001"))
+
+    def test_retraction_removed_mass(self, scenario):
+        """The retraction epoch actually subtracted: its artifact rows
+        carry negative values, and the journal records sign=-1."""
+        assert scenario["r3"].sign == -1
+        assert scenario["r3"].rows > 0
+        levels = LevelArraysSink.load(
+            os.path.join(scenario["root"], scenario["r3"].artifact))
+        finest = levels[max(levels)]
+        assert np.all(np.asarray(finest["value"]) < 0)
+
+
+class TestIdempotency:
+    def test_duplicate_apply_is_a_noop(self, scenario):
+        """Re-applying a journaled batch: same store bytes, no new
+        epoch, no artifact written, duplicate flagged."""
+        assert scenario["r2_dup"].duplicate
+        assert not scenario["r2"].duplicate
+        assert scenario["r2_dup"].epoch == scenario["r2"].epoch
+        assert scenario["r2_dup"].artifact == scenario["r2"].artifact
+        assert scenario["r2_dup"].rows == 0
+        assert scenario["digest_after_dup"] == scenario["digest_before_dup"]
+        assert scenario["epochs_after_dup"] == scenario["epochs_before_dup"]
+
+    def test_duplicate_detection_survives_compaction(self, scenario):
+        """Epochs inside the retention window stay journaled after
+        compaction, so their re-submits are still no-ops."""
+        res = delta.apply_batch(scenario["root"], open_source(DELTA_SPEC),
+                                scenario["config"])
+        assert res.duplicate
+        assert res.epoch == scenario["r2"].epoch
+
+    def test_retraction_hash_differs_from_insert(self):
+        cols = {"latitude": np.array([1.0]), "longitude": np.array([2.0]),
+                "user_id": ["u"]}
+        assert (batch_content_hash(cols, sign=1)
+                != batch_content_hash(cols, sign=-1))
+        assert batch_content_hash(cols, sign=1).startswith("sha256:")
+
+    def test_config_mismatch_refused(self, scenario):
+        other = BatchJobConfig(detail_zoom=8, min_detail_zoom=5)
+        src = ColumnsSource({"latitude": np.array([1.0]),
+                             "longitude": np.array([2.0]),
+                             "user_id": ["u-mismatch"]})
+        with pytest.raises(ValueError, match="was built with config"):
+            delta.apply_batch(scenario["root"], src, other)
+
+
+class TestServing:
+    def test_targeted_invalidation(self, tmp_path):
+        """A delta apply invalidates only the affected tile keys: the
+        cached tile the delta point lands in is dropped, a cached tile
+        elsewhere survives, and the store generation does NOT bump (so
+        surviving entries stay valid, unlike reload())."""
+        config = BatchJobConfig(detail_zoom=8, min_detail_zoom=5)
+        root = str(tmp_path / "store")
+        delta.apply_batch(root, open_source("synthetic:1000:7"), config)
+        store = TileStore(f"delta:{root}")
+        cache = TileCache()
+        gen = store.generation
+
+        # One cached tile over the base data, one over the (empty) cell
+        # the delta point will land in — distinct z=5 tiles.
+        base_cols = read_columns(open_source("synthetic:1000:7"))
+        brow, bcol, _ = project_points_np(base_cols["latitude"][:1],
+                                          base_cols["longitude"][:1], 8)
+        untouched = ("default", 5, int(bcol[0]) >> 3, int(brow[0]) >> 3,
+                     "json")
+        drow, dcol, _ = project_points_np([40.0], [-100.0], 8)
+        touched = ("default", 5, int(dcol[0]) >> 3, int(drow[0]) >> 3,
+                   "json")
+        assert touched != untouched
+        cache.get_or_render(untouched, gen, lambda: b"U0")
+        cache.get_or_render(touched, gen, lambda: b"T0")
+
+        res = delta.apply_batch(
+            root,
+            ColumnsSource({"latitude": np.array([40.0]),
+                           "longitude": np.array([-100.0]),
+                           "user_id": ["u-delta"]}),
+            config)
+        assert touched in res.affected_keys
+        assert untouched not in res.affected_keys
+
+        dropped = delta.refresh_serving(res, store, cache)
+        assert dropped == 1  # only the touched key was cached
+        assert store.generation == gen  # no bump — that's the point
+
+        value, hit = cache.get_or_render(untouched, gen, lambda: b"U1")
+        assert hit and value == b"U0"  # untouched entry survived
+        value, hit = cache.get_or_render(touched, gen, lambda: b"T1")
+        assert not hit and value == b"T1"  # touched entry re-rendered
+
+        # And the refreshed index actually serves the delta point.
+        layer = store.layer("default")
+        doc = tile_json_bytes(layer, touched[1], touched[2], touched[3])
+        assert doc is not None
+
+    def test_duplicate_refresh_is_free(self, tmp_path):
+        class _Boom:
+            def refresh_layers(self):  # pragma: no cover - must not run
+                raise AssertionError("duplicate apply must not refresh")
+
+        res = delta.DeltaResult(epoch=1, points=1, sign=1, duplicate=True,
+                                artifact="delta-000001", rows=0,
+                                seconds=0.0)
+        assert delta.refresh_serving(res, _Boom(), TileCache()) == 0
+
+    def test_tile_formats_pinned_to_serve(self):
+        from heatmap_tpu.delta import compute
+        from heatmap_tpu.serve import live
+
+        assert compute.TILE_FORMATS == live.TILE_FORMATS
+
+
+class TestStoreLayout:
+    def test_orphan_artifact_is_invisible(self, tmp_path):
+        """A delta dir with no journal entry (crashed apply: artifact
+        written, append lost) never reaches the overlay."""
+        root = str(tmp_path / "store")
+        delta.init_store(root)
+        os.makedirs(os.path.join(root, "delta-000099"))
+        assert delta.overlay_dirs(root) == []
+        assert delta.load_overlay_levels(root) == []
+
+    def test_base_adoption_refuses_double_init(self, tmp_path):
+        src = tmp_path / "base_src"
+        src.mkdir()
+        (src / "marker").write_text("x")
+        root = str(tmp_path / "store")
+        cur = delta.init_store(root, base_dir=str(src))
+        assert cur["base"] == "base-000000"
+        assert os.path.exists(os.path.join(root, "base-000000", "marker"))
+        with pytest.raises(ValueError, match="already has base"):
+            delta.init_store(root, base_dir=str(src))

@@ -927,3 +927,69 @@ class TestMergeShards:
         LevelArraysSink(str(b)).write_levels([lvl(4)])
         with pytest.raises(ValueError, match="coarse_zoom"):
             merge_level_dirs([str(a), str(b)])
+
+    @staticmethod
+    def _lvl(rows, cols, values, zoom=8, coarse_zoom=3, user="all"):
+        n = len(rows)
+        return {
+            "zoom": zoom, "coarse_zoom": coarse_zoom,
+            "row": np.asarray(rows), "col": np.asarray(cols),
+            "value": np.asarray(values, np.float64),
+            "user_idx": np.zeros(n, np.int32),
+            "timespan_idx": np.zeros(n, np.int32),
+            "user_names": np.asarray([user]),
+            "timespan_names": np.asarray(["alltime"]),
+            "coarse_row": np.zeros(n, np.int64),
+            "coarse_col": np.zeros(n, np.int64),
+        }
+
+    def test_level_parts_empty_part_is_identity(self):
+        """An empty part (a host that ingested nothing) contributes
+        nothing — the merge equals merging the non-empty part alone."""
+        from heatmap_tpu.io.merge import merge_level_parts
+
+        part = [self._lvl([1, 2], [3, 4], [1.0, 2.0])]
+        alone = merge_level_parts([part])
+        with_empty = merge_level_parts([part, []])
+        assert len(with_empty) == len(alone) == 1
+        for key in ("row", "col", "value", "user_idx", "timespan_idx"):
+            np.testing.assert_array_equal(with_empty[0][key], alone[0][key])
+
+    def test_level_parts_disjoint_keys_union_unsummed(self):
+        """Parts with disjoint (timespan, user, row, col) keys union:
+        every row survives with its original value — re-aggregation
+        only sums genuine collisions."""
+        from heatmap_tpu.io.merge import merge_level_parts
+
+        a = [self._lvl([1], [1], [5.0])]
+        b = [self._lvl([2], [2], [7.0])]
+        (merged,) = merge_level_parts([a, b])
+        np.testing.assert_array_equal(merged["row"], [1, 2])
+        np.testing.assert_array_equal(merged["col"], [1, 2])
+        np.testing.assert_array_equal(merged["value"], [5.0, 7.0])
+
+    def test_level_dirs_missing_shard_dir_raises(self, tmp_path):
+        """A listed-but-absent shard dir is a hard error (a silently
+        skipped host would under-count every tile it owned)."""
+        from heatmap_tpu.io.merge import merge_level_dirs
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        a = tmp_path / "host000"
+        LevelArraysSink(str(a)).write_levels([self._lvl([1], [1], [1.0])])
+        with pytest.raises(FileNotFoundError):
+            merge_level_dirs([str(a), str(tmp_path / "host001")])
+
+    def test_level_dirs_empty_shard_dir_contributes_nothing(self, tmp_path):
+        """An existing-but-empty shard dir (host wrote no levels) is a
+        valid empty contribution, not an error."""
+        from heatmap_tpu.io.merge import merge_level_dirs
+        from heatmap_tpu.io.sinks import LevelArraysSink
+
+        a, b = tmp_path / "host000", tmp_path / "host001"
+        LevelArraysSink(str(a)).write_levels([self._lvl([1], [2], [3.0])])
+        b.mkdir()
+        merged = merge_level_dirs([str(a), str(b)])
+        (alone,) = merge_level_dirs([str(a)])
+        assert len(merged) == 1
+        np.testing.assert_array_equal(merged[0]["value"], alone["value"])
+        np.testing.assert_array_equal(merged[0]["row"], alone["row"])

@@ -38,6 +38,19 @@ _PAYLOADS = {
     "http_request": {"route": "tiles", "status": 200,
                      "path": "/tiles/default/7/20/44.json", "ms": 1.2,
                      "bytes": 512, "cache": "hit"},
+    "store_reload": {"old_generation": 0, "generation": 1, "levels": 5,
+                     "seconds": 0.1, "spec": "delta:store/", "layers": 3,
+                     "initial": False},
+    "delta_applied": {"epoch": 2, "points": 300, "sign": 1,
+                      "seconds": 0.8, "content_hash": "sha256:00",
+                      "artifact": "delta-000002", "rows": 120,
+                      "duplicate": False, "watermark": 1.7e12,
+                      "keys_invalidated": 42},
+    "compaction_start": {"root": "store/", "deltas": 3,
+                         "base": "base-000001"},
+    "compaction_end": {"root": "store/", "seconds": 0.4, "status": "ok",
+                       "base": "base-000004", "levels": 5, "rows": 2048,
+                       "pruned_entries": 2},
     "run_end": {"status": "ok", "blobs": 42, "checksum": "crc32:00000000",
                 "seconds": 1.0},
 }
@@ -455,3 +468,16 @@ class TestNoRawInstrumentation:
         # And the guard pattern does bite on what serve must not do.
         assert self.PATTERN.search("print('GET /tiles 200')")
         assert self.PATTERN.search("t0 = time.perf_counter()")
+
+    def test_delta_tree_is_guarded(self):
+        """The delta/ package times applies and compactions — that must
+        flow through the obs metrics/events, never ad-hoc timers or
+        progress prints: pin that the tree exists, is scanned, and is
+        not allowed."""
+        delta = os.path.join(REPO, "heatmap_tpu", "delta")
+        assert os.path.isdir(delta)
+        scanned = [f for f in os.listdir(delta) if f.endswith(".py")]
+        assert "journal.py" in scanned and "compact.py" in scanned
+        assert not any(a.startswith("heatmap_tpu/delta")
+                       for a in self.ALLOWED)
+        assert self.PATTERN.search("print('compacted 3 deltas')")

@@ -6,6 +6,7 @@ greenfield implementations.
 """
 
 import os
+import shutil
 import time
 
 import numpy as np
@@ -95,6 +96,48 @@ def test_checkpoint_manager_empty(tmp_path):
     assert mgr.latest_step() is None
     with pytest.raises(FileNotFoundError):
         mgr.load()
+
+
+def test_prune_survives_concurrently_deleted_file(tmp_path, monkeypatch):
+    """A file that vanishes between the listing and the unlink (another
+    maintenance pass got there first) must not abort the prune — the
+    remaining doomed checkpoints still get deleted."""
+    import heatmap_tpu.utils.checkpoint as ckpt_mod
+
+    mgr = CheckpointManager(str(tmp_path), keep=5)
+    for step in (1, 2, 3, 4):
+        mgr.save(step, {"x": np.zeros(1)})
+    victim = mgr._path(1)
+    real_unlink = os.unlink
+
+    def racing_unlink(path, *args, **kwargs):
+        if os.path.abspath(path) == os.path.abspath(victim):
+            real_unlink(path)  # the "other" pass deletes it first...
+        return real_unlink(path, *args, **kwargs)  # ...then we ENOENT
+
+    monkeypatch.setattr(ckpt_mod.os, "unlink", racing_unlink)
+    mgr.prune(keep=1)  # must not raise on the vanished ckpt-1
+    assert mgr.steps() == [4]
+
+
+def test_prune_keep_zero_and_validation(tmp_path):
+    mgr = CheckpointManager(str(tmp_path), keep=3)
+    for step in (1, 2):
+        mgr.save(step, {"x": np.zeros(1)})
+    with pytest.raises(ValueError, match="keep"):
+        mgr.prune(keep=-1)
+    mgr.prune(keep=0)
+    assert mgr.steps() == []
+
+
+def test_steps_on_removed_directory_is_empty(tmp_path):
+    """steps() on a directory a concurrent pass removed entirely reads
+    as an empty store, not a crash."""
+    d = tmp_path / "ckpts"
+    mgr = CheckpointManager(str(d))
+    shutil.rmtree(d)
+    assert mgr.steps() == []
+    assert mgr.latest_step() is None
 
 
 # ------------------------------------------------------------- recovery

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""Incremental delta apply vs. full recompute: BENCH_delta.json.
+
+For each base:delta size ratio, builds a delta store whose base holds
+``--base-points`` synthetic points (compacted, so the overlay starts
+clean), then measures two ways of absorbing one new batch of
+``base_points / ratio`` points:
+
+- **full**  — the reference shape: re-run the whole batch job over the
+  union of old + new points (``run_job`` into a fresh columnar
+  artifact);
+- **incremental** — ``delta.apply_batch``: journal the batch, cascade
+  only the new points, emit a delta artifact the serve overlay merges
+  on read.
+
+Both paths run in process on the same backend; the pyramids they
+produce are byte-equivalent at the served-blob level (pinned by
+tests/test_delta.py), so the comparison is pure wall-clock. The
+headline number is the speedup at 100:1 — the "minutes-scale full
+recompute becomes seconds-scale delta apply" claim made measurable.
+
+The record mirrors tools/bench_job.py / load_gen.py: one JSON object
+with the headline numbers plus the same folded ``run_report`` block
+(obs.build_run_report over the shared in-process registry), so delta
+benches land in the bench trajectory schema-compatible with the rest.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/bench_delta.py \
+        [--base-points 200000] [--ratios 100,20,5] \
+        [--detail-zoom 12] [--out BENCH_delta.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+class _Chain:
+    """Concatenate sources: the union job reads old + new points as one
+    stream (synthetic sources are deterministic, so re-opening them
+    replays the exact same points the store ingested)."""
+
+    def __init__(self, *sources):
+        self.sources = sources
+
+    def batches(self, batch_size: int = 1 << 20):
+        for src in self.sources:
+            yield from src.batches(batch_size)
+
+
+def bench_ratio(ratio: int, base_points: int, config, tmpdir: str) -> dict:
+    from heatmap_tpu import delta
+    from heatmap_tpu.io import open_source
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.pipeline import run_job
+
+    delta_points = max(1, base_points // ratio)
+    base_spec = f"synthetic:{base_points}:7"
+    delta_spec = f"synthetic:{delta_points}:11"
+    root = os.path.join(tmpdir, f"store-{ratio}")
+
+    # Base build rides the delta engine itself (apply + compact) — it
+    # also warms the jit caches so neither measured path pays first-
+    # compile alone.
+    t0 = time.perf_counter()
+    delta.apply_batch(root, open_source(base_spec), config)
+    delta.compact(root, retention=0)
+    base_s = time.perf_counter() - t0
+
+    # Full recompute over the union (the reference's only option).
+    full_dir = os.path.join(tmpdir, f"full-{ratio}")
+    t0 = time.perf_counter()
+    full_stats = run_job(
+        _Chain(open_source(base_spec), open_source(delta_spec)),
+        LevelArraysSink(full_dir), config)
+    full_s = time.perf_counter() - t0
+
+    # Incremental: journal + cascade only the new points. One warmup
+    # apply (different seed, same size) first — steady-state serving
+    # applies a stream of similar-size batches, so the measured apply
+    # should not be the one paying the small-shape jit compile.
+    delta.apply_batch(root, open_source(f"synthetic:{delta_points}:13"),
+                      config)
+    t0 = time.perf_counter()
+    res = delta.apply_batch(root, open_source(delta_spec), config)
+    incr_s = time.perf_counter() - t0
+
+    shutil.rmtree(full_dir, ignore_errors=True)
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "ratio": ratio,
+        "base_points": base_points,
+        "delta_points": delta_points,
+        "base_build_s": round(base_s, 3),
+        "full_recompute_s": round(full_s, 3),
+        "incremental_apply_s": round(incr_s, 3),
+        "speedup": round(full_s / incr_s, 2) if incr_s else None,
+        "full_rows": int(full_stats.get("rows", 0))
+        if isinstance(full_stats, dict) else None,
+        "delta_rows": res.rows,
+        "affected_keys": len(res.affected_keys),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--base-points", type=int, default=200_000)
+    ap.add_argument("--ratios", default="100,20,5",
+                    help="comma list of base:delta ratios")
+    ap.add_argument("--detail-zoom", type=int, default=12)
+    ap.add_argument("--min-detail-zoom", type=int, default=5)
+    ap.add_argument("--out", default="BENCH_delta.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu import obs
+    from heatmap_tpu.pipeline import BatchJobConfig
+    from heatmap_tpu.utils.trace import get_tracer
+
+    obs.enable_metrics(True)
+    config = BatchJobConfig(detail_zoom=args.detail_zoom,
+                            min_detail_zoom=args.min_detail_zoom)
+    ratios = [int(r) for r in args.ratios.split(",") if r.strip()]
+    tmpdir = tempfile.mkdtemp(prefix="benchdelta-")
+    results = []
+    try:
+        for ratio in ratios:
+            row = bench_ratio(ratio, args.base_points, config, tmpdir)
+            print(json.dumps({k: row[k] for k in
+                              ("ratio", "full_recompute_s",
+                               "incremental_apply_s", "speedup")}),
+                  flush=True)
+            results.append(row)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    record = {
+        "bench": "delta",
+        "base_points": args.base_points,
+        "detail_zoom": args.detail_zoom,
+        "results": results,
+        # Same folded block bench_job.py embeds: delta benches stay
+        # schema-compatible with job benches in the bench trajectory.
+        "run_report": obs.build_run_report(tracer=get_tracer(),
+                                           registry=obs.get_registry()),
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, default=str)
+        f.write("\n")
+    print(json.dumps({"wrote": args.out}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
