@@ -48,11 +48,20 @@ def _add_backend_flags(p):
         help="keep JAX in 32-bit mode (the composite-key cascade needs "
         "x64; only the dense tiles path works without it)",
     )
+    p.add_argument(
+        "--chaos", default=None, metavar="SPEC",
+        help="arm deterministic fault injection (heatmap_tpu.faults), "
+        "e.g. 'seed=7,source.read=3x5,sink.write=p0.01'; also read "
+        "from $HEATMAP_TPU_CHAOS (flag wins). See docs/robustness.md",
+    )
 
 
 def _init_backend(args):
     import jax
 
+    from heatmap_tpu import faults
+
+    faults.install_from_env(getattr(args, "chaos", None))
     if args.backend == "cpu":
         jax.config.update("jax_platforms", "cpu")
     if not args.no_x64:
@@ -787,9 +796,11 @@ def cmd_serve(args) -> int:
     never initializes a jax backend, so the server stays up next to a
     dead accelerator relay.
     """
-    from heatmap_tpu import obs
+    from heatmap_tpu import faults, obs
     from heatmap_tpu.serve import ServeApp, TileCache, TileStore, make_server
 
+    # serve skips _init_backend (numpy-only), so arm chaos here too.
+    faults.install_from_env(getattr(args, "chaos", None))
     # /metrics is a first-class endpoint here, not an opt-in artifact.
     obs.enable_metrics(True)
     ev_log = None
@@ -808,7 +819,8 @@ def cmd_serve(args) -> int:
         raise SystemExit(str(e)) from e
     cache = TileCache(max_bytes=args.cache_bytes,
                       ttl_s=ttl if (ttl and ttl > 0) else None)
-    app = ServeApp(store, cache)
+    app = ServeApp(store, cache,
+                   render_timeout_s=getattr(args, "render_timeout", None))
     stop_stream = None
     if args.follow_stream:
         stop_stream = _follow_stream(args, app)
@@ -1364,6 +1376,12 @@ def build_parser() -> argparse.ArgumentParser:
                          help="comma list of name=user|timespan layer "
                          "mounts (default: every slice in the artifact "
                          "plus 'default' -> all|alltime)")
+    p_serve.add_argument("--render-timeout", type=float, default=None,
+                         metavar="S",
+                         help="per-tile render deadline in seconds; a "
+                         "render past it serves the last-good cached "
+                         "bytes (stale-200) or a typed 503, never a "
+                         "hung request (docs/robustness.md)")
     p_serve.add_argument("--events", default=None, metavar="PATH",
                          help="append http_request events to PATH (JSONL, "
                          "docs/observability.md)")

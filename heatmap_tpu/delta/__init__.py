@@ -39,9 +39,11 @@ from heatmap_tpu.delta.compact import (check_config, compact, init_store,
                                        overlay_dirs, read_current)
 from heatmap_tpu.delta.compute import (ColumnsSource, affected_tile_keys,
                                        compute_delta, read_columns)
-from heatmap_tpu.delta.journal import DeltaJournal, batch_content_hash
+from heatmap_tpu.delta.journal import (DeltaJournal, batch_content_hash,
+                                       entry_digest)
 from heatmap_tpu.delta.metrics import (COMPACTION_SECONDS,
                                        DELTA_APPLY_SECONDS, DELTA_POINTS)
+from heatmap_tpu.delta.recover import sweep
 from heatmap_tpu.io.sinks import LevelArraysSink
 
 
@@ -141,6 +143,7 @@ __all__ = [
     "COMPACTION_SECONDS", "ColumnsSource", "DELTA_APPLY_SECONDS",
     "DELTA_POINTS", "DeltaJournal", "DeltaResult", "affected_tile_keys",
     "apply_batch", "batch_content_hash", "check_config", "compact",
-    "compute_delta", "init_store", "live_entries", "load_overlay_levels",
-    "overlay_dirs", "read_columns", "read_current", "refresh_serving",
+    "compute_delta", "entry_digest", "init_store", "live_entries",
+    "load_overlay_levels", "overlay_dirs", "read_columns", "read_current",
+    "refresh_serving", "sweep",
 ]

@@ -16,14 +16,18 @@ re-aggregation the multihost shard merge uses — then prune exact-zero
 cells left by retractions, so the overlay is indistinguishable from a
 full recompute over the surviving points.
 
-Compaction writes the merged pyramid to a ``.tmp`` dir, renames it to
-its final ``base-XXXXXX`` name, then atomically rewrites CURRENT (the
-``save_checkpoint`` crash-safety contract: tmp + fsync + os.replace).
-A crash at any point leaves either the old pointer with the old base
-intact, or the new pointer with the new base complete — never a
-half-merged store. Superseded bases and journal entries older than the
-retention window are pruned afterwards; an orphan dir from a crashed
-pass is overwritten by the next one.
+Compaction writes the merged pyramid to a ``.tmp`` dir, publishes it to
+its final ``base-XXXXXX`` name through ``utils.checkpoint.publish_dir``
+(per-file fsync + rename + parent-dir fsync — the directory-shaped
+``save_checkpoint`` contract), then atomically rewrites CURRENT (tmp +
+fsync + os.replace + parent fsync). A crash at any point leaves either
+the old pointer with the old base intact, or the new pointer with the
+new base complete — never a half-merged store. Superseded bases and
+journal entries older than the retention window are pruned afterwards;
+garbage from a crashed pass (orphan ``*.tmp`` staging dirs, an
+unflipped base) is quarantined by the recovery sweep
+(delta/recover.py) that runs at the head of ``init_store`` and
+``compact``.
 """
 
 from __future__ import annotations
@@ -36,9 +40,11 @@ import time
 
 import numpy as np
 
+from heatmap_tpu import faults
 from heatmap_tpu.delta.journal import DeltaJournal
 from heatmap_tpu.io.merge import merge_level_dirs
 from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.utils.checkpoint import fsync_dir, publish_dir
 
 CURRENT_SCHEMA = "heatmap-tpu.delta_store.v1"
 JOURNAL_DIRNAME = "journal"
@@ -67,30 +73,45 @@ def read_current(root: str) -> dict:
 
 
 def write_current(root: str, cur: dict):
-    """Atomic pointer flip: tmp + fsync + os.replace, the
-    save_checkpoint contract."""
-    fd, tmp = tempfile.mkstemp(dir=root, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "w") as f:
-            json.dump(cur, f, indent=2, sort_keys=True)
-            f.write("\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, os.path.join(root, "CURRENT"))
-    except BaseException:
+    """Atomic pointer flip: tmp + fsync + os.replace + parent-dir
+    fsync, the save_checkpoint contract. Runs under the
+    ``compact.publish`` fault site + retry policy — the flip is atomic,
+    so a retried attempt lands the pointer exactly once."""
+
+    def _flip():
+        fd, tmp = tempfile.mkstemp(dir=root, suffix=".tmp")
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+            with os.fdopen(fd, "w") as f:
+                json.dump(cur, f, indent=2, sort_keys=True)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(root, "CURRENT"))
+            fsync_dir(root)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    faults.retry_call(_flip, site="compact.publish", key="current")
 
 
 def init_store(root: str, base_dir: str | None = None) -> dict:
     """Create (or no-op on) a delta store root; optionally adopt an
     existing arrays artifact as the initial base (copied in, so the
-    store owns its files and compaction can prune them)."""
+    store owns its files and compaction can prune them).
+
+    Runs the crash-recovery sweep first (delta/recover.py), so every
+    apply starts from a store with no torn journal entries or orphan
+    staging dirs — a batch whose entry was quarantined re-journals
+    under a fresh epoch and applies cleanly."""
+    from heatmap_tpu.delta import recover
+
     os.makedirs(root, exist_ok=True)
     os.makedirs(journal_dir(root), exist_ok=True)
+    recover.sweep(root)
     cur = read_current(root)
     if base_dir is not None:
         if cur.get("base"):
@@ -194,8 +215,10 @@ def compact(root: str, *, retention: int = 2) -> dict:
     (compacting nothing would only rewrite the base it already has).
     """
     from heatmap_tpu import obs
+    from heatmap_tpu.delta import recover
     from heatmap_tpu.delta.metrics import COMPACTION_SECONDS
 
+    recover.sweep(root)
     cur = read_current(root)
     journal = DeltaJournal(journal_dir(root))
     live = live_entries(root)
@@ -212,13 +235,12 @@ def compact(root: str, *, retention: int = 2) -> dict:
         new_epoch = max(e["epoch"] for e in live)
         new_name = f"base-{new_epoch:06d}"
         new_path = os.path.join(root, new_name)
+        # The sweep above quarantined any orphan tmp/base dirs from a
+        # crashed pass, so both staging and final paths start absent.
         tmp_path = new_path + ".tmp"
-        if os.path.isdir(tmp_path):
-            shutil.rmtree(tmp_path)
         rows = LevelArraysSink(tmp_path).write_levels(merged)
-        if os.path.isdir(new_path):  # orphan of a crashed pass
-            shutil.rmtree(new_path)
-        os.rename(tmp_path, new_path)
+        faults.retry_call(publish_dir, tmp_path, new_path,
+                          site="compact.publish", key="base")
         cur = dict(cur)
         cur["base"] = new_name
         cur["applied_through"] = int(new_epoch)

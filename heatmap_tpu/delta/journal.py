@@ -24,10 +24,12 @@ retention window to cover the upstream's maximum redelivery horizon.
 from __future__ import annotations
 
 import hashlib
+import os
 import time
 
 import numpy as np
 
+from heatmap_tpu import faults
 from heatmap_tpu.utils.checkpoint import CheckpointManager, save_checkpoint
 
 #: Columns hashed (when present) to derive a batch identity. Floats are
@@ -55,6 +57,31 @@ def batch_content_hash(cols: dict, sign: int = 1) -> str:
         if name in cols and len(cols[name]):
             h.update(name.encode())
             h.update("\x00".join(str(v) for v in cols[name]).encode())
+    return "sha256:" + h.hexdigest()
+
+
+def entry_digest(root: str, *, content_hash: str, sign: int, points: int,
+                 artifact: str) -> str:
+    """Integrity digest binding a journal entry to its artifact bytes.
+
+    Hashes the entry's identity fields plus every file in the artifact
+    directory (sorted by name), so a torn artifact write, a swapped
+    artifact, or a tampered ``content_hash`` in the entry meta all
+    produce a digest mismatch the recovery sweep (delta/recover.py)
+    quarantines. Stored in the entry meta as ``entry_digest``; entries
+    from stores predating the field skip verification (legacy).
+    """
+    h = hashlib.sha256()
+    h.update(f"{content_hash}|{int(sign)}|{int(points)}|{artifact}".encode())
+    d = os.path.join(root, artifact)
+    if os.path.isdir(d):
+        for name in sorted(os.listdir(d)):
+            full = os.path.join(d, name)
+            if not os.path.isfile(full):
+                continue
+            h.update(name.encode())
+            with open(full, "rb") as f:
+                h.update(f.read())
     return "sha256:" + h.hexdigest()
 
 
@@ -109,6 +136,7 @@ class DeltaJournal:
         if existing is not None:
             return existing
         epoch = self.next_epoch()
+        root = os.path.dirname(os.path.abspath(self.directory))
         meta = {
             "epoch": epoch,
             "content_hash": content_hash,
@@ -117,8 +145,14 @@ class DeltaJournal:
             "artifact": artifact,
             "watermark": watermark,
             "ts": time.time(),
+            "entry_digest": entry_digest(root, content_hash=content_hash,
+                                         sign=sign, points=points,
+                                         artifact=artifact),
         }
-        save_checkpoint(self._mgr._path(epoch), {}, meta)
+        # save_checkpoint is atomic, so a retried append (real transient
+        # or injected journal.append fault) lands the entry exactly once.
+        faults.retry_call(save_checkpoint, self._mgr._path(epoch), {}, meta,
+                          site="journal.append")
         return meta
 
     def prune(self, *, applied_through: int, retention: int) -> list[dict]:

@@ -1,0 +1,173 @@
+"""Crash-recovery sweep for the delta store.
+
+The store's write paths are atomic (save_checkpoint entries, tmp+rename
+artifact publishes, the CURRENT pointer flip), so a crash can only
+leave *garbage*, never a half-applied state the read path would serve:
+orphan ``*.tmp`` staging files/dirs, a journal entry torn mid-write by
+a power cut that beat the fsync, or an artifact dir whose journal
+append never landed. This sweep finds all of it and moves it into
+``root/quarantine/`` — quarantine, not delete, so an operator can
+inspect what a chaotic run left behind — emitting one ``quarantine``
+obs event per item.
+
+What gets quarantined:
+
+- any ``*.tmp`` entry in the root or the journal dir (crashed staging);
+- journal entries that fail to load (torn npz), are missing required
+  meta fields, disagree with their filename epoch, or whose
+  ``entry_digest`` no longer matches the digest recomputed over the
+  meta identity + artifact bytes (tampered content hash, torn or
+  swapped artifact). Entries predating the digest field are legacy and
+  skip digest verification;
+- ``delta-XXXXXX`` dirs no surviving journal entry references (a
+  crashed apply; also freed when their entry was quarantined — the
+  next submit of that batch re-journals under a fresh epoch and
+  re-applies cleanly, exactly once);
+- ``base-XXXXXX`` dirs other than CURRENT's base (a compaction that
+  crashed between publishing the new base and flipping the pointer, or
+  between flipping and pruning).
+
+Digest verification re-hashes artifact bytes, so results are memoised
+per entry file identity (path, size, mtime_ns) — journaled entries and
+their artifacts are immutable by contract, making entry-file identity a
+sound cache key. ``clear_verified_cache`` resets it (tests).
+
+Runs at ``init_store`` (the head of every apply) and at the top of
+``compact``; the serve tier never sweeps — it is read-only and handles
+store corruption by degrading instead (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+
+from heatmap_tpu.delta.journal import entry_digest
+from heatmap_tpu.utils.checkpoint import load_checkpoint
+
+QUARANTINE_DIRNAME = "quarantine"
+
+_ENTRY_RE = re.compile(r"^ckpt-(\d+)\.npz$")
+_DELTA_RE = re.compile(r"^delta-\d{6}$")
+_BASE_RE = re.compile(r"^base-\d{6}$")
+
+_REQUIRED_META = ("epoch", "content_hash", "artifact", "sign", "points")
+
+# (entry abspath, size, mtime_ns) -> True for digest-verified entries.
+_VERIFIED: dict = {}
+
+
+def clear_verified_cache():
+    _VERIFIED.clear()
+
+
+def _quarantine(root: str, path: str, reason: str, kind: str,
+                items: list, detail: str | None = None):
+    from heatmap_tpu import obs
+
+    qdir = os.path.join(root, QUARANTINE_DIRNAME)
+    os.makedirs(qdir, exist_ok=True)
+    base = os.path.basename(path.rstrip(os.sep))
+    dest = os.path.join(qdir, base)
+    n = 0
+    while os.path.exists(dest):
+        n += 1
+        dest = os.path.join(qdir, f"{base}.{n}")
+    try:
+        shutil.move(path, dest)
+    except FileNotFoundError:
+        return  # concurrently removed — nothing left to quarantine
+    rel = os.path.relpath(path, root)
+    items.append({"path": rel, "reason": reason, "kind": kind})
+    fields = {"detail": detail} if detail else {}
+    obs.emit("quarantine", root=root, path=rel, reason=reason, kind=kind,
+             **fields)
+
+
+def _entry_fault(root: str, name: str, verify: bool):
+    """-> (meta, reason, detail): reason is None for a valid entry."""
+    path = os.path.join(root, "journal", name)
+    try:
+        st = os.stat(path)
+        cache_key = (os.path.abspath(path), st.st_size, st.st_mtime_ns)
+    except OSError:
+        return None, None, None  # vanished concurrently
+    if cache_key in _VERIFIED:
+        # Cached metas are not kept; reload (cheap — digest is the
+        # expensive part and that is what the cache skips).
+        verify = False
+    try:
+        _, meta = load_checkpoint(path)
+    except Exception as e:  # torn npz, bad zip, bad meta JSON
+        return None, "unreadable", repr(e)
+    missing = [k for k in _REQUIRED_META if meta.get(k) is None]
+    if missing:
+        return meta, "malformed", f"missing fields {missing}"
+    m = _ENTRY_RE.match(name)
+    if m and int(meta["epoch"]) != int(m.group(1)):
+        return meta, "malformed", (
+            f"epoch {meta['epoch']} != filename epoch {m.group(1)}")
+    recorded = meta.get("entry_digest")
+    if verify and recorded is not None:
+        actual = entry_digest(root, content_hash=meta["content_hash"],
+                              sign=meta["sign"], points=meta["points"],
+                              artifact=meta["artifact"])
+        if actual != recorded:
+            return meta, "digest_mismatch", (
+                f"recorded {recorded[:23]}..., actual {actual[:23]}...")
+        _VERIFIED[cache_key] = True
+    return meta, None, None
+
+
+def sweep(root: str, *, verify: bool = True) -> dict:
+    """Quarantine crash garbage under ``root``; see module docstring.
+
+    Returns ``{"quarantined": [{"path", "reason", "kind"}, ...]}``
+    (empty list when the store is clean or ``root`` does not exist).
+    """
+    from heatmap_tpu.delta.compact import journal_dir, read_current
+
+    items: list = []
+    if not os.path.isdir(root):
+        return {"quarantined": items}
+
+    # 1. Orphan *.tmp staging entries (root + journal dir).
+    for d in (root, journal_dir(root)):
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".tmp"):
+                _quarantine(root, os.path.join(d, name), "orphan_tmp",
+                            "tmp", items)
+
+    # 2. Torn / malformed / digest-mismatched journal entries.
+    jdir = journal_dir(root)
+    survivors: list = []
+    if os.path.isdir(jdir):
+        for name in sorted(os.listdir(jdir)):
+            if not _ENTRY_RE.match(name):
+                continue
+            meta, reason, detail = _entry_fault(root, name, verify)
+            if reason is not None:
+                _quarantine(root, os.path.join(jdir, name), reason,
+                            "journal_entry", items, detail)
+            elif meta is not None:
+                survivors.append(meta)
+
+    # 3. Delta artifacts no surviving entry references (crashed applies
+    #    and the artifacts of entries quarantined above).
+    referenced = {e["artifact"] for e in survivors}
+    cur = read_current(root)
+    for name in sorted(os.listdir(root)):
+        full = os.path.join(root, name)
+        if _DELTA_RE.match(name) and os.path.isdir(full):
+            if name not in referenced:
+                _quarantine(root, full, "orphan_artifact",
+                            "delta_artifact", items)
+        elif _BASE_RE.match(name) and os.path.isdir(full):
+            # 4. Bases CURRENT does not point at (crashed compaction).
+            if name != cur.get("base"):
+                _quarantine(root, full, "orphan_base", "base", items)
+
+    return {"quarantined": items}

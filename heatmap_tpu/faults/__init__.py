@@ -1,0 +1,25 @@
+"""Deterministic fault injection + the unified retry policy.
+
+``faults.plane`` owns the process-wide seeded, site-keyed injection
+registry (``--chaos SPEC`` / ``HEATMAP_TPU_CHAOS`` / programmatic);
+``faults.retry`` owns bounded-exponential-backoff-with-full-jitter
+retries and the per-site policy table. See docs/robustness.md for the
+fault model, the policy table, and the chaos-soak runbook.
+"""
+
+from heatmap_tpu.faults.plane import (ENV_VAR, SITES, FaultPlane,
+                                      InjectedFault, check, get_plane,
+                                      hash01, install, install_from_env,
+                                      install_spec, parse_spec)
+from heatmap_tpu.faults.retry import (DEFAULT_POLICY, POLICIES, RETRYABLE,
+                                      NonRetryable, RetryPolicy, backoff_s,
+                                      policy_for, resumable_iter, retry_call,
+                                      sleep_backoff)
+
+__all__ = [
+    "DEFAULT_POLICY", "ENV_VAR", "FaultPlane", "InjectedFault",
+    "NonRetryable", "POLICIES", "RETRYABLE", "RetryPolicy", "SITES",
+    "backoff_s", "check", "get_plane", "hash01", "install",
+    "install_from_env", "install_spec", "parse_spec", "policy_for",
+    "resumable_iter", "retry_call", "sleep_backoff",
+]

@@ -1,0 +1,194 @@
+"""Unified retry policy: bounded exponential backoff + full jitter + deadline.
+
+One policy shape for every guarded boundary (``POLICIES`` is the
+per-site table docs/robustness.md documents). Backoff for attempt *k*
+is ``min(cap_s, base_s * 2**(k-1)) * U`` where ``U`` is *full jitter* in
+[0, 1) — but deterministic: ``plane.hash01(seed, site, key, attempt)``
+rather than RNG state, so a seeded chaos run sleeps the same schedule
+every time. The installed plane's ``backoff_scale`` multiplies every
+sleep (chaos tests set it to 0), and ``time.sleep`` lives only here and
+in the plane-free fallback — the tests/test_obs.py grep guard keeps
+hand-rolled retry sleeps out of every other module.
+
+Only *transient* errors are retried (``RETRYABLE`` = OSError +
+RuntimeError, which covers real I/O failures and :class:`InjectedFault`);
+data errors (ValueError etc.) are deterministic and propagate
+immediately. Both helpers run the plane's fault check for their site
+*before* invoking the guarded operation, so an injected fault never
+leaves a half-executed write behind — retrying is idempotent by
+construction wherever the underlying operation is.
+
+``retry_call`` guards a single operation. ``resumable_iter`` guards a
+whole deterministic stream (the io sources): on a transient mid-stream
+failure it rebuilds the iterator and fast-forwards past the
+already-delivered prefix — sound because every source iterates
+deterministically (pinned in io/sources.py docs) — and its
+consecutive-failure budget resets whenever an item is delivered, so a
+long stream survives many isolated transients while still bounding any
+contiguous failure window by ``retries`` attempts and ``deadline_s``
+seconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from heatmap_tpu.faults.plane import check, get_plane, hash01
+
+# Transient error classes worth retrying. InjectedFault is a
+# RuntimeError; OSError covers real filesystem/network failures.
+RETRYABLE = (OSError, RuntimeError)
+
+
+class NonRetryable:
+    """Marker mixin: an error that matches RETRYABLE by class but is
+    deterministic (missing driver, bad config) — raised through the
+    retry machinery without burning attempts or sleeping."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """retries = re-executions allowed after the first failure;
+    deadline_s bounds one contiguous failure window (None = unbounded)."""
+
+    retries: int = 3
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    deadline_s: float | None = 30.0
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+# Per-site defaults (the docs/robustness.md policy table). Serving-path
+# sites get zero retries: the degradation machinery (stale-if-error,
+# typed 503) owns those failures, and a request handler must not sleep.
+POLICIES = {
+    "source.read": RetryPolicy(retries=4, base_s=0.05, cap_s=2.0,
+                               deadline_s=60.0),
+    "sink.write": RetryPolicy(retries=4, base_s=0.05, cap_s=2.0,
+                              deadline_s=60.0),
+    "journal.append": RetryPolicy(retries=3, base_s=0.02, cap_s=0.5,
+                                  deadline_s=10.0),
+    "compact.publish": RetryPolicy(retries=3, base_s=0.02, cap_s=0.5,
+                                   deadline_s=10.0),
+    "shard.compute": RetryPolicy(retries=2, base_s=0.05, cap_s=2.0,
+                                 deadline_s=None),
+    "tile.render": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
+                               deadline_s=None),
+    "http.request": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
+                                deadline_s=None),
+    "multihost.heartbeat": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
+                                       deadline_s=None),
+}
+
+
+def policy_for(site: str) -> RetryPolicy:
+    return POLICIES.get(site, DEFAULT_POLICY)
+
+
+def backoff_s(site: str, key, attempt: int, *, base_s: float,
+              cap_s: float) -> float:
+    """Full-jitter exponential backoff for retry ``attempt`` (1-based),
+    deterministic under the installed plane's seed and scaled by its
+    ``backoff_scale``."""
+    if base_s <= 0 or attempt < 1:
+        return 0.0
+    plane = get_plane()
+    seed = plane.seed if plane is not None else 0
+    scale = plane.backoff_scale if plane is not None else 1.0
+    exp = min(cap_s, base_s * (2.0 ** (attempt - 1)))
+    return exp * hash01(seed, "backoff", site, key, attempt) * scale
+
+
+def sleep_backoff(site: str, key, attempt: int, *, base_s: float,
+                  cap_s: float) -> float:
+    """Compute + sleep the backoff; returns the seconds slept. The only
+    sanctioned retry sleep in the package (see the grep guard)."""
+    delay = backoff_s(site, key, attempt, base_s=base_s, cap_s=cap_s)
+    if delay > 0:
+        time.sleep(delay)
+    return delay
+
+
+def retry_call(fn, *args, site: str, key=None,
+               policy: RetryPolicy | None = None, clock=time.monotonic):
+    """Run ``fn(*args)`` under the site's fault check + retry policy.
+
+    Retries RETRYABLE errors with backoff until the policy's attempt or
+    deadline budget is spent, then re-raises the last error. ``fn`` must
+    be safe to re-execute (atomic or idempotent).
+    """
+    if policy is None:
+        policy = policy_for(site)
+    attempt = 0
+    start = clock()
+    while True:
+        try:
+            check(site, key)
+            return fn(*args)
+        except RETRYABLE as e:
+            if isinstance(e, NonRetryable):
+                raise
+            attempt += 1
+            if attempt > policy.retries:
+                raise
+            if (policy.deadline_s is not None
+                    and clock() - start >= policy.deadline_s):
+                raise
+            from heatmap_tpu import obs
+
+            obs.record_io_retry(site)
+            sleep_backoff(site, key, attempt,
+                          base_s=policy.base_s, cap_s=policy.cap_s)
+
+
+def resumable_iter(make_iter, *, site: str, key=None,
+                   policy: RetryPolicy | None = None, clock=time.monotonic):
+    """Yield from ``make_iter()`` with transparent retry-with-resume.
+
+    On a retryable failure (including an injected fault at the per-item
+    site check) the iterator is rebuilt and the already-delivered prefix
+    replayed and discarded — identical bytes, because sources iterate
+    deterministically. Delivered items reset the attempt/deadline
+    window; non-retryable errors and exhausted budgets propagate.
+    """
+    if policy is None:
+        policy = policy_for(site)
+    delivered = 0
+    attempt = 0
+    window_start = None
+    while True:
+        try:
+            it = make_iter()
+            for _ in range(delivered):
+                next(it)  # replay prefix: no fault checks, no re-delivery
+            while True:
+                check(site, key)
+                try:
+                    item = next(it)
+                except StopIteration:
+                    return
+                delivered += 1
+                attempt = 0
+                window_start = None
+                yield item
+        except StopIteration:
+            return  # stream ended during replay
+        except RETRYABLE as e:
+            if isinstance(e, NonRetryable):
+                raise
+            attempt += 1
+            now = clock()
+            if window_start is None:
+                window_start = now
+            if attempt > policy.retries:
+                raise
+            if (policy.deadline_s is not None
+                    and now - window_start >= policy.deadline_s):
+                raise
+            from heatmap_tpu import obs
+
+            obs.record_io_retry(site)
+            sleep_backoff(site, key, attempt,
+                          base_s=policy.base_s, cap_s=policy.cap_s)

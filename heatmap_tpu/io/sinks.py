@@ -18,12 +18,17 @@ from typing import Iterable
 
 import numpy as np
 
-from heatmap_tpu import obs
+from heatmap_tpu import faults, obs
 from heatmap_tpu.io.png import raster_to_png
 
 
 class BlobSink:
-    """Base: consumes (id, heatmap-dict-or-json) records."""
+    """Base: consumes (id, heatmap-dict-or-json) records.
+
+    ``write`` runs each ``write_one`` under the unified ``sink.write``
+    retry policy (faults/retry.py): the fault check fires *before* the
+    write starts and every concrete ``write_one`` is an upsert by id,
+    so retried writes are idempotent."""
 
     #: Metric label for sink_blobs_written_total{sink=...}.
     KIND = "blob"
@@ -31,7 +36,8 @@ class BlobSink:
     def write(self, records: Iterable[tuple]) -> int:
         n = 0
         for blob_id, heatmap in records:
-            self.write_one(blob_id, heatmap)
+            faults.retry_call(self.write_one, blob_id, heatmap,
+                              site="sink.write", key=self.KIND)
             n += 1
         if n and obs.metrics_enabled():
             obs.SINK_BLOBS.inc(n, sink=self.KIND)
@@ -52,6 +58,10 @@ class BlobSink:
 
 def _as_json(heatmap) -> str:
     return heatmap if isinstance(heatmap, str) else json.dumps(heatmap)
+
+
+class SinkConfigError(RuntimeError, faults.NonRetryable):
+    """Deterministic sink misconfiguration — never retried."""
 
 
 class MemorySink(BlobSink):
@@ -106,13 +116,15 @@ class JSONLBlobSink(BlobSink):
         for blob_id, heatmap in records:
             lines.append(self._line(blob_id, heatmap) + "\n")
             if len(lines) >= 16384:
-                f.writelines(lines)
+                faults.retry_call(f.writelines, lines,
+                                  site="sink.write", key=self.KIND)
                 n += len(lines)
                 if counting:
                     nbytes += sum(len(ln) for ln in lines)
                 lines.clear()
         if lines:
-            f.writelines(lines)
+            faults.retry_call(f.writelines, lines,
+                              site="sink.write", key=self.KIND)
             n += len(lines)
             if counting:
                 nbytes += sum(len(ln) for ln in lines)
@@ -170,7 +182,7 @@ class CassandraBlobSink(BlobSink):
 
     def write_one(self, blob_id, heatmap):
         if self.session is None:
-            raise RuntimeError(
+            raise SinkConfigError(
                 "CassandraBlobSink needs a cassandra-driver session "
                 "(not baked into this image); use JSONL/Directory sinks "
                 "or inject session=..."
@@ -247,35 +259,42 @@ class LevelArraysSink:
                 self.path, f"level_z{lvl['zoom']:02d}.{ext}"
             )
             tmp = final + ".tmp"
-            if self.format == "parquet":
-                import pyarrow as pa
-                import pyarrow.parquet as pq
 
-                n = len(out["value"])
-                cols = {}
-                for k, v in out.items():
-                    if k == "user_idx":
-                        cols["user"] = pa.DictionaryArray.from_arrays(
-                            pa.array(v), pa.array(lvl["user_names"])
-                        )
-                    elif k == "timespan_idx":
-                        cols["timespan"] = pa.DictionaryArray.from_arrays(
-                            pa.array(v), pa.array(lvl["timespan_names"])
-                        )
-                    else:
-                        cols[k] = np.full(n, v) if v.ndim == 0 else v
-                pq.write_table(pa.table(cols), tmp)
-            else:
-                out["user_names"] = np.asarray(lvl["user_names"])
-                out["timespan_names"] = np.asarray(lvl["timespan_names"])
-                # Plain savez by default: zlib cost dominated egress
-                # (~17s of a 40s 2M-point job); columns are already
-                # compact (int32 + dictionary encoding).
-                save = (np.savez_compressed
-                        if self.format == "npz-compressed" else np.savez)
-                with open(tmp, "wb") as f:
-                    save(f, **out)
-            os.replace(tmp, final)
+            def _publish_level():
+                # One retried unit per level: stage to tmp, then atomic
+                # replace — re-running after a transient failure (or an
+                # injected sink.write fault) rewrites the whole level.
+                if self.format == "parquet":
+                    import pyarrow as pa
+                    import pyarrow.parquet as pq
+
+                    n = len(out["value"])
+                    cols = {}
+                    for k, v in out.items():
+                        if k == "user_idx":
+                            cols["user"] = pa.DictionaryArray.from_arrays(
+                                pa.array(v), pa.array(lvl["user_names"])
+                            )
+                        elif k == "timespan_idx":
+                            cols["timespan"] = pa.DictionaryArray.from_arrays(
+                                pa.array(v), pa.array(lvl["timespan_names"])
+                            )
+                        else:
+                            cols[k] = np.full(n, v) if v.ndim == 0 else v
+                    pq.write_table(pa.table(cols), tmp)
+                else:
+                    out["user_names"] = np.asarray(lvl["user_names"])
+                    out["timespan_names"] = np.asarray(lvl["timespan_names"])
+                    # Plain savez by default: zlib cost dominated egress
+                    # (~17s of a 40s 2M-point job); columns are already
+                    # compact (int32 + dictionary encoding).
+                    save = (np.savez_compressed
+                            if self.format == "npz-compressed" else np.savez)
+                    with open(tmp, "wb") as f:
+                        save(f, **out)
+                os.replace(tmp, final)
+
+            faults.retry_call(_publish_level, site="sink.write", key="arrays")
             rows += len(out["value"])
             if obs.metrics_enabled():
                 obs.SINK_ROWS.inc(len(out["value"]), sink="arrays")

@@ -26,7 +26,7 @@ from typing import Iterator
 
 import numpy as np
 
-from heatmap_tpu import obs
+from heatmap_tpu import faults, obs
 
 #: Column names of the reference's ``rhom.locations`` table
 #: (reference heatmap.py:25-36).
@@ -39,6 +39,12 @@ COLUMNS = ("latitude", "longitude", "user_id", "source", "timestamp")
 VALUE_COLUMN = "value"
 
 DEFAULT_BATCH = 1 << 20
+
+
+class ConfigError(RuntimeError, faults.NonRetryable):
+    """Deterministic configuration failure (missing driver/env/spec) —
+    still a RuntimeError for callers, but the unified retry policy
+    raises it straight through instead of burning retry attempts."""
 
 
 def _empty_batch():
@@ -73,14 +79,27 @@ def _finalize_with_value(cols, vals):
 
 def _count_rows(kind: str):
     """Decorator for ``batches`` impls: attribute every yielded row to
-    the ``source_rows_read_total{source=<kind>}`` counter. Free when
-    metrics are off (one flag read per batch); the wrapper re-yields, so
-    mid-stream errors still propagate from the underlying reader."""
+    the ``source_rows_read_total{source=<kind>}`` counter, and guard the
+    stream with the unified retry policy (faults/retry.py).
+
+    Every batch pull runs the ``source.read`` fault check; a transient
+    failure (real OSError/RuntimeError or an injected fault) rebuilds
+    the underlying iterator and fast-forwards past the batches already
+    delivered — sound because every source iterates deterministically
+    (the contract ``_scan_bounds`` and resumable jobs already rely on).
+    Rows are only counted for batches actually delivered, so a replayed
+    prefix never double-counts. Deterministic data errors (ValueError
+    etc.) still propagate immediately from the underlying reader.
+    """
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, batch_size: int = DEFAULT_BATCH):
-            for batch in fn(self, batch_size):
+            from heatmap_tpu import faults
+
+            stream = faults.resumable_iter(
+                lambda: fn(self, batch_size), site="source.read", key=kind)
+            for batch in stream:
                 if obs.metrics_enabled():
                     obs.SOURCE_ROWS.inc(len(batch["latitude"]),
                                         source=kind)
@@ -422,7 +441,7 @@ class CassandraSource(Source):
     def _session(self):
         cfg = self.config
         if not cfg.endpoint:
-            raise RuntimeError(
+            raise ConfigError(
                 "no Cassandra endpoint configured — the reference selects "
                 "CosmosDB in that case (reference heatmap.py:132,140-146); "
                 "use CosmosDBSource (or the cosmosdb: source spec)"
@@ -432,7 +451,7 @@ class CassandraSource(Source):
         try:
             from cassandra.cluster import Cluster
         except ImportError as e:
-            raise RuntimeError(
+            raise ConfigError(
                 "Cassandra ingest requires the cassandra-driver "
                 "package (not baked into this image); pass "
                 "session_factory=... or use CSV/JSONL/Parquet sources"
@@ -552,12 +571,12 @@ class CosmosDBSource(Source):
         if self.client_factory is not None:
             return self.client_factory()
         if not host or not key:
-            raise RuntimeError(
+            raise ConfigError(
                 f"CosmosDB ingest needs ${cfg.cosmosdb_host_env} and "
                 f"${cfg.cosmosdb_key_env} (reference heatmap.py:141-142) "
                 "or an injected client_factory"
             )
-        raise RuntimeError(
+        raise ConfigError(
             "CosmosDB ingest requires the azure-cosmos SDK, which is not "
             "available in this image; inject client_factory=... (see the "
             "class docstring for the adapter contract) or use "

@@ -78,6 +78,12 @@ HOST_LAST_HEARTBEAT = _registry.gauge(
 DEVICE_BYTES = _registry.gauge(
     "device_bytes_in_use", "Last sampled device memory in use",
     labelnames=("device",))
+FAULTS_INJECTED = _registry.counter(
+    "faults_injected_total", "Faults fired by the injection plane",
+    labelnames=("site",))
+IO_RETRIES = _registry.counter(
+    "io_retries_total", "I/O operations retried by faults.retry",
+    labelnames=("site",))
 
 
 def telemetry_enabled() -> bool:
@@ -153,9 +159,21 @@ def sample_device_memory() -> list:
 
 def heartbeat(phase: str):
     """Per-host liveness mark for multihost phases. Timing lives here so
-    parallel/multihost.py stays free of raw clocks."""
+    parallel/multihost.py stays free of raw clocks.
+
+    The ``multihost.heartbeat`` fault site models a *lost* heartbeat: an
+    injected fault suppresses the gauge/event update without failing the
+    caller, so the staleness monitors (``heartbeat_ages`` /
+    ``check_heartbeats``) see exactly what a dead host would produce.
+    """
     if not telemetry_enabled():
         return
+    from heatmap_tpu import faults
+
+    try:
+        faults.check("multihost.heartbeat", key=phase)
+    except faults.InjectedFault:
+        return  # heartbeat lost in transit; liveness gauges go stale
     import jax
 
     pi = jax.process_index()
@@ -164,6 +182,21 @@ def heartbeat(phase: str):
     HOST_LAST_HEARTBEAT.set(time.time(), process=str(pi))
     emit("heartbeat", process_index=pi, process_count=jax.process_count(),
          phase=phase, uptime_s=round(uptime, 3))
+
+
+def heartbeat_ages(now: float | None = None) -> dict:
+    """Seconds since each process's last heartbeat, ``{process: age_s}``.
+
+    Read from the ``multihost_last_heartbeat_ts`` gauge; empty when the
+    registry is off or no heartbeat has landed yet. ``now`` overrides
+    wall-clock for tests.
+    """
+    if not _registry.enabled:
+        return {}
+    if now is None:
+        now = time.time()
+    return {key[0]: now - ts
+            for key, ts in HOST_LAST_HEARTBEAT.samples().items()}
 
 
 def record_retry(shard: int, attempt: int, error: BaseException):
@@ -180,12 +213,33 @@ def record_recovery(shard: int, attempts: int):
     emit("recovery", shard=int(shard), attempts=int(attempts))
 
 
+def record_fault(site: str, seq: int, key=None, rule: str | None = None):
+    """One injected fault fired by the faults plane (seq is the plane's
+    own monotonic injection counter, replayable from the event log)."""
+    if not telemetry_enabled():
+        return
+    FAULTS_INJECTED.inc(site=site)
+    fields = {}
+    if key is not None:
+        fields["key"] = str(key)
+    if rule is not None:
+        fields["rule"] = rule
+    emit("fault_injected", site=site, fault_seq=int(seq), **fields)
+
+
+def record_io_retry(site: str):
+    if not telemetry_enabled():
+        return
+    IO_RETRIES.inc(site=site)
+
+
 __all__ = [
     "EVENT_SCHEMA", "EventLog", "MetricsRegistry",
     "blob_checksum", "build_run_report", "device_topology", "emit",
     "enable_metrics", "events", "format_run_report", "get_event_log",
-    "get_registry", "heartbeat", "metrics", "metrics_enabled",
-    "read_events", "record_recovery", "record_retry", "record_stage",
+    "get_registry", "heartbeat", "heartbeat_ages", "metrics",
+    "metrics_enabled", "read_events", "record_fault", "record_io_retry",
+    "record_recovery", "record_retry", "record_stage",
     "sample_device_memory", "set_event_log", "telemetry_enabled",
     "validate_event", "write_run_report",
 ]

@@ -74,6 +74,20 @@ EVENT_SCHEMA = {
     "compaction_end": {"required": ("root", "seconds", "status"),
                        "optional": ("base", "levels", "rows",
                                     "pruned_entries", "error")},
+    # faults/: one record per injected fault. ``seq`` is the plane's own
+    # monotonic injection counter (not the envelope seq), so a chaos run
+    # can be replayed check-for-check from its event log.
+    "fault_injected": {"required": ("site", "fault_seq"),
+                       "optional": ("key", "rule")},
+    # serve/http.py degraded-mode transitions (/healthz mirrors the
+    # active cause set). Emitted on cause-set edges, not per request.
+    "degraded_enter": {"required": ("cause",), "optional": ("detail",)},
+    "degraded_exit": {"required": ("cause",), "optional": ("detail",)},
+    # delta/recover.py startup sweep: one per quarantined artifact
+    # (orphan *.tmp, torn/hash-mismatched journal entry, unjournaled
+    # delta dir, stale base dir).
+    "quarantine": {"required": ("root", "path", "reason"),
+                   "optional": ("kind", "detail")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",

@@ -55,6 +55,44 @@ from heatmap_tpu.io.merge import (  # noqa: F401
 from heatmap_tpu.parallel.mesh import make_mesh, shard_map
 
 
+class StragglerTimeout(RuntimeError):
+    """A host's heartbeat went stale past the configured deadline.
+
+    Raised by :func:`check_heartbeats` so a straggling or dead host
+    turns into a typed, catchable error at the next phase boundary
+    instead of the job hanging in a collective forever. Carries the
+    offending ``{process: age_s}`` map as ``.stale``.
+    """
+
+    def __init__(self, deadline_s: float, stale: dict):
+        detail = ", ".join(f"process {p}: {age:.1f}s"
+                           for p, age in sorted(stale.items()))
+        super().__init__(
+            f"heartbeat deadline {deadline_s}s exceeded ({detail})")
+        self.deadline_s = float(deadline_s)
+        self.stale = dict(stale)
+
+
+def check_heartbeats(deadline_s: float, now: float | None = None) -> dict:
+    """Raise :class:`StragglerTimeout` if any host's last heartbeat is
+    older than ``deadline_s``; otherwise return the age map.
+
+    Reads ``obs.heartbeat_ages()`` (the ``multihost_last_heartbeat_ts``
+    gauge), so it only sees hosts whose heartbeats reach this process's
+    registry — per-process in the current transport, which is exactly
+    the lost-heartbeat failure mode the ``multihost.heartbeat`` fault
+    site injects. A disabled registry yields no ages and never times
+    out (monitoring off means no straggler detection, not a crash).
+    """
+    if deadline_s is None or deadline_s <= 0:
+        raise ValueError("deadline_s must be a positive number of seconds")
+    ages = obs.heartbeat_ages(now)
+    stale = {p: age for p, age in ages.items() if age > deadline_s}
+    if stale:
+        raise StragglerTimeout(deadline_s, stale)
+    return ages
+
+
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None):
@@ -556,7 +594,8 @@ def run_job_multihost(source, sink=None, config=None,
                       egress: str = "auto",
                       max_points_in_flight: int | None = None,
                       egress_max_bytes: int = 1 << 30,
-                      merge_spill_dir: str | None = None):
+                      merge_spill_dir: str | None = None,
+                      heartbeat_deadline_s: float | None = None):
     """Process-sharded ``run_job``: each host ingests its slice of the
     source and aggregates on its local devices; egress then either
 
@@ -605,6 +644,13 @@ def run_job_multihost(source, sink=None, config=None,
     _alltoall_bytes) so a pathologically skewed keyspace fails loudly
     instead of OOMing a host — raise it here when a big job
     legitimately needs more.
+
+    ``heartbeat_deadline_s`` arms straggler detection: after each phase
+    boundary heartbeat, :func:`check_heartbeats` raises a typed
+    :class:`StragglerTimeout` if any observed host's heartbeat is older
+    than the deadline — the bounded-wait alternative to hanging in the
+    next collective (docs/robustness.md). ``None`` (default) keeps the
+    historical hang-and-hope behavior.
     """
     from heatmap_tpu.pipeline import BatchJobConfig, run_job
     from heatmap_tpu.pipeline.batch import (
@@ -664,7 +710,12 @@ def run_job_multihost(source, sink=None, config=None,
     # liveness + uptime gauges, obs.heartbeat): the spread of the
     # multihost_phase_uptime_seconds gauge across processes at one
     # phase IS the straggler gap.
-    obs.heartbeat("ingest_start")
+    def _phase(name: str):
+        obs.heartbeat(name)
+        if heartbeat_deadline_s is not None:
+            check_heartbeats(heartbeat_deadline_s)
+
+    _phase("ingest_start")
     cap = _CaptureLevels() if columnar else None
     if max_points_in_flight:
         # Bounded slice ingest: chunked cascade + host-side merge
@@ -683,21 +734,21 @@ def run_job_multihost(source, sink=None, config=None,
             local = _run_loaded(data, config, as_json=True, sink=cap)
         else:
             local = {}
-    obs.heartbeat("ingest_done")
+    _phase("ingest_done")
     if columnar:
         owned = scatter_levels(cap.levels, max_bytes=egress_max_bytes)
         rows = sink.write_levels(owned)
-        obs.heartbeat("egress_done")
+        _phase("egress_done")
         return {"egress": "levels-sharded", "levels": len(owned),
                 "rows": rows}
     if egress == "sharded":
         owned = scatter_blobs(local, max_bytes=egress_max_bytes)
         if sink is not None:
             sink.write(owned.items())
-        obs.heartbeat("egress_done")
+        _phase("egress_done")
         return owned
     blobs = gather_blobs(local, max_bytes=egress_max_bytes)
     if sink is not None and jax.process_index() == 0:
         sink.write(blobs.items())
-    obs.heartbeat("egress_done")
+    _phase("egress_done")
     return blobs

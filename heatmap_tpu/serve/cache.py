@@ -21,9 +21,20 @@ sweep on the serving path). Live-stream ticks instead call
 ``invalidate_keys`` with just the affected tile keys.
 
 Instrumented on the existing obs registry:
-``tile_cache_{hits,misses,evictions}_total`` and the
-``tile_render_seconds`` histogram (observed around the leader's render
-only — follower waits are not renders).
+``tile_cache_{hits,misses,evictions}_total``,
+``tile_cache_stale_serves_total`` and the ``tile_render_seconds``
+histogram (observed around the leader's render only — follower waits
+are not renders).
+
+**Stale-if-error** (``get_or_render(..., stale_if_error=True)``): a
+generation- or TTL-stale entry is kept as a fallback instead of being
+dropped before the re-render. If the render fails, the caller gets the
+last-good bytes back with ``hit == TileCache.STALE`` (a truthy string
+sentinel, so ``hit is True / hit is False`` checks on the normal paths
+are unaffected) and the entry stays cached for the next request; a
+successful render replaces it as usual. This is what lets the serve
+tier degrade to stale-200 instead of 500 when the store or renderer is
+having a bad day (docs/robustness.md).
 """
 
 from __future__ import annotations
@@ -42,6 +53,9 @@ CACHE_MISSES = _registry.counter(
 CACHE_EVICTIONS = _registry.counter(
     "tile_cache_evictions_total", "Cache entries dropped",
     labelnames=("reason",))
+CACHE_STALE_SERVES = _registry.counter(
+    "tile_cache_stale_serves_total",
+    "Stale entries served because the replacing render failed")
 RENDER_SECONDS = _registry.histogram(
     "tile_render_seconds", "Wall-clock of on-demand tile renders",
     labelnames=("format",),
@@ -69,12 +83,20 @@ class _Flight:
         self.error = None
 
 
+#: "No stale fallback available" marker (distinct from a cached None).
+_NO_FALLBACK = object()
+
+
 class TileCache:
     """Keys are opaque hashables (the server uses
     ``(layer, z, x, y, fmt)``); values are bytes-like (sized via
     ``len``). ``max_bytes <= 0`` disables caching but keeps
     single-flight dedup — concurrent identical renders still coalesce.
     """
+
+    #: ``hit`` value for a stale entry served under ``stale_if_error``
+    #: after the replacing render failed. Truthy, but never ``is True``.
+    STALE = "stale"
 
     def __init__(self, max_bytes: int = 256 << 20,
                  ttl_s: float | None = None, clock=time.monotonic):
@@ -98,21 +120,34 @@ class TileCache:
     # -- core --------------------------------------------------------------
 
     def get_or_render(self, key, generation: int, render_fn, *,
-                      fmt: str = "tile"):
+                      fmt: str = "tile", stale_if_error: bool = False):
         """Cached value for ``key`` at ``generation``, rendering at most
         once across concurrent callers. ``render_fn()`` runs OUTSIDE the
         cache lock. Returns ``(value, hit)``; render errors propagate to
-        every waiter of that flight (and are not cached)."""
+        every waiter of that flight (and are not cached).
+
+        With ``stale_if_error=True`` a generation/TTL-stale entry is
+        retained as a fallback: if the replacing render raises, the
+        stale bytes are returned with ``hit == TileCache.STALE`` (and
+        published to the flight's followers) instead of the error."""
         while True:
+            fallback = _NO_FALLBACK
             with self._lock:
                 entry = self._entries.get(key)
                 if entry is not None:
                     if entry.generation != generation or (
                             entry.expires is not None
                             and self._clock() >= entry.expires):
-                        reason = ("stale" if entry.generation != generation
-                                  else "ttl")
-                        self._drop(key, entry, reason)
+                        if stale_if_error:
+                            # Keep the entry: a successful render
+                            # replaces it via _insert; a failed one
+                            # serves it as the last-good fallback.
+                            fallback = entry.value
+                        else:
+                            reason = ("stale"
+                                      if entry.generation != generation
+                                      else "ttl")
+                            self._drop(key, entry, reason)
                     else:
                         self._entries.move_to_end(key)
                         if obs.metrics_enabled():
@@ -138,6 +173,14 @@ class TileCache:
             try:
                 value = render_fn()
             except BaseException as e:
+                if stale_if_error and fallback is not _NO_FALLBACK:
+                    if obs.metrics_enabled():
+                        CACHE_STALE_SERVES.inc()
+                    flight.value = fallback
+                    with self._lock:
+                        self._flights.pop(key, None)
+                    flight.done.set()
+                    return fallback, self.STALE
                 flight.error = e
                 with self._lock:
                     self._flights.pop(key, None)

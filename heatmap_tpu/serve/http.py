@@ -11,6 +11,18 @@ Routes:
 - ``POST /reload``                        — re-read the store artifact;
   the bumped generation lazily invalidates every cached tile
 
+**Graceful degradation** (docs/robustness.md): tile renders run under
+the ``tile.render`` fault site and an optional per-render timeout; a
+failed render serves the last-good cached bytes (stale-200, cache
+``"stale"`` in the ``http_request`` event) when the TileCache has them
+and a typed 503 JSON body otherwise — never a 500. A failed
+``/reload`` keeps the last-good index (TileStore builds the new index
+before swapping) and returns 503. Both paths flip the app into a
+degraded state with a named cause, edge-triggered as
+``degraded_enter``/``degraded_exit`` obs events, and ``/healthz``
+reports ``"status": "degraded"`` with the live causes until the next
+successful render/reload clears them.
+
 Tiles carry **strong ETags** (crc32 of the payload — cheap, and tile
 payloads are small enough that collision risk is irrelevant for cache
 revalidation); a matching ``If-None-Match`` short-circuits to 304 with
@@ -27,14 +39,16 @@ the raw-print grep guard (tests/test_obs.py).
 
 from __future__ import annotations
 
+import concurrent.futures
 import json
 import re
 import threading
 import time
+import urllib.parse
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from heatmap_tpu import obs
+from heatmap_tpu import faults, obs
 from heatmap_tpu.serve.cache import TileCache
 from heatmap_tpu.serve.render import tile_json_bytes, tile_png_bytes
 from heatmap_tpu.serve.store import TileStore
@@ -61,10 +75,36 @@ class ServeApp:
     handler below is a thin shell around it, which is what makes the
     serving logic testable without sockets."""
 
-    def __init__(self, store: TileStore, cache: TileCache | None = None):
+    def __init__(self, store: TileStore, cache: TileCache | None = None,
+                 *, render_timeout_s: float | None = None):
         self.store = store
         self.cache = cache if cache is not None else TileCache()
+        self.render_timeout_s = render_timeout_s
         self._extra_layers: dict = {}
+        self._degraded_lock = threading.Lock()
+        self._degraded: dict[str, str] = {}  # cause -> detail
+        self._render_pool = None  # lazy; only built when timeouts are on
+
+    # -- degraded state ----------------------------------------------------
+
+    def degraded_causes(self) -> dict:
+        """Live degradation causes (empty == healthy)."""
+        with self._degraded_lock:
+            return dict(self._degraded)
+
+    def _degrade(self, cause: str, detail: str = ""):
+        with self._degraded_lock:
+            entering = cause not in self._degraded
+            self._degraded[cause] = detail
+        if entering:  # edge-triggered: one event per episode, not per request
+            obs.emit("degraded_enter", cause=cause,
+                     **({"detail": detail} if detail else {}))
+
+    def _recover(self, cause: str):
+        with self._degraded_lock:
+            was_degraded = self._degraded.pop(cause, None) is not None
+        if was_degraded:
+            obs.emit("degraded_exit", cause=cause)
 
     # -- layers ------------------------------------------------------------
 
@@ -85,7 +125,15 @@ class ServeApp:
     def handle(self, method: str, path: str,
                if_none_match: str | None = None):
         """Returns ``(status, content_type, body, etag, route, cache)``;
-        ``body`` is b"" for 304s, ``cache`` is "hit"/"miss"/None."""
+        ``body`` is b"" for 304s, ``cache`` is "hit"/"miss"/"stale"/None.
+        Injected ``http.request`` faults surface as typed 503s — the
+        chaos soak pins that no injected fault ever becomes a 500."""
+        try:
+            faults.check("http.request", key=method)
+        except faults.InjectedFault as e:
+            body = json.dumps({"error": "service unavailable",
+                               "detail": str(e)}).encode()
+            return 503, "application/json", body, None, "error", None
         m = _TILE_RE.match(path)
         if method == "GET" and m is not None:
             return self._handle_tile(m, if_none_match)
@@ -97,14 +145,30 @@ class ServeApp:
             return (200, "text/plain; version=0.0.4", body, None,
                     "metrics", None)
         if method == "POST" and path == "/reload":
-            generation = self.store.reload()
-            body = json.dumps({"generation": generation}).encode()
-            return 200, "application/json", body, None, "reload", None
+            return self._handle_reload()
         body = json.dumps({"error": "not found", "path": path}).encode()
         return 404, "application/json", body, None, "other", None
 
+    def _handle_reload(self):
+        try:
+            generation = self.store.reload()
+        except Exception as e:
+            # TileStore builds the new index before swapping, so the
+            # last-good one is still serving; report that honestly.
+            self._degrade("reload", repr(e))
+            body = json.dumps({
+                "error": "reload failed", "detail": repr(e),
+                "generation": self.store.generation,
+            }).encode()
+            return 503, "application/json", body, None, "reload", None
+        self._recover("reload")
+        body = json.dumps({"generation": generation}).encode()
+        return 200, "application/json", body, None, "reload", None
+
     def _handle_tile(self, m, if_none_match):
-        layer_name = m["layer"]
+        # Layer names may carry characters clients percent-encode in a
+        # path segment (the delta stores' "user|timespan" keys).
+        layer_name = urllib.parse.unquote(m["layer"])
         z, x, y = int(m["z"]), int(m["x"]), int(m["y"])
         fmt = m["fmt"]
         layer = self.layer(layer_name)
@@ -115,10 +179,24 @@ class ServeApp:
             }).encode()
             return 404, "application/json", body, None, "tiles", None
         render = tile_png_bytes if fmt == "png" else tile_json_bytes
-        body, hit = self.cache.get_or_render(
-            (layer_name, z, x, y, fmt), self.store.generation,
-            lambda: render(layer, z, x, y), fmt=fmt)
-        cache = "hit" if hit else "miss"
+        try:
+            body, hit = self.cache.get_or_render(
+                (layer_name, z, x, y, fmt), self.store.generation,
+                lambda: self._render(render, layer, z, x, y, fmt),
+                fmt=fmt, stale_if_error=True)
+        except Exception as e:
+            # No last-good bytes to fall back on: typed 503, never 500.
+            self._degrade("render", repr(e))
+            payload = json.dumps({"error": "render failed",
+                                  "detail": repr(e)}).encode()
+            return 503, "application/json", payload, None, "tiles", None
+        if hit == TileCache.STALE:
+            self._degrade("render", "serving stale tiles")
+            cache = "stale"
+        else:
+            if hit is False:  # a fresh render succeeded end-to-end
+                self._recover("render")
+            cache = "hit" if hit else "miss"
         if body is None:
             payload = json.dumps({"error": "empty tile"}).encode()
             return 404, "application/json", payload, None, "tiles", cache
@@ -126,6 +204,30 @@ class ServeApp:
         if if_none_match is not None and etag in if_none_match:
             return 304, _CONTENT_TYPES[fmt], b"", etag, "tiles", cache
         return 200, _CONTENT_TYPES[fmt], body, etag, "tiles", cache
+
+    def _render(self, render, layer, z, x, y, fmt: str):
+        """One tile render under the ``tile.render`` fault site and the
+        optional per-render deadline. The deadline runs the render on a
+        worker thread so a wedged renderer costs the request a bounded
+        wait, not the whole server a thread forever; the abandoned
+        render finishes (or dies) in the pool without a waiter."""
+        faults.check("tile.render", key=fmt)
+        if self.render_timeout_s is None:
+            return render(layer, z, x, y)
+        if self._render_pool is None:
+            with self._degraded_lock:
+                if self._render_pool is None:
+                    self._render_pool = (
+                        concurrent.futures.ThreadPoolExecutor(
+                            max_workers=4,
+                            thread_name_prefix="tile-render"))
+        future = self._render_pool.submit(render, layer, z, x, y)
+        try:
+            return future.result(timeout=self.render_timeout_s)
+        except concurrent.futures.TimeoutError:
+            future.cancel()
+            raise TimeoutError(
+                f"tile render exceeded {self.render_timeout_s}s deadline")
 
     def _health(self) -> dict:
         stats = self.store.stats()
@@ -140,7 +242,10 @@ class ServeApp:
             }
         stats["cache"] = {"entries": len(self.cache),
                           "bytes": self.cache.nbytes}
-        stats["status"] = "ok"
+        causes = self.degraded_causes()
+        stats["status"] = "degraded" if causes else "ok"
+        if causes:
+            stats["degraded"] = causes
         return stats
 
 

@@ -223,7 +223,14 @@ class TileStore:
 
     def reload(self, _initial: bool = False) -> int:
         """Re-read the artifact and atomically swap the index; returns
-        the new generation (the cache-invalidation token)."""
+        the new generation (the cache-invalidation token).
+
+        Build-before-swap is a contract the serve tier's degraded mode
+        relies on (serve/http.py, tests/test_chaos.py): ``_build()``
+        runs to completion BEFORE ``self._layers`` is touched, so a
+        reload that raises — unreadable artifact, store mid-rewrite —
+        leaves the last-good index serving and the generation
+        unchanged."""
         t0 = time.monotonic()
         built = self._build()
         with self._lock:

@@ -18,7 +18,9 @@ from heatmap_tpu.utils.trace import (  # noqa: F401
 )
 from heatmap_tpu.utils.checkpoint import (  # noqa: F401
     CheckpointManager,
+    fsync_dir,
     load_checkpoint,
+    publish_dir,
     save_checkpoint,
 )
 from heatmap_tpu.utils.recovery import (  # noqa: F401

@@ -29,8 +29,54 @@ _META_KEY = "__meta_json__"
 _STEP_RE = re.compile(r"^ckpt-(\d+)\.npz$")
 
 
+def fsync_dir(path: str):
+    """fsync a directory so a just-renamed entry survives power loss.
+
+    Best-effort: platforms/filesystems that refuse O_RDONLY directory
+    fds (or directory fsync entirely) degrade to the pre-fsync
+    behavior rather than failing the publish.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def publish_dir(tmp_path: str, final_path: str):
+    """Durably publish a staged directory: fsync every file it holds,
+    rename ``tmp_path`` -> ``final_path``, then fsync the parent so the
+    rename itself is on disk — the directory-shaped counterpart of
+    ``save_checkpoint``'s tmp+fsync+replace contract. ``final_path``
+    must not exist (a recovery sweep quarantines stale orphans first;
+    see delta/recover.py) — checked explicitly, because POSIX rename
+    onto an empty directory would silently succeed."""
+    if os.path.exists(final_path):
+        raise FileExistsError(
+            f"publish target {final_path!r} already exists; run the "
+            "recovery sweep (delta/recover.py) to quarantine it first")
+    for name in sorted(os.listdir(tmp_path)):
+        full = os.path.join(tmp_path, name)
+        if not os.path.isfile(full):
+            continue
+        fd = os.open(full, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    fsync_dir(tmp_path)
+    os.rename(tmp_path, final_path)
+    fsync_dir(os.path.dirname(os.path.abspath(final_path)))
+
+
 def save_checkpoint(path: str, arrays: dict, meta: dict | None = None):
-    """Atomically write ``arrays`` (+ JSON ``meta``) to ``path`` (.npz)."""
+    """Atomically write ``arrays`` (+ JSON ``meta``) to ``path`` (.npz):
+    write-to-temp, fsync, ``os.replace``, parent-dir fsync."""
     for k in arrays:
         if k == _META_KEY:
             raise ValueError(f"array name {k!r} is reserved")
@@ -47,6 +93,7 @@ def save_checkpoint(path: str, arrays: dict, meta: dict | None = None):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
+        fsync_dir(d)
     except BaseException:
         try:
             os.unlink(tmp)

@@ -9,14 +9,21 @@ shard's contribution is a pure sum, and a failed shard is simply re-run
 — re-adding an identical partial is the only way a retry can land, so
 recovery is idempotent by construction.
 
-``FaultInjector`` provides the fault-injection hook the reference never
-had: tests (and chaos runs) fail chosen shards a chosen number of times
-to exercise the retry/recovery path.
+Fault injection: every attempt runs the process-wide plane's
+``shard.compute`` check (heatmap_tpu/faults/), and the legacy
+``FaultInjector`` — kept as the stable test/chaos API — is now a thin
+wrapper over a private plane with per-shard count rules. Backoff
+follows the unified policy (bounded exponential + full jitter,
+deterministic under an installed plane's seed); the sleep itself lives
+in ``faults.retry.sleep_backoff``, keeping this module free of
+hand-rolled retry sleeps.
 """
 
 from __future__ import annotations
 
 import time
+
+from heatmap_tpu import faults
 
 
 class ShardFailure(RuntimeError):
@@ -38,26 +45,29 @@ class FaultInjector:
     ``fail_counts``: {shard_index: times_to_fail}. Call ``check(i)``
     at the top of shard work; it raises until shard i's budget is
     spent, then lets the shard through — modeling a transient fault.
+
+    Implemented as per-shard count rules on a private
+    :class:`heatmap_tpu.faults.FaultPlane` (the ``shard.compute`` site),
+    so the legacy API and the chaos plane share one injection engine.
     """
 
     def __init__(self, fail_counts: dict):
-        import threading
+        self._plane = faults.FaultPlane()
+        for shard_index, times in fail_counts.items():
+            if times > 0:
+                self._plane.add_rule("shard.compute", key=shard_index,
+                                     count=int(times))
 
-        self._remaining = dict(fail_counts)
-        self._lock = threading.Lock()  # run_shards may be threaded
-        self.injected = 0
+    @property
+    def injected(self) -> int:
+        return self._plane.injected
 
     def check(self, shard_index):
-        with self._lock:
-            left = self._remaining.get(shard_index, 0)
-            if left <= 0:
-                return
-            self._remaining[shard_index] = left - 1
-            self.injected += 1
-        raise RuntimeError(f"injected fault on shard {shard_index}")
+        self._plane.check("shard.compute", key=shard_index)
 
 
 def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
+               backoff_cap_s: float = 2.0, deadline_s: float | None = None,
                fault_injector: FaultInjector | None = None,
                on_retry=None, tracer=None, max_workers: int = 1):
     """Run ``process(shard)`` over every shard with per-shard retries.
@@ -70,21 +80,32 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
     unhealthy, ...). Raises ShardFailure once a shard exhausts its
     budget.
 
+    Backoff before retry ``k`` is full-jitter exponential:
+    ``min(backoff_cap_s, backoff_s * 2**(k-1)) * U`` with deterministic
+    jitter U (see faults/retry.py); ``backoff_s=0`` (the default)
+    disables sleeping. ``deadline_s`` bounds one shard's total
+    failure+backoff window — exceeding it fails the shard even with
+    retry budget left.
+
     ``max_workers > 1`` runs shards on a thread pool — the right shape
     for IO-bound shards like Cassandra token-range or CosmosDB
     partition-range scans, which spend their time off-GIL in sockets.
     Retry bookkeeping is per shard and thread-local; ``on_retry`` may
-    be called concurrently and must be thread-safe.
+    be called concurrently and must be thread-safe. On the first
+    ShardFailure, outstanding (not-yet-started) shards are cancelled
+    rather than left to run behind the raised error.
     """
 
     from heatmap_tpu import obs
 
     def run_one(i, shard):
         attempt = 0
+        started = time.monotonic()
         while True:
             try:
                 if fault_injector is not None:
                     fault_injector.check(i)
+                faults.check("shard.compute", key=i)
                 if tracer is not None:
                     with tracer.span("shard"):
                         result = process(shard)
@@ -97,8 +118,13 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
                     on_retry(i, attempt, e)
                 if attempt > retries:
                     raise ShardFailure(i, attempt, e) from e
+                if (deadline_s is not None
+                        and time.monotonic() - started >= deadline_s):
+                    raise ShardFailure(i, attempt, e) from e
                 if backoff_s:
-                    time.sleep(backoff_s * attempt)
+                    faults.sleep_backoff("shard.compute", i, attempt,
+                                         base_s=backoff_s,
+                                         cap_s=backoff_cap_s)
             else:
                 if attempt:
                     # The shard landed after at least one failure —
@@ -114,6 +140,12 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
     with ThreadPoolExecutor(max_workers=max_workers) as ex:
         futures = [ex.submit(run_one, i, s) for i, s in enumerate(shards)]
         # In-order collection keeps results deterministic; the first
-        # exhausted shard raises (others complete or are abandoned with
-        # the pool).
-        return [f.result() for f in futures]
+        # exhausted shard raises after cancelling every shard that has
+        # not started yet (already-running shards finish their attempt
+        # inside the pool's shutdown wait).
+        try:
+            return [f.result() for f in futures]
+        except BaseException:
+            for f in futures:
+                f.cancel()
+            raise

@@ -49,7 +49,8 @@ def _reset_telemetry():
     ``enable_stage_tracing(True)`` (or enables metrics) cannot leak
     instrumentation cost or state into later hot-path tests."""
     yield
-    from heatmap_tpu import obs
+    from heatmap_tpu import faults, obs
+    from heatmap_tpu.delta import recover
     from heatmap_tpu.utils import trace
 
     trace.get_tracer().reset()
@@ -60,3 +61,5 @@ def _reset_telemetry():
     if log is not None:
         log.close()
         obs.set_event_log(None)
+    faults.install(None)  # disarm any chaos a test left installed
+    recover.clear_verified_cache()

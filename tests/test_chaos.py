@@ -1,0 +1,207 @@
+"""Fast chaos subset (tools/chaos_soak.py distilled for tier-1).
+
+Two pins, selectable with ``-m chaos``:
+
+1. **Byte identity** — a delta store built under a seeded fault plane
+   (torn reads, failed sink publishes, torn journal appends, failed
+   compaction publishes) converges to the same served bytes as a
+   fault-free build of the same batches.
+2. **Graceful serve degradation** — at the ``ServeApp.handle`` level:
+   render faults yield stale 200s (warm cache) or typed 503s (cold),
+   never a 500; ``/healthz`` flips to ``degraded`` and recovers on the
+   next fresh render; a failed reload keeps the last-good index; an
+   injected ``http.request`` fault is a typed 503.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import delta, faults
+from heatmap_tpu.io.sources import SyntheticSource
+from heatmap_tpu.pipeline import BatchJobConfig
+from heatmap_tpu.serve import ServeApp, TileCache, TileStore
+
+pytestmark = pytest.mark.chaos
+
+CFG = BatchJobConfig(detail_zoom=10, min_detail_zoom=8, result_delta=2)
+
+#: Count rules spaced inside each site's retry budget; scale=0 keeps
+#: the backoffs sleepless in tier-1.
+SPEC = ("seed=3,scale=0,source.read=20x2,sink.write=10x2,"
+        "journal.append=4x2,compact.publish=2x2")
+
+
+def _build(root, chaos=False):
+    if chaos:
+        faults.install_spec(SPEC)
+    try:
+        delta.apply_batch(root, SyntheticSource(n=200, seed=1), CFG,
+                          batch_size=64)
+        delta.apply_batch(root, SyntheticSource(n=150, seed=2), CFG,
+                          batch_size=64)
+        summary = delta.compact(root)
+        return summary
+    finally:
+        faults.install(None)
+
+
+class TestByteIdentity:
+    def test_chaos_build_matches_clean_build(self, tmp_path):
+        clean, hurt = str(tmp_path / "clean"), str(tmp_path / "hurt")
+        s1 = _build(clean)
+        plane_before = faults.get_plane()
+        s2 = _build(hurt, chaos=True)
+        assert faults.get_plane() is plane_before  # uninstalled after
+        assert s1["base"] == s2["base"]
+        a = delta.load_overlay_levels(clean)
+        b = delta.load_overlay_levels(hurt)
+        assert len(a) == len(b)
+        for la, lb in zip(a, b):
+            for col in ("row", "col", "value", "zoom"):
+                np.testing.assert_array_equal(np.asarray(la[col]),
+                                              np.asarray(lb[col]))
+
+    def test_chaos_rules_actually_fired(self, tmp_path):
+        faults.install_spec(SPEC)
+        try:
+            _ = delta.apply_batch(str(tmp_path / "s"),
+                                  SyntheticSource(n=200, seed=1), CFG,
+                                  batch_size=64)
+            counts = faults.get_plane().counts()
+        finally:
+            faults.install(None)
+        assert sum(counts.values()) >= 5
+        assert {"source.read", "journal.append"} <= set(counts)
+
+
+@pytest.fixture()
+def app(tmp_path):
+    root = str(tmp_path / "store")
+    _build(root)
+    store = TileStore(f"delta:{root}")
+    return ServeApp(store, TileCache(max_bytes=8 << 20))
+
+
+def _first_tile(app):
+    for name, layer in sorted(app.store.layers.items()):
+        if name == "default":
+            continue
+        for want, level in sorted(layer.levels.items()):
+            z = want - layer.result_delta
+            if z < 0:
+                continue
+            code = int(np.min(level.codes)) >> (2 * layer.result_delta)
+            from heatmap_tpu.tilemath.morton import morton_decode_np
+
+            rows, cols = morton_decode_np(np.asarray([code]))
+            return name, z, int(cols[0]), int(rows[0])
+    raise AssertionError("store has no servable tiles")
+
+
+class TestServeDegradation:
+    def test_cold_render_fault_is_typed_503(self, app):
+        name, z, x, y = _first_tile(app)
+        faults.install_spec("seed=1,scale=0,tile.render=1")
+        try:
+            status, _, body, _, route, cache = app.handle(
+                "GET", f"/tiles/{name}/{z}/{x}/{y}.json")
+        finally:
+            faults.install(None)
+        assert status == 503
+        assert route == "tiles"
+        assert "render failed" in json.loads(body)["error"]
+        assert "render" in app.degraded_causes()
+
+    def test_warm_cache_serves_stale_200(self, app):
+        name, z, x, y = _first_tile(app)
+        path = f"/tiles/{name}/{z}/{x}/{y}.json"
+        status, _, fresh, _, _, cache = app.handle("GET", path)
+        assert (status, cache) == (200, "miss")
+        # Generation bump stales the entry; the replacing render fails.
+        app.store.reload()
+        faults.install_spec("seed=1,scale=0,tile.render=1")
+        try:
+            status, _, body, _, _, cache = app.handle("GET", path)
+        finally:
+            faults.install(None)
+        assert (status, cache) == (200, "stale")
+        assert body == fresh  # last-good bytes, verbatim
+        assert app.degraded_causes().get("render") == "serving stale tiles"
+        # Next fresh render heals the flag.
+        status, _, body2, _, _, cache = app.handle("GET", path)
+        assert (status, cache) == (200, "miss")
+        assert body2 == fresh
+        assert app.degraded_causes() == {}
+
+    def test_healthz_degraded_then_recovers(self, app):
+        name, z, x, y = _first_tile(app)
+        path = f"/tiles/{name}/{z}/{x}/{y}.json"
+        faults.install_spec("seed=1,scale=0,tile.render=1")
+        try:
+            assert app.handle("GET", path)[0] == 503
+            status, _, body, _, _, _ = app.handle("GET", "/healthz")
+        finally:
+            faults.install(None)
+        health = json.loads(body)
+        assert status == 200
+        assert health["status"] == "degraded"
+        assert "render" in health["degraded"]
+        assert app.handle("GET", path)[0] == 200  # fault budget spent
+        health = json.loads(app.handle("GET", "/healthz")[2])
+        assert health["status"] == "ok"
+        assert "degraded" not in health
+
+    def test_http_request_fault_is_typed_503(self, app):
+        faults.install_spec("seed=1,scale=0,http.request=1")
+        try:
+            status, _, body, _, route, _ = app.handle("GET", "/healthz")
+        finally:
+            faults.install(None)
+        assert (status, route) == (503, "error")
+        assert json.loads(body)["error"] == "service unavailable"
+
+    def test_failed_reload_keeps_last_good_index(self, app, monkeypatch):
+        name, z, x, y = _first_tile(app)
+        path = f"/tiles/{name}/{z}/{x}/{y}.json"
+        assert app.handle("GET", path)[0] == 200
+        gen = app.store.generation
+
+        def boom(_initial=False):
+            raise OSError("store root unreachable")
+
+        monkeypatch.setattr(app.store, "reload", boom)
+        status, _, body, _, route, _ = app.handle("POST", "/reload")
+        assert (status, route) == (503, "reload")
+        assert json.loads(body)["generation"] == gen
+        assert app.store.generation == gen
+        assert "reload" in app.degraded_causes()
+        # The last-good index still serves (cache hit or re-render).
+        assert app.handle("GET", path)[0] == 200
+        monkeypatch.undo()
+        status, _, body, _, _, _ = app.handle("POST", "/reload")
+        assert status == 200
+        assert app.degraded_causes() == {}
+
+    def test_render_faults_never_500(self, app):
+        """Sweep every tile under a heavy render-fault probability: each
+        response is 200 or typed 503, and every tile converges."""
+        faults.install_spec("seed=9,scale=0,tile.render=p0.5")
+        statuses = set()
+        try:
+            name, z, x, y = _first_tile(app)
+            path = f"/tiles/{name}/{z}/{x}/{y}.json"
+            ok = False
+            for _ in range(64):
+                status = app.handle("GET", path)[0]
+                statuses.add(status)
+                if status == 200:
+                    ok = True
+                    break
+        finally:
+            faults.install(None)
+        assert ok
+        assert statuses <= {200, 503}

@@ -1,0 +1,359 @@
+"""Chaos plane + unified retry tests (heatmap_tpu/faults/).
+
+The plane's contract is DETERMINISM: a (seed, rule set) pair fires the
+same faults at the same check sequence every run — which is what lets
+tools/chaos_soak.py assert byte-identity between a faulted and a
+fault-free pipeline, and what makes any chaos failure replayable from
+its spec string. The retry side's contract is the policy table: every
+guarded site retries with bounded-exponential-plus-full-jitter backoff
+and a per-operation deadline, deterministic config errors excepted
+(``NonRetryable``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+from heatmap_tpu import faults, obs
+from heatmap_tpu.utils.recovery import FaultInjector, ShardFailure, run_shards
+
+
+class TestFaultPlane:
+    def test_count_rule_fires_first_n_checks(self):
+        plane = faults.FaultPlane(seed=1)
+        plane.add_rule("source.read", count=2)
+        for _ in range(2):
+            with pytest.raises(faults.InjectedFault):
+                plane.check("source.read")
+        for _ in range(10):
+            plane.check("source.read")  # budget spent — clean forever
+        assert plane.injected == 2
+        assert plane.counts() == {"source.read": 2}
+
+    def test_spacing_spreads_faults_across_checks(self):
+        """N faults every K-th check — isolated transients, so each one
+        lands inside a fresh per-retry budget instead of N consecutive
+        failures exhausting it (the soak's bread and butter)."""
+        plane = faults.FaultPlane(seed=1)
+        plane.add_rule("sink.write", count=3, spacing=4)
+        fired = []
+        for i in range(16):
+            try:
+                plane.check("sink.write")
+            except faults.InjectedFault:
+                fired.append(i)
+        assert len(fired) == 3
+        # consecutive firings are >= spacing checks apart
+        assert all(b - a >= 4 for a, b in zip(fired, fired[1:]))
+
+    def test_keyed_rule_only_matches_its_key(self):
+        plane = faults.FaultPlane(seed=1)
+        plane.add_rule("shard.compute", key=3, count=1)
+        plane.check("shard.compute", key=2)
+        with pytest.raises(faults.InjectedFault):
+            plane.check("shard.compute", key=3)
+        plane.check("shard.compute", key=3)  # spent
+
+    def test_probability_rule_is_seed_deterministic(self):
+        def firing_pattern(seed):
+            plane = faults.FaultPlane(seed=seed)
+            plane.add_rule("tile.render", prob=0.3)
+            out = []
+            for i in range(200):
+                try:
+                    plane.check("tile.render", key=i % 7)
+                except faults.InjectedFault:
+                    out.append(i)
+            return out
+
+        a, b, c = firing_pattern(5), firing_pattern(5), firing_pattern(6)
+        assert a == b  # same seed -> identical fault schedule
+        assert a != c  # different seed -> different schedule
+        assert 20 < len(a) < 100  # ~30% of 200, loosely
+
+    def test_unknown_site_rejected(self):
+        plane = faults.FaultPlane()
+        with pytest.raises(ValueError, match="unknown fault site"):
+            plane.add_rule("not.a.site", count=1)
+        with pytest.raises(ValueError, match="unknown fault site"):
+            plane.check("not.a.site")
+
+    def test_fault_carries_site_key_seq(self):
+        plane = faults.FaultPlane(seed=1)
+        plane.add_rule("journal.append", count=1)
+        with pytest.raises(faults.InjectedFault) as ei:
+            plane.check("journal.append", key="current")
+        assert ei.value.site == "journal.append"
+        assert ei.value.key == "current"
+        assert ei.value.seq == 0
+
+    def test_fired_faults_hit_obs(self):
+        obs.enable_metrics(True)
+        log_path = None
+        plane = faults.FaultPlane(seed=1)
+        plane.add_rule("source.read", count=1)
+        with pytest.raises(faults.InjectedFault):
+            plane.check("source.read", key="csv")
+        from heatmap_tpu.obs import FAULTS_INJECTED
+
+        assert FAULTS_INJECTED.value(site="source.read") == 1
+        assert log_path is None  # event-log coverage lives in test_obs
+
+
+class TestSpecGrammar:
+    def test_full_grammar_round_trip(self):
+        plane = faults.install_spec(
+            "seed=9,scale=0.5,source.read=3,sink.write=2x5,"
+            "tile.render=p0.25,shard.compute@1=1")
+        try:
+            assert plane.seed == 9
+            assert plane.backoff_scale == 0.5
+            descs = [r.describe() for r in plane._rules]
+            assert descs == ["source.read=3", "sink.write=2x5",
+                             "tile.render=p0.25", "shard.compute@1=1"]
+        finally:
+            faults.install(None)
+
+    def test_bad_specs_rejected(self):
+        for spec in ("source.read", "source.read=x", "nope=3",
+                     "source.read=p2.0", "source.read=0x0"):
+            with pytest.raises(ValueError):
+                faults.parse_spec(spec)
+
+    def test_install_from_env_flag_wins(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_VAR, "seed=1,source.read=1")
+        try:
+            plane = faults.install_from_env("seed=2,sink.write=1")
+            assert plane.seed == 2  # CLI spec beats the env var
+            assert [r.site for r in plane._rules] == ["sink.write"]
+            plane = faults.install_from_env(None)
+            assert plane.seed == 1  # env var alone
+        finally:
+            faults.install(None)
+
+    def test_no_spec_means_no_plane(self, monkeypatch):
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        assert faults.install_from_env(None) is None
+        assert faults.get_plane() is None
+        faults.check("source.read")  # global no-op must stay cheap + silent
+
+
+class TestRetryCall:
+    def test_retries_through_injected_faults(self):
+        faults.install_spec("seed=1,scale=0,sink.write=2")
+        calls = []
+        out = faults.retry_call(lambda: calls.append(1) or "ok",
+                                site="sink.write")
+        assert out == "ok"
+        assert len(calls) == 1  # faults fire BEFORE the op; op ran once
+        assert faults.get_plane().injected == 2
+
+    def test_budget_exhaustion_reraises_the_fault(self):
+        faults.install_spec("seed=1,scale=0,journal.append=99")
+        with pytest.raises(faults.InjectedFault):
+            faults.retry_call(lambda: "never", site="journal.append")
+        # journal.append policy: 3 retries -> 4 checks total
+        assert faults.get_plane().injected == 4
+
+    def test_nonretryable_fails_immediately(self):
+        class Boom(RuntimeError, faults.NonRetryable):
+            pass
+
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise Boom("config error")
+
+        with pytest.raises(Boom):
+            faults.retry_call(fn, site="source.read")
+        assert len(calls) == 1
+
+    def test_real_transient_errors_also_retry(self):
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise OSError("disk hiccup")
+            return "recovered"
+
+        policy = faults.RetryPolicy(retries=3, base_s=0.0, cap_s=0.0,
+                                    deadline_s=None)
+        assert faults.retry_call(flaky, site="sink.write",
+                                 policy=policy) == "recovered"
+        assert len(attempts) == 3
+
+    def test_deadline_bounds_total_retry_time(self):
+        t = [0.0]
+
+        def clock():
+            return t[0]
+
+        def fn():
+            t[0] += 10.0
+            raise RuntimeError("slow failure")
+
+        policy = faults.RetryPolicy(retries=99, base_s=0.0, cap_s=0.0,
+                                    deadline_s=25.0)
+        with pytest.raises(RuntimeError, match="slow failure"):
+            faults.retry_call(fn, site="sink.write", policy=policy,
+                              clock=clock)
+        assert t[0] <= 40.0  # deadline cut it off long before 99 retries
+
+    def test_backoff_is_bounded_and_jittered(self):
+        vals = [faults.backoff_s("sink.write", "k", attempt,
+                                 base_s=0.05, cap_s=2.0)
+                for attempt in range(1, 12)]
+        assert all(0.0 <= v <= 2.0 for v in vals)  # full jitter in [0, cap]
+        assert len(set(vals)) > 5  # jitter actually varies by attempt
+        # deterministic: same (site, key, attempt) -> same delay
+        assert vals[3] == faults.backoff_s("sink.write", "k", 4,
+                                           base_s=0.05, cap_s=2.0)
+
+    def test_scale_zero_makes_backoff_instant(self):
+        faults.install_spec("seed=1,scale=0,sink.write=1")
+        assert faults.backoff_s("sink.write", None, 5,
+                                base_s=1.0, cap_s=60.0) == 0.0
+
+
+class TestResumableIter:
+    def test_stream_resumes_without_loss_or_duplication(self):
+        faults.install_spec("seed=1,scale=0,source.read=3x4")
+        items = list(faults.resumable_iter(lambda: iter(range(10)),
+                                           site="source.read"))
+        assert items == list(range(10))
+        assert faults.get_plane().injected == 3
+
+    def test_attempt_budget_resets_per_delivered_item(self):
+        """12 isolated transients across a 40-item stream — far more
+        total faults than any single retry budget, survivable because
+        delivery resets the attempt counter."""
+        faults.install_spec("seed=2,scale=0,source.read=12x3")
+        items = list(faults.resumable_iter(lambda: iter(range(40)),
+                                           site="source.read"))
+        assert items == list(range(40))
+        assert faults.get_plane().injected == 12
+
+    def test_consecutive_faults_exhaust_the_budget(self):
+        faults.install_spec("seed=1,scale=0,source.read=99")
+        with pytest.raises(faults.InjectedFault):
+            list(faults.resumable_iter(lambda: iter(range(5)),
+                                       site="source.read"))
+
+    def test_nonretryable_from_stream_passes_through(self):
+        class Cfg(RuntimeError, faults.NonRetryable):
+            pass
+
+        def make():
+            def gen():
+                yield 1
+                raise Cfg("bad config")
+            return gen()
+
+        rebuilds = []
+
+        def counted():
+            rebuilds.append(1)
+            return make()
+
+        with pytest.raises(Cfg):
+            list(faults.resumable_iter(counted, site="source.read"))
+        assert len(rebuilds) == 1  # no retry on a deterministic error
+
+
+class TestRunShards:
+    def test_exponential_backoff_replaces_linear(self):
+        """backoff_s now seeds bounded-exp-plus-jitter; with the plane's
+        scale at 0 the waits collapse, so a retried run is instant."""
+        faults.install_spec("seed=1,scale=0")
+        inj = FaultInjector({0: 2, 1: 1})
+        t0 = time.monotonic()
+        out = run_shards([10, 20], lambda s: s + 1, retries=3,
+                         backoff_s=5.0, fault_injector=inj)
+        assert out == [11, 21]
+        assert time.monotonic() - t0 < 1.0  # 5s linear backoff would hang
+        assert inj.injected == 3
+
+    def test_fail_fast_cancels_outstanding_shards(self):
+        """First ShardFailure cancels queued futures: with one worker, a
+        poisoned shard 0 must prevent later shards from running."""
+        ran = []
+
+        def process(s):
+            ran.append(s)
+            if s == 0:
+                raise RuntimeError("poisoned")
+            time.sleep(0.05)  # hold the worker so the cancel can land
+            return s
+
+        with pytest.raises(ShardFailure):
+            run_shards(list(range(6)), process, retries=0, max_workers=2)
+        # cancellation is best-effort (in-flight shards finish), but the
+        # tail of the queue must never start
+        assert len(ran) < 6
+
+    def test_deadline_s_bounds_a_shards_retry_loop(self):
+        t = {"n": 0}
+
+        def process(s):
+            t["n"] += 1
+            raise RuntimeError("always fails")
+
+        with pytest.raises(ShardFailure) as ei:
+            run_shards([0], process, retries=10 ** 6, backoff_s=0.0,
+                       deadline_s=0.0)
+        assert ei.value.shard_index == 0
+        assert t["n"] < 100  # deadline, not the million retries
+
+
+class TestHeartbeatFaults:
+    def test_injected_heartbeat_loss_goes_stale_and_times_out(self):
+        from heatmap_tpu.parallel.multihost import (StragglerTimeout,
+                                                    check_heartbeats)
+
+        obs.enable_metrics(True)
+        obs.heartbeat("phase_a")  # real heartbeat lands
+        ages = obs.heartbeat_ages()
+        assert list(ages) == ["0"] and ages["0"] < 5.0
+
+        faults.install_spec("seed=1,multihost.heartbeat=99")
+        obs.heartbeat("phase_b")  # lost in transit: gauge NOT updated
+        now = time.time() + 30.0
+        with pytest.raises(StragglerTimeout) as ei:
+            check_heartbeats(10.0, now=now)
+        assert "0" in ei.value.stale
+        assert ei.value.stale["0"] > 10.0
+
+    def test_check_heartbeats_quiet_when_fresh(self):
+        from heatmap_tpu.parallel.multihost import check_heartbeats
+
+        obs.enable_metrics(True)
+        obs.heartbeat("x")
+        ages = check_heartbeats(60.0)
+        assert set(ages) == {"0"}
+
+    def test_disabled_registry_never_times_out(self):
+        from heatmap_tpu.parallel.multihost import check_heartbeats
+
+        assert check_heartbeats(0.001) == {}
+
+
+class TestCLIWiring:
+    def test_chaos_flag_parses_and_installs(self):
+        from heatmap_tpu.cli import build_parser
+
+        parser = build_parser()
+        args = parser.parse_args(
+            ["run", "--input", "synthetic:10", "--chaos",
+             "seed=4,source.read=1"])
+        assert args.chaos == "seed=4,source.read=1"
+        plane = faults.install_from_env(args.chaos)
+        assert plane.seed == 4
+
+    def test_env_var_name_is_stable(self):
+        assert faults.ENV_VAR == "HEATMAP_TPU_CHAOS"
+        assert os.environ.get(faults.ENV_VAR) is None  # tests run clean

@@ -51,6 +51,13 @@ _PAYLOADS = {
     "compaction_end": {"root": "store/", "seconds": 0.4, "status": "ok",
                        "base": "base-000004", "levels": 5, "rows": 2048,
                        "pruned_entries": 2},
+    "fault_injected": {"site": "source.read", "fault_seq": 0, "key": "jsonl",
+                       "rule": "source.read=3x5"},
+    "degraded_enter": {"cause": "render", "detail": "serving stale tiles"},
+    "degraded_exit": {"cause": "render"},
+    "quarantine": {"root": "store/", "path": "journal/ckpt-3.npz",
+                   "reason": "digest_mismatch", "kind": "journal_entry",
+                   "detail": "recorded sha256:aa..., actual sha256:bb..."},
     "run_end": {"status": "ok", "blobs": 42, "checksum": "crc32:00000000",
                 "seconds": 1.0},
 }
@@ -468,6 +475,38 @@ class TestNoRawInstrumentation:
         # And the guard pattern does bite on what serve must not do.
         assert self.PATTERN.search("print('GET /tiles 200')")
         assert self.PATTERN.search("t0 = time.perf_counter()")
+
+    SLEEP_ALLOWED = ("heatmap_tpu/faults/",)
+    SLEEP_PATTERN = re.compile(r"(?<![\w.])time\.sleep\(")
+
+    def test_no_hand_rolled_retry_sleeps(self):
+        """Every backoff sleep goes through faults.sleep_backoff — the
+        only sanctioned ``time.sleep`` in the library. A hand-rolled
+        ``time.sleep`` retry loop would dodge the unified policy table,
+        the chaos plane's ``backoff_scale`` (which is how the soak and
+        the chaos tests keep injected-fault retries instant), and the
+        ``io_retries_total`` accounting (docs/robustness.md)."""
+        offenders = []
+        pkg = os.path.join(REPO, "heatmap_tpu")
+        for dirpath, _, files in os.walk(pkg):
+            for fname in files:
+                if not fname.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                rel = os.path.relpath(full, REPO).replace(os.sep, "/")
+                if any(rel.startswith(a) for a in self.SLEEP_ALLOWED):
+                    continue
+                with open(full) as f:
+                    for lineno, line in enumerate(f, 1):
+                        code = line.split("#", 1)[0]
+                        if self.SLEEP_PATTERN.search(code):
+                            offenders.append(f"{rel}:{lineno}")
+        assert not offenders, (
+            "time.sleep() outside heatmap_tpu/faults/ — use "
+            "faults.sleep_backoff / faults.retry_call for retry waits: "
+            + ", ".join(offenders))
+        # The pattern does bite on what the guard forbids.
+        assert self.SLEEP_PATTERN.search("time.sleep(backoff_s * attempt)")
 
     def test_delta_tree_is_guarded(self):
         """The delta/ package times applies and compactions — that must

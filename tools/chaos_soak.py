@@ -1,0 +1,345 @@
+"""Chaos soak: the full pipeline under deterministic fault injection,
+byte-identical to a fault-free run.
+
+Drives ingest -> cascade -> delta apply -> compact -> serve twice over
+the same synthetic input: once clean, once with a seeded fault plane
+(faults/plane.py) firing hundreds of injected failures across every
+site — source reads, sink publishes, journal appends, compaction
+publishes, shard compute, tile renders, HTTP requests, and lost
+multihost heartbeats. The chaos run must converge to the *same bytes*:
+level arrays, journal state, and every served JSON tile. Along the way
+the HTTP tier must degrade gracefully (typed 503s / stale serves,
+``/healthz`` reporting ``degraded``) and never return a 500.
+
+Usage:
+    python tools/chaos_soak.py [--n 3000] [--chaos SPEC] [--keep]
+
+Every phase reports one JSON line; the exit code is non-zero if any
+failed. A fast subset runs in tier-1 as tests/test_chaos.py (-m chaos).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+import traceback
+import urllib.error
+import urllib.parse
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_enable_x64", True)  # composite keys need int64
+
+import numpy as np
+
+from heatmap_tpu import delta, faults, obs
+from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.io.sources import SyntheticSource
+from heatmap_tpu.parallel.multihost import StragglerTimeout, check_heartbeats
+from heatmap_tpu.pipeline import BatchJobConfig, run_job
+from heatmap_tpu.serve import ServeApp, TileCache, TileStore, serve_in_thread
+from heatmap_tpu.tilemath.morton import morton_decode_np
+from heatmap_tpu.utils.recovery import run_shards
+
+CFG = BatchJobConfig(detail_zoom=10, min_detail_zoom=8, result_delta=2)
+
+#: Default plane: count rules spaced so transient bursts stay inside
+#: each site's retry budget (faults/retry.py POLICIES), probability
+#: rules on the serve tier where the HTTP client retries 503s.
+DEFAULT_CHAOS = ",".join([
+    "seed=11", "scale=0",
+    "source.read=60x2",
+    "sink.write=30x2",
+    "journal.append=8x2",
+    "compact.publish=4x2",
+    "shard.compute=40x3",
+    "tile.render=p0.3",
+    "http.request=p0.2",
+    "multihost.heartbeat=6x2",
+])
+
+FETCH_ATTEMPTS = 64  # per-URL 503-retry budget under probability rules
+
+
+# ---------------------------------------------------------------- pipeline
+
+def _pipeline(root: str, arrays_dir: str, n: int):
+    """Ingest -> cascade -> 3 delta applies -> compact -> post-compact
+    apply. Identical call sequence for the clean and chaos runs."""
+    run_job(SyntheticSource(n=n, seed=7), LevelArraysSink(arrays_dir),
+            config=CFG, batch_size=512)
+    shards = [(i, min(i + 8, 96)) for i in range(0, 96, 8)]
+    digests = run_shards(shards, lambda s: s[1] - s[0], retries=2)
+    applies = [
+        delta.apply_batch(root, SyntheticSource(n=n // 3, seed=1), CFG,
+                          batch_size=256),
+        delta.apply_batch(root, SyntheticSource(n=n // 3, seed=2), CFG,
+                          batch_size=256),
+        delta.apply_batch(root, SyntheticSource(n=n // 4, seed=3), CFG,
+                          batch_size=256),
+    ]
+    summary = delta.compact(root)
+    post = delta.apply_batch(root, SyntheticSource(n=n // 5, seed=4), CFG,
+                             batch_size=256)
+    return {"shard_rows": int(sum(digests)),
+            "epochs": [r.epoch for r in applies + [post]],
+            "compact": summary.get("base"),
+            "points": int(sum(r.points for r in applies + [post]))}
+
+
+def _tile_coords(store: TileStore):
+    """Every servable JSON tile of every layer, from the stored Morton
+    codes (the tests/test_delta.py enumeration)."""
+    coords = []
+    for name, layer in sorted(store.layers.items()):
+        if name == "default":
+            continue
+        shift = 2 * layer.result_delta
+        for want, level in layer.levels.items():
+            z = want - layer.result_delta
+            if z < 0:
+                continue
+            rows, cols = morton_decode_np(np.unique(level.codes >> shift))
+            for r, c in zip(rows, cols):
+                coords.append((name, z, int(c), int(r)))
+    return coords
+
+
+def _get(url: str):
+    """-> (status, body). 503s come back as data, not exceptions."""
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _fetch_all(base: str, coords, ctx):
+    """Fetch every tile, retrying typed 503s; record status codes and
+    whether /healthz reported ``degraded`` while render faults were
+    live. Any 500, or a URL that never converges, is a failure."""
+    docs, probes = {}, 0
+    for name, z, x, y in coords:
+        url = (f"{base}/tiles/{urllib.parse.quote(name, safe='')}"
+               f"/{z}/{x}/{y}.json")
+        for attempt in range(FETCH_ATTEMPTS):
+            status, body = _get(url)
+            ctx["codes"][status] = ctx["codes"].get(status, 0) + 1
+            assert status != 500, f"HTTP 500 from {url}: {body[:200]!r}"
+            if status == 200:
+                docs[(name, z, x, y)] = body
+                break
+            assert status == 503, f"unexpected {status} from {url}"
+            # A render fault just degraded the app: /healthz must say so
+            # (itself retried through http.request faults).
+            if b"render" in body and not ctx["saw_degraded"] and probes < 8:
+                probes += 1
+                for _ in range(FETCH_ATTEMPTS):
+                    hs, hb = _get(f"{base}/healthz")
+                    assert hs != 500
+                    if hs == 200:
+                        health = json.loads(hb)
+                        if health.get("status") == "degraded":
+                            ctx["saw_degraded"] = True
+                            ctx["degraded_causes"] = health.get("degraded")
+                        break
+        else:
+            raise AssertionError(f"{url} never returned 200 in "
+                                 f"{FETCH_ATTEMPTS} attempts")
+    return docs
+
+
+def _serve_docs(root: str, ctx=None):
+    """Serve the delta store over real HTTP and fetch every tile."""
+    ctx = ctx if ctx is not None else {"codes": {}, "saw_degraded": False}
+    store = TileStore(f"delta:{root}")
+    app = ServeApp(store, TileCache(max_bytes=64 << 20),
+                   render_timeout_s=30.0)
+    server, base = serve_in_thread(app)
+    try:
+        docs = _fetch_all(base, _tile_coords(store), ctx)
+    finally:
+        server.shutdown()
+    ctx["docs"] = docs
+    return ctx
+
+
+def _levels_bytes(path: str) -> dict:
+    out = {}
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if os.path.isfile(full):
+            with open(full, "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+# ------------------------------------------------------------------ phases
+
+def phase_baseline(ctx):
+    faults.install(None)
+    t0 = time.monotonic()
+    info = _pipeline(ctx["base_root"], ctx["base_arrays"], ctx["n"])
+    served = _serve_docs(ctx["base_root"])
+    ctx["base_docs"] = served["docs"]
+    assert served["codes"].get(500, 0) == 0
+    return {**info, "tiles": len(served["docs"]),
+            "seconds": round(time.monotonic() - t0, 1)}
+
+
+def phase_chaos_pipeline(ctx):
+    plane = faults.install_spec(ctx["chaos"])
+    t0 = time.monotonic()
+    info = _pipeline(ctx["chaos_root"], ctx["chaos_arrays"], ctx["n"])
+    return {**info, "faults_so_far": plane.injected,
+            "seconds": round(time.monotonic() - t0, 1)}
+
+
+def phase_chaos_serve(ctx):
+    """Serve the chaos store while render/request faults are still
+    firing: every tile must converge to 200 (typed 503s in between,
+    never a 500) and /healthz must report ``degraded`` mid-storm."""
+    served = _serve_docs(ctx["chaos_root"],
+                         ctx.setdefault("serve_ctx",
+                                        {"codes": {}, "saw_degraded": False}))
+    ctx["chaos_docs"] = served["docs"]
+    codes = served["codes"]
+    assert codes.get(500, 0) == 0, f"500s observed: {codes}"
+    assert codes.get(503, 0) > 0, \
+        f"soak never exercised the degraded path: {codes}"
+    assert served["saw_degraded"], "/healthz never reported degraded"
+    return {"codes": {str(k): v for k, v in sorted(codes.items())},
+            "tiles": len(served["docs"]),
+            "degraded_causes": served.get("degraded_causes")}
+
+
+def phase_heartbeat(ctx):
+    """Lost-heartbeat detection: injected multihost.heartbeat faults
+    suppress the liveness gauge, and the deadline monitor raises a
+    typed StragglerTimeout once the surviving mark goes stale."""
+    obs.enable_metrics(True)
+    try:
+        plane = faults.get_plane()
+        before = plane.counts().get("multihost.heartbeat", 0)
+        for _ in range(12):
+            obs.heartbeat("soak")  # every other one is lost in transit
+        lost = plane.counts().get("multihost.heartbeat", 0) - before
+        assert lost >= 4, f"heartbeat faults never fired ({lost})"
+        ages = check_heartbeats(deadline_s=3600.0)  # fresh: no straggler
+        try:
+            check_heartbeats(deadline_s=0.5, now=time.time() + 10)
+        except StragglerTimeout as e:
+            stale = e.stale
+        else:
+            raise AssertionError("stale heartbeats went undetected")
+        return {"lost": lost, "ages": {k: round(v, 3) for k, v in
+                                       ages.items()},
+                "stale_processes": sorted(stale)}
+    finally:
+        obs.enable_metrics(False)
+
+
+def phase_fault_floor(ctx):
+    """The acceptance floor: >= 200 injected faults across >= 6 sites."""
+    counts = faults.get_plane().counts()
+    total = sum(counts.values())
+    assert total >= 200, f"only {total} faults injected: {counts}"
+    assert len(counts) >= 6, f"only {len(counts)} sites fired: {counts}"
+    ctx["fault_counts"] = counts
+    return {"total": total, "sites": counts}
+
+
+def phase_byte_equality(ctx):
+    """The anchor: the chaos run's bytes are identical to the clean
+    run's — level arrays from the cascade AND every served tile."""
+    faults.install(None)
+    a = _levels_bytes(ctx["base_arrays"])
+    b = _levels_bytes(ctx["chaos_arrays"])
+    assert sorted(a) == sorted(b), "level-array file sets diverged"
+    for name in a:
+        assert a[name] == b[name], f"level arrays diverged at {name}"
+    base, chaos = ctx["base_docs"], ctx["chaos_docs"]
+    assert sorted(base) == sorted(chaos), (
+        f"served tile sets diverged: {len(base)} vs {len(chaos)}")
+    mism = [k for k in base if base[k] != chaos[k]]
+    assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
+    # Fault-free aftermath: the degraded flags cleared and the chaos
+    # store serves clean (no stale 503s linger once the plane is gone).
+    served = _serve_docs(ctx["chaos_root"])
+    assert served["codes"].get(503, 0) == 0
+    assert served["codes"].get(500, 0) == 0
+    return {"levels": len(a), "tiles": len(base),
+            "clean_refetch_codes": {str(k): v for k, v in
+                                    sorted(served["codes"].items())}}
+
+
+PHASES = [
+    ("baseline", phase_baseline),
+    ("chaos_pipeline", phase_chaos_pipeline),
+    ("chaos_serve", phase_chaos_serve),
+    ("heartbeat", phase_heartbeat),
+    ("fault_floor", phase_fault_floor),
+    ("byte_equality", phase_byte_equality),
+]
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="pipeline chaos soak: byte-equality under "
+                    "deterministic fault injection")
+    ap.add_argument("--n", type=int, default=3000,
+                    help="synthetic points for the ingest run")
+    ap.add_argument("--chaos", default=DEFAULT_CHAOS,
+                    help="fault-plane spec (see docs/robustness.md)")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory")
+    ap.add_argument("--only", action="append", default=None,
+                    help="run only the named phase(s); byte_equality "
+                         "needs the earlier ones")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="chaos-soak-")
+    ctx = {
+        "n": args.n, "chaos": args.chaos,
+        "base_root": os.path.join(tmp, "store-base"),
+        "chaos_root": os.path.join(tmp, "store-chaos"),
+        "base_arrays": os.path.join(tmp, "arrays-base"),
+        "chaos_arrays": os.path.join(tmp, "arrays-chaos"),
+    }
+    failed = 0
+    try:
+        for name, fn in PHASES:
+            if args.only and name not in args.only:
+                continue
+            t0 = time.monotonic()
+            try:
+                info = fn(ctx)
+                print(json.dumps({"phase": name, "ok": True,
+                                  "seconds": round(time.monotonic() - t0, 1),
+                                  **(info or {})}))
+            except Exception as e:
+                failed += 1
+                traceback.print_exc()
+                print(json.dumps({"phase": name, "ok": False,
+                                  "seconds": round(time.monotonic() - t0, 1),
+                                  "error": f"{type(e).__name__}: {e}"}))
+            sys.stdout.flush()
+    finally:
+        faults.install(None)
+        if args.keep:
+            print(json.dumps({"scratch": tmp}))
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
