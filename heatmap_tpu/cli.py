@@ -242,6 +242,54 @@ def _add_run_flags(p):
                    "shard (path sinks get a per-host suffix "
                    "automatically) — the scalable reducer-write path; "
                    "required for columnar sinks on pods")
+    _add_trace_flags(p)
+
+
+def _add_trace_flags(p):
+    """--trace-out / --trace-sample / --slo, shared by run, update and
+    serve (docs/observability.md)."""
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="enable hierarchical span tracing and export "
+                   "the span trees as Chrome/Perfetto trace-event JSON "
+                   "to PATH at exit (load in chrome://tracing, "
+                   "ui.perfetto.dev, or tools/trace_analyze.py)")
+    p.add_argument("--trace-sample", type=float, default=1.0, metavar="P",
+                   help="probability a new trace root is sampled "
+                   "(decided once per root — e.g. per serve request; "
+                   "default 1.0 records every trace)")
+    p.add_argument("--slo", action="append", default=None, metavar="SPEC",
+                   help="declare an SLO as NAME:KIND:k=v,... (kinds: "
+                   "latency, error_rate, staleness; repeatable). "
+                   "Error-budget burn rates fold into /healthz, the "
+                   "run report, and slo_breach events")
+
+
+def _setup_tracing(args):
+    """Wire --trace-out/--trace-sample/--slo; returns the live
+    TraceCollector (None with tracing off)."""
+    from heatmap_tpu import obs
+
+    collector = None
+    if getattr(args, "trace_out", None):
+        try:
+            collector = obs.enable_tracing(sample=args.trace_sample)
+        except ValueError as e:
+            raise SystemExit(f"--trace-sample: {e}") from e
+    if getattr(args, "slo", None):
+        try:
+            obs.install_specs(args.slo)
+        except ValueError as e:
+            raise SystemExit(f"--slo: {e}") from e
+    return collector
+
+
+def _export_trace(args, collector):
+    if collector is None:
+        return
+    n = collector.export_chrome(args.trace_out)
+    line = {"trace_out": args.trace_out, "span_events": n,
+            "dropped": collector.dropped}
+    print(json.dumps(line), file=sys.stderr)
 
 
 def cmd_run(args) -> int:
@@ -408,6 +456,10 @@ def cmd_run(args) -> int:
                         for k, v in _dc.asdict(config).items()}
             obs.emit("run_start", config=manifest, backend=args.backend,
                      devices=obs.device_topology(), argv=sys.argv[1:])
+    from heatmap_tpu.obs import tracing as tracing_mod
+
+    collector = _setup_tracing(args)
+    root_span = tracing_mod.begin_span("run")
     t0 = time.perf_counter()
     prof = jax_profile(args.profile) if args.profile else contextlib.nullcontext()
     job_error = None
@@ -450,9 +502,12 @@ def cmd_run(args) -> int:
                                     merge_spill_dir=args.merge_spill_dir)
     except BaseException as e:  # noqa: BLE001 — run_end must record it
         if not telemetry:
+            tracing_mod.end_span(root_span)
+            _export_trace(args, collector)
             raise
         job_error = e
     dt = time.perf_counter() - t0
+    tracing_mod.end_span(root_span)
     if args.profile:
         print(get_tracer().format_report(), file=sys.stderr)
     if telemetry:
@@ -484,7 +539,9 @@ def cmd_run(args) -> int:
             obs.write_run_report(args.report, report)
             print(obs.format_run_report(report), file=sys.stderr)
         if job_error is not None:
+            _export_trace(args, collector)
             raise job_error
+    _export_trace(args, collector)
     summary = {"seconds": round(dt, 3), "output": output_spec,
                "ingest": "fast" if fast_source is not None else "standard"}
     if isinstance(blobs, dict) and str(
@@ -807,6 +864,7 @@ def cmd_serve(args) -> int:
     if args.events:
         ev_log = obs.EventLog(args.events)
         obs.set_event_log(ev_log)
+    collector = _setup_tracing(args)
     ttl = args.ttl
     if args.follow_stream and not (ttl and ttl > 0):
         # Targeted invalidation only drops tiles a batch touched; decay
@@ -841,6 +899,7 @@ def cmd_serve(args) -> int:
         if stop_stream is not None:
             stop_stream()
         server.server_close()
+        _export_trace(args, collector)
         if ev_log is not None:
             obs.set_event_log(None)
             ev_log.close()
@@ -1061,6 +1120,7 @@ def _add_update_flags(p):
                    default=None, metavar="PATH",
                    help="fold tracer + metrics + events into a run "
                    "report at PATH and print the span table to stderr")
+    _add_trace_flags(p)
 
 
 def cmd_update(args) -> int:
@@ -1133,6 +1193,10 @@ def cmd_update(args) -> int:
                             for k, v in _dc.asdict(config).items()}
             obs.emit("run_start", config=manifest, backend=args.backend,
                      devices=obs.device_topology(), argv=sys.argv[1:])
+    from heatmap_tpu.obs import tracing as tracing_mod
+
+    collector = _setup_tracing(args)
+    root_span = tracing_mod.begin_span("update")
     t0 = time.perf_counter()
     job_error = None
     summary = {"journal": args.journal}
@@ -1173,13 +1237,18 @@ def cmd_update(args) -> int:
     except ValueError as e:
         # Config mismatch / double --base: operator errors, one line.
         if not telemetry:
+            tracing_mod.end_span(root_span)
+            _export_trace(args, collector)
             raise SystemExit(str(e)) from e
         job_error = e
     except BaseException as e:  # noqa: BLE001 — run_end must record it
         if not telemetry:
+            tracing_mod.end_span(root_span)
+            _export_trace(args, collector)
             raise
         job_error = e
     dt = time.perf_counter() - t0
+    tracing_mod.end_span(root_span)
     if telemetry:
         from heatmap_tpu import obs
         from heatmap_tpu.utils.trace import get_tracer
@@ -1205,9 +1274,11 @@ def cmd_update(args) -> int:
             obs.write_run_report(args.report, report)
             print(obs.format_run_report(report), file=sys.stderr)
         if job_error is not None:
+            _export_trace(args, collector)
             if isinstance(job_error, ValueError):
                 raise SystemExit(str(job_error)) from job_error
             raise job_error
+    _export_trace(args, collector)
     summary["seconds"] = round(dt, 3)
     print(json.dumps(summary))
     return 0
@@ -1385,6 +1456,7 @@ def build_parser() -> argparse.ArgumentParser:
     p_serve.add_argument("--events", default=None, metavar="PATH",
                          help="append http_request events to PATH (JSONL, "
                          "docs/observability.md)")
+    _add_trace_flags(p_serve)
     p_serve.add_argument("--follow-stream", default=None, metavar="SPEC",
                          help="live mode: consume this source spec as "
                          "micro-batches into a decayed stream layer "

@@ -33,6 +33,7 @@ import os
 import time
 
 from heatmap_tpu import obs
+from heatmap_tpu.obs import tracing
 from heatmap_tpu.delta import compact as compact_mod
 from heatmap_tpu.delta.compact import (check_config, compact, init_store,
                                        live_entries, load_overlay_levels,
@@ -82,45 +83,51 @@ def apply_batch(root: str, source, config, *, sign: int = 1,
     """
     if sign not in (1, -1):
         raise ValueError("sign must be +1 (insert) or -1 (retraction)")
-    t0 = time.monotonic()
-    init_store(root)
-    cols = read_columns(source, batch_size=batch_size)
-    content_hash = batch_content_hash(cols, sign=sign)
-    journal = DeltaJournal(compact_mod.journal_dir(root))
-    existing = journal.find(content_hash)
-    if existing is not None:
+    # Root-on-demand: under a CLI `update` root this nests; a direct
+    # apply_batch call with tracing on becomes its own connected tree.
+    tsp = tracing.begin_span("delta.apply", {"sign": sign})
+    try:
+        t0 = time.monotonic()
+        init_store(root)
+        cols = read_columns(source, batch_size=batch_size)
+        content_hash = batch_content_hash(cols, sign=sign)
+        journal = DeltaJournal(compact_mod.journal_dir(root))
+        existing = journal.find(content_hash)
+        if existing is not None:
+            seconds = time.monotonic() - t0
+            obs.emit("delta_applied", epoch=existing["epoch"],
+                     points=existing["points"], sign=existing["sign"],
+                     seconds=round(seconds, 6), duplicate=True,
+                     content_hash=content_hash)
+            return DeltaResult(epoch=existing["epoch"],
+                               points=existing["points"],
+                               sign=existing["sign"], duplicate=True,
+                               artifact=existing.get("artifact"), rows=0,
+                               seconds=seconds)
+        check_config(root, config)
+        n_points = int(len(cols["latitude"]))
+        epoch = journal.next_epoch()
+        artifact = f"delta-{epoch:06d}"
+        out_dir = os.path.join(root, artifact)
+        stats = compute_delta(ColumnsSource(cols), out_dir, config,
+                              sign=sign, batch_size=batch_size)
+        rows = int(stats.get("rows", 0)) if isinstance(stats, dict) else 0
+        watermark = _watermark(cols)
+        journal.append(content_hash=content_hash, points=n_points,
+                       sign=sign, artifact=artifact, watermark=watermark)
+        keys = affected_tile_keys(LevelArraysSink.load(out_dir))
         seconds = time.monotonic() - t0
-        obs.emit("delta_applied", epoch=existing["epoch"],
-                 points=existing["points"], sign=existing["sign"],
-                 seconds=round(seconds, 6), duplicate=True,
-                 content_hash=content_hash)
-        return DeltaResult(epoch=existing["epoch"],
-                           points=existing["points"],
-                           sign=existing["sign"], duplicate=True,
-                           artifact=existing.get("artifact"), rows=0,
-                           seconds=seconds)
-    check_config(root, config)
-    n_points = int(len(cols["latitude"]))
-    epoch = journal.next_epoch()
-    artifact = f"delta-{epoch:06d}"
-    out_dir = os.path.join(root, artifact)
-    stats = compute_delta(ColumnsSource(cols), out_dir, config, sign=sign,
-                          batch_size=batch_size)
-    rows = int(stats.get("rows", 0)) if isinstance(stats, dict) else 0
-    watermark = _watermark(cols)
-    journal.append(content_hash=content_hash, points=n_points, sign=sign,
-                   artifact=artifact, watermark=watermark)
-    keys = affected_tile_keys(LevelArraysSink.load(out_dir))
-    seconds = time.monotonic() - t0
-    DELTA_POINTS.inc(n_points, kind="insert" if sign > 0 else "retract")
-    DELTA_APPLY_SECONDS.observe(seconds)
-    obs.emit("delta_applied", epoch=epoch, points=n_points, sign=sign,
-             seconds=round(seconds, 6), content_hash=content_hash,
-             artifact=artifact, rows=rows, watermark=watermark,
-             keys_invalidated=len(keys))
-    return DeltaResult(epoch=epoch, points=n_points, sign=sign,
-                       duplicate=False, artifact=artifact, rows=rows,
-                       seconds=seconds, affected_keys=keys)
+        DELTA_POINTS.inc(n_points, kind="insert" if sign > 0 else "retract")
+        DELTA_APPLY_SECONDS.observe(seconds)
+        obs.emit("delta_applied", epoch=epoch, points=n_points, sign=sign,
+                 seconds=round(seconds, 6), content_hash=content_hash,
+                 artifact=artifact, rows=rows, watermark=watermark,
+                 keys_invalidated=len(keys))
+        return DeltaResult(epoch=epoch, points=n_points, sign=sign,
+                           duplicate=False, artifact=artifact, rows=rows,
+                           seconds=seconds, affected_keys=keys)
+    finally:
+        tracing.end_span(tsp)
 
 
 def refresh_serving(result: DeltaResult, store, cache=None) -> int:

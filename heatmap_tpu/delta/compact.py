@@ -217,6 +217,7 @@ def compact(root: str, *, retention: int = 2) -> dict:
     from heatmap_tpu import obs
     from heatmap_tpu.delta import recover
     from heatmap_tpu.delta.metrics import COMPACTION_SECONDS
+    from heatmap_tpu.obs import tracing
 
     recover.sweep(root)
     cur = read_current(root)
@@ -229,6 +230,7 @@ def compact(root: str, *, retention: int = 2) -> dict:
     obs.emit("compaction_start", root=root, deltas=len(live),
              base=base_name)
     t0 = time.monotonic()
+    tsp = tracing.begin_span("delta.compact", {"deltas": len(live)})
     try:
         dirs = overlay_dirs(root)
         merged = drop_zero_rows(merge_level_dirs(dirs)) if dirs else []
@@ -270,3 +272,5 @@ def compact(root: str, *, retention: int = 2) -> dict:
                  seconds=round(time.monotonic() - t0, 6),
                  status="error", error=repr(exc))
         raise
+    finally:
+        tracing.end_span(tsp)

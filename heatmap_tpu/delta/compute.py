@@ -108,13 +108,15 @@ def compute_delta(source, out_dir: str, config, *, sign: int = 1,
     """Run ``source`` through the full batch cascade into a delta
     artifact dir (LevelArraysSink format). Returns run_job's stats."""
     from heatmap_tpu.pipeline import run_job  # defers the jax import
+    from heatmap_tpu.obs import tracing
 
     if sign not in (1, -1):
         raise ValueError("sign must be +1 (insert) or -1 (retraction)")
     sink = LevelArraysSink(out_dir)
     if sign == -1:
         sink = _NegatingLevels(sink)
-    return run_job(source, sink, config, batch_size=batch_size)
+    with tracing.span("delta.compute", sign=sign):
+        return run_job(source, sink, config, batch_size=batch_size)
 
 
 def affected_tile_keys(levels: dict,

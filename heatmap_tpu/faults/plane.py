@@ -61,12 +61,22 @@ _SITE_SET = frozenset(SITES)
 
 
 class InjectedFault(RuntimeError):
-    """A fault fired by the injection plane (transient by design)."""
+    """A fault fired by the injection plane (transient by design).
+
+    ``trace_id`` is the ambient trace at injection time (None with
+    tracing off): the exception a retry layer logs and the
+    ``fault_injected`` event — which obs.events stamps with the same
+    identity — point at the same span tree.
+    """
 
     def __init__(self, site: str, key=None, seq: int = 0):
         self.site = site
         self.key = key
         self.seq = seq
+        from heatmap_tpu.obs import tracing
+
+        ids = tracing.current_ids()
+        self.trace_id = ids[0] if ids else None
         at = f"{site}@{key}" if key is not None else site
         super().__init__(f"injected fault #{seq} at {at}")
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import time
 
-from heatmap_tpu.obs import events, metrics
+from heatmap_tpu.obs import events, metrics, slo, tracing
 from heatmap_tpu.obs.events import (EVENT_SCHEMA, EventLog, emit,
                                     get_event_log, read_events,
                                     set_event_log, validate_event)
@@ -31,6 +31,12 @@ from heatmap_tpu.obs.metrics import (MetricsRegistry, enable_metrics,
                                      get_registry, metrics_enabled)
 from heatmap_tpu.obs.report import (blob_checksum, build_run_report,
                                     format_run_report, write_run_report)
+from heatmap_tpu.obs.slo import (SLOEngine, SLOSpec, install_specs,
+                                 parse_slo_spec, slo_status)
+from heatmap_tpu.obs.tracing import (TraceCollector, current_span,
+                                     current_traceparent, disable_tracing,
+                                     enable_tracing, get_collector,
+                                     parse_traceparent, tracing_enabled)
 
 _T0 = time.monotonic()  # heartbeat uptime origin (~process start)
 
@@ -84,6 +90,26 @@ FAULTS_INJECTED = _registry.counter(
 IO_RETRIES = _registry.counter(
     "io_retries_total", "I/O operations retried by faults.retry",
     labelnames=("site",))
+PROCESS_UPTIME = _registry.gauge(
+    "process_uptime_seconds", "Seconds since this process imported obs")
+BUILD_INFO = _registry.gauge(
+    "heatmap_build_info", "Constant 1; the version label is the payload",
+    labelnames=("version",))
+
+
+def refresh_process_gauges():
+    """Stamp process_uptime_seconds and heatmap_build_info{version}.
+
+    Gauge writes no-op while the registry is disabled, so these are
+    refreshed at scrape time (serve /metrics, write_prometheus dumps)
+    rather than set once at import.
+    """
+    if not _registry.enabled:
+        return
+    from heatmap_tpu import __version__
+
+    PROCESS_UPTIME.set(time.monotonic() - _T0)
+    BUILD_INFO.set(1, version=__version__)
 
 
 def telemetry_enabled() -> bool:
@@ -99,18 +125,20 @@ def record_stage(stage: str, wall_s: float, items=None, **attrs):
     """
     enabled = _registry.enabled
     log = events._current
-    if not enabled and log is None:
+    if not enabled and log is None and events._observer is None:
         return
     if enabled:
         STAGE_SECONDS.observe(wall_s, stage=stage)
         if items:
             STAGE_ITEMS.inc(int(items), stage=stage)
-    if log is not None:
+    if log is not None or events._observer is not None:
         fields = {k: v for k, v in attrs.items() if v is not None}
         if items:
             fields["items"] = int(items)
-        log.emit("stage_end", stage=stage, wall_s=round(wall_s, 6),
-                 **fields)
+        # Through events.emit (not log.emit) so the record is trace-
+        # stamped and the SLO observer sees it.
+        events.emit("stage_end", stage=stage, wall_s=round(wall_s, 6),
+                    **fields)
 
 
 def device_topology() -> dict:
@@ -180,8 +208,14 @@ def heartbeat(phase: str):
     uptime = time.monotonic() - _T0
     HOST_PHASE_SECONDS.set(uptime, phase=phase, process=str(pi))
     HOST_LAST_HEARTBEAT.set(time.time(), process=str(pi))
+    fields = {}
+    tp = tracing.current_traceparent()
+    if tp is not None:
+        # Cross-process propagation: a collector on another host can
+        # continue this trace by passing the header to begin_span.
+        fields["traceparent"] = tp
     emit("heartbeat", process_index=pi, process_count=jax.process_count(),
-         phase=phase, uptime_s=round(uptime, 3))
+         phase=phase, uptime_s=round(uptime, 3), **fields)
 
 
 def heartbeat_ages(now: float | None = None) -> dict:
@@ -234,12 +268,15 @@ def record_io_retry(site: str):
 
 
 __all__ = [
-    "EVENT_SCHEMA", "EventLog", "MetricsRegistry",
-    "blob_checksum", "build_run_report", "device_topology", "emit",
-    "enable_metrics", "events", "format_run_report", "get_event_log",
-    "get_registry", "heartbeat", "heartbeat_ages", "metrics",
-    "metrics_enabled", "read_events", "record_fault", "record_io_retry",
-    "record_recovery", "record_retry", "record_stage",
-    "sample_device_memory", "set_event_log", "telemetry_enabled",
+    "EVENT_SCHEMA", "EventLog", "MetricsRegistry", "SLOEngine", "SLOSpec",
+    "TraceCollector", "blob_checksum", "build_run_report", "current_span",
+    "current_traceparent", "device_topology", "disable_tracing", "emit",
+    "enable_metrics", "enable_tracing", "events", "format_run_report",
+    "get_collector", "get_event_log", "get_registry", "heartbeat",
+    "heartbeat_ages", "install_specs", "metrics", "metrics_enabled",
+    "parse_slo_spec", "parse_traceparent", "read_events", "record_fault",
+    "record_io_retry", "record_recovery", "record_retry", "record_stage",
+    "refresh_process_gauges", "sample_device_memory", "set_event_log",
+    "slo", "slo_status", "telemetry_enabled", "tracing", "tracing_enabled",
     "validate_event", "write_run_report",
 ]

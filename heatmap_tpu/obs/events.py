@@ -29,9 +29,11 @@ EVENT_SCHEMA = {
     "run_start": {"required": ("config", "backend", "devices"),
                   "optional": ("argv",)},
     # One per closed tracer span when an event log is installed.
+    # trace_id/span_id land automatically when tracing is on (the span
+    # that just closed), linking slow aggregates back to span trees.
     "stage_end": {"required": ("stage", "wall_s"),
                   "optional": ("items", "bytes", "backend", "level",
-                               "window")},
+                               "window", "trace_id", "span_id")},
     # Job-level routing decision: how cascade_backend="auto" resolved.
     "backend_resolved": {"required": ("requested", "resolved"),
                          "optional": ("reason", "weighted", "data_parallel",
@@ -40,21 +42,24 @@ EVENT_SCHEMA = {
     # backend_resolved: what run_cascade actually executed).
     "cascade_dispatch": {"required": ("backend",),
                          "optional": ("jit", "mesh", "merge", "n_emissions",
-                                      "n_slots")},
+                                      "n_slots", "trace_id", "span_id")},
     # jax.local_devices()[i].memory_stats() snapshot (empty on CPU).
     "device_memory": {"required": ("samples",), "optional": ()},
     # utils/recovery.py shard retry loop.
     "retry": {"required": ("shard", "attempt", "error"), "optional": ()},
     "recovery": {"required": ("shard", "attempts"), "optional": ()},
     # parallel/multihost.py per-host phase heartbeats.
+    # traceparent (W3C-style 00-{trace_id}-{span_id}-{flags}) carries
+    # the emitting host's ambient trace across process boundaries.
     "heartbeat": {"required": ("process_index", "process_count", "phase"),
-                  "optional": ("uptime_s",)},
+                  "optional": ("uptime_s", "traceparent")},
     # utils/trace.py jax_profile failed to start (satellite fix).
     "profiler_unavailable": {"required": ("error",), "optional": ("logdir",)},
     # serve/http.py per-request record (route is the coarse family,
     # e.g. "tiles"; path the concrete URL; cache "hit"/"miss" on tiles).
     "http_request": {"required": ("route", "status"),
-                     "optional": ("path", "ms", "bytes", "cache")},
+                     "optional": ("path", "ms", "bytes", "cache",
+                                  "trace_id", "span_id")},
     # serve/store.py full index rebuild (TileStore.reload): every
     # cached tile is invalidated by the generation bump — the
     # heavyweight counterpart to a targeted delta apply.
@@ -78,7 +83,7 @@ EVENT_SCHEMA = {
     # monotonic injection counter (not the envelope seq), so a chaos run
     # can be replayed check-for-check from its event log.
     "fault_injected": {"required": ("site", "fault_seq"),
-                       "optional": ("key", "rule")},
+                       "optional": ("key", "rule", "trace_id", "span_id")},
     # serve/http.py degraded-mode transitions (/healthz mirrors the
     # active cause set). Emitted on cause-set edges, not per request.
     "degraded_enter": {"required": ("cause",), "optional": ("detail",)},
@@ -88,6 +93,11 @@ EVENT_SCHEMA = {
     # delta dir, stale base dir).
     "quarantine": {"required": ("root", "path", "reason"),
                    "optional": ("kind", "detail")},
+    # obs/slo.py: an objective's burn rate crossed 1.0 (rising edge;
+    # one record per breach episode, not per evaluation).
+    "slo_breach": {"required": ("slo", "burn_rate"),
+                   "optional": ("kind", "compliance", "target",
+                                "window_s", "detail")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",
@@ -169,6 +179,21 @@ class EventLog:
 
 _current: EventLog | None = None
 
+# Integration hooks, both None unless their owner installed them (one
+# global read each on the emit path, keeping the zero-cost stance):
+# - _trace_ids: set by obs.tracing.enable_tracing; returns the ambient
+#   (trace_id, span_id) so _TRACE_STAMPED events link to span trees.
+# - _observer: set by obs.slo.set_engine; sees every emitted record so
+#   the SLO window fills without re-reading the log file.
+_trace_ids = None
+_observer = None
+
+# Events that get the ambient trace identity stamped automatically
+# (explicit trace_id in fields always wins, e.g. serve passes the
+# request root's ids after the span has closed).
+_TRACE_STAMPED = frozenset(
+    {"stage_end", "http_request", "fault_injected", "cascade_dispatch"})
+
 
 def set_event_log(log: EventLog | None):
     """Install (or clear, with None) the process-wide event log."""
@@ -181,11 +206,28 @@ def get_event_log() -> EventLog | None:
 
 
 def emit(event: str, **fields) -> dict | None:
-    """Emit to the installed log; no-op (returns None) when none is set."""
+    """Emit to the installed log; no-op (returns None) when none is set.
+
+    The observer hook fires even without a log (on a synthetic,
+    unjournaled record), so ``serve --slo`` fills its compliance
+    window without requiring ``--events``.
+    """
     log = _current
-    if log is None:
+    observer = _observer
+    if log is None and observer is None:
         return None
-    return log.emit(event, **fields)
+    ids_fn = _trace_ids
+    if (ids_fn is not None and event in _TRACE_STAMPED
+            and "trace_id" not in fields):
+        ids = ids_fn()
+        if ids is not None:
+            fields["trace_id"], fields["span_id"] = ids
+    rec = (log.emit(event, **fields) if log is not None
+           else {"run_id": "-", "seq": -1, "ts": time.time(),
+                 "event": event, **fields})
+    if observer is not None:
+        observer(rec)
+    return rec if log is not None else None
 
 
 def read_events(path: str) -> list:

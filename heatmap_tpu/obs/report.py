@@ -35,7 +35,12 @@ def blob_checksum(blobs: dict) -> str:
 
 def build_run_report(tracer=None, registry=None,
                      events_path: str | None = None) -> dict:
-    """Assemble the report dict from whichever sources are available."""
+    """Assemble the report dict from whichever sources are available.
+
+    When tracing is live its collector summary folds in as ``trace``;
+    when an SLO engine is installed its status folds in as ``slo``
+    (evaluated over whatever the engine has observed/ingested).
+    """
     report: dict = {"schema": REPORT_SCHEMA}
     warnings: list = []
 
@@ -103,6 +108,15 @@ def build_run_report(tracer=None, registry=None,
         if last_mem is not None:
             report["device_memory"] = last_mem
 
+    from heatmap_tpu.obs import slo, tracing
+
+    collector = tracing.get_collector()
+    if collector is not None:
+        report["trace"] = collector.summary()
+    slo_state = slo.slo_status()
+    if slo_state is not None:
+        report["slo"] = slo_state
+
     if warnings:
         report["warnings"] = warnings
     return report
@@ -151,6 +165,22 @@ def format_run_report(report: dict) -> str:
                          f"{rec['max_s']:>10.4f}  {ips}")
     else:
         lines.append("  (no stage spans recorded)")
+
+    trace = report.get("trace")
+    if trace:
+        lines.append(f"  traces: {trace.get('n_traces', 0)} "
+                     f"({trace.get('n_spans', 0)} spans)")
+        for root in trace.get("roots", ()):
+            lines.append(f"    {root['name']:<26}{root['wall_s']:>10.3f}s"
+                         f"  spans={root['n_spans']}")
+    slo_state = report.get("slo")
+    if slo_state:
+        for obj in slo_state.get("objectives", ()):
+            flag = "BREACH" if obj.get("breaching") else "ok"
+            lines.append(
+                f"  slo {obj['name']:<22}{flag:>7}  "
+                f"compliance={obj.get('compliance')} "
+                f"burn={obj.get('burn_rate')}x")
 
     mem = report.get("device_memory")
     if mem:

@@ -42,6 +42,7 @@ import jax
 import numpy as np
 
 from heatmap_tpu import obs
+from heatmap_tpu.obs import tracing
 from heatmap_tpu.io.sinks import LevelArraysSink as _LevelArraysSink
 # Merge semantics live in the jax-free io.merge module (the CLI's
 # offline shard merge uses them without an accelerator stack);
@@ -717,38 +718,46 @@ def run_job_multihost(source, sink=None, config=None,
 
     _phase("ingest_start")
     cap = _CaptureLevels() if columnar else None
-    if max_points_in_flight:
-        # Bounded slice ingest: chunked cascade + host-side merge
-        # (auto-spill / explicit spill included) — blobs equal the
-        # single-shot slice run by the same linearity the bounded path
-        # already guarantees.
-        local = _run_job_bounded(slice_source, cap, config, batch_size,
-                                 max_points_in_flight,
-                                 spill_dir=merge_spill_dir)
-    else:
-        data = ingest_columns(slice_source.batches(batch_size), config)
-        if data is not None:
-            # Cross-host blob merge sums colliding numeric dicts, which
-            # is exactly the weighted semantics too (f64 sums are
-            # linear across host shards).
-            local = _run_loaded(data, config, as_json=True, sink=cap)
+    # Phase regions are spans (heartbeats at their edges carry the
+    # ambient traceparent, so a collector on another host can stitch
+    # the per-host trees of one job together by trace_id).
+    with tracing.span("multihost.ingest",
+                      process=int(jax.process_index())):
+        if max_points_in_flight:
+            # Bounded slice ingest: chunked cascade + host-side merge
+            # (auto-spill / explicit spill included) — blobs equal the
+            # single-shot slice run by the same linearity the bounded
+            # path already guarantees.
+            local = _run_job_bounded(slice_source, cap, config,
+                                     batch_size, max_points_in_flight,
+                                     spill_dir=merge_spill_dir)
         else:
-            local = {}
+            data = ingest_columns(slice_source.batches(batch_size),
+                                  config)
+            if data is not None:
+                # Cross-host blob merge sums colliding numeric dicts,
+                # which is exactly the weighted semantics too (f64 sums
+                # are linear across host shards).
+                local = _run_loaded(data, config, as_json=True, sink=cap)
+            else:
+                local = {}
     _phase("ingest_done")
-    if columnar:
-        owned = scatter_levels(cap.levels, max_bytes=egress_max_bytes)
-        rows = sink.write_levels(owned)
+    with tracing.span("multihost.egress",
+                      egress="levels-sharded" if columnar else egress):
+        if columnar:
+            owned = scatter_levels(cap.levels, max_bytes=egress_max_bytes)
+            rows = sink.write_levels(owned)
+            _phase("egress_done")
+            return {"egress": "levels-sharded", "levels": len(owned),
+                    "rows": rows}
+        if egress == "sharded":
+            owned = scatter_blobs(local, max_bytes=egress_max_bytes)
+            if sink is not None:
+                sink.write(owned.items())
+            _phase("egress_done")
+            return owned
+        blobs = gather_blobs(local, max_bytes=egress_max_bytes)
+        if sink is not None and jax.process_index() == 0:
+            sink.write(blobs.items())
         _phase("egress_done")
-        return {"egress": "levels-sharded", "levels": len(owned),
-                "rows": rows}
-    if egress == "sharded":
-        owned = scatter_blobs(local, max_bytes=egress_max_bytes)
-        if sink is not None:
-            sink.write(owned.items())
-        _phase("egress_done")
-        return owned
-    blobs = gather_blobs(local, max_bytes=egress_max_bytes)
-    if sink is not None and jax.process_index() == 0:
-        sink.write(blobs.items())
-    _phase("egress_done")
-    return blobs
+        return blobs

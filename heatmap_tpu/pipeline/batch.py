@@ -512,6 +512,7 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
     egress (_SpillMerge) — for near-unique-output shapes whose merge
     table outgrows RAM.
     """
+    from heatmap_tpu.obs import tracing
     from heatmap_tpu.utils.trace import get_tracer
 
     config = config or BatchJobConfig()
@@ -525,18 +526,22 @@ def run_job(source, sink=None, config: BatchJobConfig | None = None,
             "max_points_in_flight > 0 to chunk — silently ignoring the "
             "spill request would run the in-RAM merge it exists to avoid"
         )
-    if max_points_in_flight:  # 0/None -> single-shot
-        return _run_job_bounded(
-            source, sink, config, batch_size, max_points_in_flight,
-            overlap_ingest=overlap_ingest, spill_dir=merge_spill_dir,
-        )
-    tracer = get_tracer()
-    data = ingest_columns(source.batches(batch_size), config)
-    if data is None:
-        return {}
-    with tracer.span("cascade", items=len(data["latitude"])):
-        blobs = _run_loaded(data, config, as_json=True, sink=sink)
-    return blobs
+    # Tree-only span (no aggregate entry): a bare run_job call under
+    # tracing yields ONE connected tree whose ingest/cascade/egress
+    # tracer spans all parent here (root-on-demand when no CLI root).
+    with tracing.span("run_job", bounded=bool(max_points_in_flight)):
+        if max_points_in_flight:  # 0/None -> single-shot
+            return _run_job_bounded(
+                source, sink, config, batch_size, max_points_in_flight,
+                overlap_ingest=overlap_ingest, spill_dir=merge_spill_dir,
+            )
+        tracer = get_tracer()
+        data = ingest_columns(source.batches(batch_size), config)
+        if data is None:
+            return {}
+        with tracer.span("cascade", items=len(data["latitude"])):
+            blobs = _run_loaded(data, config, as_json=True, sink=sink)
+        return blobs
 
 
 #: Rough host bytes per point on the string ingest path: two f64
@@ -1267,8 +1272,13 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
                 finally:
                     put(DONE)
 
-            t = threading.Thread(target=producer, name="ingest-prefetch",
-                                 daemon=True)
+            # context_bound: the prefetch thread's ingest.batch spans
+            # must parent under the ambient job span, not open a
+            # disconnected trace of their own.
+            from heatmap_tpu.obs import tracing as _tracing
+
+            t = threading.Thread(target=_tracing.context_bound(producer),
+                                 name="ingest-prefetch", daemon=True)
             t.start()
             try:
                 while True:

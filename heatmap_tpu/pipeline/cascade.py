@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from heatmap_tpu.obs import events as obs_events
+from heatmap_tpu.obs import tracing
 from heatmap_tpu.ops import pyramid as pyramid_ops
 from heatmap_tpu.tilemath.morton import morton_decode_np
 
@@ -299,31 +300,38 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     call and should stay eager). ``mesh`` (hashable, a valid static
     arg) routes the detail reduction through the data-parallel sharded
     pyramid — see build_cascade."""
-    if obs_events._current is not None:
-        # Audit every dispatch: what the cascade actually executed
-        # (shape info is static even on tracers, so this is safe in
-        # eager AND pre-jit contexts). backend_resolved in batch.py
-        # records the routing *decision*; this records each execution.
-        obs_events.emit(
-            "cascade_dispatch", backend=backend,
-            jit=bool(jit and not adaptive), mesh=mesh is not None,
-            merge=merge, n_emissions=int(codes.shape[0]),
-            n_slots=int(n_slots))
-    if adaptive or not jit:
-        return build_cascade(
-            codes, slots, config, n_slots, weights=weights, valid=valid,
-            capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
+    # Tree-only span around the dispatch: the cascade_dispatch event is
+    # emitted inside it, so the audit record carries this span's
+    # trace_id/span_id (events.py stamps _TRACE_STAMPED types).
+    tsp = tracing.begin_span("cascade.dispatch", {"backend": backend})
+    try:
+        if obs_events._current is not None:
+            # Audit every dispatch: what the cascade actually executed
+            # (shape info is static even on tracers, so this is safe in
+            # eager AND pre-jit contexts). backend_resolved in batch.py
+            # records the routing *decision*; this records each execution.
+            obs_events.emit(
+                "cascade_dispatch", backend=backend,
+                jit=bool(jit and not adaptive), mesh=mesh is not None,
+                merge=merge, n_emissions=int(codes.shape[0]),
+                n_slots=int(n_slots))
+        if adaptive or not jit:
+            return build_cascade(
+                codes, slots, config, n_slots, weights=weights, valid=valid,
+                capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
+                backend=backend, mesh=mesh, merge=merge,
+                weight_bound=weight_bound,
+            )
+        if isinstance(capacity, list):
+            capacity = tuple(capacity)  # static args must be hashable
+        return _build_cascade_jit(
+            codes, slots, config=config, n_slots=n_slots, weights=weights,
+            valid=valid, capacity=capacity, acc_dtype=acc_dtype,
             backend=backend, mesh=mesh, merge=merge,
             weight_bound=weight_bound,
         )
-    if isinstance(capacity, list):
-        capacity = tuple(capacity)  # static args must be hashable
-    return _build_cascade_jit(
-        codes, slots, config=config, n_slots=n_slots, weights=weights,
-        valid=valid, capacity=capacity, acc_dtype=acc_dtype,
-        backend=backend, mesh=mesh, merge=merge,
-        weight_bound=weight_bound,
-    )
+    finally:
+        tracing.end_span(tsp)
 
 
 def _on_accelerator(x) -> bool:

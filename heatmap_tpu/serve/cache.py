@@ -44,6 +44,7 @@ import time
 from collections import OrderedDict
 
 from heatmap_tpu import obs
+from heatmap_tpu.obs import tracing
 
 _registry = obs.get_registry()
 CACHE_HITS = _registry.counter(
@@ -170,9 +171,15 @@ class TileCache:
             if obs.metrics_enabled():
                 CACHE_MISSES.inc()
             t0 = self._clock()
+            # Only the leader's render is a span (followers wait, they
+            # don't render) — it parents under the request span of the
+            # thread that won the flight.
+            tsp = tracing.begin_span("tile.render", {"format": fmt})
             try:
                 value = render_fn()
             except BaseException as e:
+                tracing.end_span(tsp)
+                tsp = None
                 if stale_if_error and fallback is not _NO_FALLBACK:
                     if obs.metrics_enabled():
                         CACHE_STALE_SERVES.inc()
@@ -186,6 +193,7 @@ class TileCache:
                     self._flights.pop(key, None)
                 flight.done.set()
                 raise
+            tracing.end_span(tsp)
             if obs.metrics_enabled():
                 RENDER_SECONDS.observe(self._clock() - t0, format=fmt)
             flight.value = value

@@ -49,6 +49,7 @@ import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from heatmap_tpu import faults, obs
+from heatmap_tpu.obs import slo, tracing
 from heatmap_tpu.serve.cache import TileCache
 from heatmap_tpu.serve.render import tile_json_bytes, tile_png_bytes
 from heatmap_tpu.serve.store import TileStore
@@ -141,6 +142,7 @@ class ServeApp:
             body = json.dumps(self._health(), indent=2).encode()
             return 200, "application/json", body, None, "healthz", None
         if method == "GET" and path == "/metrics":
+            obs.refresh_process_gauges()
             body = _registry.render_prometheus().encode()
             return (200, "text/plain; version=0.0.4", body, None,
                     "metrics", None)
@@ -221,7 +223,18 @@ class ServeApp:
                         concurrent.futures.ThreadPoolExecutor(
                             max_workers=4,
                             thread_name_prefix="tile-render"))
-        future = self._render_pool.submit(render, layer, z, x, y)
+        # context_bound carries the ambient request span into the pool
+        # worker (a plain submit would start from an empty context and
+        # the worker-side span would orphan into its own trace).
+        def pooled(layer, z, x, y):
+            span = tracing.begin_span("tile.render.worker", {"format": fmt})
+            try:
+                return render(layer, z, x, y)
+            finally:
+                tracing.end_span(span)
+
+        future = self._render_pool.submit(
+            tracing.context_bound(pooled), layer, z, x, y)
         try:
             return future.result(timeout=self.render_timeout_s)
         except concurrent.futures.TimeoutError:
@@ -246,6 +259,9 @@ class ServeApp:
         stats["status"] = "degraded" if causes else "ok"
         if causes:
             stats["degraded"] = causes
+        slo_state = slo.slo_status()
+        if slo_state is not None:
+            stats["slo"] = slo_state
         return stats
 
 
@@ -258,26 +274,44 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _dispatch(self, method: str):
         t0 = time.monotonic()
+        # Each request is a trace root (sampled per --trace-sample); an
+        # incoming traceparent header instead continues the client's
+        # trace, inheriting its sampled flag. Handler threads start
+        # with a fresh context, so every request tree is independent.
+        req_span = tracing.begin_span(
+            "serve.request", {"method": method, "path": self.path},
+            traceparent=self.headers.get("traceparent"))
         try:
-            status, ctype, body, etag, route, cache = self.app.handle(
-                method, self.path, self.headers.get("If-None-Match"))
-        except Exception as e:  # defensive: a render bug must not kill serving
-            status, ctype, route, cache = 500, "application/json", "error", None
-            body = json.dumps({"error": repr(e)}).encode()
-            etag = None
-        self.send_response(status)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(body)))
-        if etag is not None:
-            self.send_header("ETag", etag)
-        self.end_headers()
-        if body:
-            self.wfile.write(body)
-        if obs.metrics_enabled():
-            HTTP_REQUESTS.inc(route=route, status=str(status))
-        obs.emit("http_request", route=route, status=int(status),
-                 path=self.path, ms=round((time.monotonic() - t0) * 1e3, 3),
-                 bytes=len(body), **({"cache": cache} if cache else {}))
+            try:
+                status, ctype, body, etag, route, cache = self.app.handle(
+                    method, self.path, self.headers.get("If-None-Match"))
+            except Exception as e:  # defensive: a render bug must not kill serving
+                status, ctype, route, cache = (500, "application/json",
+                                               "error", None)
+                body = json.dumps({"error": repr(e)}).encode()
+                etag = None
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            if etag is not None:
+                self.send_header("ETag", etag)
+            tp = tracing.current_traceparent()
+            if tp is not None:
+                self.send_header("traceparent", tp)
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+            if obs.metrics_enabled():
+                HTTP_REQUESTS.inc(route=route, status=str(status))
+            # Emitted while the request span is still ambient, so the
+            # event is stamped with this tree's trace_id/span_id.
+            obs.emit("http_request", route=route, status=int(status),
+                     path=self.path,
+                     ms=round((time.monotonic() - t0) * 1e3, 3),
+                     bytes=len(body),
+                     **({"cache": cache} if cache else {}))
+        finally:
+            tracing.end_span(req_span)
 
     def do_GET(self):
         self._dispatch("GET")

@@ -31,6 +31,13 @@ import time
 
 _obs = None  # lazily imported so importing trace never pulls in obs/jax
 
+# Span-tree hooks, installed by obs.tracing.enable_tracing (and removed
+# by disable_tracing). While set, every default-tracer span also opens a
+# node in the hierarchical trace (obs/tracing.py) — the aggregate API
+# here is unchanged, and with tracing off the cost is one global read.
+_tree_begin = None
+_tree_end = None
+
 
 def _obs_record(name: str, wall_s: float, items, attrs: dict):
     global _obs
@@ -71,6 +78,9 @@ class Tracer:
     def span(self, name: str, items: int | None = None, **attrs):
         """Extra keyword attrs (e.g. ``backend="partitioned"``) ride
         along on the stage_end event when an event log is installed."""
+        begin = _tree_begin
+        tree_span = (begin(name, attrs or None)
+                     if begin is not None and self is _default else None)
         t0 = time.perf_counter()
         try:
             yield self
@@ -84,7 +94,13 @@ class Tracer:
                 if items:
                     s.items += int(items)
             if self is _default:
+                # stage_end emits while the tree span is still ambient,
+                # so the event is stamped with this span's identity.
                 _obs_record(name, dt, items, attrs)
+            if tree_span is not None:
+                end = _tree_end
+                if end is not None:  # may be unhooked mid-span in tests
+                    end(tree_span)
 
     def add_items(self, name: str, n: int):
         """Attribute ``n`` processed items to ``name`` (throughput)."""

@@ -51,6 +51,7 @@ def _reset_telemetry():
     yield
     from heatmap_tpu import faults, obs
     from heatmap_tpu.delta import recover
+    from heatmap_tpu.obs import slo, tracing
     from heatmap_tpu.utils import trace
 
     trace.get_tracer().reset()
@@ -61,5 +62,7 @@ def _reset_telemetry():
     if log is not None:
         log.close()
         obs.set_event_log(None)
+    tracing.disable_tracing()  # unhooks trace/events integrations too
+    slo.set_engine(None)
     faults.install(None)  # disarm any chaos a test left installed
     recover.clear_verified_cache()

@@ -58,6 +58,10 @@ _PAYLOADS = {
     "quarantine": {"root": "store/", "path": "journal/ckpt-3.npz",
                    "reason": "digest_mismatch", "kind": "journal_entry",
                    "detail": "recorded sha256:aa..., actual sha256:bb..."},
+    "slo_breach": {"slo": "tiles-fast", "burn_rate": 2.5,
+                   "kind": "latency", "compliance": 0.9975,
+                   "target": 0.999, "window_s": 300.0,
+                   "detail": "threshold_ms=50"},
     "run_end": {"status": "ok", "blobs": 42, "checksum": "crc32:00000000",
                 "seconds": 1.0},
 }
@@ -301,6 +305,20 @@ class TestRunTelemetry:
         # -- acceptance: blobs byte-identical with telemetry on vs off
         assert out_on.read_bytes() == out_off.read_bytes()
 
+        # -- and with span tracing + an SLO engine on top (the span
+        # tree must be purely observational too)
+        out_traced = tmp_path / "traced.jsonl"
+        trace_out = tmp_path / "trace.json"
+        assert cmd_run(_run_args(
+            ["--output", f"jsonl:{out_traced}",
+             "--trace-out", str(trace_out),
+             "--slo", "stage-budget:error_rate:target=0.9"])) == 0
+        capsys.readouterr()
+        assert out_traced.read_bytes() == out_off.read_bytes()
+        traced = json.loads(trace_out.read_text())
+        assert any(e.get("name") == "run"
+                   for e in traced["traceEvents"])
+
         # -- event log: ordering + coverage
         records = obs.read_events(str(events))
         for rec in records:
@@ -507,6 +525,41 @@ class TestNoRawInstrumentation:
             + ", ".join(offenders))
         # The pattern does bite on what the guard forbids.
         assert self.SLEEP_PATTERN.search("time.sleep(backoff_s * attempt)")
+
+    TRACING_MODULES = ("heatmap_tpu/obs/tracing.py",
+                       "heatmap_tpu/obs/slo.py")
+    TRACING_PATTERN = re.compile(
+        r"(?:(?<![\w.])print\(|time\.perf_counter\(|(?<![\w.])time\.sleep\()")
+
+    def test_tracing_and_slo_have_no_unsanctioned_clocks(self):
+        """obs/tracing.py and obs/slo.py sit inside the blanket
+        ``heatmap_tpu/obs/`` allowance above, so they get their own
+        tighter guard: no raw print()/perf_counter()/time.sleep()
+        except on lines explicitly marked ``# sanctioned:`` (tracing's
+        single ``_now_s`` clock site). The SLO engine must run entirely
+        on event timestamps — it never owns a clock or sleeps."""
+        offenders, sanctioned = [], []
+        for rel in self.TRACING_MODULES:
+            full = os.path.join(REPO, rel)
+            assert os.path.isfile(full), f"{rel} missing"
+            with open(full) as f:
+                for lineno, line in enumerate(f, 1):
+                    if not self.TRACING_PATTERN.search(line):
+                        continue
+                    if "# sanctioned:" in line:
+                        sanctioned.append(f"{rel}:{lineno}")
+                    else:
+                        offenders.append(f"{rel}:{lineno}")
+        assert not offenders, (
+            "unsanctioned print()/perf_counter()/sleep() in the "
+            "tracing/SLO modules — all timing goes through _now_s "
+            "(mark deliberate sites '# sanctioned: <why>'): "
+            + ", ".join(offenders))
+        # Exactly one sanctioned clock: tracing._now_s. Growing this
+        # list is a deliberate act that must touch this test.
+        assert sanctioned == ["heatmap_tpu/obs/tracing.py:59"] or (
+            len(sanctioned) == 1
+            and sanctioned[0].startswith("heatmap_tpu/obs/tracing.py:"))
 
     def test_delta_tree_is_guarded(self):
         """The delta/ package times applies and compactions — that must

@@ -392,6 +392,16 @@ class TestHTTP:
                 assert line.startswith(("# HELP", "# TYPE"))
             else:
                 assert line_re.match(line), line
+        # Process-identity gauges are part of the default scrape:
+        # uptime ticks forward and build_info carries the version label.
+        text = body.decode()
+        [uptime_line] = [l for l in text.splitlines()
+                         if l.startswith("process_uptime_seconds ")]
+        assert float(uptime_line.split()[-1]) > 0
+        from heatmap_tpu import __version__
+
+        assert (f'heatmap_build_info{{version="{__version__}"}} 1'
+                in text)
 
     def test_healthz_and_reload(self, served):
         app, base = served

@@ -1,0 +1,212 @@
+"""SLO engine tests: spec grammar, burn-rate math, breach edges,
+freshness, the log-less observer path, and run-report folding."""
+
+from __future__ import annotations
+
+import pytest
+
+from heatmap_tpu import obs
+from heatmap_tpu.obs import slo
+
+
+def _http(engine, ts, *, route="tiles", status=200, ms=5.0):
+    engine.observe({"event": "http_request", "ts": ts, "route": route,
+                    "status": status, "ms": ms})
+
+
+class TestSpecGrammar:
+    def test_defaults(self):
+        spec = slo.parse_slo_spec("errs:error_rate")
+        assert (spec.name, spec.kind) == ("errs", "error_rate")
+        assert spec.target == 0.999
+        assert spec.window_s == 300.0
+        assert spec.route is None
+        assert spec.budget == pytest.approx(0.001)
+
+    def test_full_parse_with_route(self):
+        spec = slo.parse_slo_spec(
+            "tiles-fast:latency:threshold_ms=50,target=0.99,"
+            "window_s=60,route=tiles")
+        assert spec.threshold_ms == 50.0
+        assert spec.target == 0.99
+        assert spec.window_s == 60.0
+        assert spec.route == "tiles"
+        assert spec.describe() == {
+            "name": "tiles-fast", "kind": "latency", "target": 0.99,
+            "window_s": 60.0, "threshold_ms": 50.0, "route": "tiles"}
+
+    def test_staleness_parse(self):
+        spec = slo.parse_slo_spec("fresh:staleness:max_age_s=120")
+        assert spec.max_age_s == 120.0
+
+    @pytest.mark.parametrize("bad, match", [
+        ("just-a-name", "want NAME:KIND"),
+        ("x:availability", "unknown SLO kind"),
+        ("x:latency", "threshold_ms"),
+        ("x:staleness", "max_age_s"),
+        ("x:error_rate:color=red", "unknown SLO param"),
+        ("x:error_rate:target", "key=value"),
+        ("x:error_rate:target=1.5", "target"),
+        ("x:error_rate:window_s=0", "window_s"),
+    ])
+    def test_rejects(self, bad, match):
+        with pytest.raises(ValueError, match=match):
+            slo.parse_slo_spec(bad)
+
+
+class TestBurnRate:
+    def test_error_rate_math(self):
+        engine = slo.SLOEngine(
+            [slo.parse_slo_spec("e:error_rate:target=0.9,window_s=300")])
+        now = 1000.0
+        for i in range(8):
+            _http(engine, now - i, status=200)
+        for i in range(2):
+            _http(engine, now - i, status=503)
+        [st] = engine.evaluate(now=now)
+        assert (st["total"], st["good"]) == (10, 8)
+        assert st["compliance"] == pytest.approx(0.8)
+        # budget 0.1, bad fraction 0.2 -> burn 2x
+        assert st["burn_rate"] == pytest.approx(2.0)
+        assert st["breaching"] is True
+
+    def test_latency_threshold(self):
+        engine = slo.SLOEngine([slo.parse_slo_spec(
+            "l:latency:threshold_ms=10,target=0.5,window_s=300")])
+        now = 1000.0
+        _http(engine, now, ms=5.0)
+        _http(engine, now, ms=50.0)
+        _http(engine, now, ms=None)  # unmeasured: excluded, not bad
+        [st] = engine.evaluate(now=now)
+        assert (st["total"], st["good"]) == (2, 1)
+        assert st["burn_rate"] == pytest.approx(1.0)
+        assert st["breaching"] is False  # burn must EXCEED 1.0
+
+    def test_no_data_is_compliant(self):
+        engine = slo.SLOEngine([slo.parse_slo_spec("e:error_rate")])
+        [st] = engine.evaluate(now=1000.0)
+        assert st["total"] == 0
+        assert st["compliance"] == 1.0
+        assert st["breaching"] is False
+
+    def test_route_filter(self):
+        engine = slo.SLOEngine([slo.parse_slo_spec(
+            "e:error_rate:target=0.9,route=tiles")])
+        now = 1000.0
+        _http(engine, now, route="tiles", status=200)
+        _http(engine, now, route="healthz", status=500)  # filtered out
+        [st] = engine.evaluate(now=now)
+        assert (st["total"], st["good"]) == (1, 1)
+        assert st["breaching"] is False
+
+    def test_window_eviction(self):
+        engine = slo.SLOEngine([slo.parse_slo_spec(
+            "e:error_rate:target=0.9,window_s=60")])
+        now = 1000.0
+        _http(engine, now - 120, status=503)  # outside the window
+        _http(engine, now - 10, status=200)
+        [st] = engine.evaluate(now=now)
+        assert (st["total"], st["good"]) == (1, 1)
+        assert st["breaching"] is False
+
+
+class TestStaleness:
+    def test_no_freshness_signal_is_ok(self):
+        engine = slo.SLOEngine([slo.parse_slo_spec(
+            "f:staleness:max_age_s=60")])
+        [st] = engine.evaluate(now=1000.0)
+        assert st["breaching"] is False
+        assert st["age_s"] is None
+
+    def test_fresh_ok_then_stale_breaches(self):
+        engine = slo.SLOEngine([slo.parse_slo_spec(
+            "f:staleness:max_age_s=60,target=0.5")])
+        engine.observe({"event": "delta_applied", "ts": 990.0})
+        [st] = engine.evaluate(now=1000.0)
+        assert st["breaching"] is False
+        assert st["age_s"] == pytest.approx(10.0)
+        [st] = engine.evaluate(now=990.0 + 300.0)
+        assert st["breaching"] is True
+        # store_reload also counts as freshness, and only forward
+        engine.observe({"event": "store_reload", "ts": 1280.0})
+        engine.observe({"event": "delta_applied", "ts": 100.0})  # older
+        [st] = engine.evaluate(now=1290.0)
+        assert st["breaching"] is False
+        assert st["age_s"] == pytest.approx(10.0)
+
+
+class TestBreachEdges:
+    def test_breach_event_on_rising_edges_only(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        obs.set_event_log(obs.EventLog(path))
+        engine = slo.SLOEngine([slo.parse_slo_spec(
+            "e:error_rate:target=0.9,window_s=60")])
+        slo.set_engine(engine)
+        now = 1000.0
+        _http(engine, now, status=503)
+        engine.evaluate(now=now)          # rising edge -> one event
+        engine.evaluate(now=now)          # still breaching -> no event
+        engine.evaluate(now=now + 120.0)  # window empty -> cleared
+        _http(engine, now + 130.0, status=503)
+        engine.evaluate(now=now + 130.0)  # second rising edge
+        obs.get_event_log().close()
+        obs.set_event_log(None)
+        breaches = [r for r in obs.read_events(path)
+                    if r["event"] == "slo_breach"]
+        assert len(breaches) == 2
+        assert all(r["slo"] == "e" for r in breaches)
+        assert breaches[0]["burn_rate"] == pytest.approx(10.0)
+
+
+class TestObserverWiring:
+    def test_emit_feeds_engine_without_event_log(self):
+        """`serve --slo` without `--events`: emit returns None (nothing
+        persisted) but the observer still sees every record."""
+        engine = obs.install_specs(["e:error_rate:target=0.9"])
+        assert obs.get_event_log() is None
+        assert obs.emit("http_request", route="tiles", status=503,
+                        ms=1.0) is None
+        [st] = engine.evaluate()
+        assert (st["total"], st["good"]) == (1, 0)
+        assert st["breaching"] is True
+
+    def test_install_specs_empty_clears_engine(self):
+        obs.install_specs(["e:error_rate"])
+        assert slo.get_engine() is not None
+        assert obs.install_specs([]) is None
+        assert slo.get_engine() is None
+        assert obs.slo_status() is None
+
+    def test_ingest_log_replays_finished_run(self, tmp_path):
+        path = str(tmp_path / "ev.jsonl")
+        obs.set_event_log(obs.EventLog(path))
+        obs.emit("http_request", route="tiles", status=200, ms=2.0)
+        obs.emit("http_request", route="tiles", status=500, ms=2.0)
+        obs.get_event_log().close()
+        obs.set_event_log(None)
+        engine = slo.SLOEngine([slo.parse_slo_spec(
+            "e:error_rate:target=0.9,window_s=1e9")])
+        assert engine.ingest_log(path) >= 2
+        [st] = engine.evaluate()
+        assert (st["total"], st["good"]) == (2, 1)
+
+
+class TestReportFolding:
+    def test_report_folds_trace_and_slo(self):
+        from heatmap_tpu.obs import tracing
+        from heatmap_tpu.obs.report import (build_run_report,
+                                            format_run_report)
+
+        tracing.enable_tracing()
+        with tracing.span("run"):
+            with tracing.span("ingest"):
+                pass
+        engine = obs.install_specs(["e:error_rate:target=0.9"])
+        _http(engine, 0.0)  # ancient ts: evaluates as no-data -> ok
+        report = build_run_report()
+        assert report["trace"]["n_spans"] == 2
+        assert report["trace"]["roots"][0]["name"] == "run"
+        assert report["slo"]["ok"] is True
+        text = format_run_report(report)
+        assert "traces:" in text
+        assert "slo " in text

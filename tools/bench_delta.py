@@ -123,10 +123,15 @@ def main() -> int:
     jax.config.update("jax_enable_x64", True)
 
     from heatmap_tpu import obs
+    from heatmap_tpu.obs import tracing
     from heatmap_tpu.pipeline import BatchJobConfig
     from heatmap_tpu.utils.trace import get_tracer
 
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_analyze
+
     obs.enable_metrics(True)
+    collector = tracing.enable_tracing()
     config = BatchJobConfig(detail_zoom=args.detail_zoom,
                             min_detail_zoom=args.min_detail_zoom)
     ratios = [int(r) for r in args.ratios.split(",") if r.strip()]
@@ -152,6 +157,9 @@ def main() -> int:
         # schema-compatible with job benches in the bench trajectory.
         "run_report": obs.build_run_report(tracer=get_tracer(),
                                            registry=obs.get_registry()),
+        # Span-tree digest: top self-time spans + the slowest trace's
+        # critical path (tools/trace_analyze.py).
+        "trace": trace_analyze.summarize(collector.to_chrome()),
     }
     with open(args.out, "w") as f:
         json.dump(record, f, indent=2, default=str)

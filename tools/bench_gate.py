@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Bench trend gate: fold BENCH artifacts into series, fail on regression.
+
+Reads every bench artifact the repo's tooling writes —
+
+- ``BENCH_r*.json``   round records (tools/bench.py trajectory): the
+  ``parsed.value`` points/sec headline, keyed per device (a cpu
+  fallback round must never gate against a tpu round);
+- ``BENCH_delta.json``  (tools/bench_delta.py): per-ratio incremental
+  apply seconds (lower is better) and full/incremental speedup;
+- ``BENCH_serve.json``  (tools/load_gen.py): rps (higher) and p99
+  latency ms (lower);
+
+— prints the folded trend table, and exits non-zero when the newest
+value of any series regresses more than ``--threshold`` (default 15%)
+against the best prior round of the same series. Missing artifacts and
+series with no prior point are reported and skipped, never failed: the
+gate only compares what has actually been measured twice.
+
+``BENCH_r*`` rounds carry their history in-repo. The delta/serve
+artifacts are single snapshots, so their history lives in a state file
+(``--state``, default BENCH_trend.json): pass ``--update`` to fold the
+current values in after a green run (CI does compare-only).
+
+    python tools/bench_gate.py [--threshold 0.15] [--update]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def _load(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_gate: skipping unreadable {path}: {e}",
+              file=sys.stderr)
+        return None
+
+
+def round_series(root: str) -> dict:
+    """``{series_key: [(round, value), ...]}`` from BENCH_r*.json.
+    Higher is better; failed rounds (rc != 0 / no parsed value) are
+    skipped."""
+    series: dict = {}
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        doc = _load(path)
+        if m is None or not isinstance(doc, dict):
+            continue
+        parsed = doc.get("parsed")
+        if doc.get("rc") != 0 or not isinstance(parsed, dict):
+            continue
+        value = parsed.get("value")
+        if not isinstance(value, (int, float)):
+            continue
+        device = parsed.get("device", "unknown")
+        key = f"job:points_per_s[{device}]"
+        series.setdefault(key, []).append((int(m.group(1)), float(value)))
+    return series
+
+
+def snapshot_metrics(root: str) -> dict:
+    """``{series_key: (value, higher_is_better)}`` from the snapshot
+    artifacts (delta + serve benches)."""
+    out: dict = {}
+    doc = _load(os.path.join(root, "BENCH_delta.json"))
+    if isinstance(doc, dict):
+        for row in doc.get("results", []):
+            ratio = row.get("ratio")
+            if ratio is None:
+                continue
+            if isinstance(row.get("incremental_apply_s"), (int, float)):
+                out[f"delta:apply_s[{ratio}]"] = (
+                    float(row["incremental_apply_s"]), False)
+            if isinstance(row.get("speedup"), (int, float)):
+                out[f"delta:speedup[{ratio}]"] = (float(row["speedup"]),
+                                                  True)
+    doc = _load(os.path.join(root, "BENCH_serve.json"))
+    if isinstance(doc, dict):
+        if isinstance(doc.get("rps"), (int, float)):
+            out["serve:rps"] = (float(doc["rps"]), True)
+        p99 = (doc.get("latency_ms") or {}).get("p99")
+        if isinstance(p99, (int, float)):
+            out["serve:p99_ms"] = (float(p99), False)
+    return out
+
+
+def regression(best_prior: float, current: float,
+               higher_is_better: bool) -> float:
+    """Fractional regression of ``current`` vs ``best_prior`` (>0 means
+    worse); best_prior must be > 0."""
+    if higher_is_better:
+        return (best_prior - current) / best_prior
+    return (current - best_prior) / best_prior
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="fail when the newest bench round regresses >15%")
+    ap.add_argument("--root", default=".",
+                    help="directory holding the BENCH artifacts")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="max tolerated fractional regression")
+    ap.add_argument("--state", default="BENCH_trend.json",
+                    help="trend history for the snapshot artifacts "
+                    "(relative to --root)")
+    ap.add_argument("--update", action="store_true",
+                    help="fold current snapshot values into --state "
+                    "after a green comparison")
+    args = ap.parse_args()
+
+    failures, compared, skipped = [], 0, 0
+
+    # BENCH_r* rounds: newest round vs the best earlier one per series.
+    for key, points in sorted(round_series(args.root).items()):
+        points.sort()
+        if len(points) < 2:
+            skipped += 1
+            print(f"  {key:32s} r{points[-1][0]:02d}={points[-1][1]:,.0f}"
+                  f"  (no prior round; skipped)")
+            continue
+        cur_round, cur = points[-1]
+        best_round, best = max(points[:-1], key=lambda p: p[1])
+        reg = regression(best, cur, higher_is_better=True)
+        compared += 1
+        verdict = "REGRESSION" if reg > args.threshold else "ok"
+        print(f"  {key:32s} r{cur_round:02d}={cur:,.0f} vs best "
+              f"r{best_round:02d}={best:,.0f}  "
+              f"({-reg:+.1%})  {verdict}")
+        if reg > args.threshold:
+            failures.append(key)
+
+    # Snapshot artifacts vs the recorded trend state.
+    state_path = os.path.join(args.root, args.state)
+    state = _load(state_path) if os.path.exists(state_path) else None
+    history = state.get("series", {}) if isinstance(state, dict) else {}
+    current = snapshot_metrics(args.root)
+    for key, (value, higher) in sorted(current.items()):
+        prior = [v for v in history.get(key, [])
+                 if isinstance(v, (int, float)) and v > 0]
+        if not prior:
+            skipped += 1
+            print(f"  {key:32s} {value:g}  (no prior; skipped)")
+            continue
+        best = max(prior) if higher else min(prior)
+        reg = regression(best, value, higher)
+        compared += 1
+        verdict = "REGRESSION" if reg > args.threshold else "ok"
+        print(f"  {key:32s} {value:g} vs best {best:g}  "
+              f"({-reg:+.1%})  {verdict}")
+        if reg > args.threshold:
+            failures.append(key)
+
+    if failures:
+        print(f"bench_gate: FAIL — {len(failures)} series regressed "
+              f"past {args.threshold:.0%}: {', '.join(failures)}")
+        return 1
+    if args.update and current:
+        for key, (value, _higher) in current.items():
+            history.setdefault(key, []).append(value)
+        tmp = state_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"series": history}, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, state_path)
+        print(f"bench_gate: folded {len(current)} series into "
+              f"{state_path}")
+    print(f"bench_gate: ok ({compared} compared, {skipped} skipped)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

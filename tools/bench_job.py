@@ -76,8 +76,12 @@ def run_single(args) -> int:
     from heatmap_tpu import obs
     from heatmap_tpu.io.hmpb import HMPBSource
     from heatmap_tpu.io.sinks import LevelArraysSink, MemorySink
+    from heatmap_tpu.obs import tracing
     from heatmap_tpu.pipeline import BatchJobConfig, run_job_fast
     from heatmap_tpu.utils.trace import enable_stage_tracing, get_tracer
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_analyze
 
     if args.trace_stages:
         enable_stage_tracing(True)
@@ -90,6 +94,9 @@ def run_single(args) -> int:
               else BatchJobConfig(cascade_backend=backend))
     tracer = get_tracer()
     tracer.reset()
+    # Span-tree capture rides along (hooks only; no I/O in the timed
+    # region) so the record carries critical-path attribution.
+    collector = tracing.enable_tracing()
     if args.egress == "arrays":
         sink = LevelArraysSink(os.path.join(
             os.path.dirname(args.hmpb), f"levels{args.run}-{backend}"))
@@ -120,6 +127,9 @@ def run_single(args) -> int:
         # `cli run --report` writes (obs.report schema).
         "run_report": obs.build_run_report(tracer=tracer,
                                            registry=obs.get_registry()),
+        # Span-tree digest: top self-time spans + the slowest trace's
+        # critical path (tools/trace_analyze.py).
+        "trace": trace_analyze.summarize(collector.to_chrome()),
     }, default=str), flush=True)
     return 0
 

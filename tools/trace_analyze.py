@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""Critical-path analysis over Chrome trace-event JSON.
+
+Reads the artifact ``--trace-out`` writes (obs/tracing.py — also any
+trace-event file whose ``X`` events carry ``trace_id`` / ``span_id`` /
+``parent_id`` in ``args``), rebuilds each trace's span tree, and
+attributes SELF time: a span's duration minus its direct children's,
+clipped at zero (threaded children can overlap their parent). Two
+outputs per file:
+
+- a top-k table of span names ranked by total self time — where the
+  process actually spent its wall clock, with parent "umbrella" spans
+  deflated to their own bookkeeping cost;
+- per trace, the CRITICAL PATH: the root-to-leaf walk that descends
+  into the longest child at every level — the chain of spans an
+  optimization must shorten for the end-to-end time to move.
+
+Single-threaded trees satisfy sum(self) == root wall exactly (modulo
+clock jitter); tests/test_tracing.py pins the 5% envelope. The module
+is import-friendly (``load_events`` / ``analyze`` / ``summarize``) so
+tools/bench_job.py and tools/bench_delta.py embed the same analysis
+into their bench records.
+
+    python tools/trace_analyze.py trace.json [--top 10] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_events(path: str) -> list[dict]:
+    """Span dicts from a trace-event file (``{"traceEvents": [...]}``
+    or a bare event list); non-span events (metadata, no span_id) are
+    skipped."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+    spans = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args") or {}
+        if "span_id" not in args:
+            continue
+        spans.append({
+            "name": e.get("name", "?"),
+            "ts_us": float(e.get("ts", 0.0)),
+            "dur_us": float(e.get("dur", 0.0)),
+            "tid": e.get("tid"),
+            "trace_id": args.get("trace_id"),
+            "span_id": args["span_id"],
+            "parent_id": args.get("parent_id"),
+            "attrs": {k: v for k, v in args.items()
+                      if k not in ("trace_id", "span_id", "parent_id")},
+        })
+    return spans
+
+
+def build_traces(spans: list[dict]) -> dict:
+    """``{trace_id: {"spans": {id: span}, "children": {id: [span]},
+    "roots": [span]}}``. A span whose parent is absent from the file
+    (remote parent, dropped span) is treated as a root."""
+    traces: dict = {}
+    for s in spans:
+        t = traces.setdefault(s["trace_id"], {
+            "spans": {}, "children": defaultdict(list), "roots": []})
+        t["spans"][s["span_id"]] = s
+    for s in spans:
+        t = traces[s["trace_id"]]
+        pid = s["parent_id"]
+        if pid is not None and pid in t["spans"]:
+            t["children"][pid].append(s)
+        else:
+            t["roots"].append(s)
+    for t in traces.values():
+        for kids in t["children"].values():
+            kids.sort(key=lambda s: s["ts_us"])
+        t["roots"].sort(key=lambda s: s["ts_us"])
+    return traces
+
+
+def self_times(trace: dict) -> dict:
+    """{span_id: self_us} — duration minus direct children, >= 0."""
+    out = {}
+    for sid, s in trace["spans"].items():
+        child_us = sum(k["dur_us"] for k in trace["children"].get(sid, ()))
+        out[sid] = max(s["dur_us"] - child_us, 0.0)
+    return out
+
+
+def subtree_self_sum(trace: dict, root: dict, selfs: dict) -> float:
+    total, stack = 0.0, [root]
+    while stack:
+        node = stack.pop()
+        total += selfs[node["span_id"]]
+        stack.extend(trace["children"].get(node["span_id"], ()))
+    return total
+
+
+def critical_path(trace: dict, root: dict) -> list[dict]:
+    """Greedy root-to-leaf walk descending into the longest child."""
+    path, node = [root], root
+    while True:
+        kids = trace["children"].get(node["span_id"])
+        if not kids:
+            return path
+        node = max(kids, key=lambda s: s["dur_us"])
+        path.append(node)
+
+
+def analyze(spans: list[dict], top: int = 10) -> dict:
+    """Full analysis: per-trace critical paths + the global top-k
+    self-time table."""
+    traces = build_traces(spans)
+    self_by_name: dict = defaultdict(float)
+    calls: dict = defaultdict(int)
+    trace_rows = []
+    for tid, trace in traces.items():
+        selfs = self_times(trace)
+        for sid, us in selfs.items():
+            name = trace["spans"][sid]["name"]
+            self_by_name[name] += us
+            calls[name] += 1
+        for root in trace["roots"]:
+            trace_rows.append({
+                "trace_id": tid,
+                "root": root["name"],
+                "wall_us": round(root["dur_us"], 1),
+                "n_spans": len(trace["spans"]),
+                "self_sum_us": round(
+                    subtree_self_sum(trace, root, selfs), 1),
+                "critical_path": [
+                    {"name": p["name"],
+                     "dur_us": round(p["dur_us"], 1),
+                     "self_us": round(selfs[p["span_id"]], 1)}
+                    for p in critical_path(trace, root)],
+            })
+    trace_rows.sort(key=lambda r: -r["wall_us"])
+    ranked = sorted(self_by_name, key=lambda n: -self_by_name[n])[:top]
+    return {
+        "n_spans": len(spans),
+        "n_traces": len(traces),
+        "traces": trace_rows,
+        "top_self": [{"name": n,
+                      "self_us": round(self_by_name[n], 1),
+                      "calls": calls[n]} for n in ranked],
+    }
+
+
+def summarize(chrome_doc: dict, top: int = 6) -> dict:
+    """Compact digest of an in-memory ``to_chrome()`` document for
+    embedding in bench records: top self-time names + the slowest
+    trace's critical path."""
+    spans = []
+    for e in chrome_doc.get("traceEvents", []):
+        if e.get("ph") != "X" or "span_id" not in (e.get("args") or {}):
+            continue
+        args = e["args"]
+        spans.append({"name": e.get("name", "?"),
+                      "ts_us": float(e.get("ts", 0.0)),
+                      "dur_us": float(e.get("dur", 0.0)),
+                      "tid": e.get("tid"),
+                      "trace_id": args.get("trace_id"),
+                      "span_id": args["span_id"],
+                      "parent_id": args.get("parent_id"),
+                      "attrs": {}})
+    if not spans:
+        return {"n_spans": 0, "n_traces": 0, "top_self": [],
+                "critical_path": []}
+    full = analyze(spans, top=top)
+    slowest = full["traces"][0] if full["traces"] else None
+    return {
+        "n_spans": full["n_spans"],
+        "n_traces": full["n_traces"],
+        "top_self": full["top_self"],
+        "critical_path": (slowest["critical_path"] if slowest else []),
+    }
+
+
+def format_report(result: dict, max_traces: int = 3) -> str:
+    lines = [f"spans: {result['n_spans']}  traces: {result['n_traces']}",
+             "", "top self time:",
+             f"  {'span':28s} {'calls':>6s} {'self':>10s}"]
+    for row in result["top_self"]:
+        lines.append(f"  {row['name']:28s} {row['calls']:6d} "
+                     f"{row['self_us'] / 1e3:9.2f}ms")
+    for t in result["traces"][:max_traces]:
+        lines.append("")
+        lines.append(f"trace {t['trace_id']}  root={t['root']}  "
+                     f"wall={t['wall_us'] / 1e3:.2f}ms  "
+                     f"spans={t['n_spans']}  "
+                     f"self_sum={t['self_sum_us'] / 1e3:.2f}ms")
+        lines.append("  critical path:")
+        for i, hop in enumerate(t["critical_path"]):
+            lines.append(f"  {'  ' * i}{hop['name']}  "
+                         f"dur={hop['dur_us'] / 1e3:.2f}ms  "
+                         f"self={hop['self_us'] / 1e3:.2f}ms")
+    extra = len(result["traces"]) - max_traces
+    if extra > 0:
+        lines.append(f"... {extra} more trace(s); --json for all")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="critical-path analysis over --trace-out JSON")
+    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows in the self-time table")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full analysis as JSON")
+    ap.add_argument("--max-traces", type=int, default=3,
+                    help="traces printed in table mode")
+    args = ap.parse_args()
+    spans = load_events(args.trace)
+    if not spans:
+        print(f"no spans in {args.trace}", file=sys.stderr)
+        return 1
+    result = analyze(spans, top=args.top)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        print(format_report(result, max_traces=args.max_traces))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
