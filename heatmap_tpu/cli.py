@@ -1284,6 +1284,226 @@ def cmd_update(args) -> int:
     return 0
 
 
+def _add_ingest_flags(p):
+    p.add_argument("--journal", required=True, metavar="ROOT",
+                   help="delta store root the loop journals into "
+                   "(created on first use; serve mounts it as "
+                   "delta:ROOT — docs/ingest.md)")
+    p.add_argument("--input", required=True,
+                   help="source spec consumed as micro-batches "
+                   "(each one journaled as its own signed epoch)")
+    p.add_argument("--retract", action="store_true",
+                   help="retract every batch instead of inserting "
+                   "(sign=-1 epochs: counts are subtracted)")
+    p.add_argument("--micro-batch", type=int, default=1 << 14,
+                   help="points per tick (the journal/apply/publish "
+                   "granularity)")
+    p.add_argument("--queue-depth", type=int, default=4,
+                   help="bounded-queue depth between the source reader "
+                   "and the apply loop; a full queue blocks the "
+                   "reader (back-pressure). 0 = synchronous, no "
+                   "reader thread")
+    p.add_argument("--max-ticks", type=int, default=None,
+                   help="stop after N ticks (default: drain the source)")
+    p.add_argument("--compact-every", type=int, default=16, metavar="N",
+                   help="fold the delta stack into a new base whenever "
+                   "N live deltas accumulate (0 = never)")
+    p.add_argument("--compact-max-age", type=float, default=0.0,
+                   metavar="S",
+                   help="also compact when the oldest live delta is "
+                   "older than S seconds (0 = never)")
+    p.add_argument("--retention", type=int, default=2,
+                   help="journal entries kept after compaction as the "
+                   "idempotency window")
+    p.add_argument("--pad-bucketing", default="pow2",
+                   choices=("pow2", "geometric", "exact"),
+                   help="bucketed-padding compile cache for the "
+                   "cascade (pipeline/bucketing.py): pow2/geometric "
+                   "reuse one compilation per size bucket; exact "
+                   "compiles per distinct batch size")
+    p.add_argument("--pad-bucket-min", type=int, default=1 << 12,
+                   help="bucket floor: batches below this many "
+                   "emissions share one compilation")
+    p.add_argument("--serve-port", type=int, default=None, metavar="PORT",
+                   help="serve the store over HTTP from this process "
+                   "while ingesting (0 = ephemeral port; bound "
+                   "address printed to stderr); each tick publishes "
+                   "via targeted invalidation")
+    p.add_argument("--detail-zoom", type=int, default=21)
+    p.add_argument("--min-detail-zoom", type=int, default=5)
+    p.add_argument("--result-delta", type=int, default=5)
+    p.add_argument("--timespans", default="alltime")
+    p.add_argument("--weighted", action="store_true",
+                   help="sum the source's per-point 'value' column "
+                   "instead of counting points")
+    p.add_argument("--cascade-backend", default="auto",
+                   choices=("auto", "scatter", "partitioned"))
+    p.add_argument("--data-parallel", choices=("auto", "on", "off"),
+                   default="auto")
+    p.add_argument("--metrics-dir", default=None, metavar="DIR",
+                   help="enable the metrics registry and write "
+                   "DIR/metrics.prom at command end")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="append structured events to PATH (ingest_tick, "
+                   "delta_applied, compaction_start/end — "
+                   "docs/observability.md)")
+    p.add_argument("--report", nargs="?", const="run_report.json",
+                   default=None, metavar="PATH",
+                   help="fold tracer + metrics + events into a run "
+                   "report at PATH and print the span table to stderr")
+    _add_trace_flags(p)
+
+
+def cmd_ingest(args) -> int:
+    """Continuous ingest: drain a source through the bounded-queue
+    loop (heatmap_tpu.ingest) — every micro-batch journals as a signed
+    epoch, applies through the bucketed cascade, and (with
+    --serve-port) publishes to an in-process tile server via targeted
+    invalidation. A ``staleness`` SLO over tick recency rides the
+    shared --slo flag, e.g. ``--slo fresh:staleness:max_age_s=30``."""
+    from heatmap_tpu.pipeline.timespan import VALID_TYPES
+
+    requested = tuple(t.strip() for t in args.timespans.split(",")
+                      if t.strip())
+    bad = [t for t in requested if t not in VALID_TYPES]
+    if bad:
+        raise SystemExit(
+            f"--timespans: unknown type(s) {bad}; valid: "
+            f"{', '.join(VALID_TYPES)}"
+        )
+    _init_backend(args)
+    from heatmap_tpu import delta as delta_mod
+    from heatmap_tpu import ingest as ingest_mod
+    from heatmap_tpu.io import open_source
+    from heatmap_tpu.pipeline import BatchJobConfig, bucketing
+
+    try:
+        config = BatchJobConfig(
+            detail_zoom=args.detail_zoom,
+            min_detail_zoom=args.min_detail_zoom,
+            result_delta=args.result_delta,
+            timespans=requested,
+            weighted=args.weighted,
+            cascade_backend=args.cascade_backend,
+            data_parallel={"auto": None, "on": True, "off": False}[
+                args.data_parallel],
+            pad_bucketing=args.pad_bucketing,
+            pad_bucket_min=args.pad_bucket_min,
+        )
+        ing = ingest_mod.IngestConfig(
+            micro_batch=args.micro_batch,
+            queue_depth=args.queue_depth or None,
+            sign=-1 if args.retract else 1,
+            compact_every=args.compact_every,
+            compact_max_age_s=args.compact_max_age,
+            retention=args.retention,
+            max_ticks=args.max_ticks,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
+
+    telemetry = bool(args.metrics_dir or args.events
+                     or args.report is not None)
+    ev_log = None
+    if telemetry:
+        from heatmap_tpu import obs
+
+        obs.enable_metrics(True)
+        if args.events:
+            ev_log = obs.EventLog(args.events)
+            obs.set_event_log(ev_log)
+            import dataclasses as _dc
+
+            manifest = {k: (list(v) if isinstance(v, tuple) else v)
+                        for k, v in _dc.asdict(config).items()}
+            obs.emit("run_start", config=manifest, backend=args.backend,
+                     devices=obs.device_topology(), argv=sys.argv[1:])
+    from heatmap_tpu.obs import tracing as tracing_mod
+
+    collector = _setup_tracing(args)
+    root_span = tracing_mod.begin_span("ingest")
+    t0 = time.perf_counter()
+    job_error = None
+    server = None
+    summary = {"journal": args.journal}
+    try:
+        delta_mod.init_store(args.journal)
+        store = cache = None
+        if args.serve_port is not None:
+            from heatmap_tpu.serve import (ServeApp, TileCache, TileStore,
+                                           serve_in_thread)
+
+            store = TileStore(f"delta:{args.journal}")
+            cache = TileCache()
+            server, base_url = serve_in_thread(
+                ServeApp(store, cache), port=args.serve_port)
+            summary["serving"] = base_url
+            print(f"serving {base_url}/tiles/... while ingesting",
+                  file=sys.stderr)
+        stats = ingest_mod.run_ingest(
+            args.journal, open_source(args.input, read_value=args.weighted),
+            config, ingest=ing, store=store, cache=cache)
+        summary.update({
+            "ticks": stats.ticks, "points": stats.points,
+            "epochs": len(stats.epochs), "duplicates": stats.duplicates,
+            "watermark": stats.watermark,
+            "max_queue_depth": stats.max_queue_depth,
+            "compactions": stats.compactions,
+            "keys_invalidated": stats.keys_invalidated,
+            "live_deltas": len(delta_mod.live_entries(args.journal)),
+            "compile_cache": bucketing.cache_stats(),
+        })
+    except ValueError as e:
+        if not telemetry:
+            tracing_mod.end_span(root_span)
+            _export_trace(args, collector)
+            raise SystemExit(str(e)) from e
+        job_error = e
+    except BaseException as e:  # noqa: BLE001 — run_end must record it
+        if not telemetry:
+            tracing_mod.end_span(root_span)
+            _export_trace(args, collector)
+            raise
+        job_error = e
+    finally:
+        if server is not None:
+            server.shutdown()
+    dt = time.perf_counter() - t0
+    tracing_mod.end_span(root_span)
+    if telemetry:
+        from heatmap_tpu import obs
+        from heatmap_tpu.utils.trace import get_tracer
+
+        if ev_log is not None:
+            end = {"status": "error" if job_error is not None else "ok",
+                   "seconds": round(dt, 3)}
+            if job_error is not None:
+                end["error"] = repr(job_error)
+            else:
+                end["rows"] = int(summary.get("points", 0))
+            obs.emit("run_end", **end)
+            obs.set_event_log(None)
+            ev_log.close()
+        if args.metrics_dir:
+            obs.get_registry().write_prometheus(
+                os.path.join(args.metrics_dir, "metrics.prom"))
+        if args.report is not None:
+            report = obs.build_run_report(
+                tracer=get_tracer(), registry=obs.get_registry(),
+                events_path=args.events)
+            obs.write_run_report(args.report, report)
+            print(obs.format_run_report(report), file=sys.stderr)
+        if job_error is not None:
+            _export_trace(args, collector)
+            if isinstance(job_error, ValueError):
+                raise SystemExit(str(job_error)) from job_error
+            raise job_error
+    _export_trace(args, collector)
+    summary["seconds"] = round(dt, 3)
+    print(json.dumps(summary))
+    return 0
+
+
 def cmd_info(args) -> int:
     # info reports unreachability as structured JSON (below) rather
     # than the fail-fast SystemExit the job commands want; an explicit
@@ -1534,6 +1754,15 @@ def build_parser() -> argparse.ArgumentParser:
     _add_backend_flags(p_update)
     _add_update_flags(p_update)
     p_update.set_defaults(fn=cmd_update)
+
+    p_ingest = sub.add_parser(
+        "ingest",
+        help="continuous ingest: source -> bounded queue -> journaled "
+        "epochs -> servable tiles, with the bucketed compile cache "
+        "(docs/ingest.md)")
+    _add_backend_flags(p_ingest)
+    _add_ingest_flags(p_ingest)
+    p_ingest.set_defaults(fn=cmd_ingest)
 
     p_info = sub.add_parser("info", help="resolved config + devices")
     _add_backend_flags(p_info)

@@ -56,6 +56,8 @@ SITES = (
     "tile.render",
     "http.request",
     "multihost.heartbeat",
+    "ingest.tick",
+    "ingest.publish",
 )
 _SITE_SET = frozenset(SITES)
 

@@ -80,6 +80,16 @@ POLICIES = {
                                 deadline_s=None),
     "multihost.heartbeat": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
                                        deadline_s=None),
+    # Ingest-loop boundaries. A tick is idempotent end to end (the
+    # journal's content hash turns a replay into a no-op), so retrying
+    # the whole tick is safe; publish is pure cache invalidation +
+    # artifact re-read, also safe to repeat. Short caps: a standing
+    # loop must shed a poisoned tick quickly rather than stall the
+    # queue behind a long backoff.
+    "ingest.tick": RetryPolicy(retries=2, base_s=0.02, cap_s=0.5,
+                               deadline_s=10.0),
+    "ingest.publish": RetryPolicy(retries=3, base_s=0.02, cap_s=0.5,
+                                  deadline_s=10.0),
 }
 
 

@@ -1,0 +1,23 @@
+"""Continuous-ingest subsystem: source -> journal -> cascade -> tiles.
+
+``loop.py`` owns the bounded-queue pump and the tick loop
+(``run_ingest`` is the entry; ``run_ticks`` is the shared pump the
+legacy ``streaming.run_stream`` driver also delegates to);
+``metrics.py`` the watermark/lag/queue handles on the obs registry.
+Architecture, cost model, and the soak runbook live in docs/ingest.md.
+"""
+
+from heatmap_tpu.ingest.loop import (IngestConfig, IngestStats,
+                                     TickContext, run_ingest, run_ticks)
+from heatmap_tpu.ingest.metrics import (INGEST_LAG_SECONDS, INGEST_POINTS,
+                                        INGEST_QUEUE_DEPTH, INGEST_TICKS,
+                                        INGEST_TICK_SECONDS,
+                                        INGEST_WATERMARK,
+                                        record_stream_tick)
+
+__all__ = [
+    "INGEST_LAG_SECONDS", "INGEST_POINTS", "INGEST_QUEUE_DEPTH",
+    "INGEST_TICKS", "INGEST_TICK_SECONDS", "INGEST_WATERMARK",
+    "IngestConfig", "IngestStats", "TickContext", "record_stream_tick",
+    "run_ingest", "run_ticks",
+]

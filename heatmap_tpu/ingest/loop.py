@@ -1,0 +1,295 @@
+"""Continuous ingest: source -> bounded queue -> journaled apply -> publish.
+
+One loop replaces the streaming-ticks-vs-delta-applies split (ROADMAP
+"unify streaming.py with the delta engine"): a producer thread pulls
+micro-batches from any ``io/sources.py`` source into a bounded queue
+(a full queue blocks the producer — back-pressure, so an unbounded
+source can never outrun the apply path), and the consumer runs one
+**tick** per micro-batch:
+
+1. journal + apply through the ordinary cascade (``delta.apply_batch``
+   — exactly-once by content hash, so a retried or replayed tick is an
+   idempotent no-op),
+2. publish to a live serve store via ``delta.refresh_serving``
+   (targeted invalidation, no generation bump),
+3. compact the delta stack when the size/age policy says so.
+
+The whole point of a standing loop is small batches, and small batches
+are compile-bound (ROADMAP; BENCH_delta.json) — so ``run_ingest``
+defaults the job config to the bucketed-padding compile cache
+(``pipeline/bucketing.py``): arbitrary micro-batch sizes reuse one
+cascade compilation per bucket.
+
+The loop is a first-class citizen of the existing planes:
+
+- obs: event-time watermark + ingest-to-servable lag on the registry
+  (``ingest/metrics.py``), one ``ingest_tick`` event per tick, and the
+  ``staleness`` SLO kind tracks tick recency (obs/slo.py).
+- tracing: every tick is a span (root-on-demand under a CLI root).
+- faults: ticks and publishes run under the ``ingest.tick`` /
+  ``ingest.publish`` sites with their retry policies; both operations
+  are idempotent end to end, which is what makes retrying the whole
+  tick safe. Crash mid-tick heals byte-identical through
+  ``delta/recover.py`` on the next apply's startup sweep.
+
+Timestamps: event time comes from the batches' ``timestamp`` column
+(the watermark); loop durations use ``time.monotonic()``. Wall-clock
+sleeps, prints, and perf_counter are banned here by the obs grep
+guards — blocking happens only inside queue waits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue as queue_mod
+import threading
+import time
+
+from heatmap_tpu import faults, obs
+from heatmap_tpu.obs import tracing
+
+_DONE = object()  # producer -> consumer end-of-stream sentinel
+_POLL_S = 0.05    # producer put/abort poll interval (bounded wait, not a sleep)
+
+
+@dataclasses.dataclass(frozen=True)
+class TickContext:
+    """Per-tick metadata ``run_ticks`` hands the tick callback."""
+
+    index: int          #: 0-based tick number
+    enqueued_at: float  #: time.monotonic() when the producer queued it
+    queue_depth: int    #: items still waiting behind this one at dequeue
+
+
+def run_ticks(items, tick, *, queue_depth: int | None = None) -> dict:
+    """Drive ``tick(item, ctx)`` over an iterable, optionally through a
+    bounded producer/consumer queue.
+
+    ``queue_depth=None`` runs synchronously in the calling thread (the
+    legacy ``streaming.run_stream`` cadence). With a depth, a producer
+    thread reads ``items`` into a ``queue.Queue(maxsize=depth)`` while
+    ticks run here: at most ``depth`` micro-batches wait in memory and
+    a slow consumer blocks the producer — the back-pressure bound
+    (pinned in tests/test_ingest.py). Producer exceptions re-raise in
+    the caller after in-flight ticks finish; a tick exception unblocks
+    and stops the producer before propagating.
+
+    Returns ``{"ticks": n, "max_queue_depth": m}`` where ``m`` is the
+    largest resident backlog observed at any dequeue.
+    """
+    stats = {"ticks": 0, "max_queue_depth": 0}
+    if queue_depth is None:
+        for i, item in enumerate(items):
+            tick(item, TickContext(i, time.monotonic(), 0))
+            stats["ticks"] += 1
+        return stats
+    if queue_depth < 1:
+        raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=queue_depth)
+    abort = threading.Event()
+    producer_error: list = []
+
+    def _produce():
+        try:
+            payloads = ((item, time.monotonic()) for item in items)
+            for payload in itertools.chain(payloads, (_DONE,)):
+                while not abort.is_set():
+                    try:
+                        q.put(payload, timeout=_POLL_S)
+                        break
+                    except queue_mod.Full:
+                        continue
+                if abort.is_set():
+                    return
+        except BaseException as e:  # re-raised in the consumer
+            producer_error.append(e)
+            abort.set()
+
+    producer = threading.Thread(
+        target=_produce, name="ingest-producer", daemon=True)
+    producer.start()
+    try:
+        index = 0
+        while True:
+            try:
+                got = q.get(timeout=_POLL_S)
+            except queue_mod.Empty:
+                if abort.is_set():
+                    break
+                continue
+            if got is _DONE:
+                break
+            item, enqueued_at = got
+            backlog = q.qsize()
+            stats["max_queue_depth"] = max(
+                stats["max_queue_depth"], backlog + 1)
+            tick(item, TickContext(index, enqueued_at, backlog))
+            stats["ticks"] += 1
+            index += 1
+    finally:
+        abort.set()
+        producer.join(timeout=5.0)
+    if producer_error:
+        raise producer_error[0]
+    return stats
+
+
+@dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Loop parameters (the job/pyramid config stays a BatchJobConfig)."""
+
+    #: Points per micro-batch (the tick granularity).
+    micro_batch: int = 1 << 14
+    #: Bounded-queue depth (back-pressure bound). None = synchronous:
+    #: no producer thread, read-next-batch happens between ticks.
+    queue_depth: int | None = 4
+    #: +1 inserts, -1 retracts every batch (journal-signed).
+    sign: int = 1
+    #: Compact when this many live (unfolded) deltas accumulate.
+    #: 0 disables size-triggered compaction.
+    compact_every: int = 16
+    #: Compact when the oldest live delta is older than this many
+    #: seconds (monotonic, measured from its apply). 0 disables.
+    compact_max_age_s: float = 0.0
+    #: Journal entries kept behind the fold (delta.compact retention).
+    retention: int = 2
+    #: Stop after this many ticks (None = drain the source).
+    max_ticks: int | None = None
+
+    def __post_init__(self):
+        if self.micro_batch < 1:
+            raise ValueError(
+                f"micro_batch must be >= 1, got {self.micro_batch}")
+        if self.sign not in (1, -1):
+            raise ValueError("sign must be +1 (insert) or -1 (retraction)")
+        if self.compact_every < 0 or self.compact_max_age_s < 0:
+            raise ValueError("compaction thresholds must be >= 0")
+
+
+@dataclasses.dataclass
+class IngestStats:
+    """Outcome of one ``run_ingest`` drain."""
+
+    ticks: int = 0
+    points: int = 0
+    duplicates: int = 0
+    epochs: list = dataclasses.field(default_factory=list)
+    watermark: float | None = None
+    max_queue_depth: int = 0
+    compactions: int = 0
+    keys_invalidated: int = 0
+    seconds: float = 0.0
+
+
+def _event_watermark(cols) -> float | None:
+    """Max event-time timestamp of a column batch (None when absent)."""
+    stamps = cols.get("timestamp")
+    if stamps is None or not len(stamps):
+        return None
+    try:
+        return max(float(t) for t in stamps if t is not None)
+    except (TypeError, ValueError):
+        return None
+
+
+def run_ingest(root: str, source, config=None, *,
+               ingest: IngestConfig | None = None,
+               store=None, cache=None) -> IngestStats:
+    """Drain ``source`` through the continuous-ingest loop into the
+    delta store at ``root``, publishing to ``store``/``cache`` (a live
+    ``serve.TileStore`` mounted on this root's ``delta:`` spec) when
+    given.
+
+    ``config=None`` defaults to ``BatchJobConfig(pad_bucketing="pow2")``
+    — the loop exists for small batches and small batches are
+    compile-bound, so the bucketed compile cache is on unless the
+    caller explicitly opts out. Safe to restart after any crash: the
+    journal's content hashes make every tick exactly-once, and the
+    recovery sweep inside ``apply_batch`` quarantines torn state first.
+    """
+    from heatmap_tpu import delta as delta_mod
+    from heatmap_tpu.ingest import metrics as ingest_metrics
+    from heatmap_tpu.pipeline import BatchJobConfig
+
+    ing = ingest or IngestConfig()
+    if config is None:
+        config = BatchJobConfig(pad_bucketing="pow2")
+    stats = IngestStats()
+    t_loop = time.monotonic()
+    # Monotonic clock of the oldest live delta, for the age trigger.
+    oldest_live: list = []
+    metrics_on = obs.metrics_enabled()
+
+    def _tick(cols, ctx: TickContext):
+        t0 = time.monotonic()
+        with tracing.span("ingest.tick", tick=ctx.index):
+            def _apply():
+                return delta_mod.apply_batch(
+                    root, delta_mod.ColumnsSource(cols), config,
+                    sign=ing.sign)
+
+            result = faults.retry_call(
+                _apply, site="ingest.tick", key=ctx.index)
+            invalidated = 0
+            if store is not None and not result.duplicate:
+                invalidated = faults.retry_call(
+                    delta_mod.refresh_serving, result, store, cache,
+                    site="ingest.publish", key=ctx.index)
+            compacted = False
+            if not result.duplicate:
+                if not oldest_live:
+                    oldest_live.append(t0)
+                live = (ing.compact_every or ing.compact_max_age_s) and \
+                    len(delta_mod.live_entries(root))
+                due_size = ing.compact_every and live >= ing.compact_every
+                due_age = (ing.compact_max_age_s and live and
+                           time.monotonic() - oldest_live[0]
+                           >= ing.compact_max_age_s)
+                if due_size or due_age:
+                    delta_mod.compact(root, retention=ing.retention)
+                    oldest_live.clear()
+                    compacted = True
+                    stats.compactions += 1
+                    if store is not None:
+                        # Compaction is byte-neutral (base ⊕ deltas
+                        # pinned identical), so re-point the overlay
+                        # without dropping any cache entries.
+                        store.refresh_layers()
+        seconds = time.monotonic() - t0
+        lag = max(0.0, time.monotonic() - ctx.enqueued_at)
+        wm = _event_watermark(cols)
+        if wm is not None and (stats.watermark is None
+                               or wm > stats.watermark):
+            stats.watermark = wm  # monotonic under out-of-order batches
+        stats.ticks += 1
+        stats.points += result.points if not result.duplicate else 0
+        stats.keys_invalidated += invalidated
+        if result.duplicate:
+            stats.duplicates += 1
+        else:
+            stats.epochs.append(result.epoch)
+        if metrics_on:
+            ingest_metrics.INGEST_TICKS.inc(
+                status="duplicate" if result.duplicate else "applied")
+            if not result.duplicate:
+                ingest_metrics.INGEST_POINTS.inc(result.points)
+            if stats.watermark is not None:
+                ingest_metrics.INGEST_WATERMARK.set(stats.watermark)
+            ingest_metrics.INGEST_QUEUE_DEPTH.set(ctx.queue_depth)
+            ingest_metrics.INGEST_LAG_SECONDS.observe(lag)
+            ingest_metrics.INGEST_TICK_SECONDS.observe(seconds)
+        obs.emit("ingest_tick", tick=ctx.index, points=result.points,
+                 seconds=round(seconds, 6), epoch=result.epoch,
+                 duplicate=result.duplicate, watermark=stats.watermark,
+                 lag_s=round(lag, 6), queue_depth=ctx.queue_depth,
+                 keys_invalidated=invalidated, compacted=compacted)
+
+    batches = source.batches(ing.micro_batch)
+    if ing.max_ticks is not None:
+        batches = itertools.islice(batches, ing.max_ticks)
+    with tracing.span("ingest.loop"):
+        pump = run_ticks(batches, _tick, queue_depth=ing.queue_depth)
+    stats.max_queue_depth = pump["max_queue_depth"]
+    stats.seconds = time.monotonic() - t_loop
+    return stats

@@ -73,6 +73,14 @@ EVENT_SCHEMA = {
                       "optional": ("content_hash", "artifact", "rows",
                                    "duplicate", "watermark",
                                    "keys_invalidated")},
+    # ingest/: one continuous-ingest tick — one micro-batch journaled,
+    # applied, and published (delta_applied covers the apply inside;
+    # this record adds the loop's view: event-time watermark, queue
+    # depth at dequeue, and end-to-end ingest->servable lag).
+    "ingest_tick": {"required": ("tick", "points", "seconds"),
+                    "optional": ("epoch", "duplicate", "watermark",
+                                 "lag_s", "queue_depth", "keys_invalidated",
+                                 "compacted", "trace_id", "span_id")},
     # delta/compact.py: fold the live delta stack into a new base.
     "compaction_start": {"required": ("root", "deltas"),
                          "optional": ("base",)},
@@ -192,7 +200,8 @@ _observer = None
 # (explicit trace_id in fields always wins, e.g. serve passes the
 # request root's ids after the span has closed).
 _TRACE_STAMPED = frozenset(
-    {"stage_end", "http_request", "fault_injected", "cascade_dispatch"})
+    {"stage_end", "http_request", "fault_injected", "cascade_dispatch",
+     "ingest_tick"})
 
 
 def set_event_log(log: EventLog | None):

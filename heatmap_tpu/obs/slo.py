@@ -41,7 +41,12 @@ DEFAULT_TARGET = 0.999
 DEFAULT_WINDOW_S = 300.0
 KINDS = ("latency", "error_rate", "staleness")
 _MAX_BUFFER = 10_000
-_FRESHNESS_EVENTS = ("delta_applied", "store_reload")
+# Events that mark served data as "fresh" for staleness objectives.
+# ingest_tick uses the record's wall-clock ts (not the event-time
+# watermark it carries — synthetic/replayed streams stamp epoch-scale
+# timestamps): a staleness SLO over an ingest loop breaches when no
+# tick has completed within max_age_s.
+_FRESHNESS_EVENTS = ("delta_applied", "store_reload", "ingest_tick")
 
 
 class SLOSpec:

@@ -20,6 +20,7 @@ import jax
 import numpy as np
 
 from heatmap_tpu import obs
+from heatmap_tpu.pipeline import bucketing as bucketing_mod
 from heatmap_tpu.pipeline import cascade as cascade_mod
 from heatmap_tpu.tilemath import mercator, morton
 from heatmap_tpu.pipeline.groups import ALL_GROUP, EXCLUDED, UserVocab
@@ -110,8 +111,33 @@ class BatchJobConfig:
     #: only; explicit True/False ignore the threshold, so combining is
     #: rejected at config time.
     dp_min_emissions: int | None = None
+    #: Bucketed-padding compile cache (pipeline/bucketing.py): "exact"
+    #: (default — shapes follow the input, every distinct batch size
+    #: compiles fresh), "pow2" or "geometric" (pad emissions up to a
+    #: power-of-two / 1.25x-geometric bucket with masked pad lanes, so
+    #: arbitrary-size applies and streaming ticks reuse one compilation
+    #: per bucket). Byte-neutral: decode truncates to real unique
+    #: counts, pinned in tests/test_ingest.py. This knob is runtime
+    #: tuning, NOT data semantics — delta/compact.CONFIG_FIELDS
+    #: deliberately excludes it, so stores accept mixed settings.
+    pad_bucketing: str = "exact"
+    #: Bucket floor for pad_bucketing != "exact": batches below this
+    #: many emissions share one compilation (bucketing.bucket_size).
+    pad_bucket_min: int = 1 << 12
 
     def __post_init__(self):
+        from heatmap_tpu.pipeline.bucketing import BUCKETING_MODES
+
+        if self.pad_bucketing not in BUCKETING_MODES:
+            raise ValueError(
+                f"unknown pad_bucketing {self.pad_bucketing!r} (valid: "
+                f"{', '.join(BUCKETING_MODES)}) — rejected at config "
+                "time so a typo fails before a multi-hour ingest"
+            )
+        if self.pad_bucket_min < 1:
+            raise ValueError(
+                f"pad_bucket_min must be >= 1, got {self.pad_bucket_min}"
+            )
         if self.dp_merge not in ("replicated", "prefix"):
             raise ValueError(
                 f"unknown dp_merge {self.dp_merge!r} (valid: "
@@ -2016,6 +2042,18 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
     n_slots = len(ts_vocab) * n_groups
 
     ccfg = config.cascade_config()
+    if config.pad_bucketing != "exact":
+        # Pad BEFORE backend/mesh routing so the auto-DP threshold and
+        # shard math see the bucket length: routing then is a pure
+        # function of the bucket, keeping the compile count bounded by
+        # the bucket count rather than by routing crossovers.
+        with tracer.span("cascade.bucket", items=len(e_codes)):
+            target = bucketing_mod.bucket_size(
+                len(e_codes), config.pad_bucketing, config.pad_bucket_min)
+            e_codes, e_slots, e_valid, e_weights = (
+                bucketing_mod.pad_emissions(
+                    e_codes, e_slots, e_valid, e_weights, target))
+            n_slots = bucketing_mod.bucket_slots(n_slots)
     dp_mesh = _dp_mesh_for(_dp_mesh(config), config, len(e_codes))
     backend = _resolve_backend(config, n_emissions=len(e_codes),
                                data_parallel=dp_mesh is not None)
@@ -2024,18 +2062,44 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
 
         from heatmap_tpu.utils.trace import stage_tracing_enabled
 
+        acc_dtype = jnp.float64 if e_weights is not None else None
+        capacity = config.capacity or len(e_codes)
+        jit = not stage_tracing_enabled()
+        if jit and not config.adaptive_capacity:
+            # Mirror the jit cache key (shapes + every static arg of
+            # _build_cascade_jit) so bucket hit/miss counters track
+            # actual compiles without poking jax internals.
+            bucketing_mod.note_dispatch(
+                (
+                    int(e_codes.shape[0]),
+                    str(e_codes.dtype),
+                    str(e_slots.dtype),
+                    e_valid is not None,
+                    None if e_weights is None else str(e_weights.dtype),
+                    ccfg,
+                    n_slots,
+                    capacity,
+                    None if acc_dtype is None else str(acc_dtype),
+                    backend,
+                    None if dp_mesh is None
+                    else tuple(sorted(dp_mesh.shape.items())),
+                    config.dp_merge,
+                    config.weight_bound,
+                ),
+                config.pad_bucketing,
+            )
         levels = cascade_mod.run_cascade(
             e_codes,
             e_slots,
             ccfg,
             n_slots=n_slots,
             valid=e_valid,
-            capacity=config.capacity or len(e_codes),
+            capacity=capacity,
             weights=e_weights,
             # Weighted sums accumulate in f64 (f32 would both round and
             # stop moving near 2^24-scale cell sums; counts use the
             # int32 path, SURVEY.md §8.8).
-            acc_dtype=jnp.float64 if e_weights is not None else None,
+            acc_dtype=acc_dtype,
             adaptive=config.adaptive_capacity,
             backend=backend,
             mesh=dp_mesh,
@@ -2044,7 +2108,7 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             # Stage tracing needs the cascade EAGER: under the fused jit
             # the sort/segment-reduce spans would time tracing, not
             # execution (utils/trace.py stage_span).
-            jit=not stage_tracing_enabled(),
+            jit=jit,
         )
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)

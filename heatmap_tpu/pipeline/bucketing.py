@@ -1,0 +1,202 @@
+"""Bucketed-padding compile cache for the cascade.
+
+The delta engine's apply floor is jit re-compilation: the cascade is
+jitted with static ``(config, n_slots, capacity, ...)`` args and traced
+per input SHAPE, so every distinct emission count — i.e. every distinct
+micro-batch size — compiles a fresh executable (ROADMAP.md;
+BENCH_delta.json shows incremental apply only ~1.3-1.75x over full
+recompute because compile time dominates small batches).
+
+This module rounds the padded shapes UP to a small set of buckets so
+arbitrary batch sizes reuse one compilation per bucket:
+
+- emission arrays are padded to the bucket length with ``valid=False``
+  pad lanes — the masking path every cascade kernel already drops
+  (the exact mechanism ``_build_cascade_sharded`` uses to pad to the
+  shard count);
+- ``n_slots`` is rounded up to a power of two — it only feeds overflow
+  checks and the zoom-clamped capacity bound (``n_slots << 2*(dz-l)``),
+  never slot *names* (those come from the vocabs), so a larger value is
+  byte-neutral and stops per-batch vocab growth from forcing compiles;
+- the derived default capacity keys off the PADDED length, so the
+  per-level capacity tuple (a static jit arg) is a pure function of the
+  bucket, not the batch.
+
+Byte equality with exact padding holds because ``decode_levels``
+truncates every level to its real unique count before any host egress:
+pad lanes are masked out on device and never reach a blob.
+
+Cost model (docs/ingest.md): pow2 buckets waste < 2x emissions worst
+case (amortized ~1.33x) for a compile count bounded by
+``log2(max_batch)``; the 1.25x-geometric ladder tightens waste to
+< 1.25x at ~3.1x the bucket count. Both collapse a continuous-ingest
+loop's compile count from O(distinct batch sizes) to O(log max size).
+
+The module also mirrors the jit cache's hit/miss behaviour: every
+jitted cascade dispatch from ``_run_grouped`` registers its would-be
+compilation signature here, so ``cascade_bucket_hits_total`` /
+``cascade_bucket_misses_total`` (and :func:`cache_stats`) count cache
+hits and compiles without touching jax internals — misses == fresh XLA
+compiles as long as the process-wide jit cache is not evicting (it
+holds thousands of entries; tests assert on exactly this mirror).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+
+from heatmap_tpu.obs import get_registry
+
+#: Valid BatchJobConfig.pad_bucketing values. "exact" = no bucketing
+#: (the historical behaviour: shapes follow the input exactly).
+BUCKETING_MODES = ("exact", "pow2", "geometric")
+
+#: Growth factor of the "geometric" ladder (ROADMAP names 1.25x).
+GEOMETRIC_RATIO = 1.25
+
+#: Floor for every bucket: batches below this pad up to it, so the
+#: whole small-batch tail shares ONE compilation. 4096 emissions is
+#: ~1ms of cascade work on CPU — far below compile cost either way.
+DEFAULT_MIN_BUCKET = 1 << 12
+
+_registry = get_registry()
+
+CASCADE_BUCKET_HITS = _registry.counter(
+    "cascade_bucket_hits_total",
+    "Jitted cascade dispatches that reused a compiled bucket",
+    labelnames=("mode",))
+CASCADE_BUCKET_MISSES = _registry.counter(
+    "cascade_bucket_misses_total",
+    "Jitted cascade dispatches that compiled a new bucket signature",
+    labelnames=("mode",))
+CASCADE_PAD_EMISSIONS = _registry.counter(
+    "cascade_pad_emissions_total",
+    "Masked pad lanes added by bucketed padding (waste accounting)")
+
+# Signature mirror of the process-wide jit cache (jax caches per
+# (shapes, static args) — so do we). Guarded: run_job may be driven
+# from producer/consumer threads.
+_lock = threading.Lock()
+_seen: set = set()
+_stats = {"hits": 0, "misses": 0}
+
+
+def bucket_size(n: int, mode: str,
+                min_bucket: int = DEFAULT_MIN_BUCKET) -> int:
+    """Bucket length for ``n`` emissions under ``mode``.
+
+    exact -> n unchanged; pow2 -> next power of two >= max(n,
+    min_bucket); geometric -> the smallest rung of the
+    ``min_bucket * 1.25^k`` ladder >= n. n == 0 stays 0 (an empty
+    batch compiles its own trivial shape either way).
+    """
+    if mode not in BUCKETING_MODES:
+        raise ValueError(
+            f"unknown pad_bucketing {mode!r} (valid: "
+            f"{', '.join(BUCKETING_MODES)})")
+    if mode == "exact" or n <= 0:
+        return max(int(n), 0)
+    n = int(n)
+    if n <= min_bucket:
+        return int(min_bucket)
+    if mode == "pow2":
+        return 1 << (n - 1).bit_length()
+    # geometric: ceil rung of min_bucket * ratio^k. Computed by log,
+    # then corrected for float edge cases so the rung always covers n
+    # and the rung index is minimal.
+    k = math.ceil(math.log(n / min_bucket) / math.log(GEOMETRIC_RATIO))
+    size = int(math.ceil(min_bucket * GEOMETRIC_RATIO ** k))
+    while size < n:  # float log undershoot
+        k += 1
+        size = int(math.ceil(min_bucket * GEOMETRIC_RATIO ** k))
+    while k > 0:
+        prev = int(math.ceil(min_bucket * GEOMETRIC_RATIO ** (k - 1)))
+        if prev < n:
+            break
+        k, size = k - 1, prev
+    return size
+
+
+def bucket_slots(n_slots: int) -> int:
+    """Round the slot count up to a power of two (>= 2).
+
+    ``n_slots`` reaches the cascade only as a static overflow bound and
+    the zoom-clamped capacity multiplier — never as data — so a larger
+    value cannot change any emitted byte, but a per-batch exact value
+    (every new user grows the vocab) would force a recompile per tick.
+    """
+    n = max(int(n_slots), 2)
+    return 1 << (n - 1).bit_length()
+
+
+def pad_emissions(e_codes, e_slots, e_valid, e_weights, target: int):
+    """Pad emission arrays to ``target`` lanes with ``valid=False``.
+
+    Works on numpy and device (jnp) arrays alike — the x64 ingest path
+    keeps codes device-resident, and a host round-trip here would undo
+    that win. Pad codes/slots are zeros (any in-range value works: the
+    valid mask drops them in every kernel), pad weights 0.0.
+    """
+    n = int(e_codes.shape[0])
+    pad = target - n
+    if pad <= 0:
+        return e_codes, e_slots, e_valid, e_weights
+    if isinstance(e_codes, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp
+    e_codes = xp.concatenate([e_codes, xp.zeros((pad,), e_codes.dtype)])
+    e_slots = xp.concatenate([e_slots, xp.zeros((pad,), e_slots.dtype)])
+    if e_valid is None:
+        e_valid = xp.arange(target) < n
+    else:
+        e_valid = xp.concatenate(
+            [xp.asarray(e_valid, bool), xp.zeros((pad,), bool)])
+    if e_weights is not None:
+        e_weights = xp.concatenate(
+            [e_weights, xp.zeros((pad,), e_weights.dtype)])
+    if _registry.enabled:
+        CASCADE_PAD_EMISSIONS.inc(pad)
+    return e_codes, e_slots, e_valid, e_weights
+
+
+def note_dispatch(signature: tuple, mode: str) -> bool:
+    """Record one jitted cascade dispatch; True if its compilation
+    signature was already seen (a compile-cache hit).
+
+    ``signature`` must contain everything jax keys the compiled
+    executable on: input shapes/dtypes plus every static arg
+    (pipeline.batch builds it next to the run_cascade call so the two
+    cannot drift silently).
+    """
+    with _lock:
+        hit = signature in _seen
+        if hit:
+            _stats["hits"] += 1
+        else:
+            _seen.add(signature)
+            _stats["misses"] += 1
+    if _registry.enabled:
+        (CASCADE_BUCKET_HITS if hit else CASCADE_BUCKET_MISSES).inc(
+            mode=mode)
+    return hit
+
+
+def cache_stats() -> dict:
+    """{"hits": n, "misses": n, "signatures": n} — misses mirror fresh
+    XLA compiles of the jitted cascade (see module docstring)."""
+    with _lock:
+        return {**_stats, "signatures": len(_seen)}
+
+
+def reset_cache_stats():
+    """Forget seen signatures + counters (tests and benches only; the
+    real jit cache is NOT cleared — after a reset the first dispatch of
+    an already-compiled signature counts as a miss again)."""
+    with _lock:
+        _seen.clear()
+        _stats["hits"] = 0
+        _stats["misses"] = 0

@@ -267,11 +267,16 @@ def default_stream_hook(stream: HeatmapStream, t: float):
     metrics sink is enabled (``HeatmapStream.update`` already keeps the
     ingest counters; this adds the decay-tick view the run_stream loop
     owns). Deliberately does NOT snapshot the raster — that is a
-    device->host copy per tick; pass a custom hook for that."""
-    if not obs.metrics_enabled():
-        return
-    obs.STREAM_TICKS.inc()
-    obs.STREAM_TIME.set(float(t))
+    device->host copy per tick; pass a custom hook for that.
+
+    .. deprecated:: The recorder now lives in
+       ``heatmap_tpu.ingest.metrics.record_stream_tick`` (the unified
+       continuous-ingest loop); this wrapper keeps the historical
+       counter names and hook signature for existing callers.
+    """
+    from heatmap_tpu.ingest.metrics import record_stream_tick
+
+    record_stream_tick(t)
 
 
 def run_stream(stream: HeatmapStream, timed_batches, *, on_batch=None):
@@ -280,15 +285,29 @@ def run_stream(stream: HeatmapStream, timed_batches, *, on_batch=None):
     background rows dropped like the batch path, reference
     heatmap.py:28-29). ``on_batch(stream, t)`` fires after each step;
     the default is ``default_stream_hook`` (decay-tick and ingest
-    gauges, free when telemetry is off)."""
+    gauges, free when telemetry is off).
+
+    .. deprecated:: This is a compat shim over
+       ``heatmap_tpu.ingest.run_ticks`` — streaming ticks and journaled
+       delta applies are the same pump at different cadences (ROADMAP
+       "unify streaming.py with the delta engine"). New code that wants
+       journaled, servable ingest should use
+       ``heatmap_tpu.ingest.run_ingest``; this driver keeps the
+       raster-decay workload and its synchronous cadence.
+    """
+    from heatmap_tpu.ingest.loop import run_ticks
     from heatmap_tpu.pipeline import load_columns
 
     if on_batch is None:
         on_batch = default_stream_hook
-    for t, batch in timed_batches:
+
+    def _tick(item, ctx):
+        t, batch = item
         cols = load_columns(batch)
         stream.update(cols["latitude"], cols["longitude"], t)
         on_batch(stream, t)
+
+    run_ticks(timed_batches, _tick)
     return stream
 
 

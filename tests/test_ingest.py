@@ -1,0 +1,412 @@
+"""Continuous-ingest subsystem tests (heatmap_tpu/ingest/ +
+pipeline/bucketing.py).
+
+The loop invariants the subsystem stands on:
+
+- **Byte neutrality of bucketed padding** — pow2/geometric padded runs
+  emit blobs byte-identical to exact padding (pad lanes are masked and
+  decode truncates to real unique counts).
+- **Compile bound** — N ticks of N distinct batch sizes incur at most
+  bucket-count cascade compiles, asserted via the bucketing cache's
+  signature mirror of the jit key.
+- **Back-pressure** — a slow consumer bounds how far the producer can
+  read ahead (queue depth + one in flight).
+- **Watermark monotonicity** — out-of-order micro-batches never move
+  the event-time watermark backwards.
+- **Crash-mid-tick recovery** — a fault storm that kills an apply
+  between artifact write and journal append heals byte-identical
+  through delta/recover.py on the re-run, exactly once per batch.
+
+Tier-1: CPU backend, small shapes, no network.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import delta, faults, ingest, obs
+from heatmap_tpu.delta.compute import ColumnsSource
+from heatmap_tpu.pipeline import BatchJobConfig, bucketing, run_batch
+from heatmap_tpu.serve.store import TileStore
+
+from test_delta import _collect_docs
+
+
+def _rows(n, seed=0, t0=1.5e9, users=4):
+    rng = np.random.default_rng(seed)
+    return [
+        {"latitude": float(la), "longitude": float(lo),
+         "user_id": f"u{i % users}", "timestamp": t0 + i, "source": "gps"}
+        for i, (la, lo) in enumerate(zip(
+            rng.uniform(37.0, 37.2, n), rng.uniform(-122.2, -122.0, n)))
+    ]
+
+
+def _cols(n, seed=0, t0=1.5e9, users=4):
+    rng = np.random.default_rng(seed)
+    return {
+        "latitude": rng.uniform(37.0, 37.2, n),
+        "longitude": rng.uniform(-122.2, -122.0, n),
+        "user_id": [f"u{i % users}" for i in range(n)],
+        "source": ["gps"] * n,
+        "timestamp": [t0 + i for i in range(n)],
+    }
+
+
+class TestBucketSize:
+    def test_exact_is_identity(self):
+        for n in (0, 1, 7, 4096, 100_000):
+            assert bucketing.bucket_size(n, "exact") == n
+
+    def test_min_bucket_floor(self):
+        assert bucketing.bucket_size(1, "pow2") == bucketing.DEFAULT_MIN_BUCKET
+        assert bucketing.bucket_size(10, "pow2", min_bucket=64) == 64
+        assert bucketing.bucket_size(64, "geometric", min_bucket=64) == 64
+
+    def test_pow2_rounds_up(self):
+        assert bucketing.bucket_size(4097, "pow2") == 8192
+        assert bucketing.bucket_size(8192, "pow2") == 8192
+        assert bucketing.bucket_size(8193, "pow2") == 16384
+
+    def test_geometric_ladder_minimal_and_covering(self):
+        """Every rung covers its inputs and is the MINIMAL such rung."""
+        mb = 1 << 12
+        for n in (4097, 5000, 5120, 5121, 9000, 123_457):
+            size = bucketing.bucket_size(n, "geometric", min_bucket=mb)
+            assert size >= n
+            # the next rung down must NOT cover n
+            import math
+            k = round(math.log(size / mb) / math.log(
+                bucketing.GEOMETRIC_RATIO))
+            if k > 0:
+                prev = int(math.ceil(
+                    mb * bucketing.GEOMETRIC_RATIO ** (k - 1)))
+                assert prev < n
+
+    def test_geometric_tighter_than_pow2(self):
+        """The 1.25x ladder wastes less than pow2 on a mid-bucket size."""
+        n = 100_000
+        g = bucketing.bucket_size(n, "geometric")
+        p = bucketing.bucket_size(n, "pow2")
+        assert n <= g <= p
+
+    def test_zero_and_unknown_mode(self):
+        assert bucketing.bucket_size(0, "pow2") == 0
+        with pytest.raises(ValueError, match="unknown pad_bucketing"):
+            bucketing.bucket_size(5, "nope")
+
+    def test_bucket_slots_pow2(self):
+        assert bucketing.bucket_slots(1) == 2
+        assert bucketing.bucket_slots(3) == 4
+        assert bucketing.bucket_slots(64) == 64
+        assert bucketing.bucket_slots(65) == 128
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="unknown pad_bucketing"):
+            BatchJobConfig(pad_bucketing="nope")
+        with pytest.raises(ValueError, match="pad_bucket_min"):
+            BatchJobConfig(pad_bucket_min=0)
+
+
+class TestByteNeutrality:
+    BASE = dict(detail_zoom=10, min_detail_zoom=5, result_delta=3)
+
+    def test_bucketed_blobs_byte_identical(self):
+        rows = _rows(700, seed=1)
+        blobs = {}
+        for mode in bucketing.BUCKETING_MODES:
+            cfg = BatchJobConfig(**self.BASE, pad_bucketing=mode,
+                                 pad_bucket_min=1 << 9)
+            blobs[mode] = run_batch(rows, config=cfg, as_json=False)
+        assert blobs["pow2"] == blobs["exact"]
+        assert blobs["geometric"] == blobs["exact"]
+        assert len(blobs["exact"]) > 4  # non-trivial pyramid
+
+    def test_weighted_path_byte_identical(self):
+        rows = [{**r, "value": float(1 + i % 3)}
+                for i, r in enumerate(_rows(400, seed=2))]
+        out = {}
+        for mode in ("exact", "pow2"):
+            cfg = BatchJobConfig(**self.BASE, weighted=True,
+                                 pad_bucketing=mode, pad_bucket_min=1 << 9)
+            out[mode] = run_batch(rows, config=cfg, as_json=False)
+        assert out["pow2"] == out["exact"]
+
+
+class TestCompileBound:
+    def test_n_distinct_sizes_at_most_bucket_count_compiles(self):
+        """N ticks of N distinct batch sizes reuse compilations: misses
+        (the jit-cache mirror) are bounded by the number of distinct
+        buckets, not the number of distinct sizes."""
+        cfg = BatchJobConfig(detail_zoom=9, min_detail_zoom=5,
+                             result_delta=3, pad_bucketing="pow2",
+                             pad_bucket_min=1 << 8)
+        sizes = [130, 190, 220, 250, 300, 420, 510, 600]
+        buckets = {bucketing.bucket_size(s, "pow2", 1 << 8) for s in sizes}
+        assert len(buckets) < len(sizes)  # the test must exercise reuse
+        bucketing.reset_cache_stats()
+        for i, s in enumerate(sizes):
+            # same 4-user set every tick: the slot count stays stable,
+            # so the only compile pressure is the batch size
+            run_batch(_rows(s, seed=10 + i), config=cfg, as_json=False)
+        stats = bucketing.cache_stats()
+        assert stats["misses"] <= len(buckets)
+        assert stats["hits"] == len(sizes) - stats["misses"]
+
+    def test_exact_mode_compiles_per_size(self):
+        """Control: exact padding's signature count grows with every
+        distinct size — the regression the buckets exist to stop."""
+        cfg = BatchJobConfig(detail_zoom=9, min_detail_zoom=5,
+                             result_delta=3)
+        sizes = (60, 61, 62)
+        bucketing.reset_cache_stats()
+        for i, s in enumerate(sizes):
+            run_batch(_rows(s, seed=20 + i), config=cfg, as_json=False)
+        assert bucketing.cache_stats()["misses"] == len(sizes)
+
+
+class TestRunTicks:
+    def test_synchronous_when_no_depth(self):
+        seen = []
+        stats = ingest.run_ticks(
+            iter("abc"), lambda item, ctx: seen.append((item, ctx.index)))
+        assert seen == [("a", 0), ("b", 1), ("c", 2)]
+        assert stats == {"ticks": 3, "max_queue_depth": 0}
+
+    def test_backpressure_bounds_producer_readahead(self):
+        """A slow consumer blocks the producer: at every tick the
+        source has yielded at most consumed + depth + 1 items (queue
+        resident + the one the producer holds in put)."""
+        depth = 2
+        produced = [0]
+
+        def source():
+            for i in range(12):
+                produced[0] += 1
+                yield i
+
+        violations = []
+
+        def slow_tick(item, ctx):
+            # let the producer run ahead as far as the queue allows
+            deadline = time.monotonic() + 0.3
+            while produced[0] < min(12, item + 1 + depth + 1) \
+                    and time.monotonic() < deadline:
+                threading.Event().wait(0.005)
+            ahead = produced[0] - (item + 1)
+            if ahead > depth + 1:
+                violations.append((item, produced[0]))
+
+        stats = ingest.run_ticks(source(), slow_tick, queue_depth=depth)
+        assert stats["ticks"] == 12
+        assert not violations, f"producer outran back-pressure: {violations}"
+        assert stats["max_queue_depth"] <= depth
+
+    def test_producer_error_propagates(self):
+        def bad_source():
+            yield 1
+            raise OSError("source died")
+
+        done = []
+        with pytest.raises(OSError, match="source died"):
+            ingest.run_ticks(bad_source(),
+                             lambda item, ctx: done.append(item),
+                             queue_depth=2)
+        assert done == [1]
+
+    def test_tick_error_stops_producer(self):
+        produced = [0]
+
+        def source():
+            for i in range(1000):
+                produced[0] += 1
+                yield i
+
+        def boom(item, ctx):
+            raise RuntimeError("tick failed")
+
+        with pytest.raises(RuntimeError, match="tick failed"):
+            ingest.run_ticks(source(), boom, queue_depth=2)
+        assert produced[0] < 1000  # producer did not drain the source
+
+    def test_depth_validation(self):
+        with pytest.raises(ValueError, match="queue_depth"):
+            ingest.run_ticks(iter([]), lambda i, c: None, queue_depth=0)
+
+
+@pytest.fixture()
+def event_capture():
+    """Collect emitted events via the observer hook (no log file)."""
+    from heatmap_tpu.obs import events as events_mod
+
+    records = []
+    events_mod._observer = records.append
+    yield records
+    events_mod._observer = None
+
+
+class TestIngestLoop:
+    CFG = dict(detail_zoom=9, min_detail_zoom=5, result_delta=3)
+
+    def test_watermark_monotonic_under_out_of_order_batches(
+            self, tmp_path, event_capture):
+        """Micro-batches arriving with DECREASING event time never move
+        the watermark backwards: it is the monotonic max."""
+        cols = _cols(300, seed=3, t0=2.0e9)
+        # reverse event time across batches: batch 0 has the NEWEST rows
+        order = np.argsort([-t for t in cols["timestamp"]])
+        cols = {k: [v[i] for i in order] for k, v in cols.items()}
+        cfg = BatchJobConfig(**self.CFG, pad_bucketing="pow2",
+                             pad_bucket_min=1 << 8)
+        stats = ingest.run_ingest(
+            str(tmp_path / "store"), ColumnsSource(cols), cfg,
+            ingest=ingest.IngestConfig(micro_batch=75, queue_depth=2,
+                                       compact_every=0))
+        assert stats.ticks == 4
+        marks = [r["watermark"] for r in event_capture
+                 if r["event"] == "ingest_tick"]
+        assert len(marks) == 4
+        assert marks == sorted(marks)  # non-decreasing
+        assert stats.watermark == max(float(t) for t in cols["timestamp"])
+        # first batch already carried the global max: later (older)
+        # batches must not have lowered it
+        assert marks[0] == marks[-1]
+
+    def test_loop_matches_oneshot_and_is_idempotent(self, tmp_path):
+        """The acceptance anchor: a looped run (bucketed, compacted,
+        published per tick) serves byte-identical docs to a one-shot
+        exact apply — and re-draining the same source is a no-op."""
+        cols = _cols(900, seed=4)
+        cfg = BatchJobConfig(**self.CFG, pad_bucketing="pow2",
+                             pad_bucket_min=1 << 8)
+        root = str(tmp_path / "loop_store")
+        # retention covers every tick: compaction prunes journal
+        # entries (and their dedup hashes) beyond the retention
+        # window, so a full-source replay is only exactly-once while
+        # the hashes survive — docs/ingest.md documents the window.
+        stats = ingest.run_ingest(
+            root, ColumnsSource(cols), cfg,
+            ingest=ingest.IngestConfig(micro_batch=250, queue_depth=2,
+                                       compact_every=2, retention=4))
+        assert stats.ticks == 4 and stats.compactions >= 1
+        one = str(tmp_path / "oneshot_store")
+        delta.apply_batch(one, ColumnsSource(cols),
+                          BatchJobConfig(**self.CFG))
+        docs_loop = _collect_docs(TileStore(f"delta:{root}"))
+        docs_one = _collect_docs(TileStore(f"delta:{one}"))
+        assert docs_loop.keys() == docs_one.keys()
+        assert docs_loop == docs_one
+        # replay: every batch's content hash is already journaled
+        replay = ingest.run_ingest(
+            root, ColumnsSource(cols), cfg,
+            ingest=ingest.IngestConfig(micro_batch=250, queue_depth=2,
+                                       compact_every=0))
+        assert replay.duplicates == replay.ticks
+        assert replay.epochs == []
+        assert _collect_docs(TileStore(f"delta:{root}")) == docs_one
+
+    def test_publish_refreshes_live_store(self, tmp_path):
+        """A store mounted before the loop serves the new mass after
+        ticks without a generation bump (targeted invalidation)."""
+        root = str(tmp_path / "store")
+        cfg = BatchJobConfig(**self.CFG, pad_bucketing="pow2",
+                             pad_bucket_min=1 << 8)
+        delta.init_store(root)
+        store = TileStore(f"delta:{root}")
+        gen0 = store.generation
+        assert _collect_docs(store) == {}
+        ingest.run_ingest(
+            root, ColumnsSource(_cols(300, seed=5)), cfg, store=store,
+            ingest=ingest.IngestConfig(micro_batch=100, queue_depth=None,
+                                       compact_every=0))
+        assert len(_collect_docs(store)) > 0
+        assert store.generation == gen0
+
+    def test_crash_mid_tick_heals_byte_identical(self, tmp_path):
+        """A storm at journal.append past the retry budget kills an
+        apply AFTER its artifact dir is written but BEFORE the journal
+        entry lands — the torn state delta/recover.py exists for. The
+        re-run sweeps the orphan, re-journals the batch under a fresh
+        epoch, and the final store is byte-identical to a clean
+        one-shot, with every batch applied exactly once."""
+        cols = _cols(600, seed=6)
+        cfg = BatchJobConfig(**self.CFG, pad_bucketing="pow2",
+                             pad_bucket_min=1 << 8)
+        root = str(tmp_path / "crash_store")
+        ing = ingest.IngestConfig(micro_batch=200, queue_depth=None,
+                                  compact_every=0)
+        # tick 0 lands cleanly, then a storm kills every later journal
+        # append (99 >> the retry budget: 3 ingest.tick attempts x 4
+        # append attempts). The duplicate path never reaches the
+        # append site, so the replayed tick 0 sails through and the
+        # crash hits tick 1 after its artifact dir is written.
+        ingest.run_ingest(
+            root, ColumnsSource(cols), cfg,
+            ingest=ingest.IngestConfig(micro_batch=200, queue_depth=None,
+                                       compact_every=0, max_ticks=1))
+        faults.install_spec("seed=3,scale=0,journal.append=99")
+        with pytest.raises(faults.InjectedFault):
+            ingest.run_ingest(root, ColumnsSource(cols), cfg, ingest=ing)
+        faults.install(None)
+        assert len(delta.live_entries(root)) == 1  # only tick 0 journaled
+        # the crashed tick's artifact dir is orphaned (journal lost);
+        # restart drains the whole source again — duplicates no-op,
+        # the crashed batch re-journals, the orphan is swept
+        stats = ingest.run_ingest(root, ColumnsSource(cols), cfg,
+                                  ingest=ing)
+        assert stats.ticks == 3
+        assert stats.duplicates == 1
+        live = delta.live_entries(root)
+        assert len(live) == 3  # exactly once per batch
+        hashes = [e["content_hash"] for e in live]
+        assert len(set(hashes)) == 3
+        one = str(tmp_path / "clean_store")
+        delta.apply_batch(one, ColumnsSource(cols),
+                          BatchJobConfig(**self.CFG))
+        assert _collect_docs(TileStore(f"delta:{root}")) == \
+            _collect_docs(TileStore(f"delta:{one}"))
+
+    def test_tick_site_faults_absorbed_by_retry(self, tmp_path):
+        """An ingest.tick storm inside the retry budget is invisible in
+        the result: same ticks and epochs, faults counted by the plane."""
+        cols = _cols(300, seed=7)
+        cfg = BatchJobConfig(**self.CFG, pad_bucketing="pow2",
+                             pad_bucket_min=1 << 8)
+        faults.install_spec("seed=5,scale=0,ingest.tick=2x2")
+        stats = ingest.run_ingest(
+            str(tmp_path / "store"), ColumnsSource(cols), cfg,
+            ingest=ingest.IngestConfig(micro_batch=150, queue_depth=None,
+                                       compact_every=0))
+        injected = faults.get_plane().injected
+        faults.install(None)
+        assert stats.ticks == 2 and stats.duplicates == 0
+        assert len(stats.epochs) == 2
+        assert injected == 2  # both faults fired, both absorbed
+        assert faults.get_plane() is None
+
+    def test_ingest_config_validation(self):
+        with pytest.raises(ValueError, match="micro_batch"):
+            ingest.IngestConfig(micro_batch=0)
+        with pytest.raises(ValueError, match="sign"):
+            ingest.IngestConfig(sign=2)
+
+
+class TestStalenessSLO:
+    def test_ingest_tick_feeds_staleness_freshness(self):
+        from heatmap_tpu.obs import slo
+
+        engine = slo.SLOEngine([slo.SLOSpec(
+            "fresh", "staleness", max_age_s=60.0)])
+        slo.set_engine(engine)
+        try:
+            obs.emit("ingest_tick", tick=0, points=10, seconds=0.01)
+            status = engine.status()
+            (obj,) = status["objectives"]
+            assert obj["name"] == "fresh"
+            assert obj["compliance"] == 1.0
+        finally:
+            slo.set_engine(None)

@@ -46,6 +46,10 @@ _PAYLOADS = {
                       "artifact": "delta-000002", "rows": 120,
                       "duplicate": False, "watermark": 1.7e12,
                       "keys_invalidated": 42},
+    "ingest_tick": {"tick": 7, "points": 300, "seconds": 0.12,
+                    "epoch": 8, "duplicate": False, "watermark": 1.5e9,
+                    "lag_s": 0.34, "queue_depth": 2,
+                    "keys_invalidated": 17, "compacted": False},
     "compaction_start": {"root": "store/", "deltas": 3,
                          "base": "base-000001"},
     "compaction_end": {"root": "store/", "seconds": 0.4, "status": "ok",

@@ -10,6 +10,11 @@ Reads every bench artifact the repo's tooling writes —
   apply seconds (lower is better) and full/incremental speedup;
 - ``BENCH_serve.json``  (tools/load_gen.py): rps (higher) and p99
   latency ms (lower);
+- ``BENCH_ingest.json`` (tools/bench_ingest.py): per micro-batch and
+  padding mode, sustained points/sec (higher) and ingest->servable
+  p99 lag ms (lower);
+- ``onchip_state/sweep.jsonl`` stream cells (tools/bench_stream.py):
+  per (backend, batch, device) update-loop points/sec (higher);
 
 — prints the folded trend table, and exits non-zero when the newest
 value of any series regresses more than ``--threshold`` (default 15%)
@@ -92,6 +97,51 @@ def snapshot_metrics(root: str) -> dict:
         p99 = (doc.get("latency_ms") or {}).get("p99")
         if isinstance(p99, (int, float)):
             out["serve:p99_ms"] = (float(p99), False)
+    doc = _load(os.path.join(root, "BENCH_ingest.json"))
+    if isinstance(doc, dict):
+        for row in doc.get("results", []):
+            batch, mode = row.get("micro_batch"), row.get("mode")
+            if batch is None or mode is None:
+                continue
+            cell = f"{batch},{mode}"
+            if isinstance(row.get("pts_per_s"), (int, float)):
+                out[f"ingest:pts_per_s[{cell}]"] = (
+                    float(row["pts_per_s"]), True)
+            p99 = (row.get("lag_ms") or {}).get("p99")
+            if isinstance(p99, (int, float)):
+                out[f"ingest:lag_p99_ms[{cell}]"] = (float(p99), False)
+    out.update(stream_metrics(root))
+    return out
+
+
+def stream_metrics(root: str) -> dict:
+    """Stream-bench cells from the on-chip sweep JSONL (the relay's
+    append-only state file; non-stream checks and unparsable lines are
+    ignored). Last row wins per cell, matching the resume contract —
+    a re-measured cell supersedes the crashed attempt's row."""
+    out: dict = {}
+    path = os.path.join(root, "onchip_state", "sweep.jsonl")
+    if not os.path.exists(path):
+        return out
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError as e:
+        print(f"bench_gate: skipping unreadable {path}: {e}",
+              file=sys.stderr)
+        return out
+    for line in lines:
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if not isinstance(rec, dict) or rec.get("check") != "stream":
+            continue
+        if not isinstance(rec.get("pts_per_s"), (int, float)):
+            continue
+        cell = (f"{rec.get('backend')},{rec.get('batch')},"
+                f"{rec.get('device', 'unknown')}")
+        out[f"stream:pts_per_s[{cell}]"] = (float(rec["pts_per_s"]), True)
     return out
 
 

@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Continuous-ingest bench: throughput + ingest->servable latency,
+bucketed vs exact padding side by side: BENCH_ingest.json.
+
+For each micro-batch size, drains the same deterministically *jittered*
+synthetic stream (batch sizes vary tick to tick, the realistic shape a
+standing loop sees) through ``ingest.run_ingest`` twice — once with the
+exact-padding cascade (one jit compile per distinct batch size) and
+once with the pow2 bucketed compile cache (``pipeline/bucketing.py``,
+one compile per bucket) — publishing every tick to a live serve store.
+
+Measured per cell:
+
+- ``pts_per_s``    sustained applied points / loop wall seconds;
+- ``lag_ms``       ingest->servable p50/p99: micro-batch enqueued ->
+                   tiles invalidated (the ``lag_s`` field of each
+                   ``ingest_tick`` event);
+- ``tick_ms``      apply+publish p50/p99 (queue wait excluded);
+- ``compiles``     distinct cascade jit signatures this run
+                   (``bucketing.cache_stats()["misses"]`` — counted for
+                   exact mode too, under its own mode label).
+
+The exact cell of each pair runs first so a warm jax cache can only
+ever favor exact; bucketed cells still win on jittered sizes because
+later ticks land in an already-compiled bucket. The acceptance anchor
+(docs/ingest.md): bucketed compile count <= bucket count while exact
+pays one compile per distinct size.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/bench_ingest.py \
+        [--points 40000] [--micro-batches 512,2048,8192] \
+        [--out BENCH_ingest.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+#: Tick-to-tick batch size multipliers (deterministic jitter cycle).
+JITTER = (1.0, 0.62, 0.91, 0.55, 0.84, 0.73)
+
+
+class JitteredSource:
+    """Re-chunk a materialized columnar batch into deterministically
+    varying micro-batch sizes: tick k gets ``batch_size * JITTER[k %
+    len(JITTER)]`` rows. Same points, same order, every drain."""
+
+    def __init__(self, cols: dict):
+        self.cols = cols
+
+    def batches(self, batch_size: int = 1 << 20):
+        n = len(self.cols["latitude"])
+        i = k = 0
+        while i < n:
+            take = max(1, min(n - i,
+                              int(batch_size * JITTER[k % len(JITTER)])))
+            yield {c: v[i:i + take] for c, v in self.cols.items()}
+            i += take
+            k += 1
+
+
+def _materialize(spec: str) -> dict:
+    """Drain a source spec into one columnar dict."""
+    from heatmap_tpu.io import open_source
+
+    cols: dict = {}
+    for batch in open_source(spec).batches(1 << 20):
+        for c, v in batch.items():
+            cols.setdefault(c, []).extend(v)
+    return cols
+
+
+def _pct(sorted_vals: list, q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def bench_cell(cols: dict, micro_batch: int, mode: str,
+               tmpdir: str) -> dict:
+    from heatmap_tpu import delta, ingest
+    from heatmap_tpu.obs import events
+    from heatmap_tpu.pipeline import BatchJobConfig, bucketing
+    from heatmap_tpu.serve import TileCache, TileStore
+
+    config = BatchJobConfig(detail_zoom=11, min_detail_zoom=5,
+                            result_delta=3, pad_bucketing=mode)
+    root = os.path.join(tmpdir, f"store-{micro_batch}-{mode}")
+    delta.init_store(root)
+    store, cache = TileStore(f"delta:{root}"), TileCache()
+    events_path = os.path.join(tmpdir, f"events-{micro_batch}-{mode}.jsonl")
+    bucketing.reset_cache_stats()
+    log = events.EventLog(events_path)
+    events.set_event_log(log)
+    t0 = time.perf_counter()
+    try:
+        stats = ingest.run_ingest(
+            root, JitteredSource(cols), config, store=store, cache=cache,
+            ingest=ingest.IngestConfig(micro_batch=micro_batch,
+                                       queue_depth=4, compact_every=0))
+    finally:
+        events.set_event_log(None)
+        log.close()
+    wall_s = time.perf_counter() - t0
+    ticks = [r for r in events.read_events(events_path)
+             if r["event"] == "ingest_tick"]
+    lags = sorted(1e3 * float(r["lag_s"]) for r in ticks)
+    secs = sorted(1e3 * float(r["seconds"]) for r in ticks)
+    cache_stats = bucketing.cache_stats()
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "micro_batch": micro_batch,
+        "mode": mode,
+        "ticks": stats.ticks,
+        "points": stats.points,
+        "pts_per_s": round(stats.points / wall_s, 1) if wall_s else None,
+        "lag_ms": {"p50": _pct(lags, 0.50), "p99": _pct(lags, 0.99)},
+        "tick_ms": {"p50": _pct(secs, 0.50), "p99": _pct(secs, 0.99)},
+        "compiles": cache_stats["misses"],
+        "cache_hits": cache_stats["hits"],
+        "keys_invalidated": stats.keys_invalidated,
+        "max_queue_depth": stats.max_queue_depth,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=40_000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--micro-batches", default="512,2048,8192",
+                    help="comma list of micro-batch sizes")
+    ap.add_argument("--out", default="BENCH_ingest.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu import obs
+    from heatmap_tpu.utils.trace import get_tracer
+
+    obs.enable_metrics(True)
+    cols = _materialize(f"synthetic:{args.points}:{args.seed}")
+    sizes = [int(b) for b in args.micro_batches.split(",") if b.strip()]
+    tmpdir = tempfile.mkdtemp(prefix="benchingest-")
+    results = []
+    try:
+        for micro_batch in sizes:
+            # exact first: a warm jax cache can only favor exact.
+            for mode in ("exact", "pow2"):
+                row = bench_cell(cols, micro_batch, mode, tmpdir)
+                print(json.dumps({k: row[k] for k in
+                                  ("micro_batch", "mode", "pts_per_s",
+                                   "lag_ms", "compiles")}), flush=True)
+                results.append(row)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    record = {
+        "bench": "ingest",
+        "points": args.points,
+        "micro_batches": sizes,
+        "results": results,
+        "run_report": obs.build_run_report(tracer=get_tracer(),
+                                           registry=obs.get_registry()),
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, default=str)
+        f.write("\n")
+    print(json.dumps({"wrote": args.out}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,7 +6,11 @@ the same synthetic input: once clean, once with a seeded fault plane
 (faults/plane.py) firing hundreds of injected failures across every
 site — source reads, sink publishes, journal appends, compaction
 publishes, shard compute, tile renders, HTTP requests, and lost
-multihost heartbeats. The chaos run must converge to the *same bytes*:
+multihost heartbeats. A separate phase soaks the continuous-ingest
+loop (heatmap_tpu/ingest/): an ``ingest.*`` storm the retries absorb,
+then a kill mid-tick whose restart must heal exactly-once and serve
+byte-identical to a one-shot apply. The chaos run must converge to
+the *same bytes*:
 level arrays, journal state, and every served JSON tile. Along the way
 the HTTP tier must degrade gracefully (typed 503s / stale serves,
 ``/healthz`` reporting ``degraded``) and never return a 500.
@@ -67,6 +71,17 @@ DEFAULT_CHAOS = ",".join([
 ])
 
 FETCH_ATTEMPTS = 64  # per-URL 503-retry budget under probability rules
+
+#: Ingest-phase storms (the continuous loop has its own plane: the
+#: chaos plane above is spent by the time the ingest phase runs).
+#: Absorbed storm: one ingest.tick + one ingest.publish fault per tick,
+#: inside both retry budgets, so the loop result must be unchanged.
+INGEST_CHAOS = "seed=13,scale=0,ingest.tick=2x2,ingest.publish=2x2"
+#: Kill storm: every journal append fails past the whole retry stack
+#: (3 ingest.tick attempts x 4 journal.append attempts), crashing the
+#: first non-duplicate tick AFTER its artifact dir is written — the
+#: torn state delta/recover.py heals on the next run's startup sweep.
+INGEST_KILL = "seed=13,scale=0,journal.append=99"
 
 
 # ---------------------------------------------------------------- pipeline
@@ -281,12 +296,99 @@ def phase_byte_equality(ctx):
                                     sorted(served["codes"].items())}}
 
 
+def phase_ingest_crash(ctx):
+    """The continuous-ingest loop under an ``ingest.*`` storm with a
+    kill mid-tick: absorbed faults are invisible in the outcome, the
+    killed run heals exactly-once on restart (duplicates no-op, the
+    crashed batch re-journals, the orphan artifact is swept), and the
+    recovered store serves byte-identical to a one-shot apply of the
+    same points. Runs after fault_floor — it installs its own planes."""
+    from heatmap_tpu import ingest
+
+    n = ctx["n"]
+    cols: dict = {}
+    for batch in SyntheticSource(n=n, seed=21).batches(1 << 20):
+        for c, v in batch.items():
+            cols.setdefault(c, []).extend(v)
+    micro = max(1, -(-n // 4))  # 4 ticks
+    ticks_total = -(-n // micro)
+    root = os.path.join(os.path.dirname(ctx["base_root"]), "store-ingest")
+    # The loop runs the bucketed compile cache; the one-shot reference
+    # stays exact — byte-neutrality of the padding is part of the soak.
+    icfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                          result_delta=2, pad_bucketing="pow2",
+                          pad_bucket_min=1 << 8)
+
+    # A live store rides through the whole phase so every tick also
+    # publishes (exercising the ingest.publish site and its faults).
+    delta.init_store(root)
+    store, cache = TileStore(f"delta:{root}"), TileCache()
+
+    # 1. Absorbed storm: the first two ticks land despite one tick
+    #    fault and one publish fault each (inside the retry budgets).
+    plane = faults.install_spec(INGEST_CHAOS)
+    first = ingest.run_ingest(
+        root, delta.ColumnsSource(cols), icfg, store=store, cache=cache,
+        ingest=ingest.IngestConfig(micro_batch=micro, queue_depth=2,
+                                   compact_every=0, max_ticks=2))
+    absorbed = plane.injected
+    assert first.ticks == 2 and first.duplicates == 0, vars(first)
+    assert absorbed >= 4, f"absorbed storm never fired ({absorbed})"
+
+    # 2. Kill mid-tick: duplicates sail through (the dedup path never
+    #    reaches journal.append), the first fresh tick dies with its
+    #    artifact dir orphaned.
+    faults.install_spec(INGEST_KILL)
+    try:
+        ingest.run_ingest(root, delta.ColumnsSource(cols), icfg,
+                          store=store, cache=cache,
+                          ingest=ingest.IngestConfig(micro_batch=micro,
+                                                     queue_depth=2,
+                                                     compact_every=0))
+    except faults.InjectedFault:
+        pass
+    else:
+        raise AssertionError("kill storm never crashed the loop")
+    faults.install(None)
+    assert len(delta.live_entries(root)) == 2, "crashed tick journaled"
+
+    # 3. Recovery: re-drain the whole source; exactly-once epochs.
+    stats = ingest.run_ingest(root, delta.ColumnsSource(cols), icfg,
+                              store=store, cache=cache,
+                              ingest=ingest.IngestConfig(
+                                  micro_batch=micro, queue_depth=2,
+                                  compact_every=0))
+    assert stats.ticks == ticks_total and stats.duplicates == 2, \
+        vars(stats)
+    live = delta.live_entries(root)
+    hashes = [e["content_hash"] for e in live]
+    assert len(live) == ticks_total and len(set(hashes)) == ticks_total
+    epochs = [e["epoch"] for e in live]
+    assert epochs == sorted(epochs)
+
+    # 4. Byte identity vs a one-shot apply of the union.
+    ref = os.path.join(os.path.dirname(ctx["base_root"]),
+                       "store-ingest-ref")
+    delta.apply_batch(ref, delta.ColumnsSource(cols),
+                      BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                                     result_delta=2))
+    got = _serve_docs(root)["docs"]
+    want = _serve_docs(ref)["docs"]
+    assert sorted(got) == sorted(want), (
+        f"served tile sets diverged: {len(got)} vs {len(want)}")
+    mism = [k for k in want if got[k] != want[k]]
+    assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
+    return {"ticks": ticks_total, "absorbed_faults": absorbed,
+            "epochs": epochs, "tiles": len(got)}
+
+
 PHASES = [
     ("baseline", phase_baseline),
     ("chaos_pipeline", phase_chaos_pipeline),
     ("chaos_serve", phase_chaos_serve),
     ("heartbeat", phase_heartbeat),
     ("fault_floor", phase_fault_floor),
+    ("ingest_crash", phase_ingest_crash),
     ("byte_equality", phase_byte_equality),
 ]
 
