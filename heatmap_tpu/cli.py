@@ -242,6 +242,32 @@ def _add_run_flags(p):
                    "shard (path sinks get a per-host suffix "
                    "automatically) — the scalable reducer-write path; "
                    "required for columnar sinks on pods")
+    p.add_argument("--heartbeat-deadline", type=float, default=None,
+                   metavar="S",
+                   help="arm straggler detection: at each multihost "
+                   "phase boundary, a host whose heartbeat is older "
+                   "than S seconds raises a typed StragglerTimeout "
+                   "(docs/robustness.md) instead of hanging the next "
+                   "collective")
+    p.add_argument("--on-straggler", choices=("raise", "reassign"),
+                   default="raise",
+                   help="what a straggler timeout means: raise (the "
+                   "default — job dies with the typed error) or "
+                   "reassign (elastic execution: the stale host's "
+                   "unfinished shards re-run on survivors from the "
+                   "--elastic-dir lineage manifest, output "
+                   "byte-identical to an unfailed run; needs a "
+                   "columnar arrays: output)")
+    p.add_argument("--elastic-dir", default=None, metavar="DIR",
+                   help="shard-lineage manifest root for "
+                   "--on-straggler reassign: completed shards persist "
+                   "their partial pyramid here atomically, so finished "
+                   "work survives a crash and re-runs are exactly-once "
+                   "by shard hash")
+    p.add_argument("--elastic-hosts", type=int, default=None, metavar="K",
+                   help="simulated host count for elastic execution on "
+                   "a single process (default 2); real multi-process "
+                   "runs use one host per process")
     _add_trace_flags(p)
 
 
@@ -337,6 +363,16 @@ def cmd_run(args) -> int:
         # job on EVERY host of a per-host launch script, with all of
         # them writing the same output path.
         raise SystemExit("--multihost-egress requires --multihost")
+    if not args.multihost and (args.on_straggler != "raise"
+                               or args.elastic_dir or args.elastic_hosts
+                               or args.heartbeat_deadline is not None):
+        raise SystemExit("--heartbeat-deadline / --on-straggler / "
+                         "--elastic-dir / --elastic-hosts require "
+                         "--multihost")
+    if args.on_straggler == "reassign" and not args.elastic_dir:
+        raise SystemExit("--on-straggler reassign needs --elastic-dir "
+                         "(the shard-lineage manifest is what makes "
+                         "failover re-execution exactly-once)")
     if args.merge_spill_dir and args.checkpoint_dir:
         # The spill merge lives on the bounded path; checkpointing
         # never routes there — ignoring the flag would quietly run the
@@ -492,6 +528,10 @@ def cmd_run(args) -> int:
                         max_points_in_flight=args.max_points_in_flight,
                         egress=args.multihost_egress,
                         merge_spill_dir=args.merge_spill_dir,
+                        heartbeat_deadline_s=args.heartbeat_deadline,
+                        on_straggler=args.on_straggler,
+                        elastic_dir=args.elastic_dir,
+                        elastic_hosts=args.elastic_hosts,
                     )
                 else:
                     blobs = run_job(open_source(args.input,

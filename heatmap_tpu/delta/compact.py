@@ -49,6 +49,11 @@ from heatmap_tpu.utils.checkpoint import fsync_dir, publish_dir
 CURRENT_SCHEMA = "heatmap-tpu.delta_store.v1"
 JOURNAL_DIRNAME = "journal"
 
+#: Quarantined garbage younger than this is never pruned regardless of
+#: the retention count — a day is the operator's minimum window to
+#: inspect what a chaotic run left behind (delta/recover.py).
+QUARANTINE_MIN_AGE_S = 24 * 3600.0
+
 #: Config fields that change pyramid bytes: every batch applied to a
 #: store must agree on them or base ⊕ delta is meaningless. Runtime
 #: knobs (cascade_backend, data_parallel, chunking) are byte-neutral
@@ -257,6 +262,11 @@ def compact(root: str, *, retention: int = 2) -> dict:
                     and os.path.isdir(os.path.join(root, name))):
                 shutil.rmtree(os.path.join(root, name),
                               ignore_errors=True)
+        # Quarantine rides the same retention knob: keep the newest
+        # ``retention`` quarantined items, but nothing younger than the
+        # minimum age (an operator's incident-investigation window).
+        recover.prune_quarantine(root, keep=retention,
+                                 min_age_s=QUARANTINE_MIN_AGE_S)
         seconds = time.monotonic() - t0
         COMPACTION_SECONDS.observe(seconds)
         obs.emit("compaction_end", root=root, seconds=round(seconds, 6),

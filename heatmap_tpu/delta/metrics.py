@@ -23,3 +23,6 @@ COMPACTION_SECONDS = _registry.histogram(
     "compaction_seconds",
     "Wall-clock of folding the live delta stack into a new base",
     buckets=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0))
+QUARANTINE_BYTES = _registry.gauge(
+    "quarantine_bytes",
+    "Bytes held in the most recently swept store's quarantine/ dir")

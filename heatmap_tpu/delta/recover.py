@@ -35,6 +35,13 @@ sound cache key. ``clear_verified_cache`` resets it (tests).
 Runs at ``init_store`` (the head of every apply) and at the top of
 ``compact``; the serve tier never sweeps — it is read-only and handles
 store corruption by degrading instead (docs/robustness.md).
+
+Quarantine growth is bounded, not infinite: every sweep refreshes the
+``quarantine_bytes`` gauge, and ``prune_quarantine`` (called after each
+successful compaction under the store's ``--retention`` knob) deletes
+the oldest entries beyond the retention count — never an entry younger
+than the minimum age, so an operator always gets a full
+investigation window for recent incidents.
 """
 
 from __future__ import annotations
@@ -120,6 +127,75 @@ def _entry_fault(root: str, name: str, verify: bool):
     return meta, None, None
 
 
+def quarantine_bytes(root: str) -> int:
+    """Total bytes under ``root/quarantine/`` (0 when absent); also
+    refreshes the ``quarantine_bytes`` gauge."""
+    from heatmap_tpu.delta.metrics import QUARANTINE_BYTES
+
+    qdir = os.path.join(root, QUARANTINE_DIRNAME)
+    total = 0
+    for dirpath, _dirs, files in os.walk(qdir):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(dirpath, name))
+            except OSError:
+                continue  # pruned/moved concurrently
+    QUARANTINE_BYTES.set(total)
+    return total
+
+
+def prune_quarantine(root: str, *, keep: int, min_age_s: float = 0.0,
+                     now: float | None = None) -> dict:
+    """Bound ``root/quarantine/`` growth: delete the oldest entries
+    beyond the newest ``keep``, but NEVER an entry younger than
+    ``min_age_s`` — recent quarantines are exactly the ones an operator
+    investigating a live incident still needs, so age wins over count.
+
+    The count cap rides the delta store's existing ``--retention``
+    knob (delta/compact.py calls this after every successful
+    compaction). Returns ``{"pruned": [names], "kept": n, "bytes":
+    remaining}`` and refreshes the ``quarantine_bytes`` gauge.
+    """
+    import time as _time
+
+    from heatmap_tpu import obs
+
+    if keep < 0:
+        raise ValueError("keep must be >= 0")
+    if now is None:
+        now = _time.time()
+    qdir = os.path.join(root, QUARANTINE_DIRNAME)
+    pruned: list = []
+    if os.path.isdir(qdir):
+        entries = []
+        for name in os.listdir(qdir):
+            full = os.path.join(qdir, name)
+            try:
+                entries.append((os.path.getmtime(full), name, full))
+            except OSError:
+                continue
+        entries.sort(reverse=True)  # newest first
+        for mtime, name, full in entries[keep:]:
+            if now - mtime < min_age_s:
+                continue
+            if os.path.isdir(full):
+                shutil.rmtree(full, ignore_errors=True)
+            else:
+                try:
+                    os.remove(full)
+                except OSError:
+                    continue
+            pruned.append(name)
+            obs.emit("quarantine", root=root,
+                     path=os.path.join(QUARANTINE_DIRNAME, name),
+                     reason="pruned", kind="prune",
+                     detail=f"beyond retention keep={keep}")
+    remaining = quarantine_bytes(root)
+    kept = (len([n for n in os.listdir(qdir)])
+            if os.path.isdir(qdir) else 0)
+    return {"pruned": pruned, "kept": kept, "bytes": remaining}
+
+
 def sweep(root: str, *, verify: bool = True) -> dict:
     """Quarantine crash garbage under ``root``; see module docstring.
 
@@ -170,4 +246,5 @@ def sweep(root: str, *, verify: bool = True) -> dict:
             if name != cur.get("base"):
                 _quarantine(root, full, "orphan_base", "base", items)
 
+    quarantine_bytes(root)  # refresh the growth gauge every sweep
     return {"quarantined": items}

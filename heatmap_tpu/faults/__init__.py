@@ -11,14 +11,17 @@ from heatmap_tpu.faults.plane import (ENV_VAR, SITES, FaultPlane,
                                       InjectedFault, check, get_plane,
                                       hash01, install, install_from_env,
                                       install_spec, parse_spec)
-from heatmap_tpu.faults.retry import (DEFAULT_POLICY, POLICIES, RETRYABLE,
-                                      NonRetryable, RetryPolicy, backoff_s,
-                                      policy_for, resumable_iter, retry_call,
-                                      sleep_backoff)
+from heatmap_tpu.faults.retry import (DEFAULT_POLICY,
+                                      MAX_REBUILDS_PER_POSITION, POLICIES,
+                                      RETRYABLE, NonRetryable,
+                                      PoisonedStream, RetryPolicy,
+                                      backoff_s, policy_for, resumable_iter,
+                                      retry_call, sleep_backoff)
 
 __all__ = [
     "DEFAULT_POLICY", "ENV_VAR", "FaultPlane", "InjectedFault",
-    "NonRetryable", "POLICIES", "RETRYABLE", "RetryPolicy", "SITES",
+    "MAX_REBUILDS_PER_POSITION", "NonRetryable", "POLICIES",
+    "PoisonedStream", "RETRYABLE", "RetryPolicy", "SITES",
     "backoff_s", "check", "get_plane", "hash01", "install",
     "install_from_env", "install_spec", "parse_spec", "policy_for",
     "resumable_iter", "retry_call", "sleep_backoff",

@@ -1,6 +1,6 @@
 """Process-wide deterministic fault-injection plane.
 
-One registry, eight sites, zero cost when off. Every I/O and compute
+One registry, a closed site allowlist, zero cost when off. Every I/O and compute
 boundary in the pipeline calls ``faults.check(site, key=...)`` at the
 top of the guarded operation; with no plane installed that is a single
 module-global read. With a plane installed, rules decide — purely as a
@@ -17,7 +17,10 @@ publishes), ``shard.compute`` (utils/recovery.run_shards — the site the
 legacy ``FaultInjector`` maps onto), ``tile.render`` (serve render
 functions), ``http.request`` (ServeApp dispatch), and
 ``multihost.heartbeat`` (a *lost* heartbeat: obs.heartbeat swallows the
-fault and skips the liveness update instead of failing the caller).
+fault and skips the liveness update instead of failing the caller),
+``ingest.tick`` / ``ingest.publish`` (continuous-ingest micro-batch
+boundaries), and ``elastic.reassign`` (each orphaned-shard re-execution
+on a surviving host — parallel/elastic.py).
 
 Rule shapes:
 
@@ -58,6 +61,7 @@ SITES = (
     "multihost.heartbeat",
     "ingest.tick",
     "ingest.publish",
+    "elastic.reassign",
 )
 _SITE_SET = frozenset(SITES)
 

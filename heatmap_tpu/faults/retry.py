@@ -47,6 +47,33 @@ class NonRetryable:
     retry machinery without burning attempts or sleeping."""
 
 
+#: Hard ceiling on iterator rebuilds at one stream position
+#: (resumable_iter). The per-policy attempt budget already bounds a
+#: contiguous failure window under the shipped POLICIES table, but a
+#: permissive caller policy (retries=10**9, deadline_s=None) would
+#: otherwise rebuild a deterministically-poisoned batch forever; this
+#: cap turns that pathology into a typed PoisonedStream regardless of
+#: how generous the policy is.
+MAX_REBUILDS_PER_POSITION = 8
+
+
+class PoisonedStream(NonRetryable, RuntimeError):
+    """A stream failed :data:`MAX_REBUILDS_PER_POSITION` times at the
+    same position — the batch is deterministically poisoned, not
+    transient, so rebuilding again cannot help."""
+
+    def __init__(self, site: str, position: int, rebuilds: int,
+                 last_error: BaseException):
+        super().__init__(
+            f"{site}: stream poisoned at position {position} — "
+            f"{rebuilds} rebuilds all failed there "
+            f"(last: {last_error!r})")
+        self.site = site
+        self.position = int(position)
+        self.rebuilds = int(rebuilds)
+        self.last_error = last_error
+
+
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
     """retries = re-executions allowed after the first failure;
@@ -90,6 +117,13 @@ POLICIES = {
                                deadline_s=10.0),
     "ingest.publish": RetryPolicy(retries=3, base_s=0.02, cap_s=0.5,
                                   deadline_s=10.0),
+    # Orphaned-shard re-execution on a surviving host. The shard
+    # already failed once on the dead host, so the retry budget here
+    # guards only the survivor's own transients; a shard that also
+    # fails on the survivor should surface quickly rather than wander
+    # the fleet.
+    "elastic.reassign": RetryPolicy(retries=2, base_s=0.05, cap_s=2.0,
+                                    deadline_s=None),
 }
 
 
@@ -154,7 +188,8 @@ def retry_call(fn, *args, site: str, key=None,
 
 
 def resumable_iter(make_iter, *, site: str, key=None,
-                   policy: RetryPolicy | None = None, clock=time.monotonic):
+                   policy: RetryPolicy | None = None, clock=time.monotonic,
+                   max_rebuilds: int = MAX_REBUILDS_PER_POSITION):
     """Yield from ``make_iter()`` with transparent retry-with-resume.
 
     On a retryable failure (including an injected fault at the per-item
@@ -162,12 +197,22 @@ def resumable_iter(make_iter, *, site: str, key=None,
     replayed and discarded — identical bytes, because sources iterate
     deterministically. Delivered items reset the attempt/deadline
     window; non-retryable errors and exhausted budgets propagate.
+
+    The per-delivery window reset is what lets a long stream absorb
+    many isolated transients, but it also means the *policy* never
+    bounds total rebuilds of one poisoned position when the caller's
+    policy is permissive. ``max_rebuilds`` is the independent
+    poison-batch bound: once that many consecutive rebuilds fail at the
+    same position the stream raises :class:`PoisonedStream`
+    (NonRetryable) instead of rebuilding forever.
     """
     if policy is None:
         policy = policy_for(site)
     delivered = 0
     attempt = 0
     window_start = None
+    poison_position = None  # stream position of the last failure
+    poison_rebuilds = 0  # consecutive failures at that position
     while True:
         try:
             it = make_iter()
@@ -188,6 +233,14 @@ def resumable_iter(make_iter, *, site: str, key=None,
         except RETRYABLE as e:
             if isinstance(e, NonRetryable):
                 raise
+            if delivered == poison_position:
+                poison_rebuilds += 1
+            else:
+                poison_position = delivered
+                poison_rebuilds = 1
+            if poison_rebuilds >= max_rebuilds:
+                raise PoisonedStream(site, delivered, poison_rebuilds,
+                                     e) from e
             attempt += 1
             now = clock()
             if window_start is None:

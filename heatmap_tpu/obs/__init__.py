@@ -84,6 +84,13 @@ HOST_LAST_HEARTBEAT = _registry.gauge(
 DEVICE_BYTES = _registry.gauge(
     "device_bytes_in_use", "Last sampled device memory in use",
     labelnames=("device",))
+ELASTIC_REASSIGNMENTS = _registry.counter(
+    "elastic_reassignments_total",
+    "Orphaned shards reassigned to surviving hosts (parallel/elastic)")
+SPECULATIVE_LAUNCHES = _registry.counter(
+    "speculative_launches_total",
+    "Speculative duplicate shard executions by race outcome",
+    labelnames=("outcome",))  # outcome = win | lose
 FAULTS_INJECTED = _registry.counter(
     "faults_injected_total", "Faults fired by the injection plane",
     labelnames=("site",))
@@ -185,7 +192,7 @@ def sample_device_memory() -> list:
     return samples
 
 
-def heartbeat(phase: str):
+def heartbeat(phase: str, process: int | None = None):
     """Per-host liveness mark for multihost phases. Timing lives here so
     parallel/multihost.py stays free of raw clocks.
 
@@ -193,18 +200,27 @@ def heartbeat(phase: str):
     injected fault suppresses the gauge/event update without failing the
     caller, so the staleness monitors (``heartbeat_ages`` /
     ``check_heartbeats``) see exactly what a dead host would produce.
+
+    ``process`` overrides the host identity (default: this JAX
+    process). The elastic driver's simulated hosts beat with their own
+    ids; the fault key then becomes ``p<process>`` so a chaos spec can
+    kill exactly one simulated host's heartbeats
+    (``multihost.heartbeat@p2=999``) while phase-keyed rules keep
+    matching real multihost beats.
     """
     if not telemetry_enabled():
         return
     from heatmap_tpu import faults
 
     try:
-        faults.check("multihost.heartbeat", key=phase)
+        faults.check("multihost.heartbeat",
+                     key=phase if process is None else f"p{process}")
     except faults.InjectedFault:
         return  # heartbeat lost in transit; liveness gauges go stale
     import jax
 
-    pi = jax.process_index()
+    pi = jax.process_index() if process is None else int(process)
+    count = jax.process_count()
     uptime = time.monotonic() - _T0
     HOST_PHASE_SECONDS.set(uptime, phase=phase, process=str(pi))
     HOST_LAST_HEARTBEAT.set(time.time(), process=str(pi))
@@ -214,7 +230,7 @@ def heartbeat(phase: str):
         # Cross-process propagation: a collector on another host can
         # continue this trace by passing the header to begin_span.
         fields["traceparent"] = tp
-    emit("heartbeat", process_index=pi, process_count=jax.process_count(),
+    emit("heartbeat", process_index=pi, process_count=count,
          phase=phase, uptime_s=round(uptime, 3), **fields)
 
 
@@ -267,6 +283,59 @@ def record_io_retry(site: str):
     IO_RETRIES.inc(site=site)
 
 
+def record_shard_orphaned(shard, host, reason: str | None = None):
+    """A stale host's unfinished shard was marked orphaned
+    (parallel/elastic.py failover)."""
+    if not telemetry_enabled():
+        return
+    fields = {"reason": reason} if reason else {}
+    emit("shard_orphaned", shard=str(shard), host=str(host), **fields)
+
+
+def record_shard_reassigned(shard, from_host, to_host):
+    """An orphaned shard was handed to a surviving host; paired 1:1
+    with record_shard_orphaned and counted in
+    elastic_reassignments_total."""
+    if not telemetry_enabled():
+        return
+    ELASTIC_REASSIGNMENTS.inc()
+    emit("shard_reassigned", shard=str(shard), from_host=str(from_host),
+         to_host=str(to_host))
+
+
+def record_speculative_launch(shard, host, runtime_s=None,
+                              threshold_s=None):
+    """A duplicate execution of a straggling shard was launched on an
+    idle host (first-completion-wins)."""
+    if not telemetry_enabled():
+        return
+    fields = {}
+    if runtime_s is not None:
+        fields["runtime_s"] = round(float(runtime_s), 3)
+    if threshold_s is not None:
+        fields["threshold_s"] = round(float(threshold_s), 3)
+    emit("speculative_launch", shard=str(shard), host=str(host), **fields)
+
+
+def record_speculative_result(shard, winner, loser=None, won: bool = False,
+                              quarantined: str | None = None):
+    """Resolve one speculative race: increments
+    speculative_launches_total{outcome} and, when the duplicate beat
+    the original, emits the speculative_win event naming the quarantined
+    loser artifact."""
+    if not telemetry_enabled():
+        return
+    SPECULATIVE_LAUNCHES.inc(outcome="win" if won else "lose")
+    if won:
+        fields = {}
+        if loser is not None:
+            fields["loser"] = str(loser)
+        if quarantined is not None:
+            fields["quarantined"] = str(quarantined)
+        emit("speculative_win", shard=str(shard), winner=str(winner),
+             **fields)
+
+
 __all__ = [
     "EVENT_SCHEMA", "EventLog", "MetricsRegistry", "SLOEngine", "SLOSpec",
     "TraceCollector", "blob_checksum", "build_run_report", "current_span",
@@ -275,8 +344,11 @@ __all__ = [
     "get_collector", "get_event_log", "get_registry", "heartbeat",
     "heartbeat_ages", "install_specs", "metrics", "metrics_enabled",
     "parse_slo_spec", "parse_traceparent", "read_events", "record_fault",
-    "record_io_retry", "record_recovery", "record_retry", "record_stage",
-    "refresh_process_gauges", "sample_device_memory", "set_event_log",
+    "record_io_retry", "record_recovery", "record_retry",
+    "record_shard_orphaned", "record_shard_reassigned",
+    "record_speculative_launch", "record_speculative_result",
+    "record_stage", "refresh_process_gauges", "sample_device_memory",
+    "set_event_log",
     "slo", "slo_status", "telemetry_enabled", "tracing", "tracing_enabled",
     "validate_event", "write_run_report",
 ]

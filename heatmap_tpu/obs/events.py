@@ -101,6 +101,21 @@ EVENT_SCHEMA = {
     # delta dir, stale base dir).
     "quarantine": {"required": ("root", "path", "reason"),
                    "optional": ("kind", "detail")},
+    # parallel/elastic.py: the elastic coordinator's lineage decisions.
+    # shard_orphaned marks a stale host's unfinished shard (one record
+    # per shard, paired 1:1 with the shard_reassigned that names the
+    # surviving winner); speculative_launch is a duplicate execution of
+    # a straggling shard, and speculative_win fires only when the
+    # duplicate beats the original (the loser's artifact is quarantined,
+    # never merged).
+    "shard_orphaned": {"required": ("shard", "host"),
+                       "optional": ("reason",)},
+    "shard_reassigned": {"required": ("shard", "from_host", "to_host"),
+                         "optional": ()},
+    "speculative_launch": {"required": ("shard", "host"),
+                           "optional": ("runtime_s", "threshold_s")},
+    "speculative_win": {"required": ("shard", "winner"),
+                        "optional": ("loser", "quarantined")},
     # obs/slo.py: an objective's burn rate crossed 1.0 (rising edge;
     # one record per breach episode, not per evaluation).
     "slo_breach": {"required": ("slo", "burn_rate"),

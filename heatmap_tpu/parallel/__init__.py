@@ -33,6 +33,8 @@ from heatmap_tpu.parallel.sharded import (  # noqa: F401
     splat_rowsharded,
 )
 from heatmap_tpu.parallel.multihost import (  # noqa: F401
+    StragglerTimeout,
+    check_heartbeats,
     gather_blobs,
     initialize,
     make_hybrid_mesh,
@@ -40,4 +42,13 @@ from heatmap_tpu.parallel.multihost import (  # noqa: F401
     run_job_multihost,
     shard_source,
     shard_source_rows,
+)
+from heatmap_tpu.parallel.elastic import (  # noqa: F401
+    ElasticCoordinator,
+    ShardLineage,
+    WorkShard,
+    job_fingerprint,
+    plan_shards,
+    run_job_elastic,
+    shard_fingerprint,
 )

@@ -74,7 +74,8 @@ class StragglerTimeout(RuntimeError):
         self.stale = dict(stale)
 
 
-def check_heartbeats(deadline_s: float, now: float | None = None) -> dict:
+def check_heartbeats(deadline_s: float, now: float | None = None,
+                     expected=None) -> dict:
     """Raise :class:`StragglerTimeout` if any host's last heartbeat is
     older than ``deadline_s``; otherwise return the age map.
 
@@ -84,11 +85,23 @@ def check_heartbeats(deadline_s: float, now: float | None = None) -> dict:
     the lost-heartbeat failure mode the ``multihost.heartbeat`` fault
     site injects. A disabled registry yields no ages and never times
     out (monitoring off means no straggler detection, not a crash).
+
+    A host that *never* heartbeats is invisible to the age map — it has
+    no gauge sample to go stale. ``expected`` closes that gap: an
+    iterable of process labels that MUST have beaten at least once;
+    any expected label absent from the ages is reported stale with age
+    ``inf`` (caught at the first phase boundary, not after a hang).
+    Without ``expected`` the historical observed-hosts-only semantics
+    are unchanged — see docs/robustness.md for the distinction.
     """
     if deadline_s is None or deadline_s <= 0:
         raise ValueError("deadline_s must be a positive number of seconds")
     ages = obs.heartbeat_ages(now)
     stale = {p: age for p, age in ages.items() if age > deadline_s}
+    if expected is not None:
+        for p in expected:
+            if str(p) not in ages:
+                stale[str(p)] = float("inf")
     if stale:
         raise StragglerTimeout(deadline_s, stale)
     return ages
@@ -596,7 +609,11 @@ def run_job_multihost(source, sink=None, config=None,
                       max_points_in_flight: int | None = None,
                       egress_max_bytes: int = 1 << 30,
                       merge_spill_dir: str | None = None,
-                      heartbeat_deadline_s: float | None = None):
+                      heartbeat_deadline_s: float | None = None,
+                      on_straggler: str = "raise",
+                      elastic_dir: str | None = None,
+                      elastic_hosts: int | None = None,
+                      elastic_opts: dict | None = None):
     """Process-sharded ``run_job``: each host ingests its slice of the
     source and aggregates on its local devices; egress then either
 
@@ -652,6 +669,18 @@ def run_job_multihost(source, sink=None, config=None,
     than the deadline — the bounded-wait alternative to hanging in the
     next collective (docs/robustness.md). ``None`` (default) keeps the
     historical hang-and-hope behavior.
+
+    ``on_straggler`` decides what a straggler timeout means:
+    ``"raise"`` (default, today's semantics) surfaces the typed error
+    and the job dies; ``"reassign"`` routes the whole job through the
+    elastic execution layer (parallel/elastic.py — shard-lineage
+    manifest under ``elastic_dir``, orphaned shards of a stale host
+    re-executed on survivors, byte-identical output). Reassign mode
+    requires ``elastic_dir`` and a columnar (``write_levels``) sink or
+    no sink; ``elastic_hosts`` sets the simulated host count on a
+    single process (default 2), and ``elastic_opts`` forwards advanced
+    knobs (speculation, chaos wedge hooks) to
+    :func:`heatmap_tpu.parallel.elastic.run_job_elastic`.
     """
     from heatmap_tpu.pipeline import BatchJobConfig, run_job
     from heatmap_tpu.pipeline.batch import (
@@ -660,6 +689,26 @@ def run_job_multihost(source, sink=None, config=None,
     )
 
     config = config or BatchJobConfig()
+    if on_straggler not in ("raise", "reassign"):
+        raise ValueError(f"unknown on_straggler mode {on_straggler!r}")
+    if on_straggler == "reassign":
+        if elastic_dir is None:
+            raise ValueError(
+                "on_straggler='reassign' needs elastic_dir: the shard-"
+                "lineage manifest is what makes failover re-execution "
+                "exactly-once (parallel/elastic.py)")
+        from heatmap_tpu.parallel.elastic import run_job_elastic
+
+        return run_job_elastic(
+            source, sink, config, batch_size=batch_size, n_total=n_total,
+            lineage_dir=elastic_dir, n_hosts=elastic_hosts,
+            heartbeat_deadline_s=heartbeat_deadline_s,
+            **(elastic_opts or {}))
+    if elastic_dir is not None or elastic_hosts is not None \
+            or elastic_opts is not None:
+        raise ValueError(
+            "elastic_dir/elastic_hosts/elastic_opts only apply with "
+            "on_straggler='reassign'")
     if egress not in ("auto", "gather", "sharded"):
         raise ValueError(f"unknown egress mode {egress!r}")
     columnar = sink is not None and hasattr(sink, "write_levels")

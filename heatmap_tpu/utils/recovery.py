@@ -69,7 +69,9 @@ class FaultInjector:
 def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
                backoff_cap_s: float = 2.0, deadline_s: float | None = None,
                fault_injector: FaultInjector | None = None,
-               on_retry=None, tracer=None, max_workers: int = 1):
+               on_retry=None, tracer=None, max_workers: int = 1,
+               fallback=None, speculate_factor: float | None = None,
+               speculate_quantile: float = 0.75, on_speculate=None):
     """Run ``process(shard)`` over every shard with per-shard retries.
 
     Returns the list of per-shard results in shard order (order is
@@ -94,6 +96,23 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
     be called concurrently and must be thread-safe. On the first
     ShardFailure, outstanding (not-yet-started) shards are cancelled
     rather than left to run behind the raised error.
+
+    Two elastic-execution hooks (parallel/elastic.py is the full
+    coordinator; these are the run_shards-level primitives):
+
+    - ``fallback(i, shard, last_error)`` — failover re-execution:
+      called *instead of raising ShardFailure* once shard ``i``
+      exhausts its local budget; its return value becomes the shard's
+      result (the hook re-runs the shard elsewhere, serves a cached
+      partial, ...). Exceptions from the hook propagate unwrapped.
+    - ``speculate_factor`` — speculative straggler duplication (pool
+      path only): once at least three shards have completed, a still-
+      running shard whose elapsed time exceeds ``speculate_factor`` x
+      the ``speculate_quantile``-quantile of completed durations is
+      submitted a second time; first completion wins, the duplicate's
+      identical result is discarded (shards are deterministic, so
+      either result is THE result). ``on_speculate(i, elapsed_s,
+      threshold_s)`` observes each launch.
     """
 
     from heatmap_tpu import obs
@@ -116,10 +135,12 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
                 obs.record_retry(i, attempt, e)
                 if on_retry is not None:
                     on_retry(i, attempt, e)
-                if attempt > retries:
-                    raise ShardFailure(i, attempt, e) from e
-                if (deadline_s is not None
-                        and time.monotonic() - started >= deadline_s):
+                exhausted = attempt > retries or (
+                    deadline_s is not None
+                    and time.monotonic() - started >= deadline_s)
+                if exhausted:
+                    if fallback is not None:
+                        return fallback(i, shard, e)
                     raise ShardFailure(i, attempt, e) from e
                 if backoff_s:
                     faults.sleep_backoff("shard.compute", i, attempt,
@@ -135,6 +156,12 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
     shards = list(shards)
     if max_workers <= 1:
         return [run_one(i, s) for i, s in enumerate(shards)]
+    if speculate_factor is not None:
+        return _run_shards_speculative(
+            shards, run_one, max_workers=max_workers,
+            speculate_factor=speculate_factor,
+            speculate_quantile=speculate_quantile,
+            on_speculate=on_speculate)
     from concurrent.futures import ThreadPoolExecutor
 
     with ThreadPoolExecutor(max_workers=max_workers) as ex:
@@ -149,3 +176,81 @@ def run_shards(shards, process, *, retries: int = 2, backoff_s: float = 0.0,
             for f in futures:
                 f.cancel()
             raise
+
+
+#: Completed-shard sample needed before speculation can trigger.
+_MIN_SPECULATION_SAMPLES = 3
+
+
+def _run_shards_speculative(shards, run_one, *, max_workers: int,
+                            speculate_factor: float,
+                            speculate_quantile: float, on_speculate):
+    """Pool execution with straggler duplication (first-completion-wins).
+
+    Every attempt goes through the same ``run_one`` (full retry
+    bookkeeping); a per-shard resolution flag makes the first finisher
+    the winner and turns the loser's ShardFailure (if any) into a
+    no-op — a duplicate must never fail a shard its twin completed.
+    """
+    import threading
+    from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+    from concurrent.futures import wait as _fwait
+
+    n = len(shards)
+    results = [None] * n
+    resolved = [False] * n
+    started: dict = {}  # shard -> first actual start (not submit) time
+    durations: list = []
+    lock = threading.Lock()
+
+    def run_resolved(i, shard):
+        now = time.monotonic()
+        with lock:
+            started.setdefault(i, now)
+        try:
+            r = run_one(i, shard)
+        except ShardFailure:
+            with lock:
+                if resolved[i]:
+                    return  # the twin already won; this loss is moot
+            raise
+        with lock:
+            if not resolved[i]:
+                resolved[i] = True
+                results[i] = r
+                durations.append(time.monotonic() - now)
+
+    with ThreadPoolExecutor(max_workers=max_workers) as ex:
+        pending = {ex.submit(run_resolved, i, s)
+                   for i, s in enumerate(shards)}
+        speculated: set = set()
+        try:
+            while pending:
+                done, pending = _fwait(pending, timeout=0.05,
+                                       return_when=FIRST_COMPLETED)
+                for f in done:
+                    f.result()
+                with lock:
+                    dur = sorted(durations)
+                    snapshot = dict(started)
+                    unresolved = [i for i in range(n) if not resolved[i]]
+                if len(dur) < _MIN_SPECULATION_SAMPLES:
+                    continue
+                q = min(max(float(speculate_quantile), 0.0), 1.0)
+                threshold = speculate_factor * dur[int(q * (len(dur) - 1))]
+                now = time.monotonic()
+                for i in unresolved:
+                    if i in speculated or i not in snapshot:
+                        continue
+                    elapsed = now - snapshot[i]
+                    if elapsed <= threshold:
+                        continue
+                    speculated.add(i)
+                    if on_speculate is not None:
+                        on_speculate(i, elapsed, threshold)
+                    pending.add(ex.submit(run_resolved, i, shards[i]))
+        except BaseException:
+            for f in pending:
+                f.cancel()
+            raise
+    return results

@@ -1,0 +1,373 @@
+"""Elastic multihost execution (parallel.elastic).
+
+Three pillars under test: the shard-lineage manifest (content-hashed
+shards, atomic publish, exactly-once by hash), failover re-execution
+(orphaned shards round-robin to survivors after a straggler timeout),
+and speculative straggler duplication (first-completion-wins, the
+loser quarantined — never double-merged). The end-to-end anchors: an
+elastic run equals a plain ``run_job`` of the same input, and a run
+that loses a host mid-cascade is byte-identical to an unfailed one.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import faults, obs
+from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.io.sources import SyntheticSource
+from heatmap_tpu.parallel.elastic import (
+    ElasticCoordinator,
+    ShardLineage,
+    WorkShard,
+    job_fingerprint,
+    plan_shards,
+    run_job_elastic,
+    shard_fingerprint,
+)
+from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+CFG = BatchJobConfig(detail_zoom=10, min_detail_zoom=8, result_delta=2)
+
+
+def _shards(n, job_fp="jfp"):
+    return plan_shards(n, n, job_fp)
+
+
+def _tiny_levels(value):
+    """A minimal one-row finalized level (write_levels input shape)."""
+    return [{
+        "zoom": 8, "coarse_zoom": 6,
+        "row": np.array([3], np.int64), "col": np.array([5], np.int64),
+        "value": np.array([float(value)]),
+        "user_idx": np.array([0], np.int32),
+        "timespan_idx": np.array([0], np.int32),
+        "coarse_row": np.array([0], np.int64),
+        "coarse_col": np.array([1], np.int64),
+        "user_names": np.array(["all"]),
+        "timespan_names": np.array(["alltime"]),
+    }]
+
+
+def _levels_bytes(path):
+    out = {}
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if os.path.isfile(full):
+            with open(full, "rb") as f:
+                out[name] = f.read()
+    return out
+
+
+# ------------------------------------------------------------ plan + hashes
+
+def test_plan_shards_partition():
+    for n_batches in (1, 5, 8, 17):
+        for n_shards in (1, 3, 8, 30):
+            plan = plan_shards(n_batches, n_shards, "fp")
+            # Contiguous, disjoint, covering, balanced within 1,
+            # never an empty shard (n_shards clamps to n_batches).
+            assert plan[0].lo == 0 and plan[-1].hi == n_batches
+            for a, b in zip(plan, plan[1:]):
+                assert a.hi == b.lo
+            sizes = [s.hi - s.lo for s in plan]
+            assert min(sizes) >= 1
+            assert max(sizes) - min(sizes) <= 1
+            assert [s.index for s in plan] == list(range(len(plan)))
+
+
+def test_shard_fingerprints_deterministic_and_distinct():
+    a = plan_shards(8, 4, "job-a")
+    b = plan_shards(8, 4, "job-a")
+    c = plan_shards(8, 4, "job-b")
+    assert [s.fingerprint for s in a] == [s.fingerprint for s in b]
+    assert len({s.fingerprint for s in a}) == 4  # distinct per range
+    # A different job fingerprint shifts every shard identity.
+    assert {s.fingerprint for s in a}.isdisjoint(
+        {s.fingerprint for s in c})
+    assert shard_fingerprint("j", 0, 2) != shard_fingerprint("j", 0, 3)
+
+
+def test_job_fingerprint_pins_input_and_config():
+    src = SyntheticSource(n=100, seed=1)
+    base = job_fingerprint(src, CFG, 32, 100)
+    assert base == job_fingerprint(SyntheticSource(n=100, seed=1),
+                                   CFG, 32, 100)
+    assert base != job_fingerprint(SyntheticSource(n=100, seed=2),
+                                   CFG, 32, 100)
+    assert base != job_fingerprint(src, CFG, 64, 100)
+    other = BatchJobConfig(detail_zoom=11, min_detail_zoom=8,
+                           result_delta=2)
+    assert base != job_fingerprint(src, other, 32, 100)
+
+
+# ------------------------------------------------------------ lineage
+
+def test_lineage_publish_exactly_once(tmp_path, monkeypatch):
+    """The no-double-merge pin: of two racing publishes of one shard,
+    exactly one artifact lands in the manifest, the loser is
+    quarantined, and the merge reads the winner's bytes only."""
+    import heatmap_tpu.parallel.elastic as el
+
+    lineage = ShardLineage(str(tmp_path / "lin"))
+    shard = _shards(1)[0]
+    real = el.publish_dir
+    raced = []
+
+    def racing(tmp, final):
+        if not raced:
+            raced.append(1)
+            # The twin wins the race in the window between our manifest
+            # check and our rename: its artifact lands at final first.
+            wtmp = final + ".tmp-twin"
+            LevelArraysSink(wtmp).write_levels(_tiny_levels(7.0))
+            real(wtmp, final)
+        return real(tmp, final)
+
+    monkeypatch.setattr(el, "publish_dir", racing)
+    won, q = lineage.publish(shard, 2, _tiny_levels(99.0), {"points": 1})
+    assert not won
+    assert lineage.is_complete(shard)
+    assert q is not None and os.path.isdir(q)
+    assert os.path.dirname(q) == lineage.quarantine_dir
+    merged = lineage.merge([shard])
+    assert len(merged) == 1
+    assert float(np.asarray(merged[0]["value"])[0]) == 7.0  # winner only
+    # A later attempt short-circuits on the manifest without staging.
+    won3, q3 = lineage.publish(shard, 3, _tiny_levels(5.0), {})
+    assert not won3 and q3 is None
+    assert float(np.asarray(lineage.merge([shard])[0]["value"])[0]) == 7.0
+
+
+def test_lineage_merge_refuses_missing_shards(tmp_path):
+    lineage = ShardLineage(str(tmp_path))
+    shards = _shards(2)
+    lineage.publish(shards[0], 0, _tiny_levels(1.0), {})
+    with pytest.raises(RuntimeError, match="missing"):
+        lineage.merge(shards)
+
+
+# ------------------------------------------------------------ coordinator
+
+def test_coordinator_orphan_stale_round_robin():
+    shards = _shards(6)
+    coord = ElasticCoordinator(shards, [0, 1, 2])
+    # Host 2 owns shards 2 and 5; it completes shard 2, then dies.
+    s2, mode = coord.next_work(2, now=0.0)
+    assert (s2.index, mode) == (2, "own")
+    coord.mark_done(s2, 2, now=1.0)
+    moved = coord.orphan_stale(["2"])
+    assert moved == 1  # only shard 5 was still unfinished
+    assert coord.reassigned == 1
+    assert coord.owner[5] in (0, 1)
+    # Idempotent: a second stale report of the same host is a no-op.
+    assert coord.orphan_stale(["2"]) == 0
+    # The dead host is never handed new work.
+    assert coord.next_work(2, now=2.0) is None
+    # Survivors drain their own queues plus the orphan.
+    seen = []
+    for host in (0, 1):
+        while True:
+            got = coord.next_work(host, now=3.0)
+            if got is None:
+                break
+            seen.append(got[0].index)
+            coord.mark_done(got[0], host, now=4.0)
+    assert sorted(seen) == [0, 1, 3, 4, 5]
+    assert coord.all_done()
+
+
+def test_coordinator_orphan_spread_over_survivors():
+    """A dead host's whole queue spreads round-robin, not onto one
+    survivor."""
+    shards = _shards(9)
+    coord = ElasticCoordinator(shards, [0, 1, 2])
+    assert coord.orphan_stale([0]) == 3  # shards 0, 3, 6
+    dests = {coord.owner[i] for i in (0, 3, 6)}
+    assert dests == {1, 2}
+    got = coord.next_work(1, now=0.0)
+    assert got is not None
+
+
+def test_coordinator_no_survivors_raises():
+    coord = ElasticCoordinator(_shards(2), [0, 1])
+    with pytest.raises(RuntimeError, match="no surviving"):
+        coord.orphan_stale([0, 1])
+
+
+def test_coordinator_speculation_threshold_fake_clock():
+    shards = _shards(5)
+    coord = ElasticCoordinator(shards, [0, 1],
+                               speculative_quantile=0.5,
+                               speculative_factor=2.0, min_samples=3)
+    # Host 0 runs shards 0, 2, 4; host 1 starts shard 1 and straggles.
+    s1, _ = coord.next_work(1, now=0.0)
+    assert s1.index == 1
+    for _ in range(3):
+        s, _ = coord.next_work(0, now=10.0)
+        coord.mark_done(s, 0, now=11.0)  # three 1s completions
+    # threshold = 2.0 * median(1s) = 2s; shard 1 has run 12s.
+    assert coord.speculation_threshold() == pytest.approx(2.0)
+    got = coord.next_work(0, now=12.0)
+    assert got is not None
+    dup, mode = got
+    assert (dup.index, mode) == (1, "speculate")
+    # Never duplicated twice, and never offered to its own runner.
+    assert coord.next_work(0, now=20.0) is None
+    # First completion wins: the duplicate finishes first.
+    assert coord.mark_done(dup, 0, now=13.0) is True
+    assert coord.mark_done(s1, 1, now=14.0) is False
+
+
+def test_coordinator_speculation_needs_samples():
+    coord = ElasticCoordinator(_shards(4), [0, 1],
+                               speculative_quantile=0.5, min_samples=3)
+    s, _ = coord.next_work(1, now=0.0)
+    for _ in range(2):
+        own, _ = coord.next_work(0, now=0.0)
+        coord.mark_done(own, 0, now=1.0)
+    assert coord.speculation_threshold() is None  # 2 < min_samples
+    assert coord.next_work(0, now=100.0) is None
+
+
+# ------------------------------------------------------------ end to end
+
+def test_run_job_elastic_matches_run_job(tmp_path):
+    """pyramid(union) == ⊕ pyramid(shard): the elastic merge equals the
+    plain single-process cascade, order-insensitively."""
+    src = SyntheticSource(n=1200, seed=3)
+    plain_dir, el_dir = str(tmp_path / "plain"), str(tmp_path / "el")
+    run_job(SyntheticSource(n=1200, seed=3), LevelArraysSink(plain_dir),
+            config=CFG, batch_size=300)
+    out = run_job_elastic(src, LevelArraysSink(el_dir), CFG,
+                          batch_size=300,
+                          lineage_dir=str(tmp_path / "lin"),
+                          n_hosts=2)
+    assert out["egress"] == "levels-elastic"
+    assert out["shards"] == 4 and out["reassigned"] == 0
+    plain = LevelArraysSink.load(plain_dir)
+    el = LevelArraysSink.load(el_dir)
+    assert sorted(plain) == sorted(el)
+    for z in plain:
+        a, b = plain[z], el[z]
+        ka = np.lexsort((np.asarray(a["timespan"], str),
+                         np.asarray(a["user"], str),
+                         a["col"], a["row"]))
+        kb = np.lexsort((np.asarray(b["timespan"], str),
+                         np.asarray(b["user"], str),
+                         b["col"], b["row"]))
+        for col in ("row", "col", "value"):
+            np.testing.assert_array_equal(np.asarray(a[col])[ka],
+                                          np.asarray(b[col])[kb])
+
+
+def test_run_job_elastic_resumes_from_lineage(tmp_path):
+    """A re-run over an existing manifest re-executes nothing and
+    produces identical bytes (exactly-once by shard hash)."""
+    src = lambda: SyntheticSource(n=900, seed=5)  # noqa: E731
+    lin = str(tmp_path / "lin")
+    d1, d2 = str(tmp_path / "a"), str(tmp_path / "b")
+    run_job_elastic(src(), LevelArraysSink(d1), CFG, batch_size=300,
+                    lineage_dir=lin, n_hosts=2)
+    stamps = {s: os.path.getmtime(os.path.join(lin, "shards", s))
+              for s in os.listdir(os.path.join(lin, "shards"))}
+    run_job_elastic(src(), LevelArraysSink(d2), CFG, batch_size=300,
+                    lineage_dir=lin, n_hosts=2)
+    after = {s: os.path.getmtime(os.path.join(lin, "shards", s))
+             for s in os.listdir(os.path.join(lin, "shards"))}
+    assert after == stamps  # no artifact was rewritten
+    assert _levels_bytes(d1) == _levels_bytes(d2)
+
+
+def test_run_job_elastic_rejects_blob_sinks(tmp_path):
+    class Blobby:
+        def write(self, *a):
+            pass
+
+    with pytest.raises(ValueError, match="columnar"):
+        run_job_elastic(SyntheticSource(n=10), Blobby(), CFG,
+                        lineage_dir=str(tmp_path / "lin"))
+    with pytest.raises(ValueError, match="on_straggler"):
+        run_job_elastic(SyntheticSource(n=10), None, CFG,
+                        lineage_dir=str(tmp_path / "lin"),
+                        on_straggler="bogus")
+
+
+def test_host_loss_reassigns_and_stays_byte_identical(tmp_path):
+    """The acceptance anchor: kill one simulated host mid-cascade (its
+    heartbeats eaten by the ``multihost.heartbeat`` fault site after it
+    completes a shard), the job finishes on the survivors, and the
+    merged arrays are byte-identical to an unfailed elastic run."""
+    # 6 batches -> 6 shards over 3 hosts: host 2 owns shards 2 and 5,
+    # so after it completes one shard the wedge leaves one to orphan.
+    src = lambda: SyntheticSource(n=900, seed=7)  # noqa: E731
+    ok_dir, loss_dir = str(tmp_path / "ok"), str(tmp_path / "loss")
+    log_path = str(tmp_path / "events.jsonl")
+    obs.enable_metrics(True)
+    obs.set_event_log(obs.EventLog(log_path))
+    try:
+        run_job_elastic(src(), LevelArraysSink(ok_dir), CFG,
+                        batch_size=150,
+                        lineage_dir=str(tmp_path / "lin-ok"), n_hosts=3)
+        obs.get_registry().reset()
+        out = run_job_elastic(
+            src(), LevelArraysSink(loss_dir), CFG, batch_size=150,
+            lineage_dir=str(tmp_path / "lin-loss"), n_hosts=3,
+            heartbeat_deadline_s=0.3, on_straggler="reassign",
+            wedge_host=2, wedge_after=1,
+            wedge_spec="seed=29,scale=0,multihost.heartbeat@p2=999",
+            beat_interval_s=0.05)
+        assert out["reassigned"] > 0
+        assert obs.ELASTIC_REASSIGNMENTS.value() > 0
+    finally:
+        faults.install(None)  # the wedge installed its own plane
+        log = obs.get_event_log()
+        obs.set_event_log(None)
+        if log is not None:
+            log.close()
+        obs.enable_metrics(False)
+    names = [r["event"] for r in obs.read_events(log_path)]
+    assert "shard_orphaned" in names and "shard_reassigned" in names
+    assert _levels_bytes(ok_dir) == _levels_bytes(loss_dir)
+
+
+def test_host_loss_raise_mode_propagates(tmp_path):
+    """on_straggler="raise" (the default) keeps the old contract: the
+    same mid-cascade death aborts the job with StragglerTimeout."""
+    from heatmap_tpu.parallel.multihost import StragglerTimeout
+
+    obs.enable_metrics(True)
+    try:
+        with pytest.raises(StragglerTimeout):
+            run_job_elastic(
+                SyntheticSource(n=900, seed=7),
+                LevelArraysSink(str(tmp_path / "out")), CFG,
+                batch_size=150, lineage_dir=str(tmp_path / "lin"),
+                n_hosts=3, heartbeat_deadline_s=0.3,
+                on_straggler="raise", wedge_host=2, wedge_after=1,
+                wedge_spec="seed=29,scale=0,multihost.heartbeat@p2=999",
+                beat_interval_s=0.05)
+    finally:
+        faults.install(None)
+        obs.enable_metrics(False)
+
+
+def test_run_job_multihost_elastic_routing(tmp_path):
+    """run_job_multihost routes to the elastic layer when asked, and
+    refuses half-configured elastic flags."""
+    from heatmap_tpu.parallel.multihost import run_job_multihost
+
+    with pytest.raises(ValueError, match="elastic_dir"):
+        run_job_multihost(SyntheticSource(n=10),
+                          on_straggler="reassign")
+    with pytest.raises(ValueError, match="reassign"):
+        run_job_multihost(SyntheticSource(n=10),
+                          elastic_dir=str(tmp_path / "lin"))
+    out = run_job_multihost(
+        SyntheticSource(n=600, seed=2),
+        LevelArraysSink(str(tmp_path / "arr")), CFG, batch_size=200,
+        on_straggler="reassign", elastic_dir=str(tmp_path / "lin"),
+        elastic_hosts=2)
+    assert out["egress"] == "levels-elastic"
+    assert out["rows"] > 0

@@ -264,6 +264,43 @@ class TestResumableIter:
             list(faults.resumable_iter(counted, site="source.read"))
         assert len(rebuilds) == 1  # no retry on a deterministic error
 
+    def test_poison_batch_caps_rebuilds(self):
+        """Regression: a deterministically-failing position under a
+        permissive policy (huge attempt budget, no deadline) used to
+        rebuild the stream forever. The per-position cap turns it into
+        a typed NonRetryable after MAX_REBUILDS_PER_POSITION tries."""
+        policy = faults.RetryPolicy(retries=10**9, base_s=0.0,
+                                    cap_s=0.0, deadline_s=None)
+        rebuilds = []
+
+        def counted():
+            rebuilds.append(1)
+
+            def gen():
+                yield from range(3)
+                raise RuntimeError("poisoned batch at position 3")
+
+            return gen()
+
+        with pytest.raises(faults.PoisonedStream) as ei:
+            list(faults.resumable_iter(counted, site="source.read",
+                                       policy=policy))
+        err = ei.value
+        assert isinstance(err, faults.NonRetryable)
+        assert (err.site, err.position) == ("source.read", 3)
+        assert err.rebuilds == faults.MAX_REBUILDS_PER_POSITION
+        assert len(rebuilds) == faults.MAX_REBUILDS_PER_POSITION
+        assert "position 3" in str(err)
+
+    def test_poison_cap_resets_when_position_advances(self):
+        """Transients spread across positions never hit the cap: each
+        delivered item resets the per-position rebuild counter."""
+        faults.install_spec("seed=4,scale=0,source.read=30x2")
+        items = list(faults.resumable_iter(lambda: iter(range(40)),
+                                           site="source.read",
+                                           max_rebuilds=3))
+        assert items == list(range(40))
+
 
 class TestRunShards:
     def test_exponential_backoff_replaces_linear(self):

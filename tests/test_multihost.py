@@ -558,3 +558,56 @@ def test_multiproc_skew_exchange():
     assert r.returncode == 0 and verdict["ok"], (
         f"skew exchange failed: {lines}\nstderr: {r.stderr[-1500:]}"
     )
+
+
+def test_never_beating_host_caught_at_first_boundary():
+    """The detection gap pinned by the satellite: a host whose gauge
+    sample went STALE is caught by the age map alone, but a host that
+    NEVER heartbeat has no sample to go stale — without ``expected`` it
+    is invisible, and with ``expected`` it is flagged (age=inf) at the
+    first phase boundary instead of hanging the job."""
+    import time as _time
+
+    from heatmap_tpu import obs
+    from heatmap_tpu.parallel.multihost import (StragglerTimeout,
+                                                check_heartbeats)
+
+    obs.enable_metrics(True)
+    try:
+        now = _time.time()
+        obs.heartbeat("join", process=0)
+        obs.heartbeat("join", process=1)
+        # Hosts 0 and 1 beat; host 2 never does.
+
+        # Observed-hosts-only semantics: everything fresh, no straggler
+        # — the never-beating host is invisible.
+        ages = check_heartbeats(5.0, now=now)
+        assert set(ages) == {"0", "1"}
+
+        # expected= closes the gap at the first boundary, with age=inf.
+        with pytest.raises(StragglerTimeout) as ei:
+            check_heartbeats(5.0, now=now, expected=[0, 1, 2])
+        assert ei.value.stale == {"2": float("inf")}
+
+        # Contrast: a host that DID beat and then went silent is the
+        # ordinary stale case, caught without expected=.
+        with pytest.raises(StragglerTimeout) as ei:
+            check_heartbeats(5.0, now=now + 10.0)
+        assert set(ei.value.stale) == {"0", "1"}
+    finally:
+        obs.enable_metrics(False)
+
+
+def test_check_heartbeats_expected_matches_beaten_hosts():
+    """expected= is a no-op when every expected label has beaten."""
+    from heatmap_tpu import obs
+    from heatmap_tpu.parallel.multihost import check_heartbeats
+
+    obs.enable_metrics(True)
+    try:
+        for p in range(3):
+            obs.heartbeat("join", process=p)
+        ages = check_heartbeats(5.0, expected=[0, 1, 2])
+        assert set(ages) == {"0", "1", "2"}
+    finally:
+        obs.enable_metrics(False)

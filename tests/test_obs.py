@@ -62,6 +62,12 @@ _PAYLOADS = {
     "quarantine": {"root": "store/", "path": "journal/ckpt-3.npz",
                    "reason": "digest_mismatch", "kind": "journal_entry",
                    "detail": "recorded sha256:aa..., actual sha256:bb..."},
+    "shard_orphaned": {"shard": "5", "host": "2", "reason": "heartbeat"},
+    "shard_reassigned": {"shard": "5", "from_host": "2", "to_host": "0"},
+    "speculative_launch": {"shard": "3", "host": "1", "runtime_s": 4.2,
+                           "threshold_s": 1.9},
+    "speculative_win": {"shard": "3", "winner": "1", "loser": "0",
+                        "quarantined": "quarantine/shard-00003-ab-loser"},
     "slo_breach": {"slo": "tiles-fast", "burn_rate": 2.5,
                    "kind": "latency", "compliance": 0.9975,
                    "target": 0.999, "window_s": 300.0,

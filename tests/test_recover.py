@@ -264,6 +264,98 @@ class TestApplyAndCompactRunTheSweep:
                 np.asarray(la["value"]), np.asarray(lb["value"]))
 
 
+class TestQuarantineBounded:
+    """quarantine/ growth is bounded: the gauge tracks its size and
+    prune_quarantine deletes beyond-retention entries — but never one
+    younger than the minimum age (the operator's incident window)."""
+
+    def _seed_quarantine(self, root, names, age_s=0.0, now=None):
+        import time as _time
+
+        now = _time.time() if now is None else now
+        qdir = os.path.join(root, recover.QUARANTINE_DIRNAME)
+        os.makedirs(qdir, exist_ok=True)
+        for k, name in enumerate(names):
+            full = os.path.join(qdir, name)
+            with open(full, "w") as f:
+                f.write("x" * 10)
+            # Strictly older entries first; distinct mtimes keep the
+            # newest-first sort deterministic.
+            os.utime(full, (now - age_s - k, now - age_s - k))
+        return qdir
+
+    def test_gauge_tracks_quarantine_bytes(self, tmp_path):
+        from heatmap_tpu.delta.metrics import QUARANTINE_BYTES
+
+        root = str(tmp_path / "store")
+        obs.enable_metrics(True)
+        try:
+            assert recover.quarantine_bytes(root) == 0
+            self._seed_quarantine(root, ["a.tmp", "b.tmp", "c.tmp"])
+            assert recover.quarantine_bytes(root) == 30
+            assert QUARANTINE_BYTES.value() == 30
+        finally:
+            obs.enable_metrics(False)
+
+    def test_prune_deletes_oldest_beyond_keep(self, tmp_path):
+        root = str(tmp_path / "store")
+        self._seed_quarantine(root, ["q0", "q1", "q2", "q3"],
+                              age_s=3600.0)
+        out = recover.prune_quarantine(root, keep=2)
+        # Entries were seeded newest-to-oldest: q2/q3 are the oldest.
+        assert out["pruned"] == ["q2", "q3"]
+        assert out["kept"] == 2 and out["bytes"] == 20
+        assert _quarantined(root) == ["q0", "q1"]
+
+    def test_prune_never_touches_young_entries(self, tmp_path):
+        """The satellite pin: age wins over count — an entry younger
+        than min_age_s survives even when the count cap says prune."""
+        import time as _time
+
+        root = str(tmp_path / "store")
+        now = _time.time()
+        qdir = self._seed_quarantine(root, ["old0", "old1"],
+                                     age_s=100_000.0, now=now)
+        for name in ("young0", "young1"):
+            with open(os.path.join(qdir, name), "w") as f:
+                f.write("y" * 10)
+        out = recover.prune_quarantine(root, keep=0,
+                                       min_age_s=24 * 3600.0, now=now)
+        assert sorted(out["pruned"]) == ["old0", "old1"]
+        assert sorted(_quarantined(root)) == ["young0", "young1"]
+        # Once they age past the window, the same call removes them.
+        later = now + 2 * 24 * 3600.0
+        out2 = recover.prune_quarantine(root, keep=0,
+                                        min_age_s=24 * 3600.0, now=later)
+        assert sorted(out2["pruned"]) == ["young0", "young1"]
+        assert _quarantined(root) == []
+        assert out2["bytes"] == 0
+
+    def test_prune_validates_keep(self, tmp_path):
+        with pytest.raises(ValueError):
+            recover.prune_quarantine(str(tmp_path), keep=-1)
+
+    def test_compact_prunes_under_retention(self, tmp_path):
+        """compact() bounds quarantine growth with its --retention
+        knob, but respects the day-long minimum age for fresh garbage."""
+        from heatmap_tpu.delta.compact import QUARANTINE_MIN_AGE_S
+
+        root = str(tmp_path / "store")
+        _apply(root, seed=1)
+        _apply(root, seed=2)
+        # Old garbage beyond both caps, plus a fresh orphan the sweep
+        # quarantines during this compaction — the fresh one survives.
+        self._seed_quarantine(
+            root, [f"g{i}" for i in range(5)],
+            age_s=QUARANTINE_MIN_AGE_S + 3600.0)
+        os.makedirs(os.path.join(root, "crash.tmp"))
+        summary = delta.compact(root, retention=2)
+        assert summary["status"] == "ok"
+        left = _quarantined(root)
+        assert "crash.tmp" in left  # younger than the minimum age
+        assert len([n for n in left if n.startswith("g")]) <= 2
+
+
 class TestPublishDirContract:
     def test_publish_dir_refuses_existing_target(self, tmp_path):
         from heatmap_tpu.utils.checkpoint import publish_dir

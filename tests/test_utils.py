@@ -193,6 +193,70 @@ def test_run_shards_result_identical_with_and_without_faults():
     assert clean == faulty
 
 
+def test_run_shards_fallback_replaces_shard_failure():
+    """Failover hook: an exhausted shard calls fallback instead of
+    raising, and the hook's return value becomes the shard's result."""
+    inj = FaultInjector({1: 9})
+    seen = []
+
+    def fallback(i, shard, err):
+        seen.append((i, shard, type(err).__name__))
+        return f"recovered-{shard}"
+
+    out = run_shards(["a", "b", "c"], lambda s: s, retries=1,
+                     fault_injector=inj, fallback=fallback)
+    assert out == ["a", "recovered-b", "c"]
+    assert seen == [(1, "b", "InjectedFault")]
+
+
+def test_run_shards_fallback_exception_propagates():
+    def fallback(i, shard, err):
+        raise KeyError("no standby executor")
+
+    with pytest.raises(KeyError):
+        run_shards([0], lambda s: s, retries=0,
+                   fault_injector=FaultInjector({0: 5}),
+                   fallback=fallback)
+
+
+def test_run_shards_speculative_duplicate_first_completion_wins():
+    """Straggler duplication: after three completions, a shard stuck
+    beyond factor x quantile is launched a second time; the duplicate
+    completes, the original unblocks, and the (identical, by the
+    determinism contract) result lands exactly once."""
+    import threading
+
+    release = threading.Event()
+    lock = threading.Lock()
+    launches = {"slow": 0}
+    spec_events = []
+
+    def process(s):
+        if s == "slow":
+            with lock:
+                launches["slow"] += 1
+                first = launches["slow"] == 1
+            if first:
+                # The straggler: parked until its duplicate launches.
+                assert release.wait(30), "speculation never fired"
+            else:
+                release.set()
+            return "slow-result"
+        return s * 2
+
+    def on_speculate(i, elapsed, threshold):
+        spec_events.append((i, elapsed, threshold))
+
+    out = run_shards([1, 2, 3, "slow"], process, max_workers=4,
+                     speculate_factor=1.5, speculate_quantile=0.5,
+                     on_speculate=on_speculate)
+    assert out == [2, 4, 6, "slow-result"]
+    assert launches["slow"] == 2  # original + exactly one duplicate
+    assert len(spec_events) == 1
+    i, elapsed, threshold = spec_events[0]
+    assert i == 3 and elapsed > threshold >= 0.0
+
+
 # -------------------------------------------------- resumable batch job
 
 def _mini_cfg():

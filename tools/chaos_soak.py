@@ -9,7 +9,11 @@ publishes, shard compute, tile renders, HTTP requests, and lost
 multihost heartbeats. A separate phase soaks the continuous-ingest
 loop (heatmap_tpu/ingest/): an ``ingest.*`` storm the retries absorb,
 then a kill mid-tick whose restart must heal exactly-once and serve
-byte-identical to a one-shot apply. The chaos run must converge to
+byte-identical to a one-shot apply. A host-loss phase kills one
+simulated host mid-cascade (its heartbeats eaten by the
+``multihost.heartbeat`` site) and requires the elastic layer
+(heatmap_tpu/parallel/elastic.py) to reassign its shards and still
+produce byte-identical arrays and tiles. The chaos run must converge to
 the *same bytes*:
 level arrays, journal state, and every served JSON tile. Along the way
 the HTTP tier must degrade gracefully (typed 503s / stale serves,
@@ -47,7 +51,9 @@ import numpy as np
 from heatmap_tpu import delta, faults, obs
 from heatmap_tpu.io.sinks import LevelArraysSink
 from heatmap_tpu.io.sources import SyntheticSource
-from heatmap_tpu.parallel.multihost import StragglerTimeout, check_heartbeats
+from heatmap_tpu.parallel.multihost import (StragglerTimeout,
+                                            check_heartbeats,
+                                            run_job_multihost)
 from heatmap_tpu.pipeline import BatchJobConfig, run_job
 from heatmap_tpu.serve import ServeApp, TileCache, TileStore, serve_in_thread
 from heatmap_tpu.tilemath.morton import morton_decode_np
@@ -382,6 +388,76 @@ def phase_ingest_crash(ctx):
             "epochs": epochs, "tiles": len(got)}
 
 
+#: host_loss wedge: the wedged worker installs this spec the moment it
+#: stops beating, so simulated host 2 is alive and visible up to that
+#: point and every later beat is eaten by the ``multihost.heartbeat``
+#: fault site — a mid-cascade host death, not a host that never joined.
+HOST_LOSS_WEDGE = "seed=29,scale=0,multihost.heartbeat@p2=999"
+
+
+def phase_host_loss(ctx):
+    """Elastic execution under a mid-cascade host death: one simulated
+    host completes a shard then stops heartbeating (its beats are eaten
+    by the ``multihost.heartbeat`` fault site); the monitor flags it
+    stale, its shards are reassigned to the survivors, the job
+    completes, and the merged level arrays AND every served tile are
+    byte-identical to an unfailed elastic run."""
+    faults.install(None)
+    tmp = os.path.dirname(ctx["base_root"])
+    src = lambda: SyntheticSource(n=ctx["n"], seed=3)  # noqa: E731
+    bs = max(1, ctx["n"] // 6)  # 6 batches -> 6 shards over 3 hosts
+    obs.enable_metrics(True)
+    try:
+        obs.get_registry().reset()
+        ok = run_job_multihost(
+            src(), LevelArraysSink(os.path.join(tmp, "arrays-elastic-ok")),
+            CFG, batch_size=bs, on_straggler="reassign",
+            elastic_dir=os.path.join(tmp, "elastic-ok"), elastic_hosts=3)
+        obs.get_registry().reset()
+        lost = run_job_multihost(
+            src(), LevelArraysSink(os.path.join(tmp, "arrays-elastic-loss")),
+            CFG, batch_size=bs, heartbeat_deadline_s=0.3,
+            on_straggler="reassign",
+            elastic_dir=os.path.join(tmp, "elastic-loss"), elastic_hosts=3,
+            elastic_opts={"wedge_host": 2, "wedge_after": 1,
+                          "wedge_spec": HOST_LOSS_WEDGE,
+                          "beat_interval_s": 0.05})
+        reassigned_metric = obs.ELASTIC_REASSIGNMENTS.value()
+    finally:
+        faults.install(None)  # the wedge installed its own plane
+        obs.enable_metrics(False)
+    assert ok["rows"] == lost["rows"], (ok, lost)
+    assert lost["reassigned"] > 0, f"no shards were reassigned: {lost}"
+    assert reassigned_metric > 0, \
+        f"elastic_reassignments_total stayed 0: {lost}"
+    a = _levels_bytes(os.path.join(tmp, "arrays-elastic-ok"))
+    b = _levels_bytes(os.path.join(tmp, "arrays-elastic-loss"))
+    assert sorted(a) == sorted(b), "elastic level-array file sets diverged"
+    for name in a:
+        assert a[name] == b[name], f"elastic arrays diverged at {name}"
+    # Served tiles from the failed run's arrays, byte-for-byte.
+    docs = {}
+    for which in ("arrays-elastic-ok", "arrays-elastic-loss"):
+        store = TileStore(f"arrays:{os.path.join(tmp, which)}")
+        app = ServeApp(store, TileCache(max_bytes=64 << 20),
+                       render_timeout_s=30.0)
+        server, base = serve_in_thread(app)
+        try:
+            docs[which] = _fetch_all(
+                base, _tile_coords(store),
+                {"codes": {}, "saw_degraded": False})
+        finally:
+            server.shutdown()
+    want, got = docs["arrays-elastic-ok"], docs["arrays-elastic-loss"]
+    assert sorted(want) == sorted(got), (
+        f"served tile sets diverged: {len(want)} vs {len(got)}")
+    mism = [k for k in want if want[k] != got[k]]
+    assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
+    return {"shards": lost["shards"], "reassigned": lost["reassigned"],
+            "reassignments_metric": reassigned_metric,
+            "levels": len(a), "tiles": len(want)}
+
+
 PHASES = [
     ("baseline", phase_baseline),
     ("chaos_pipeline", phase_chaos_pipeline),
@@ -389,6 +465,7 @@ PHASES = [
     ("heartbeat", phase_heartbeat),
     ("fault_floor", phase_fault_floor),
     ("ingest_crash", phase_ingest_crash),
+    ("host_loss", phase_host_loss),
     ("byte_equality", phase_byte_equality),
 ]
 
