@@ -905,6 +905,11 @@ def cmd_serve(args) -> int:
         ev_log = obs.EventLog(args.events)
         obs.set_event_log(ev_log)
     collector = _setup_tracing(args)
+    if getattr(args, "fleet", None):
+        if args.follow_stream:
+            raise SystemExit("--fleet is incompatible with --follow-stream "
+                             "(live layers are per-process state)")
+        return _serve_fleet(args, collector, ev_log)
     ttl = args.ttl
     if args.follow_stream and not (ttl and ttl > 0):
         # Targeted invalidation only drops tiles a batch touched; decay
@@ -939,6 +944,49 @@ def cmd_serve(args) -> int:
         if stop_stream is not None:
             stop_stream()
         server.server_close()
+        _export_trace(args, collector)
+        if ev_log is not None:
+            obs.set_event_log(None)
+            ev_log.close()
+    return 0
+
+
+def _serve_fleet(args, collector, ev_log) -> int:
+    """``serve --fleet N``: supervisor + router on --host/--port.
+
+    Each backend is a child serve process over the same store artifact
+    (its own LRU); the router fronts them with the rendezvous ring,
+    breakers, hedging, and admission control (docs/serving.md)."""
+    from heatmap_tpu import obs
+    from heatmap_tpu.serve import make_server
+    from heatmap_tpu.serve.fleet import FleetSupervisor
+
+    supervisor = FleetSupervisor(
+        args.store, args.fleet,
+        host=args.host, cache_bytes=args.cache_bytes,
+        backend_max_inflight=args.max_inflight,
+        render_timeout_s=getattr(args, "render_timeout", None),
+        chaos=getattr(args, "chaos", None),
+        max_inflight=args.max_inflight or 32,
+        queue_deadline_s=args.queue_deadline,
+        hedge_quantile=args.hedge_quantile,
+        probe_interval_s=args.probe_interval)
+    supervisor.start()
+    server = make_server(supervisor.router, host=args.host, port=args.port)
+    host, port = server.server_address[:2]
+    print(json.dumps({
+        "serving": f"http://{host}:{port}",
+        "store": args.store,
+        "fleet": {bid: client.address for bid, client
+                  in supervisor.router.backends.items()},
+    }), file=sys.stderr, flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        supervisor.stop()
         _export_trace(args, collector)
         if ev_log is not None:
             obs.set_event_log(None)
@@ -1713,6 +1761,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "render past it serves the last-good cached "
                          "bytes (stale-200) or a typed 503, never a "
                          "hung request (docs/robustness.md)")
+    p_serve.add_argument("--fleet", type=int, default=None, metavar="N",
+                         help="run N shared-nothing backend processes "
+                         "behind a consistent-hash router on --port "
+                         "(rendezvous ring, circuit breakers, hedged "
+                         "reads, admission control; docs/serving.md). "
+                         "Incompatible with --follow-stream")
+    p_serve.add_argument("--max-inflight", type=int, default=None,
+                         metavar="N",
+                         help="admission bound: concurrent tile requests "
+                         "per process (router: per backend); past it "
+                         "requests shed with 503 + Retry-After. "
+                         "Fleet default: 32")
+    p_serve.add_argument("--queue-deadline", type=float, default=0.25,
+                         metavar="S",
+                         help="fleet router: how long a request may wait "
+                         "for a backend slot before shedding")
+    p_serve.add_argument("--hedge-quantile", type=float, default=0.95,
+                         help="fleet router: hedge a request to the next "
+                         "replica once it outlives this latency "
+                         "quantile (first answer wins)")
+    p_serve.add_argument("--probe-interval", type=float, default=1.0,
+                         metavar="S",
+                         help="fleet router: active health-probe period "
+                         "(half-open probes re-admit recovered "
+                         "backends)")
     p_serve.add_argument("--events", default=None, metavar="PATH",
                          help="append http_request events to PATH (JSONL, "
                          "docs/observability.md)")

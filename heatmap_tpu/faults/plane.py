@@ -19,8 +19,13 @@ functions), ``http.request`` (ServeApp dispatch), and
 ``multihost.heartbeat`` (a *lost* heartbeat: obs.heartbeat swallows the
 fault and skips the liveness update instead of failing the caller),
 ``ingest.tick`` / ``ingest.publish`` (continuous-ingest micro-batch
-boundaries), and ``elastic.reassign`` (each orphaned-shard re-execution
-on a surviving host — parallel/elastic.py).
+boundaries), ``elastic.reassign`` (each orphaned-shard re-execution
+on a surviving host — parallel/elastic.py), ``router.forward`` (one
+check per fleet-router forward attempt to a backend — serve/router.py;
+an injected fault reads as a connection failure and burns the
+one-retry-on-next-replica budget), and ``backend.probe`` (each active
+health probe the fleet prober sends — a fault reads as a failed probe
+and feeds the breaker's passive signal).
 
 Rule shapes:
 
@@ -62,6 +67,8 @@ SITES = (
     "ingest.tick",
     "ingest.publish",
     "elastic.reassign",
+    "router.forward",
+    "backend.probe",
 )
 _SITE_SET = frozenset(SITES)
 

@@ -124,6 +124,17 @@ POLICIES = {
     # the fleet.
     "elastic.reassign": RetryPolicy(retries=2, base_s=0.05, cap_s=2.0,
                                     deadline_s=None),
+    # Fleet router forward: exactly one retry, and it lands on the
+    # *next* replica in rendezvous order, never the same backend — so
+    # base_s stays 0 (no sleep in a request handler; the failover IS
+    # the backoff). Connection failures only; HTTP status codes pass
+    # through untouched.
+    "router.forward": RetryPolicy(retries=1, base_s=0.0, cap_s=0.0,
+                                  deadline_s=None),
+    # Active health probes are themselves the retry loop (the prober
+    # re-probes every interval); a failed probe just feeds the breaker.
+    "backend.probe": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
+                                 deadline_s=None),
 }
 
 

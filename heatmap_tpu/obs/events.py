@@ -116,6 +116,15 @@ EVENT_SCHEMA = {
                            "optional": ("runtime_s", "threshold_s")},
     "speculative_win": {"required": ("shard", "winner"),
                         "optional": ("loser", "quarantined")},
+    # serve/router.py fleet membership edges: a backend's circuit
+    # breaker opening (crash, probe failures, reload failure) emits
+    # _down once per episode; the half-open probe that re-closes it
+    # emits _up. Edge-triggered like degraded_enter/exit — one pair
+    # per outage, not one per failed request.
+    "fleet_backend_down": {"required": ("backend", "reason"),
+                           "optional": ("detail",)},
+    "fleet_backend_up": {"required": ("backend",),
+                         "optional": ("detail",)},
     # obs/slo.py: an objective's burn rate crossed 1.0 (rising edge;
     # one record per breach episode, not per evaluation).
     "slo_breach": {"required": ("slo", "burn_rate"),

@@ -17,7 +17,13 @@ This package is that service, TPU-framework-native:
 - ``live``   — a HeatmapStream-backed layer whose update ticks
   invalidate only the affected tile keys;
 - ``http``   — stdlib ThreadingHTTPServer frontend with ETag/304,
-  ``/healthz`` and a Prometheus ``/metrics`` endpoint (obs registry).
+  ``/healthz`` and a Prometheus ``/metrics`` endpoint (obs registry);
+- ``router`` — stateless fleet frontend: rendezvous hashing with
+  bounded-load spill, circuit breakers, hedged reads, admission
+  control (typed 503 + Retry-After, never a 500);
+- ``fleet``  — supervisor spawning N shared-nothing backend processes
+  behind one router, restarting crashers with backoff and re-admitting
+  them via half-open health probes.
 
 Everything except ``live`` is numpy-only — serving a finished job
 never initializes a jax backend (the io/merge.py offline property), so
@@ -37,3 +43,11 @@ from heatmap_tpu.serve.http import (  # noqa: F401
     serve_in_thread,
 )
 from heatmap_tpu.serve.live import LiveLayer  # noqa: F401
+from heatmap_tpu.serve.router import (  # noqa: F401
+    BackendClient,
+    CircuitBreaker,
+    RouterApp,
+    rendezvous_order,
+    route_key,
+)
+from heatmap_tpu.serve.fleet import FleetSupervisor  # noqa: F401

@@ -77,14 +77,21 @@ class ServeApp:
     serving logic testable without sockets."""
 
     def __init__(self, store: TileStore, cache: TileCache | None = None,
-                 *, render_timeout_s: float | None = None):
+                 *, render_timeout_s: float | None = None,
+                 max_inflight: int | None = None,
+                 retry_after_s: float = 1.0):
         self.store = store
         self.cache = cache if cache is not None else TileCache()
         self.render_timeout_s = render_timeout_s
+        self.max_inflight = max_inflight
+        self.retry_after_s = retry_after_s  # advertised on every 503
         self._extra_layers: dict = {}
         self._degraded_lock = threading.Lock()
         self._degraded: dict[str, str] = {}  # cause -> detail
         self._render_pool = None  # lazy; only built when timeouts are on
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0
+        self._draining = False
 
     # -- degraded state ----------------------------------------------------
 
@@ -137,7 +144,7 @@ class ServeApp:
             return 503, "application/json", body, None, "error", None
         m = _TILE_RE.match(path)
         if method == "GET" and m is not None:
-            return self._handle_tile(m, if_none_match)
+            return self._admitted_tile(m, if_none_match)
         if method == "GET" and path == "/healthz":
             body = json.dumps(self._health(), indent=2).encode()
             return 200, "application/json", body, None, "healthz", None
@@ -148,8 +155,57 @@ class ServeApp:
                     "metrics", None)
         if method == "POST" and path == "/reload":
             return self._handle_reload()
+        if method == "POST" and path in ("/drain", "/undrain"):
+            return self._handle_drain(path == "/drain")
         body = json.dumps({"error": "not found", "path": path}).encode()
         return 404, "application/json", body, None, "other", None
+
+    # -- admission + drain -------------------------------------------------
+
+    def _handle_drain(self, draining: bool):
+        """Graceful drain: in-flight requests finish, new tile traffic
+        sheds with a typed 503 until ``/undrain``. The fleet router
+        drains a backend router-side first (pulls it from the ring),
+        then forwards here so directly-addressed clients shed too."""
+        self._draining = draining
+        if draining:
+            self._degrade("drain", "draining: shedding tile traffic")
+        else:
+            self._recover("drain")
+        with self._inflight_lock:
+            inflight = self._inflight
+        body = json.dumps({"draining": draining,
+                           "inflight": inflight}).encode()
+        return 200, "application/json", body, None, "drain", None
+
+    def _admitted_tile(self, m, if_none_match):
+        """Tile dispatch behind the drain gate and the in-flight bound.
+        Shed responses are typed 503s (never 500) and edge-trigger the
+        ``shed`` degradation cause so /healthz names why."""
+        if self._draining:
+            body = json.dumps({"error": "service unavailable",
+                               "cause": "drain"}).encode()
+            return 503, "application/json", body, None, "tiles", None
+        if self.max_inflight is None:
+            return self._handle_tile(m, if_none_match)
+        with self._inflight_lock:
+            if self._inflight >= self.max_inflight:
+                admitted = False
+            else:
+                admitted = True
+                self._inflight += 1
+        if not admitted:
+            self._degrade("shed",
+                          f"in-flight bound {self.max_inflight} reached")
+            body = json.dumps({"error": "service unavailable",
+                               "cause": "shed"}).encode()
+            return 503, "application/json", body, None, "tiles", None
+        try:
+            self._recover("shed")
+            return self._handle_tile(m, if_none_match)
+        finally:
+            with self._inflight_lock:
+                self._inflight -= 1
 
     def _handle_reload(self):
         try:
@@ -255,6 +311,9 @@ class ServeApp:
             }
         stats["cache"] = {"entries": len(self.cache),
                           "bytes": self.cache.nbytes}
+        with self._inflight_lock:
+            stats["inflight"] = self._inflight
+        stats["draining"] = self._draining
         causes = self.degraded_causes()
         stats["status"] = "degraded" if causes else "ok"
         if causes:
@@ -293,6 +352,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            if status == 503:
+                # Shed/drain/degraded answers are retryable by
+                # construction; tell well-behaved clients when.
+                retry_after = getattr(self.app, "retry_after_s", 1.0)
+                self.send_header("Retry-After",
+                                 str(max(1, round(retry_after))))
             if etag is not None:
                 self.send_header("ETag", etag)
             tp = tracing.current_traceparent()

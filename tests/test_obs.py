@@ -68,6 +68,9 @@ _PAYLOADS = {
                            "threshold_s": 1.9},
     "speculative_win": {"shard": "3", "winner": "1", "loser": "0",
                         "quarantined": "quarantine/shard-00003-ab-loser"},
+    "fleet_backend_down": {"backend": "b2", "reason": "probe_failures",
+                           "detail": "3 consecutive probe failures"},
+    "fleet_backend_up": {"backend": "b2", "detail": "half-open probe ok"},
     "slo_breach": {"slo": "tiles-fast", "burn_rate": 2.5,
                    "kind": "latency", "compliance": 0.9975,
                    "target": 0.999, "window_s": 300.0,

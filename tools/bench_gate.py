@@ -9,7 +9,9 @@ Reads every bench artifact the repo's tooling writes —
 - ``BENCH_delta.json``  (tools/bench_delta.py): per-ratio incremental
   apply seconds (lower is better) and full/incremental speedup;
 - ``BENCH_serve.json``  (tools/load_gen.py): rps (higher) and p99
-  latency ms (lower);
+  latency ms (lower), plus the fleet scaling curve
+  (``serve:fleet:rps[N]`` / ``p99_ms[N]``) and kill-one-backend
+  availability when ``--fleet`` was run;
 - ``BENCH_ingest.json`` (tools/bench_ingest.py): per micro-batch and
   padding mode, sustained points/sec (higher) and ingest->servable
   p99 lag ms (lower);
@@ -97,6 +99,21 @@ def snapshot_metrics(root: str) -> dict:
         p99 = (doc.get("latency_ms") or {}).get("p99")
         if isinstance(p99, (int, float)):
             out["serve:p99_ms"] = (float(p99), False)
+        # Fleet scaling curve + kill-one availability (load_gen --fleet).
+        fleet = doc.get("fleet") or {}
+        for row in fleet.get("curve", []):
+            n = row.get("n")
+            if n is None:
+                continue
+            if isinstance(row.get("rps"), (int, float)):
+                out[f"serve:fleet:rps[{n}]"] = (float(row["rps"]), True)
+            p99 = (row.get("latency_ms") or {}).get("p99")
+            if isinstance(p99, (int, float)):
+                out[f"serve:fleet:p99_ms[{n}]"] = (float(p99), False)
+        kill = fleet.get("kill_one") or {}
+        if isinstance(kill.get("availability"), (int, float)):
+            out["serve:fleet:kill_one_availability"] = (
+                float(kill["availability"]), True)
     doc = _load(os.path.join(root, "BENCH_ingest.json"))
     if isinstance(doc, dict):
         for row in doc.get("results", []):

@@ -13,8 +13,12 @@ byte-identical to a one-shot apply. A host-loss phase kills one
 simulated host mid-cascade (its heartbeats eaten by the
 ``multihost.heartbeat`` site) and requires the elastic layer
 (heatmap_tpu/parallel/elastic.py) to reassign its shards and still
-produce byte-identical arrays and tiles. The chaos run must converge to
-the *same bytes*:
+produce byte-identical arrays and tiles. A backend-loss phase SIGKILLs
+one process of a 3-backend serve fleet (serve/fleet.py) under Zipf
+load: the router's failover must keep clients at zero 5xx, the breaker
+must open and re-close through the supervisor restart + half-open
+probe, and the recovered fleet must serve bytes identical to the clean
+single-process run. The chaos run must converge to the *same bytes*:
 level arrays, journal state, and every served JSON tile. Along the way
 the HTTP tier must degrade gracefully (typed 503s / stale serves,
 ``/healthz`` reporting ``degraded``) and never return a 500.
@@ -31,9 +35,11 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import shutil
 import sys
 import tempfile
+import threading
 import time
 import traceback
 import urllib.error
@@ -458,6 +464,107 @@ def phase_host_loss(ctx):
             "levels": len(a), "tiles": len(want)}
 
 
+def phase_backend_loss(ctx):
+    """Serve-fleet resilience: SIGKILL one backend of a 3-process fleet
+    under Zipf load. The router's connection-failure retry must keep
+    the client at zero 5xx, the victim's breaker must open
+    (``fleet_backend_down``) and re-close through the supervisor
+    restart + half-open probe (``fleet_backend_up``), and every tile
+    served through the fleet afterwards must be byte-identical to the
+    clean single-process run (``base_docs``)."""
+    from heatmap_tpu.serve.fleet import FleetSupervisor
+
+    faults.install(None)
+    spec = f"delta:{ctx['base_root']}"
+    coords = _tile_coords(TileStore(spec))
+    tmp = os.path.dirname(ctx["base_root"])
+    events_path = os.path.join(tmp, "fleet-events.jsonl")
+    ev_log = obs.EventLog(events_path)
+    obs.set_event_log(ev_log)
+    codes: dict = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+    sup = FleetSupervisor(spec, 3, cache_bytes=64 << 20,
+                          render_timeout_s=30.0, probe_interval_s=0.2,
+                          restart_base_s=0.1, restart_cap_s=1.0)
+    try:
+        sup.start()
+        server, base = serve_in_thread(sup.router)
+
+        def load_loop(seed):
+            rng = random.Random(seed)
+            while not stop.is_set():
+                # The load_gen 80/20 skew: hot-set traffic plus a tail.
+                if rng.random() < 0.8:
+                    name, z, x, y = coords[rng.randrange(
+                        max(1, len(coords) // 5))]
+                else:
+                    name, z, x, y = coords[rng.randrange(len(coords))]
+                status, _ = _get(
+                    f"{base}/tiles/{urllib.parse.quote(name, safe='')}"
+                    f"/{z}/{x}/{y}.json")
+                with lock:
+                    codes[status] = codes.get(status, 0) + 1
+
+        drivers = [threading.Thread(target=load_loop, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in drivers:
+            t.start()
+        time.sleep(1.0)  # warm traffic across the whole ring
+        victim = sorted(sup.router.backends)[0]
+        sup.kill_backend(victim)
+        # Two-stage wait: right after SIGKILL the breaker has not yet
+        # tripped, so /healthz still reports a full ring — polling for
+        # eligible==3 straight away would "recover" instantly. First
+        # wait for the victim to actually leave the ring, then for the
+        # supervisor restart + half-open probe to re-admit it.
+        def wait_ring(pred, what, timeout_s):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                status, body = _get(f"{base}/healthz")
+                if status == 200:
+                    eligible = json.loads(body)["fleet"]["eligible"]
+                    if pred(eligible):
+                        return
+                time.sleep(0.05)
+            raise AssertionError(f"victim {victim} never {what}: {codes}")
+
+        wait_ring(lambda e: victim not in e, "left the ring", 30.0)
+        wait_ring(lambda e: victim in e and len(e) == 3,
+                  "re-admitted", 60.0)
+        time.sleep(0.5)  # a little post-recovery traffic
+        stop.set()
+        for t in drivers:
+            t.join(timeout=10.0)
+        fives = {s: c for s, c in codes.items() if 500 <= s < 600}
+        assert not fives, f"fleet served 5xx during backend loss: {codes}"
+        # Byte-equality through the recovered fleet, incl. the victim.
+        docs = _fetch_all(base, coords,
+                          {"codes": {}, "saw_degraded": False})
+        server.shutdown()
+        server.server_close()
+    finally:
+        stop.set()
+        sup.stop()
+        obs.set_event_log(None)
+        ev_log.close()
+    base_docs = ctx["base_docs"]
+    assert sorted(docs) == sorted(base_docs), (
+        f"fleet tile set diverged: {len(docs)} vs {len(base_docs)}")
+    mism = [k for k in docs if docs[k] != base_docs[k]]
+    assert not mism, f"{len(mism)} fleet tiles diverged, e.g. {mism[:3]}"
+    events = [json.loads(line) for line in open(events_path)]
+    downs = [e for e in events if e["event"] == "fleet_backend_down"
+             and e["backend"] == victim]
+    ups = [e for e in events if e["event"] == "fleet_backend_up"
+           and e["backend"] == victim]
+    assert downs, f"no fleet_backend_down for {victim}: {events}"
+    assert ups, f"no fleet_backend_up for {victim}: {events}"
+    return {"victim": victim, "codes": {str(k): v for k, v in codes.items()},
+            "tiles": len(docs), "down_events": len(downs),
+            "up_events": len(ups)}
+
+
 PHASES = [
     ("baseline", phase_baseline),
     ("chaos_pipeline", phase_chaos_pipeline),
@@ -466,6 +573,7 @@ PHASES = [
     ("fault_floor", phase_fault_floor),
     ("ingest_crash", phase_ingest_crash),
     ("host_loss", phase_host_loss),
+    ("backend_loss", phase_backend_loss),
     ("byte_equality", phase_byte_equality),
 ]
 

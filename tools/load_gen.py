@@ -36,10 +36,12 @@ import http.client
 import json
 import os
 import random
+import subprocess
 import sys
 import tempfile
 import threading
 import time
+import urllib.parse
 
 import numpy as np
 
@@ -126,6 +128,161 @@ class Worker(threading.Thread):
         conn.close()
 
 
+def _drive(args) -> int:
+    """``--drive URL`` mode: act as a pure load client against an
+    already-running server (the fleet bench spawns several of these as
+    subprocesses so the *client* is not capped by one GIL). Prints one
+    JSON result line on stdout."""
+    parsed = urllib.parse.urlsplit(args.drive)
+    with open(args.universe_file) as f:
+        universe = [tuple(t) for t in json.load(f)]
+    stop_at = time.monotonic() + args.duration
+    workers = [Worker(parsed.hostname, parsed.port, universe, stop_at,
+                      seed=args.seed_base + i)
+               for i in range(args.workers)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    statuses: dict = {}
+    for w in workers:
+        for s, c in w.statuses.items():
+            statuses[str(s)] = statuses.get(str(s), 0) + c
+    print(json.dumps({
+        "latencies_ms": [round(v, 3) for w in workers
+                         for v in w.latencies_ms],
+        "statuses": statuses,
+        "errors": int(sum(w.errors for w in workers)),
+    }), flush=True)
+    return 0
+
+
+def _drive_clients(base_url: str, universe, duration: float, *,
+                   workers: int, procs: int, tmpdir: str):
+    """Fan the Zipf client out over ``procs`` subprocesses; returns
+    (sorted latencies ms, statuses, errors)."""
+    universe_file = os.path.join(tmpdir, "universe.json")
+    with open(universe_file, "w") as f:
+        json.dump([list(t) for t in universe], f)
+    children = []
+    for i in range(procs):
+        children.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--drive", base_url, "--universe-file", universe_file,
+             "--duration", str(duration), "--workers", str(workers),
+             "--seed-base", str(1000 * i)],
+            stdout=subprocess.PIPE, text=True))
+    latencies: list = []
+    statuses: dict = {}
+    errors = 0
+    for child in children:
+        out, _ = child.communicate(timeout=duration + 120)
+        result = json.loads(out.strip().splitlines()[-1])
+        latencies += result["latencies_ms"]
+        errors += result["errors"]
+        for s, c in result["statuses"].items():
+            statuses[s] = statuses.get(s, 0) + c
+    return np.sort(np.asarray(latencies)), statuses, errors
+
+
+def _lat_summary(lat) -> dict:
+    def pct(p):
+        return round(float(lat[min(len(lat) - 1, int(p * len(lat)))]), 3) \
+            if len(lat) else None
+
+    return {"p50": pct(0.50), "p90": pct(0.90), "p99": pct(0.99),
+            "max": round(float(lat[-1]), 3) if len(lat) else None}
+
+
+def _warm(base_url: str, universe):
+    parsed = urllib.parse.urlsplit(base_url)
+    conn = http.client.HTTPConnection(parsed.hostname, parsed.port,
+                                      timeout=30)
+    for layer, z, x, y, fmt in universe:
+        conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
+        conn.getresponse().read()
+    conn.close()
+
+
+def _fleet_bench(args, spec: str, universe, tmpdir: str) -> dict:
+    """The N=1/2/4 scaling curve + kill-one-backend availability, all
+    through real child serve processes and a threaded router frontend.
+
+    Honest-measurement note: on a single-core host the backends (and
+    the client subprocesses) serialize on the same CPU, so the curve
+    records whatever this host can actually show — ``host_cores`` is
+    in the record so the gate's trend comparisons stay like-for-like.
+    """
+    from heatmap_tpu.serve import serve_in_thread
+    from heatmap_tpu.serve.fleet import FleetSupervisor
+
+    sizes = [int(n) for n in args.fleet.split(",") if n.strip()]
+    curve = []
+    for n in sizes:
+        with FleetSupervisor(spec, n, cache_bytes=args.cache_bytes,
+                             probe_interval_s=0.25) as sup:
+            sup.start()
+            server, base = serve_in_thread(sup.router)
+            _warm(base, universe)
+            t0 = time.perf_counter()
+            lat, statuses, errors = _drive_clients(
+                base, universe, args.fleet_duration,
+                workers=args.workers, procs=args.drive_procs,
+                tmpdir=tmpdir)
+            measured_s = time.perf_counter() - t0
+            server.shutdown()
+            server.server_close()
+        row = {"n": n, "requests": int(len(lat)), "errors": errors,
+               "statuses": statuses,
+               "rps": round(len(lat) / measured_s, 1) if measured_s else None,
+               "latency_ms": _lat_summary(lat)}
+        curve.append(row)
+        print(json.dumps({"fleet_n": n, "rps": row["rps"],
+                          "p99_ms": row["latency_ms"]["p99"]}), flush=True)
+
+    # Kill-one availability at the largest N: SIGKILL a backend a third
+    # of the way through the window; router failover + supervisor
+    # restart should keep 5xx at zero.
+    n = max(sizes)
+    with FleetSupervisor(spec, n, cache_bytes=args.cache_bytes,
+                         probe_interval_s=0.25) as sup:
+        sup.start()
+        server, base = serve_in_thread(sup.router)
+        _warm(base, universe)
+        victim = sorted(sup.router.backends)[0]
+        killer = threading.Timer(args.fleet_duration / 3,
+                                 sup.kill_backend, args=(victim,))
+        killer.start()
+        lat, statuses, errors = _drive_clients(
+            base, universe, args.fleet_duration,
+            workers=args.workers, procs=args.drive_procs, tmpdir=tmpdir)
+        killer.cancel()
+        server.shutdown()
+        server.server_close()
+    total = int(len(lat)) + errors
+    fives = sum(c for s, c in statuses.items() if s.startswith("5"))
+    kill_one = {
+        "n": n, "victim": victim, "requests": int(len(lat)),
+        "errors": errors, "statuses": statuses, "status_5xx": int(fives),
+        "availability": round((total - fives - errors) / total, 6)
+        if total else None,
+        "latency_ms": _lat_summary(lat),
+    }
+    print(json.dumps({"fleet_kill_one": kill_one["availability"],
+                      "status_5xx": fives}), flush=True)
+    return {
+        "host_cores": os.cpu_count(),
+        "workers_per_client": args.workers,
+        "client_procs": args.drive_procs,
+        "duration_s": args.fleet_duration,
+        "curve": curve,
+        "kill_one": kill_one,
+        "note": "backends are real child processes; on hosts with few "
+                "cores the curve is serialized on the CPU and "
+                "understates multi-core scaling",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--store", default=None,
@@ -141,7 +298,24 @@ def main() -> int:
     ap.add_argument("--cache-bytes", type=int, default=256 << 20)
     ap.add_argument("--ttl", type=float, default=None)
     ap.add_argument("--out", default="BENCH_serve.json")
+    ap.add_argument("--fleet", default=None, metavar="N1,N2,...",
+                    help="also bench the serve fleet at these backend "
+                    "counts (e.g. 1,2,4) plus a kill-one-backend "
+                    "availability run at the largest N")
+    ap.add_argument("--fleet-duration", type=float, default=6.0,
+                    help="measured seconds per fleet cell")
+    ap.add_argument("--drive-procs", type=int, default=2,
+                    help="client subprocesses per fleet cell (keeps the "
+                    "load generator off a single GIL)")
+    # --drive mode internals (subprocess client; not for direct use).
+    ap.add_argument("--drive", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--universe-file", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--seed-base", type=int, default=0,
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.drive:
+        return _drive(args)
 
     from heatmap_tpu import obs
     from heatmap_tpu.serve import ServeApp, TileCache, TileStore, serve_in_thread
@@ -205,6 +379,11 @@ def main() -> int:
     misses = CACHE_MISSES.value() - misses0
     total = hits + misses
 
+    fleet = None
+    if args.fleet:
+        with tempfile.TemporaryDirectory(prefix="loadgen-fleet-") as scratch:
+            fleet = _fleet_bench(args, spec, universe, scratch)
+
     def pct(p):
         return round(float(lat[min(len(lat) - 1, int(p * len(lat)))]), 3) \
             if len(lat) else None
@@ -225,6 +404,7 @@ def main() -> int:
                        "max": round(float(lat[-1]), 3) if len(lat) else None},
         "hit_rate": round(hits / total, 4) if total else None,
         "cache": {"entries": len(cache), "bytes": cache.nbytes},
+        **({"fleet": fleet} if fleet else {}),
         # Same folded block bench_job.py embeds: serve benches stay
         # schema-compatible with job benches in the bench trajectory.
         "run_report": obs.build_run_report(tracer=get_tracer(),
