@@ -720,7 +720,22 @@ def cmd_tiles(args) -> int:
     return 0
 
 
+def _live_dir(args) -> str:
+    """Root for runtime tile artifacts (the --live-dir knob): explicit
+    flag > checkpoint dir > system tmp — never the CWD, so streaming
+    runs and tests stop littering the working directory."""
+    if getattr(args, "live_dir", None):
+        return args.live_dir
+    if getattr(args, "checkpoint_dir", None):
+        return args.checkpoint_dir
+    import tempfile
+
+    return os.path.join(tempfile.gettempdir(), "heatmap-tpu")
+
+
 def cmd_stream(args) -> int:
+    if args.output is None:
+        args.output = os.path.join(_live_dir(args), "live_tiles")
     if args.half_life <= 0:
         raise SystemExit(f"--half-life {args.half_life}: must be positive")
     if args.zoom < args.pixel_delta:
@@ -923,7 +938,8 @@ def cmd_serve(args) -> int:
     cache = TileCache(max_bytes=args.cache_bytes,
                       ttl_s=ttl if (ttl and ttl > 0) else None)
     app = ServeApp(store, cache,
-                   render_timeout_s=getattr(args, "render_timeout", None))
+                   render_timeout_s=getattr(args, "render_timeout", None),
+                   synopsis_default=getattr(args, "synopsis_default", False))
     stop_stream = None
     if args.follow_stream:
         stop_stream = _follow_stream(args, app)
@@ -1694,9 +1710,14 @@ def build_parser() -> argparse.ArgumentParser:
     _add_backend_flags(p_stream)
     p_stream.add_argument("--input", required=True,
                           help="source spec, consumed as micro-batches")
-    p_stream.add_argument("--output", default="live_tiles",
+    p_stream.add_argument("--output", default=None,
                           help="PNG tile tree dir for the final snapshot "
-                          "('' = none)")
+                          "('' = none; default: live_tiles/ under "
+                          "--live-dir)")
+    p_stream.add_argument("--live-dir", default=None,
+                          help="root for runtime tile artifacts (default: "
+                          "--checkpoint-dir when given, else the system "
+                          "tmp dir)")
     p_stream.add_argument("--batch-points", type=int, default=1 << 16,
                           help="points per micro-batch (one compiled step)")
     p_stream.add_argument("--bin-backend", default="auto",
@@ -1755,6 +1776,10 @@ def build_parser() -> argparse.ArgumentParser:
                          help="comma list of name=user|timespan layer "
                          "mounts (default: every slice in the artifact "
                          "plus 'default' -> all|alltime)")
+    p_serve.add_argument("--synopsis-default", action="store_true",
+                         help="serve coarse tiles from wavelet synopses "
+                         "by default (docs/synopsis.md); per-request "
+                         "?synopsis=0/1 always wins")
     p_serve.add_argument("--render-timeout", type=float, default=None,
                          metavar="S",
                          help="per-tile render deadline in seconds; a "

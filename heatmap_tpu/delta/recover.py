@@ -25,7 +25,12 @@ What gets quarantined:
   re-applies cleanly, exactly once);
 - ``base-XXXXXX`` dirs other than CURRENT's base (a compaction that
   crashed between publishing the new base and flipping the pointer, or
-  between flipping and pruning).
+  between flipping and pruning);
+- torn or schema-invalid ``synopsis-z*.npz`` artifacts inside CURRENT's
+  base (and their orphan ``.tmp`` staging files). Serving already skips
+  unreadable synopses — exact levels answer instead — so this step only
+  makes the corruption visible and stops every reload from re-reading a
+  bad file.
 
 Digest verification re-hashes artifact bytes, so results are memoised
 per entry file identity (path, size, mtime_ns) — journaled entries and
@@ -245,6 +250,22 @@ def sweep(root: str, *, verify: bool = True) -> dict:
             # 4. Bases CURRENT does not point at (crashed compaction).
             if name != cur.get("base"):
                 _quarantine(root, full, "orphan_base", "base", items)
+
+    # 5. Torn synopsis artifacts inside CURRENT's base.
+    base = cur.get("base")
+    bdir = os.path.join(root, base) if base else None
+    if bdir and os.path.isdir(bdir):
+        from heatmap_tpu.synopsis.build import verify_synopsis
+
+        for name in sorted(os.listdir(bdir)):
+            full = os.path.join(bdir, name)
+            if name.startswith("synopsis-") and name.endswith(".tmp"):
+                _quarantine(root, full, "orphan_tmp", "synopsis", items)
+            elif name.startswith("synopsis-z") and name.endswith(".npz"):
+                detail = verify_synopsis(full)
+                if detail is not None:
+                    _quarantine(root, full, "torn_synopsis", "synopsis",
+                                items, detail)
 
     quarantine_bytes(root)  # refresh the growth gauge every sweep
     return {"quarantined": items}

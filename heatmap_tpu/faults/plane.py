@@ -19,7 +19,9 @@ functions), ``http.request`` (ServeApp dispatch), and
 ``multihost.heartbeat`` (a *lost* heartbeat: obs.heartbeat swallows the
 fault and skips the liveness update instead of failing the caller),
 ``ingest.tick`` / ``ingest.publish`` (continuous-ingest micro-batch
-boundaries), ``elastic.reassign`` (each orphaned-shard re-execution
+boundaries), ``ingest.synopsis`` (the loop's best-effort provisional
+synopsis publish for early serving — a terminal failure is swallowed,
+never kills the loop), ``elastic.reassign`` (each orphaned-shard re-execution
 on a surviving host — parallel/elastic.py), ``router.forward`` (one
 check per fleet-router forward attempt to a backend — serve/router.py;
 an injected fault reads as a connection failure and burns the
@@ -66,6 +68,7 @@ SITES = (
     "multihost.heartbeat",
     "ingest.tick",
     "ingest.publish",
+    "ingest.synopsis",
     "elastic.reassign",
     "router.forward",
     "backend.probe",

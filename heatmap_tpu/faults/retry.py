@@ -117,6 +117,12 @@ POLICIES = {
                                deadline_s=10.0),
     "ingest.publish": RetryPolicy(retries=3, base_s=0.02, cap_s=0.5,
                                   deadline_s=10.0),
+    # Provisional synopsis publish (early serving). Best-effort by
+    # contract — the exact apply supersedes it either way — so the
+    # budget is small and the loop swallows a terminal failure instead
+    # of dying.
+    "ingest.synopsis": RetryPolicy(retries=2, base_s=0.02, cap_s=0.5,
+                                   deadline_s=10.0),
     # Orphaned-shard re-execution on a surviving host. The shard
     # already failed once on the dead host, so the retry budget here
     # guards only the survivor's own transients; a shard that also

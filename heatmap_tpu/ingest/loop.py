@@ -32,6 +32,16 @@ The loop is a first-class citizen of the existing planes:
   tick safe. Crash mid-tick heals byte-identical through
   ``delta/recover.py`` on the next apply's startup sweep.
 
+**Early serving** (docs/synopsis.md): before the exact apply, a tick
+overlays the micro-batch's coarse cell counts onto the store's decoded
+wavelet-synopsis views (``TileStore.publish_provisional``) under the
+``ingest.synopsis`` fault site — a cheap numpy projection, no cascade.
+``?synopsis=1`` tiles reflect the batch immediately, marked
+``stale=1``, until the exact apply's ``refresh_serving`` supersedes
+them. The publish is best-effort by contract: a terminal failure is
+swallowed (the exact path is unaffected), and a duplicate tick's
+overlay is discarded by an immediate ``refresh_layers``.
+
 Timestamps: event time comes from the batches' ``timestamp`` column
 (the watermark); loop durations use ``time.monotonic()``. Wall-clock
 sleeps, prints, and perf_counter are banned here by the obs grep
@@ -156,6 +166,9 @@ class IngestConfig:
     retention: int = 2
     #: Stop after this many ticks (None = drain the source).
     max_ticks: int | None = None
+    #: Publish a provisional synopsis overlay before each exact apply
+    #: (no-op when the serve store carries no synopsis views).
+    provisional_synopsis: bool = True
 
     def __post_init__(self):
         if self.micro_batch < 1:
@@ -180,6 +193,85 @@ class IngestStats:
     compactions: int = 0
     keys_invalidated: int = 0
     seconds: float = 0.0
+
+
+def _provisional_rows(store, cols, config, sign: int) -> dict:
+    """Coarse cell rows for the serve store's synopsis zooms, computed
+    from one micro-batch: ``{(user, timespan): {zoom: (rows, cols,
+    values)}}`` in the shape ``TileStore.publish_provisional`` takes.
+
+    A cheap host-side shadow of the cascade's grouping (route_user /
+    'all' aggregation / timespan labels) — exact for the counts it
+    covers, best-effort by contract: zooms with no synopsis view,
+    timespan types the batch cannot label, and the ``amplify_all``
+    compat recurrence (not reproducible per-batch) all fall out as
+    empty, and the exact apply supersedes everything it publishes.
+    """
+    targets: dict[tuple, list] = {}
+    for name in store.layer_names():
+        layer = store.layer(name)
+        syn = getattr(layer, "synopses", None)
+        if syn:
+            targets[(layer.user, layer.timespan)] = sorted(syn)
+    if not targets or getattr(config, "amplify_all", False):
+        return {}
+    import numpy as np
+
+    from heatmap_tpu.pipeline import groups, timespan
+    from heatmap_tpu.tilemath.mercator import project_points_np
+
+    lat = np.asarray(cols.get("latitude", ()), np.float64)
+    n = len(lat)
+    if n == 0:
+        return {}
+    lon = np.asarray(cols["longitude"], np.float64)
+    user_ids = cols.get("user_id") or [""] * n
+    routed = np.empty(n, object)  # None = excluded (x-prefix)
+    for i, uid in enumerate(user_ids):
+        routed[i] = groups.route_user(uid)
+    if getattr(config, "weighted", False) and cols.get("value") is not None:
+        weights = np.asarray(cols["value"], np.float64) * float(sign)
+    else:
+        weights = np.full(n, float(sign))
+    vocab = timespan.TimespanVocab()
+    label_cols = []
+    stamps = cols.get("timestamp")
+    for ts_type in getattr(config, "timespans", ("alltime",)):
+        try:
+            label_cols.append(vocab.label_ids(
+                ts_type, stamps if stamps is not None else [None] * n))
+        except (TypeError, ValueError):
+            continue  # dated type without usable timestamps
+        if getattr(config, "first_timespan_only", False):
+            break
+    if not label_cols:
+        return {}
+    umasks = {}
+    for user, _ in targets:
+        if user not in umasks:
+            if user == groups.ALL_NAME:
+                umasks[user] = np.array([r is not None for r in routed])
+            else:
+                umasks[user] = routed == user
+    out: dict[tuple, dict] = {}
+    zooms = sorted({z for zs in targets.values() for z in zs})
+    for zoom in zooms:
+        rr, cc, valid = project_points_np(lat, lon, zoom)
+        for (user, ts_name), pair_zooms in targets.items():
+            if zoom not in pair_zooms:
+                continue
+            tid = vocab.id_for(ts_name)
+            tmask = np.zeros(n, bool)
+            for ids in label_cols:
+                tmask |= ids == tid
+            sel = umasks[user] & tmask & np.asarray(valid, bool)
+            if not sel.any():
+                continue
+            out.setdefault((user, ts_name), {})[zoom] = (
+                np.asarray(rr, np.int64)[sel],
+                np.asarray(cc, np.int64)[sel],
+                weights[sel])
+    return out
 
 
 def _event_watermark(cols) -> float | None:
@@ -224,6 +316,21 @@ def run_ingest(root: str, source, config=None, *,
     def _tick(cols, ctx: TickContext):
         t0 = time.monotonic()
         with tracing.span("ingest.tick", tick=ctx.index):
+            provisional = 0
+            if store is not None and ing.provisional_synopsis:
+                def _early():
+                    rows_by = _provisional_rows(store, cols, config,
+                                                ing.sign)
+                    return store.publish_provisional(rows_by)
+
+                # Best-effort early serving: a terminal failure here
+                # must not cost the tick its exact apply.
+                try:
+                    provisional = faults.retry_call(
+                        _early, site="ingest.synopsis", key=ctx.index)
+                except Exception:
+                    provisional = 0
+
             def _apply():
                 return delta_mod.apply_batch(
                     root, delta_mod.ColumnsSource(cols), config,
@@ -232,6 +339,10 @@ def run_ingest(root: str, source, config=None, *,
             result = faults.retry_call(
                 _apply, site="ingest.tick", key=ctx.index)
             invalidated = 0
+            if store is not None and result.duplicate and provisional:
+                # The overlay double-counted an already-applied batch;
+                # rebuilding the index discards every provisional view.
+                store.refresh_layers()
             if store is not None and not result.duplicate:
                 invalidated = faults.retry_call(
                     delta_mod.refresh_serving, result, store, cache,

@@ -231,6 +231,9 @@ class LevelArraysSink:
 
     path: str
     format: str = "npz"
+    #: Also publish wavelet ``synopsis-z*.npz`` artifacts alongside the
+    #: exact levels (``arrays-synopsis:DIR`` spec; heatmap_tpu.synopsis).
+    synopses: bool = False
 
     def __post_init__(self):
         if self.format not in ("npz", "npz-compressed", "parquet"):
@@ -250,6 +253,8 @@ class LevelArraysSink:
 
     def write_levels(self, levels) -> int:
         rows = 0
+        if self.synopses:
+            levels = list(levels)  # consumed twice: levels + synopses
         for lvl in levels:
             out = {k: np.asarray(lvl[k]) for k in self.COLUMNS}
             out["zoom"] = np.asarray(lvl["zoom"])
@@ -299,6 +304,13 @@ class LevelArraysSink:
             if obs.metrics_enabled():
                 obs.SINK_ROWS.inc(len(out["value"]), sink="arrays")
                 obs.SINK_BYTES.inc(os.path.getsize(final), sink="arrays")
+        if self.synopses:
+            # Build from the in-memory finalized levels — no re-read.
+            # Synopsis artifacts are npz regardless of the level format.
+            from heatmap_tpu.synopsis import write_synopses
+
+            write_synopses(self.path,
+                           {int(lvl["zoom"]): lvl for lvl in levels})
         return rows
 
     def write(self, records):
@@ -428,7 +440,7 @@ def per_process_sink_spec(spec: str, process_index: int) -> str:
     if kind == "jsonl" or (not rest and spec.endswith((".jsonl", ".ndjson"))):
         path = rest or spec
         return f"jsonl:{path}.{tag}"
-    if kind in ("arrays", "arrays-parquet", "dir"):
+    if kind in ("arrays", "arrays-parquet", "arrays-synopsis", "dir"):
         return f"{kind}:{os.path.join(rest, 'host' + f'{process_index:03d}')}"
     if kind in ("memory", "cassandra"):
         return spec
@@ -436,8 +448,8 @@ def per_process_sink_spec(spec: str, process_index: int) -> str:
 
 
 #: Sink spec kinds ``open_sink`` accepts, in help order.
-SINK_KINDS = ("jsonl", "arrays", "arrays-parquet", "dir", "memory",
-              "cassandra")
+SINK_KINDS = ("jsonl", "arrays", "arrays-parquet", "arrays-synopsis",
+              "dir", "memory", "cassandra")
 
 
 def validate_sink_spec(spec: str) -> str:
@@ -468,6 +480,8 @@ def open_sink(spec: str) -> BlobSink:
         return LevelArraysSink(rest)
     if kind == "arrays-parquet":
         return LevelArraysSink(rest, format="parquet")
+    if kind == "arrays-synopsis":
+        return LevelArraysSink(rest, synopses=True)
     if kind == "dir":
         return DirectoryBlobSink(rest)
     if kind == "memory":

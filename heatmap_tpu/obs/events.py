@@ -130,6 +130,17 @@ EVENT_SCHEMA = {
     "slo_breach": {"required": ("slo", "burn_rate"),
                    "optional": ("kind", "compliance", "target",
                                 "window_s", "detail")},
+    # synopsis/build.py: one wavelet-synopsis artifact published for a
+    # coarse level (egress, compaction rebuild, or the ingest loop's
+    # provisional early-serve build). max_err is the stamped L-inf
+    # bound (the ACHIEVED worst cell error across pairs).
+    "synopsis_built": {"required": ("zoom", "pairs", "bytes", "max_err"),
+                       "optional": ("coefficients", "path", "provisional")},
+    # serve/http.py: a tile was answered from a decoded synopsis
+    # (?synopsis=1 or layer policy). stale=True marks a provisional
+    # early-serve overlay not yet superseded by the exact apply.
+    "synopsis_served": {"required": ("layer", "zoom", "max_err"),
+                        "optional": ("stale", "source_zoom")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",

@@ -4,6 +4,14 @@ Routes:
 
 - ``GET /tiles/{layer}/{z}/{x}/{y}.png``  — colormapped tile image
 - ``GET /tiles/{layer}/{z}/{x}/{y}.json`` — reference-compatible counts
+- ``?synopsis=1`` on a tile URL opts into the wavelet-synopsis path
+  (docs/synopsis.md): when the source zoom the exact path would use
+  carries a decoded synopsis, the tile is synthesized from it and the
+  response carries ``X-Heatmap-Synopsis: max_err=<n>`` plus a
+  ``"syn-``-prefixed ETag (approximate and exact bytes must never
+  revalidate against each other). Without a synopsis at that zoom —
+  including every ``z >= synopsis_max_z`` request — the exact path
+  answers byte-identically to an un-annotated request.
 - ``GET /healthz``                        — store/cache stats (JSON)
 - ``GET /metrics``                        — Prometheus 0.0.4 text from
   the process-wide obs registry (so serving metrics sit next to any
@@ -51,7 +59,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from heatmap_tpu import faults, obs
 from heatmap_tpu.obs import slo, tracing
 from heatmap_tpu.serve.cache import TileCache
-from heatmap_tpu.serve.render import tile_json_bytes, tile_png_bytes
+from heatmap_tpu.serve.render import (SynopsisLayer, synopsis_source,
+                                      tile_json_bytes, tile_png_bytes)
 from heatmap_tpu.serve.store import TileStore
 
 _registry = obs.get_registry()
@@ -70,6 +79,31 @@ def _etag(body: bytes) -> str:
     return f'"{zlib.crc32(body):08x}"'
 
 
+def _syn_etag(body: bytes) -> str:
+    # Distinct namespace from exact ETags: a client holding exact bytes
+    # must re-fetch when it asks for a synopsis (and vice versa), even
+    # on the astronomically-unlikely crc collision.
+    return f'"syn-{zlib.crc32(body):08x}"'
+
+
+class Response(tuple):
+    """``handle()`` result. Unpacks as the historical 6-tuple
+    ``(status, content_type, body, etag, route, cache)`` — every
+    existing consumer keeps working — while optionally carrying extra
+    transport headers (``X-Heatmap-Synopsis``) in ``.headers`` for the
+    HTTP shell and the fleet router's relay to forward."""
+
+    headers: dict | None = None
+
+    def __new__(cls, status, ctype, body, etag, route, cache,
+                headers=None):
+        self = super().__new__(
+            cls, (status, ctype, body, etag, route, cache))
+        if headers:
+            self.headers = headers
+        return self
+
+
 class ServeApp:
     """Transport-free request core: ``handle()`` maps (method, path,
     if_none_match) -> (status, content_type, body, etag). The HTTP
@@ -79,12 +113,16 @@ class ServeApp:
     def __init__(self, store: TileStore, cache: TileCache | None = None,
                  *, render_timeout_s: float | None = None,
                  max_inflight: int | None = None,
-                 retry_after_s: float = 1.0):
+                 retry_after_s: float = 1.0,
+                 synopsis_default: bool = False):
         self.store = store
         self.cache = cache if cache is not None else TileCache()
         self.render_timeout_s = render_timeout_s
         self.max_inflight = max_inflight
         self.retry_after_s = retry_after_s  # advertised on every 503
+        # Layer policy for tile requests with no ?synopsis= parameter;
+        # an explicit synopsis=0/1 on the URL always wins.
+        self.synopsis_default = synopsis_default
         self._extra_layers: dict = {}
         self._degraded_lock = threading.Lock()
         self._degraded: dict[str, str] = {}  # cause -> detail
@@ -134,6 +172,8 @@ class ServeApp:
                if_none_match: str | None = None):
         """Returns ``(status, content_type, body, etag, route, cache)``;
         ``body`` is b"" for 304s, ``cache`` is "hit"/"miss"/"stale"/None.
+        Synopsis tile answers are a :class:`Response` whose ``.headers``
+        carries ``X-Heatmap-Synopsis`` (it still unpacks as the 6-tuple).
         Injected ``http.request`` faults surface as typed 503s — the
         chaos soak pins that no injected fault ever becomes a 500."""
         try:
@@ -142,9 +182,14 @@ class ServeApp:
             body = json.dumps({"error": "service unavailable",
                                "detail": str(e)}).encode()
             return 503, "application/json", body, None, "error", None
+        # The query string never participates in routing (so the fleet
+        # router's rendezvous key colocates ?synopsis=1 with the exact
+        # tile); it only carries per-request options.
+        path, _, query = path.partition("?")
         m = _TILE_RE.match(path)
         if method == "GET" and m is not None:
-            return self._admitted_tile(m, if_none_match)
+            return self._admitted_tile(m, if_none_match,
+                                       self._synopsis_opt(query))
         if method == "GET" and path == "/healthz":
             body = json.dumps(self._health(), indent=2).encode()
             return 200, "application/json", body, None, "healthz", None
@@ -178,7 +223,17 @@ class ServeApp:
                            "inflight": inflight}).encode()
         return 200, "application/json", body, None, "drain", None
 
-    def _admitted_tile(self, m, if_none_match):
+    def _synopsis_opt(self, query: str) -> bool:
+        """Resolve the ``synopsis`` query parameter (last value wins,
+        per urllib convention) against the app default."""
+        if not query:
+            return self.synopsis_default
+        vals = urllib.parse.parse_qs(query).get("synopsis")
+        if not vals:
+            return self.synopsis_default
+        return vals[-1] not in ("0", "false", "no")
+
+    def _admitted_tile(self, m, if_none_match, synopsis=False):
         """Tile dispatch behind the drain gate and the in-flight bound.
         Shed responses are typed 503s (never 500) and edge-trigger the
         ``shed`` degradation cause so /healthz names why."""
@@ -187,7 +242,7 @@ class ServeApp:
                                "cause": "drain"}).encode()
             return 503, "application/json", body, None, "tiles", None
         if self.max_inflight is None:
-            return self._handle_tile(m, if_none_match)
+            return self._handle_tile(m, if_none_match, synopsis)
         with self._inflight_lock:
             if self._inflight >= self.max_inflight:
                 admitted = False
@@ -202,7 +257,7 @@ class ServeApp:
             return 503, "application/json", body, None, "tiles", None
         try:
             self._recover("shed")
-            return self._handle_tile(m, if_none_match)
+            return self._handle_tile(m, if_none_match, synopsis)
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
@@ -223,7 +278,7 @@ class ServeApp:
         body = json.dumps({"generation": generation}).encode()
         return 200, "application/json", body, None, "reload", None
 
-    def _handle_tile(self, m, if_none_match):
+    def _handle_tile(self, m, if_none_match, synopsis=False):
         # Layer names may carry characters clients percent-encode in a
         # path segment (the delta stores' "user|timespan" keys).
         layer_name = urllib.parse.unquote(m["layer"])
@@ -236,10 +291,29 @@ class ServeApp:
                 "layers": self.layer_names(),
             }).encode()
             return 404, "application/json", body, None, "tiles", None
+        # ?synopsis=1 only takes effect when the SAME source zoom the
+        # exact path would use carries a decoded synopsis; otherwise
+        # fall through to the exact path under the exact cache key and
+        # ETag — byte-identical to an un-annotated request.
+        syn_view = syn_src = None
+        if synopsis:
+            src, view = synopsis_source(layer, z)
+            if view is not None:
+                syn_view, syn_src = view, src
+                layer = SynopsisLayer(layer)
+        if syn_view is None:
+            key = (layer_name, z, x, y, fmt)
+        else:
+            # The synopsis_epoch in the key retires approximate bytes
+            # whenever the decoded views change (reload, refresh, a
+            # provisional early-serve publish) — the generation alone
+            # does not move on a provisional overlay.
+            key = (layer_name, z, x, y, fmt, "syn",
+                   self.store.synopsis_epoch)
         render = tile_png_bytes if fmt == "png" else tile_json_bytes
         try:
             body, hit = self.cache.get_or_render(
-                (layer_name, z, x, y, fmt), self.store.generation,
+                key, self.store.generation,
                 lambda: self._render(render, layer, z, x, y, fmt),
                 fmt=fmt, stale_if_error=True)
         except Exception as e:
@@ -258,10 +332,24 @@ class ServeApp:
         if body is None:
             payload = json.dumps({"error": "empty tile"}).encode()
             return 404, "application/json", payload, None, "tiles", cache
-        etag = _etag(body)
+        extra = None
+        if syn_view is not None:
+            marker = f"max_err={syn_view.max_err:.6g}"
+            if syn_view.stale:
+                marker += "; stale=1"
+            extra = {"X-Heatmap-Synopsis": marker}
+            obs.emit("synopsis_served", layer=layer_name, zoom=int(z),
+                     max_err=float(syn_view.max_err),
+                     source_zoom=int(syn_src),
+                     **({"stale": True} if syn_view.stale else {}))
+            etag = _syn_etag(body)
+        else:
+            etag = _etag(body)
         if if_none_match is not None and etag in if_none_match:
-            return 304, _CONTENT_TYPES[fmt], b"", etag, "tiles", cache
-        return 200, _CONTENT_TYPES[fmt], body, etag, "tiles", cache
+            return Response(304, _CONTENT_TYPES[fmt], b"", etag, "tiles",
+                            cache, headers=extra)
+        return Response(200, _CONTENT_TYPES[fmt], body, etag, "tiles",
+                        cache, headers=extra)
 
     def _render(self, render, layer, z, x, y, fmt: str):
         """One tile render under the ``tile.render`` fault site and the
@@ -342,16 +430,22 @@ class _Handler(BaseHTTPRequestHandler):
             traceparent=self.headers.get("traceparent"))
         try:
             try:
-                status, ctype, body, etag, route, cache = self.app.handle(
+                result = self.app.handle(
                     method, self.path, self.headers.get("If-None-Match"))
+                status, ctype, body, etag, route, cache = result
+                extra_headers = getattr(result, "headers", None)
             except Exception as e:  # defensive: a render bug must not kill serving
                 status, ctype, route, cache = (500, "application/json",
                                                "error", None)
                 body = json.dumps({"error": repr(e)}).encode()
                 etag = None
+                extra_headers = None
             self.send_response(status)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            if extra_headers:
+                for name, value in extra_headers.items():
+                    self.send_header(name, value)
             if status == 503:
                 # Shed/drain/degraded answers are retryable by
                 # construction; tell well-behaved clients when.

@@ -155,13 +155,61 @@ def tile_png_bytes(layer: Layer, z: int, x: int, y: int):
     return raster_to_png(raster, vmax=vmax)
 
 
+class SynopsisLayer:
+    """Layer facade for synopsis rendering: the decoded synopsis level
+    replaces the exact level at every zoom that carries one, so the
+    rollup/upsample machinery above serves approximate tiles
+    unchanged. ``blob_json`` is empty on purpose — verbatim on-disk
+    documents are an exact-path contract."""
+
+    __slots__ = ("user", "timespan", "result_delta", "levels", "blob_json")
+
+    source_zoom = Layer.source_zoom
+
+    def __init__(self, layer: Layer):
+        self.user = layer.user
+        self.timespan = layer.timespan
+        self.result_delta = layer.result_delta
+        self.levels = {
+            z: (layer.synopses[z].level if z in layer.synopses else lvl)
+            for z, lvl in layer.levels.items()
+        }
+        self.blob_json = {}
+
+
+def synopsis_source(layer: Layer, z: int):
+    """Decide whether tile zoom ``z`` can be served from a synopsis:
+    returns ``(source_zoom, SynopsisView)`` when the SAME source level
+    the exact path would pick carries a decoded synopsis, else
+    ``(None, None)`` — the caller falls back to the exact path (and
+    byte-identical output), which is what happens for every
+    ``z + result_delta >= synopsis_max_z`` tile."""
+    delta = layer.result_delta
+    # Attached live layers (serve/live.py) have no synopses attribute;
+    # they always take the exact path.
+    if delta is None or not getattr(layer, "synopses", None):
+        return None, None
+    src = layer.source_zoom(z + delta)
+    view = layer.synopses.get(src) if src is not None else None
+    if view is None:
+        return None, None
+    return src, view
+
+
 def render_tile(store: TileStore, layer_name: str, z: int, x: int, y: int,
-                fmt: str):
+                fmt: str, *, synopsis: bool = False):
     """Dispatch for the HTTP layer: bytes or None (missing layer or
-    empty tile -> 404)."""
+    empty tile -> 404). ``synopsis=True`` renders from the layer's
+    decoded synopsis views where available (callers gate on
+    :func:`synopsis_source` first; with no synopsis at the source zoom
+    this falls back to exact bytes)."""
     layer = store.layer(layer_name)
     if layer is None:
         return None
+    if synopsis:
+        src, view = synopsis_source(layer, z)
+        if view is not None:
+            layer = SynopsisLayer(layer)
     if fmt == "json":
         return tile_json_bytes(layer, z, x, y)
     if fmt == "png":

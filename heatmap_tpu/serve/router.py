@@ -57,7 +57,7 @@ from collections import deque
 
 from heatmap_tpu import faults, obs
 from heatmap_tpu.obs import tracing
-from heatmap_tpu.serve.http import _TILE_RE
+from heatmap_tpu.serve.http import _TILE_RE, Response
 
 _registry = obs.get_registry()
 FLEET_REQUESTS = _registry.counter(
@@ -110,8 +110,9 @@ def rendezvous_order(key: str, backend_ids) -> list:
 def route_key(path: str) -> str:
     """The placement key for a request path: ``layer/z/x/y`` for tiles
     (format stripped, so .png and .json colocate), the raw path
-    otherwise."""
-    m = _TILE_RE.match(path)
+    otherwise. The query string is excluded, so ``?synopsis=1`` and the
+    exact tile land on the same backend and share its LRU locality."""
+    m = _TILE_RE.match(path.partition("?")[0])
     if m is not None:
         return f"{m['layer']}/{m['z']}/{m['x']}/{m['y']}"
     return path
@@ -636,7 +637,14 @@ class RouterApp:
         status, resp_headers, body = result
         etag = resp_headers.get("ETag")
         ctype = resp_headers.get("Content-Type", "application/octet-stream")
-        route = "tiles" if _TILE_RE.match(path) else "proxy"
+        route = ("tiles" if _TILE_RE.match(path.partition("?")[0])
+                 else "proxy")
+        synopsis = resp_headers.get("X-Heatmap-Synopsis")
+        if synopsis is not None:
+            # Part of the byte-equality contract: the error annotation
+            # a backend stamped must survive the fleet hop.
+            return Response(status, ctype, body, etag, route, None,
+                            headers={"X-Heatmap-Synopsis": synopsis})
         return status, ctype, body, etag, route, None
 
     # -- fleet operations --------------------------------------------------

@@ -46,6 +46,8 @@ import numpy as np
 
 from heatmap_tpu import obs
 from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.synopsis import build as synopsis_build
+from heatmap_tpu.synopsis import metrics as synopsis_metrics
 from heatmap_tpu.tilemath.keys import parse_tile_id
 from heatmap_tpu.tilemath.morton import morton_encode_np
 
@@ -85,6 +87,24 @@ class Level:
         return len(self.codes)
 
 
+class SynopsisView:
+    """One decoded wavelet synopsis level, ready to serve.
+
+    ``level`` is the decoded count grid as an ordinary :class:`Level`
+    (render.py treats it like any stored level); ``max_err`` the
+    stamped L-inf bound from the artifact header; ``stale`` marks a
+    provisional early-serve overlay (ingest published the micro-batch
+    counts before the exact apply landed).
+    """
+
+    __slots__ = ("level", "max_err", "stale")
+
+    def __init__(self, level: Level, max_err: float, stale: bool = False):
+        self.level = level
+        self.max_err = float(max_err)
+        self.stale = bool(stale)
+
+
 class Layer:
     """One (user, timespan) slice: detail levels + raw blob documents.
 
@@ -92,9 +112,14 @@ class Layer:
     tile for blob-record stores (jsonl:/dir:), so the JSON endpoint
     serves byte-identical bytes to the artifact. Columnar stores carry
     no document form; render.py rebuilds it in stored-row order.
+
+    ``synopses`` maps detail zooms to decoded :class:`SynopsisView`\\ s
+    when the artifact carries ``synopsis-z*.npz`` files; empty
+    otherwise. Exact serving never reads it.
     """
 
-    __slots__ = ("user", "timespan", "levels", "result_delta", "blob_json")
+    __slots__ = ("user", "timespan", "levels", "result_delta", "blob_json",
+                 "synopses")
 
     def __init__(self, user: str, timespan: str, result_delta: int | None):
         self.user = user
@@ -102,6 +127,7 @@ class Layer:
         self.levels: dict[int, Level] = {}
         self.result_delta = result_delta
         self.blob_json: dict[tuple, str] = {}
+        self.synopses: dict[int, SynopsisView] = {}
 
     @property
     def detail_zooms(self) -> list[int]:
@@ -140,6 +166,17 @@ def _parse_store_spec(spec: str) -> tuple[str, str]:
         f"unrecognized store spec {spec!r}: kind must be one of "
         f"{', '.join(STORE_KINDS)} (e.g. arrays:levels/)"
     )
+
+
+def _combine_cells(codes: np.ndarray, values: np.ndarray):
+    """Sum duplicate Morton cells and drop non-positive results —
+    Level wants unique sorted codes (``lookup`` probes a single row)."""
+    order = np.argsort(codes, kind="stable")
+    codes, values = codes[order], values[order]
+    uniq, starts = np.unique(codes, return_index=True)
+    sums = np.add.reduceat(values, starts) if len(values) else values
+    keep = sums > 0.0
+    return uniq[keep], sums[keep]
 
 
 def _finalized_to_loaded(merged) -> dict[int, dict]:
@@ -204,6 +241,12 @@ class TileStore:
         self._layer_spec = dict(layers) if layers else None
         self._lock = threading.Lock()
         self.generation = 0
+        # Synopsis cache token: bumped by every index swap AND every
+        # provisional publish, and folded into synopsis cache keys —
+        # approximate bytes must never outlive the view they were
+        # decoded from (exact tiles keep the cheaper generation +
+        # targeted-invalidation scheme).
+        self.synopsis_epoch = 0
         self._layers: dict[str, Layer] = {}
         self.reload(_initial=True)
 
@@ -238,6 +281,7 @@ class TileStore:
             self._layers = built
             if not _initial:
                 self.generation += 1
+            self.synopsis_epoch += 1
             generation = self.generation
         # Full reloads invalidate every cached tile via the generation
         # bump; the event makes them distinguishable from targeted
@@ -258,19 +302,35 @@ class TileStore:
         built = self._build()
         with self._lock:
             self._layers = built
+            # Fresh synopsis views supersede any provisional overlay
+            # published since the last swap (the early-serve contract).
+            self.synopsis_epoch += 1
             return self.generation
 
     def _build(self) -> dict[str, Layer]:
+        syn_dir: str | None = None
+        delta_dirs: list[str] = []
         if self.kind == "arrays":
             by_pair = self._build_from_levels(_load_levels(self.path))
+            syn_dir = self.path
         elif self.kind == "delta":
-            from heatmap_tpu.delta.compact import load_overlay_levels
+            from heatmap_tpu.delta.compact import (load_overlay_levels,
+                                                   overlay_dirs,
+                                                   read_current)
 
             by_pair = self._build_from_levels(
                 _finalized_to_loaded(load_overlay_levels(self.path)))
+            cur = read_current(self.path)
+            if cur.get("base"):
+                syn_dir = os.path.join(self.path, cur["base"])
+                delta_dirs = [
+                    d for d in overlay_dirs(self.path)
+                    if os.path.normpath(d) != os.path.normpath(syn_dir)]
         else:
             by_pair = self._build_from_blobs(
                 _iter_blob_records(self.kind, self.path))
+        if syn_dir is not None:
+            self._attach_synopses(by_pair, syn_dir, delta_dirs)
         named: dict[str, Layer] = {}
         if self._layer_spec is None:
             for (user, ts), layer in by_pair.items():
@@ -350,11 +410,120 @@ class TileStore:
                 )
         return by_pair
 
+    # -- wavelet synopses --------------------------------------------------
+
+    def _attach_synopses(self, by_pair: dict, syn_dir: str,
+                         delta_dirs: list[str]):
+        """Decode every readable ``synopsis-z*.npz`` in ``syn_dir``
+        into servable :class:`SynopsisView`\\ s on the matching layers.
+
+        For delta stores the synopses describe the BASE pyramid, so
+        the live delta dirs' rows are scatter-added on top of the
+        decoded grid — an exact addition, keeping every cell within
+        the stamped bound of the base ⊕ deltas overlay the exact path
+        serves. Unreadable artifacts are skipped (serving falls back
+        to exact; the recovery sweep owns quarantining them)."""
+        syn = synopsis_build.load_synopses(syn_dir)
+        if not syn:
+            return
+        extras: dict[int, list] = {}
+        for d in delta_dirs:
+            try:
+                loaded = LevelArraysSink.load(d)
+            except OSError:
+                continue
+            for zoom, cols in loaded.items():
+                if int(zoom) in syn:
+                    extras.setdefault(int(zoom), []).append(cols)
+        for zoom, pairs in syn.items():
+            for sp in pairs:
+                layer = by_pair.get((sp.user, sp.timespan))
+                if layer is None:
+                    continue
+                parts = [[], [], []]
+                for cols in extras.get(zoom, ()):
+                    users = np.asarray(cols["user"], str)
+                    tss = np.asarray(cols["timespan"], str)
+                    sel = (users == sp.user) & (tss == sp.timespan)
+                    if sel.any():
+                        parts[0].append(np.asarray(cols["row"],
+                                                   np.int64)[sel])
+                        parts[1].append(np.asarray(cols["col"],
+                                                   np.int64)[sel])
+                        parts[2].append(np.asarray(cols["value"],
+                                                   np.float64)[sel])
+                extra = (tuple(np.concatenate(p) for p in parts)
+                         if parts[0] else None)
+                t0 = time.monotonic()
+                # Clamp decoded noise below zero: counts are
+                # non-negative, so clamping only moves cells TOWARD
+                # the exact value — the stamped bound still holds.
+                grid = np.maximum(sp.decode(extra), 0.0)
+                r, c = np.nonzero(grid)
+                level = Level(zoom,
+                              morton_encode_np(r.astype(np.int64),
+                                               c.astype(np.int64)),
+                              grid[r, c])
+                if obs.metrics_enabled():
+                    synopsis_metrics.SYNOPSIS_DECODE_SECONDS.observe(
+                        time.monotonic() - t0)
+                layer.synopses[zoom] = SynopsisView(level, sp.max_err)
+
+    def publish_provisional(self, rows_by: dict) -> int:
+        """Early-serving hook (ingest/loop.py): overlay a just-journaled
+        micro-batch's coarse cell counts onto the current synopsis
+        views, ahead of the exact delta apply.
+
+        ``rows_by`` is ``{(user, timespan): {zoom: (rows, cols,
+        values)}}``. Only (pair, zoom) slots that already carry a
+        synopsis are touched — the overlay is an exact addition on the
+        decoded grid, so the stamped bound is unchanged; the view is
+        marked ``stale`` until the exact apply's ``refresh_layers``
+        rebuilds the index (which supersedes every provisional view).
+        Returns the number of views updated; bumps ``synopsis_epoch``
+        so cached synopsis tiles cannot alias the provisional bytes.
+        """
+        by_pair: dict[tuple, Layer] = {}
+        for layer in self._layers.values():
+            by_pair.setdefault((layer.user, layer.timespan), layer)
+        updated = 0
+        per_zoom: dict[int, list] = {}
+        for pair, zooms in rows_by.items():
+            layer = by_pair.get(tuple(pair))
+            if layer is None:
+                continue
+            for zoom, (r, c, v) in zooms.items():
+                view = layer.synopses.get(int(zoom))
+                if view is None or not len(np.asarray(r)):
+                    continue
+                lvl = view.level
+                codes = np.concatenate([
+                    lvl.codes,
+                    morton_encode_np(np.asarray(r, np.int64),
+                                     np.asarray(c, np.int64))])
+                values = np.concatenate([lvl.values,
+                                         np.asarray(v, np.float64)])
+                codes, values = _combine_cells(codes, values)
+                layer.synopses[int(zoom)] = SynopsisView(
+                    Level(zoom, codes, values), view.max_err, stale=True)
+                per_zoom.setdefault(int(zoom), []).append(view.max_err)
+                updated += 1
+        if updated:
+            with self._lock:
+                self.synopsis_epoch += 1
+            for zoom, errs in sorted(per_zoom.items()):
+                # bytes=0: an in-memory overlay, no artifact written.
+                obs.emit("synopsis_built", zoom=zoom, pairs=len(errs),
+                         bytes=0, max_err=float(max(errs)),
+                         provisional=True)
+        return updated
+
     def stats(self) -> dict:
         """Small JSON-ready summary for /healthz."""
         return {
             "spec": self.spec,
             "generation": self.generation,
+            "synopsis_epoch": self.synopsis_epoch,
             "layers": {
                 name: {
                     "user": layer.user,
@@ -362,6 +531,9 @@ class TileStore:
                     "detail_zooms": layer.detail_zooms,
                     "result_delta": layer.result_delta,
                     "rows": int(sum(len(l) for l in layer.levels.values())),
+                    "synopsis_zooms": sorted(layer.synopses),
+                    "synopsis_stale": any(v.stale for v in
+                                          layer.synopses.values()),
                 }
                 for name, layer in sorted(self._layers.items())
             },

@@ -1,0 +1,30 @@
+"""Wavelet-synopsis coarse levels: bounded-error compressed pyramids.
+
+Coarse zoom levels aggregate every point and dominate stored bytes,
+but a heatmap PNG quantizes counts through a colormap — they tolerate
+bounded error visually. Following the top-B wavelet-histogram
+construction (arxiv 1110.6649), this package compresses each coarse
+level's per-cell count grid to its B largest Haar coefficients and
+stamps the ACHIEVED L-inf reconstruction error into the artifact, so
+serving can expose approximate tiles with an explicit accuracy
+contract (``X-Heatmap-Synopsis: max_err=<n>``) and an exact/synopsis
+choice per request.
+
+- transform.py  2D Haar twins: jit-compatible JAX forward for the
+                cascade path, numpy-only inverse for serving.
+- build.py      top-B selection, error stamping, synopsis-z*.npz
+                artifact read/write.
+- metrics.py    obs registry handles (docs/observability.md).
+
+Import discipline: everything importable from here is numpy-only; jax
+loads lazily inside the ``*_jax`` functions (tests/test_obs.py greps).
+"""
+
+from heatmap_tpu.synopsis.build import (  # noqa: F401
+    DEFAULT_MAX_Z, HARD_MAX_Z, SCHEMA, SynopsisPair, build_pair,
+    decode_pair, default_b, load_synopses, synopsis_path, write_synopses,
+)
+from heatmap_tpu.synopsis.transform import (  # noqa: F401
+    grid_from_rows_jax, grid_from_rows_np, haar2d_jax, haar2d_np,
+    inv_haar2d_np,
+)

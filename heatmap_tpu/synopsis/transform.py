@@ -1,0 +1,145 @@
+"""2D Haar transform twins: a jit-compatible JAX forward for the
+cascade path and a numpy-only decoder for serving.
+
+The transform is the UNNORMALIZED integer Haar: per 2x2 block
+``(a b / c d)`` one scale pass emits
+
+    approx = a + b + c + d        (top-left quadrant)
+    dh     = a - b + c - d        (top-right: horizontal detail)
+    dv     = a + b - c - d        (bottom-left: vertical detail)
+    dd     = a - b - c + d        (bottom-right: diagonal detail)
+
+and recurses on the approx quadrant (the standard square arrangement).
+The inverse divides by 4 per pass. Both directions are EXACT in binary
+f64 for integer-valued grids below 2^53: sums/differences of integers
+round-trip bit-exact, and /4 is a power-of-two scale — this is what
+makes a full-coefficient (B=inf) synopsis byte-identical to the exact
+level (docs/synopsis.md). Orthonormal Haar (the 1/sqrt(2) flavour)
+would lose that, which is why it is not used here.
+
+Layering contract: this module must be importable from the serve tier,
+so jax is only imported INSIDE the ``*_jax`` functions (the same lazy
+idiom as ``obs.device_topology``). tests/test_obs.py greps for it.
+
+The JAX forward is jit-compatible — ``n`` is static, the scale loop is
+a Python loop over static slice shapes — and composes with the
+bucketed-compile cascade path: :func:`grid_from_rows_jax` scatter-adds
+emission rows under their ``valid`` mask, so the zero-weight pad lanes
+``pipeline.bucketing.pad_emissions`` appends are byte-neutral and one
+compilation serves every batch in a bucket.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "haar2d_np", "inv_haar2d_np", "haar2d_jax", "grid_from_rows_jax",
+    "grid_from_rows_np",
+]
+
+
+def _check_grid(grid) -> int:
+    n = int(grid.shape[-1])
+    if grid.ndim != 2 or grid.shape[0] != n:
+        raise ValueError(f"haar2d wants a square 2D grid, got {grid.shape}")
+    if n & (n - 1):
+        raise ValueError(f"haar2d wants a power-of-two side, got {n}")
+    return n
+
+
+def haar2d_np(grid: np.ndarray) -> np.ndarray:
+    """Full 2D Haar transform of a square power-of-two grid (f64)."""
+    n = _check_grid(grid)
+    out = np.asarray(grid, np.float64).copy()
+    h = n // 2
+    while h >= 1:
+        a = out[0:2 * h:2, 0:2 * h:2].copy()
+        b = out[0:2 * h:2, 1:2 * h:2].copy()
+        c = out[1:2 * h:2, 0:2 * h:2].copy()
+        d = out[1:2 * h:2, 1:2 * h:2].copy()
+        out[:h, :h] = a + b + c + d
+        out[:h, h:2 * h] = a - b + c - d
+        out[h:2 * h, :h] = a + b - c - d
+        out[h:2 * h, h:2 * h] = a - b - c + d
+        h //= 2
+    return out
+
+
+def inv_haar2d_np(coeffs: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`haar2d_np` (numpy only: the serving decoder)."""
+    n = _check_grid(coeffs)
+    out = np.asarray(coeffs, np.float64).copy()
+    h = 1
+    while h < n:
+        s = out[:h, :h].copy()
+        dh = out[:h, h:2 * h].copy()
+        dv = out[h:2 * h, :h].copy()
+        dd = out[h:2 * h, h:2 * h].copy()
+        out[0:2 * h:2, 0:2 * h:2] = (s + dh + dv + dd) / 4.0
+        out[0:2 * h:2, 1:2 * h:2] = (s - dh + dv - dd) / 4.0
+        out[1:2 * h:2, 0:2 * h:2] = (s + dh - dv - dd) / 4.0
+        out[1:2 * h:2, 1:2 * h:2] = (s - dh - dv + dd) / 4.0
+        h *= 2
+    return out
+
+
+def grid_from_rows_np(rows, cols, values, n: int) -> np.ndarray:
+    """Scatter-add sparse (row, col, value) cells into a dense f64
+    ``(n, n)`` grid. Duplicate cells accumulate."""
+    grid = np.zeros((n, n), np.float64)
+    np.add.at(grid, (np.asarray(rows, np.int64), np.asarray(cols, np.int64)),
+              np.asarray(values, np.float64))
+    return grid
+
+
+def grid_from_rows_jax(rows, cols, values, n: int, valid=None):
+    """Device twin of :func:`grid_from_rows_np` for the cascade path.
+
+    ``valid`` masks pad lanes to weight zero (their coordinates are
+    clamped to (0, 0)), so bucketed-padded emission arrays produce the
+    same grid as the unpadded batch — one compiled executable per
+    (bucket, n) signature. jit-compatible for static ``n``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    rows = jnp.asarray(rows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    values = jnp.asarray(values, ftype)
+    if valid is not None:
+        mask = jnp.asarray(valid, bool)
+        rows = jnp.where(mask, rows, 0)
+        cols = jnp.where(mask, cols, 0)
+        values = jnp.where(mask, values, 0)
+    grid = jnp.zeros((n, n), values.dtype)
+    return grid.at[rows, cols].add(values)
+
+
+def haar2d_jax(grid):
+    """JAX forward transform — same arrangement as :func:`haar2d_np`.
+
+    Plain jnp slice arithmetic under a static-shape Python scale loop:
+    jit traces one executable per grid side ``n``. No Pallas kernel is
+    warranted — the op is O(n^2) adds with trivial arithmetic
+    intensity; XLA fuses the quadrant updates.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = _check_grid(grid)
+    ftype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    out = jnp.asarray(grid).astype(ftype)
+    h = n // 2
+    while h >= 1:
+        a = out[0:2 * h:2, 0:2 * h:2]
+        b = out[0:2 * h:2, 1:2 * h:2]
+        c = out[1:2 * h:2, 0:2 * h:2]
+        d = out[1:2 * h:2, 1:2 * h:2]
+        out = out.at[:h, :h].set(a + b + c + d)
+        out = out.at[:h, h:2 * h].set(a - b + c - d)
+        out = out.at[h:2 * h, :h].set(a + b - c - d)
+        out = out.at[h:2 * h, h:2 * h].set(a - b - c + d)
+        h //= 2
+    return out

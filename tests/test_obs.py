@@ -71,6 +71,12 @@ _PAYLOADS = {
     "fleet_backend_down": {"backend": "b2", "reason": "probe_failures",
                            "detail": "3 consecutive probe failures"},
     "fleet_backend_up": {"backend": "b2", "detail": "half-open probe ok"},
+    "synopsis_built": {"zoom": 6, "pairs": 4, "bytes": 2048,
+                       "max_err": 12.5, "coefficients": 256,
+                       "path": "store/base-000001/synopsis-z06.npz"},
+    "synopsis_served": {"layer": "all-alltime", "zoom": 6,
+                        "max_err": 12.5, "source_zoom": 6,
+                        "stale": False},
     "slo_breach": {"slo": "tiles-fast", "burn_rate": 2.5,
                    "kind": "latency", "compliance": 0.9975,
                    "target": 0.999, "window_s": 300.0,
@@ -573,6 +579,59 @@ class TestNoRawInstrumentation:
         assert sanctioned == ["heatmap_tpu/obs/tracing.py:59"] or (
             len(sanctioned) == 1
             and sanctioned[0].startswith("heatmap_tpu/obs/tracing.py:"))
+
+    def test_synopsis_tree_is_guarded(self):
+        """The synopsis/ package sits on the serve decode path — ad-hoc
+        decode timing or build-progress prints would bypass the obs
+        discipline exactly like serve/ would: pin that the tree exists,
+        is scanned by the walk above, and is not allowed."""
+        syn = os.path.join(REPO, "heatmap_tpu", "synopsis")
+        assert os.path.isdir(syn)
+        scanned = [f for f in os.listdir(syn) if f.endswith(".py")]
+        assert "transform.py" in scanned and "build.py" in scanned
+        assert not any(a.startswith("heatmap_tpu/synopsis")
+                       for a in self.ALLOWED)
+        assert self.PATTERN.search("t0 = time.perf_counter()  # decode")
+
+    # Modules the serve tier's tile DECODE path imports: synopsis
+    # decoding must work on a box with no jax install at all
+    # (docs/synopsis.md), so module-level jax imports are forbidden.
+    # serve/live.py is deliberately absent — it renders via
+    # tilemath.mercator and legitimately pulls jax.
+    JAX_FREE = ("heatmap_tpu/serve/store.py", "heatmap_tpu/serve/render.py",
+                "heatmap_tpu/serve/http.py", "heatmap_tpu/serve/cache.py",
+                "heatmap_tpu/serve/router.py", "heatmap_tpu/synopsis/")
+    JAX_IMPORT = re.compile(r"^(?:import jax\b|from jax\b)")
+
+    def test_decode_path_has_no_module_level_jax(self):
+        """The serving decode path (TileStore -> render -> http/router
+        + the whole synopsis package) must not import jax at module
+        level — lazy imports inside ``*_jax`` functions are the
+        sanctioned idiom (synopsis/transform.py docstring)."""
+        offenders = []
+        for target in self.JAX_FREE:
+            full = os.path.join(REPO, target)
+            if target.endswith("/"):
+                files = [os.path.join(full, f) for f in os.listdir(full)
+                         if f.endswith(".py")]
+            else:
+                files = [full]
+            assert files, f"{target} matched no files"
+            for fpath in files:
+                rel = os.path.relpath(fpath, REPO).replace(os.sep, "/")
+                with open(fpath) as f:
+                    for lineno, line in enumerate(f, 1):
+                        if self.JAX_IMPORT.search(line):
+                            offenders.append(f"{rel}:{lineno}")
+        assert not offenders, (
+            "module-level jax import on the serve decode path — import "
+            "jax lazily inside *_jax functions instead: "
+            + ", ".join(offenders))
+        # The pattern bites on both import spellings but not the lazy
+        # (indented) idiom.
+        assert self.JAX_IMPORT.search("import jax.numpy as jnp")
+        assert self.JAX_IMPORT.search("from jax import lax")
+        assert not self.JAX_IMPORT.search("    import jax")
 
     def test_delta_tree_is_guarded(self):
         """The delta/ package times applies and compactions — that must

@@ -1,0 +1,397 @@
+"""Wavelet-synopsis subsystem tests: transform twins, the top-B error
+contract, artifact round trips, serving semantics, early serving, and
+crash recovery.
+
+The anchors from docs/synopsis.md, in test form:
+
+- every decoded cell differs from the exact count by <= the stamped
+  ``max_err`` (the stamp IS the achieved error, not a loose bound);
+- ``b=inf`` round-trips integer grids bit-exact;
+- ``?synopsis=0`` and every ``z`` whose source level carries no
+  synopsis are byte-identical to a store without synopses;
+- exact and approximate bytes live in disjoint ETag namespaces and
+  distinct cache keys, and the fleet router colocates both variants.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from heatmap_tpu.serve import ServeApp, TileStore
+from heatmap_tpu.synopsis.build import (DEFAULT_MAX_Z, HARD_MAX_Z, SCHEMA,
+                                        SynopsisPair, build_pair, decode_pair,
+                                        default_b, load_synopses,
+                                        synopsis_path, verify_synopsis,
+                                        write_synopses)
+from heatmap_tpu.synopsis.transform import (grid_from_rows_np, haar2d_np,
+                                            inv_haar2d_np)
+
+
+def _sparse_grid(rng, zoom, nnz, vmax=50):
+    """Random sparse integer level rows + the dense grid they imply."""
+    n = 1 << zoom
+    flat = rng.choice(n * n, size=nnz, replace=False)
+    rows, cols = flat // n, flat % n
+    values = rng.integers(1, vmax, size=nnz).astype(np.float64)
+    return rows, cols, values, grid_from_rows_np(rows, cols, values, n)
+
+
+class TestTransform:
+    @pytest.mark.parametrize("zoom", [0, 1, 3, 6])
+    def test_round_trip_is_bit_exact_for_integer_grids(self, zoom):
+        rng = np.random.default_rng(7 + zoom)
+        n = 1 << zoom
+        grid = rng.integers(0, 1000, size=(n, n)).astype(np.float64)
+        back = inv_haar2d_np(haar2d_np(grid))
+        assert np.array_equal(back, grid)  # exact, not approx
+
+    def test_rejects_non_square_and_non_power_of_two(self):
+        with pytest.raises(ValueError, match="square"):
+            haar2d_np(np.zeros((4, 8)))
+        with pytest.raises(ValueError, match="power-of-two"):
+            inv_haar2d_np(np.zeros((6, 6)))
+
+    def test_jax_forward_matches_numpy_twin(self):
+        from heatmap_tpu.synopsis.transform import haar2d_jax
+
+        rng = np.random.default_rng(11)
+        grid = rng.integers(0, 100, size=(16, 16)).astype(np.float64)
+        np.testing.assert_array_equal(np.asarray(haar2d_jax(grid)),
+                                      haar2d_np(grid))
+
+    def test_jax_scatter_ignores_pad_lanes(self):
+        """Bucketed-padded emission arrays (zero-weight pad lanes under
+        a valid mask) must produce the same grid as the unpadded batch."""
+        from heatmap_tpu.synopsis.transform import grid_from_rows_jax
+
+        rng = np.random.default_rng(13)
+        rows, cols, values, grid = _sparse_grid(rng, 4, 40)
+        pad = 17
+        prow = np.concatenate([rows, np.zeros(pad, np.int64)])
+        pcol = np.concatenate([cols, np.zeros(pad, np.int64)])
+        pval = np.concatenate([values, np.full(pad, 99.0)])
+        valid = np.concatenate([np.ones(len(rows), bool),
+                                np.zeros(pad, bool)])
+        got = np.asarray(grid_from_rows_jax(prow, pcol, pval, 16,
+                                            valid=valid))
+        np.testing.assert_array_equal(got, grid)
+
+
+class TestErrorContract:
+    def test_stamp_is_the_achieved_error_across_b_sweep(self):
+        """Property sweep: for every coefficient budget the stamped
+        ``max_err`` equals the worst decoded-cell error exactly — the
+        serving decoder runs the identical deterministic inverse."""
+        rng = np.random.default_rng(42)
+        for seed in range(4):
+            rows, cols, values, grid = _sparse_grid(
+                np.random.default_rng(seed), 5, 120)
+            for b in (1, 4, 16, 64, 256, math.inf):
+                idx, val, stamped = build_pair(rows, cols, values, 5, b=b)
+                decoded = decode_pair(idx, val, 32)
+                achieved = float(np.abs(decoded - grid).max())
+                assert achieved == stamped  # not approx: same computation
+                assert np.abs(np.maximum(decoded, 0.0) - grid).max() \
+                    <= stamped  # the serve-side clamp never widens it
+                if not math.isinf(b):
+                    assert len(idx) <= b
+
+    def test_b_inf_is_bit_exact(self):
+        rng = np.random.default_rng(3)
+        rows, cols, values, grid = _sparse_grid(rng, 5, 200)
+        idx, val, stamped = build_pair(rows, cols, values, 5, b=math.inf)
+        assert stamped == 0.0
+        assert np.array_equal(decode_pair(idx, val, 32), grid)
+
+    def test_build_is_deterministic(self):
+        rows, cols, values, _ = _sparse_grid(np.random.default_rng(9),
+                                             5, 150)
+        a = build_pair(rows, cols, values, 5, b=20)
+        b = build_pair(rows, cols, values, 5, b=20)
+        assert np.array_equal(a[0], b[0]) and np.array_equal(a[1], b[1])
+        assert a[2] == b[2]
+
+    def test_hard_max_z_refusal(self):
+        with pytest.raises(ValueError, match=str(HARD_MAX_Z)):
+            build_pair([0], [0], [1.0], HARD_MAX_Z + 1)
+
+    def test_default_b_floor_and_ratio(self):
+        assert default_b(7) == 16
+        assert default_b(800) == 100
+
+    def test_decode_extras_are_exact_additions(self):
+        """Delta overlays / provisional counts scatter-add ON TOP of the
+        decoded grid — linearity keeps the stamped bound intact."""
+        rows, cols, values, grid = _sparse_grid(np.random.default_rng(5),
+                                                4, 30)
+        idx, val, stamped = build_pair(rows, cols, values, 4, b=8)
+        pair = SynopsisPair("all", "alltime", 4, 16, len(idx), stamped,
+                            idx, val)
+        extra = ([2, 2, 7], [3, 3, 1], [1.0, 2.0, 5.0])
+        plain = pair.decode()
+        overlaid = pair.decode(extra_rows=extra)
+        expect = plain.copy()
+        np.add.at(expect, ([2, 2, 7], [3, 3, 1]), [1.0, 2.0, 5.0])
+        np.testing.assert_array_equal(overlaid, expect)
+        truth = grid.copy()
+        np.add.at(truth, ([2, 2, 7], [3, 3, 1]), [1.0, 2.0, 5.0])
+        assert np.abs(overlaid - truth).max() <= stamped + 1e-12
+
+
+def _level_cols(rng, zoom, pairs, nnz=80):
+    """A finalized-shape level dict (string-column flavour) with one
+    row block per (user, timespan) pair."""
+    rs, cs, vs, us, ts = [], [], [], [], []
+    for user, span in pairs:
+        rows, cols, values, _ = _sparse_grid(rng, zoom, nnz)
+        rs.append(rows)
+        cs.append(cols)
+        vs.append(values)
+        us += [user] * nnz
+        ts += [span] * nnz
+    return {"zoom": zoom, "coarse_zoom": max(zoom - 2, 0),
+            "row": np.concatenate(rs), "col": np.concatenate(cs),
+            "value": np.concatenate(vs),
+            "user": np.asarray(us), "timespan": np.asarray(ts)}
+
+
+class TestArtifacts:
+    def test_write_load_round_trip_and_verify(self, tmp_path):
+        rng = np.random.default_rng(21)
+        cols = _level_cols(rng, 5, [("all", "alltime"), ("u1", "year")])
+        out = write_synopses(str(tmp_path), levels={5: cols})
+        assert set(out) == {5}
+        assert out[5]["pairs"] == 2
+        path = synopsis_path(str(tmp_path), 5)
+        assert os.path.exists(path) and verify_synopsis(path) is None
+        loaded = load_synopses(str(tmp_path))
+        assert sorted((p.user, p.timespan) for p in loaded[5]) == [
+            ("all", "alltime"), ("u1", "year")]
+        worst = 0.0
+        for p in loaded[5]:
+            sel = (cols["user"] == p.user) & (cols["timespan"] == p.timespan)
+            grid = grid_from_rows_np(cols["row"][sel], cols["col"][sel],
+                                     cols["value"][sel], 32)
+            assert np.abs(p.decode() - grid).max() <= p.max_err
+            worst = max(worst, p.max_err)
+        assert out[5]["max_err"] == worst
+
+    def test_max_z_gates_which_levels_get_synopses(self, tmp_path):
+        rng = np.random.default_rng(22)
+        levels = {5: _level_cols(rng, 5, [("all", "alltime")]),
+                  7: _level_cols(rng, 7, [("all", "alltime")])}
+        out = write_synopses(str(tmp_path), levels=levels, max_z=6)
+        assert set(out) == {5}
+        assert not os.path.exists(synopsis_path(str(tmp_path), 7))
+
+    def test_verify_flags_torn_and_wrong_schema(self, tmp_path):
+        torn = tmp_path / "synopsis-z05.npz"
+        torn.write_bytes(b"\x00garbage not a zip")
+        assert verify_synopsis(str(torn)) is not None
+        wrong = tmp_path / "synopsis-z06.npz"
+        np.savez(wrong, schema=np.asarray("other.v9"))
+        detail = verify_synopsis(str(wrong))
+        assert detail is not None and SCHEMA in detail
+        assert load_synopses(str(tmp_path)) == {}  # both skipped
+
+
+@pytest.fixture(scope="module")
+def syn_store(tmp_path_factory):
+    """One real batch job egressed through the arrays-synopsis sink:
+    exact levels at zooms 7-10 plus synopsis artifacts for 7/8/9 (all
+    < DEFAULT_MAX_Z; zoom-10 detail stays exact-only)."""
+    from heatmap_tpu.io import open_sink, open_source
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    root = tmp_path_factory.mktemp("syn_store")
+    config = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                            result_delta=2)
+    with open_sink(f"arrays-synopsis:{root}/levels") as sink:
+        run_job(open_source("synthetic:3000:7"), sink, config)
+    assert DEFAULT_MAX_Z == 10  # fixture zoom choices assume it
+    return f"arrays:{root}/levels"
+
+
+def _busy_tile(layer, src_zoom, tile_zoom):
+    """(x, y) of the tile covering the heaviest exact cell — guaranteed
+    non-empty on both the exact and the synopsis path."""
+    level = layer.levels[src_zoom]
+    code = int(level.codes[int(np.argmax(level.values))])
+    row = col = 0
+    for bit in range(src_zoom):
+        col |= ((code >> (2 * bit)) & 1) << bit
+        row |= ((code >> (2 * bit + 1)) & 1) << bit
+    shift = src_zoom - tile_zoom
+    return col >> shift, row >> shift
+
+
+class TestServing:
+    def test_store_indexes_synopses_below_max_z(self, syn_store):
+        layer = TileStore(syn_store).layer("default")
+        assert sorted(layer.synopses) == [7, 8, 9]
+        for view in layer.synopses.values():
+            assert view.max_err >= 0.0 and not view.stale
+
+    def test_decoded_level_respects_stamp_every_cell(self, syn_store):
+        layer = TileStore(syn_store).layer("default")
+        for zoom, view in layer.synopses.items():
+            exact = layer.levels[zoom]
+            ex = dict(zip(exact.codes.tolist(), exact.values.tolist()))
+            ap = dict(zip(view.level.codes.tolist(),
+                          view.level.values.tolist()))
+            worst = max(abs(ex.get(c, 0.0) - ap.get(c, 0.0))
+                        for c in set(ex) | set(ap))
+            assert worst <= view.max_err + 1e-9
+
+    def test_synopsis_tile_headers_etag_and_revalidation(self, syn_store):
+        store = TileStore(syn_store)
+        app = ServeApp(store)
+        layer = store.layer("default")
+        x, y = _busy_tile(layer, 7, 5)
+        path = f"/tiles/default/5/{x}/{y}.json"
+        res = app.handle("GET", path + "?synopsis=1")
+        status, ctype, body, etag, route, _ = res
+        assert (status, route) == (200, "tiles")
+        assert etag.startswith('"syn-')
+        marker = res.headers["X-Heatmap-Synopsis"]
+        view = layer.synopses[7]
+        assert marker == f"max_err={view.max_err:.6g}"
+        not_mod = app.handle("GET", path + "?synopsis=1",
+                             if_none_match=etag)
+        assert not_mod[0] == 304 and not_mod[2] == b""
+        assert not_mod.headers["X-Heatmap-Synopsis"] == marker
+        # Exact bytes never revalidate against a synopsis ETag and
+        # vice versa: disjoint namespaces by construction.
+        exact = app.handle("GET", path)
+        assert exact[0] == 200 and not exact[3].startswith('"syn-')
+        assert exact[3] != etag
+        assert app.handle("GET", path, if_none_match=etag)[0] == 200
+        assert app.handle("GET", path + "?synopsis=1",
+                          if_none_match=exact[3])[0] == 200
+
+    def test_exact_path_is_byte_identical_with_synopses_present(
+            self, syn_store):
+        store = TileStore(syn_store)
+        app = ServeApp(store)
+        layer = store.layer("default")
+        x, y = _busy_tile(layer, 7, 5)
+        path = f"/tiles/default/5/{x}/{y}.json"
+        plain = app.handle("GET", path)
+        off = app.handle("GET", path + "?synopsis=0")
+        assert tuple(off)[:5] == tuple(plain)[:5]  # cache marker aside
+        assert getattr(off, "headers", None) is None
+        # z whose source level carries no synopsis: ?synopsis=1 falls
+        # through to exact bytes, exact ETag, no annotation.
+        dx, dy = _busy_tile(layer, 10, 8)
+        deep = f"/tiles/default/8/{dx}/{dy}.json"
+        on = app.handle("GET", deep + "?synopsis=1")
+        assert tuple(on)[:5] == tuple(app.handle("GET", deep))[:5]
+        assert getattr(on, "headers", None) is None
+        assert not on[3].startswith('"syn-')
+
+    def test_synopsis_default_flag(self, syn_store):
+        store = TileStore(syn_store)
+        app = ServeApp(store, synopsis_default=True)
+        layer = store.layer("default")
+        x, y = _busy_tile(layer, 7, 5)
+        path = f"/tiles/default/5/{x}/{y}.json"
+        assert app.handle("GET", path).headers is not None
+        opted_out = app.handle("GET", path + "?synopsis=0")
+        assert getattr(opted_out, "headers", None) is None
+        # last value wins, per urllib convention
+        assert app._synopsis_opt("synopsis=0&synopsis=1") is True
+        assert ServeApp(store)._synopsis_opt("foo=1") is False
+
+    def test_router_colocates_synopsis_with_exact(self):
+        from heatmap_tpu.serve.router import route_key
+
+        assert route_key("/tiles/default/4/3/5.json?synopsis=1") == \
+            route_key("/tiles/default/4/3/5.json")
+        assert route_key("/tiles/default/4/3/5.png") == \
+            route_key("/tiles/default/4/3/5.json")
+
+    def test_stats_carry_synopsis_state(self, syn_store):
+        store = TileStore(syn_store)
+        stats = store.stats()
+        assert stats["synopsis_epoch"] == store.synopsis_epoch
+        layer_stats = stats["layers"][store.layer_names()[0]]
+        assert layer_stats["synopsis_zooms"] == [7, 8, 9]
+        assert layer_stats["synopsis_stale"] is False
+
+
+class TestEarlyServing:
+    def test_provisional_publish_marks_stale_and_refresh_supersedes(
+            self, syn_store):
+        store = TileStore(syn_store)
+        app = ServeApp(store)
+        layer = store.layer("default")
+        epoch0, gen0 = store.synopsis_epoch, store.generation
+        x, y = _busy_tile(layer, 7, 5)
+        path = f"/tiles/default/5/{x}/{y}.json?synopsis=1"
+        before = app.handle("GET", path)
+        assert "stale" not in before.headers["X-Heatmap-Synopsis"]
+
+        rows = ([1, 2], [3, 4], [5.0, 7.0])
+        updated = store.publish_provisional(
+            {(layer.user, layer.timespan): {7: rows, 8: rows}})
+        assert updated == 2
+        # synopsis tiles retire (epoch moved), exact tiles stay cached
+        # (generation did not).
+        assert store.synopsis_epoch > epoch0
+        assert store.generation == gen0
+        assert store.layer("default").synopses[7].stale
+        assert store.stats()["layers"]["default"]["synopsis_stale"] is True
+        during = app.handle("GET", path)
+        assert "stale=1" in during.headers["X-Heatmap-Synopsis"]
+
+        store.refresh_layers()  # the exact apply's supersession
+        assert not store.layer("default").synopses[7].stale
+        after = app.handle("GET", path)
+        assert "stale" not in after.headers["X-Heatmap-Synopsis"]
+        assert after[2] == before[2]  # overlay fully discarded
+
+    def test_publish_ignores_unknown_pairs_and_zooms(self, syn_store):
+        store = TileStore(syn_store)
+        rows = ([0], [0], [1.0])
+        assert store.publish_provisional(
+            {("nobody", "never"): {7: rows}}) == 0
+        assert store.publish_provisional(
+            {("all", "alltime"): {6: rows}}) == 0
+
+
+class TestRecovery:
+    def test_sweep_quarantines_torn_synopses_in_current_base(
+            self, tmp_path):
+        from heatmap_tpu.delta.recover import sweep
+
+        root = tmp_path / "store"
+        bdir = root / "base-000001"
+        bdir.mkdir(parents=True)
+        (root / "CURRENT").write_text(json.dumps(
+            {"schema": "heatmap-tpu.delta_store.v1", "base": "base-000001",
+             "applied_through": 1, "config": None}))
+        cols = _level_cols(np.random.default_rng(31), 5,
+                           [("all", "alltime")])
+        write_synopses(str(bdir), levels={5: cols})
+        (bdir / "synopsis-z06.npz").write_bytes(b"not a zip at all")
+        (bdir / "synopsis-z07.npz.tmp").write_bytes(b"crashed staging")
+
+        result = sweep(str(root))
+        got = {(i["reason"], os.path.basename(i["path"]))
+               for i in result["quarantined"]}
+        assert got == {("torn_synopsis", "synopsis-z06.npz"),
+                       ("orphan_tmp", "synopsis-z07.npz.tmp")}
+        assert all(i["kind"] == "synopsis" for i in result["quarantined"])
+        # the healthy artifact survives in place and still verifies
+        good = synopsis_path(str(bdir), 5)
+        assert os.path.exists(good) and verify_synopsis(good) is None
+        qdir = root / "quarantine"
+        assert sorted(os.listdir(qdir)) == ["synopsis-z06.npz",
+                                            "synopsis-z07.npz.tmp"]
+        # idempotent: a second sweep finds a clean store
+        assert sweep(str(root))["quarantined"] == []

@@ -15,6 +15,8 @@ Reads every bench artifact the repo's tooling writes —
 - ``BENCH_ingest.json`` (tools/bench_ingest.py): per micro-batch and
   padding mode, sustained points/sec (higher) and ingest->servable
   p99 lag ms (lower);
+- ``BENCH_synopsis.json`` (tools/bench_synopsis.py): wavelet-synopsis
+  exact/synopsis bytes ratio (higher) and pair decode p99 ms (lower);
 - ``onchip_state/sweep.jsonl`` stream cells (tools/bench_stream.py):
   per (backend, batch, device) update-loop points/sec (higher);
 
@@ -127,6 +129,14 @@ def snapshot_metrics(root: str) -> dict:
             p99 = (row.get("lag_ms") or {}).get("p99")
             if isinstance(p99, (int, float)):
                 out[f"ingest:lag_p99_ms[{cell}]"] = (float(p99), False)
+    doc = _load(os.path.join(root, "BENCH_synopsis.json"))
+    if isinstance(doc, dict):
+        ratio = (doc.get("compression") or {}).get("bytes_ratio")
+        if isinstance(ratio, (int, float)):
+            out["synopsis:bytes_ratio"] = (float(ratio), True)
+        p99 = ((doc.get("decode") or {}).get("decode_ms") or {}).get("p99")
+        if isinstance(p99, (int, float)):
+            out["synopsis:decode_p99"] = (float(p99), False)
     out.update(stream_metrics(root))
     return out
 

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Wavelet-synopsis bench: compression, decode latency, error, and
+early-serve lag: BENCH_synopsis.json.
+
+Four headline sections (docs/synopsis.md):
+
+- ``bytes``       per synopsized zoom, exact level artifact bytes vs
+                  synopsis artifact bytes; ``bytes_ratio`` is the
+                  aggregate exact/synopsis quotient at the default
+                  coefficient budget (acceptance: >= 4x);
+- ``decode_ms``   p50/p99 of one pair-level decode (sparse
+                  coefficients -> dense grid), the latency a synopsis
+                  tile render pays on a cache miss;
+- ``max_err``     the worst stamped L-inf bound across pairs and
+                  zooms, re-verified here against a freshly decoded
+                  grid (the stamp is the achieved error, so the two
+                  must agree exactly);
+- ``early_serve`` provisional-publish-to-exact-apply lag from a real
+                  ``ingest.run_ingest`` drain against a delta store
+                  whose base carries synopses: for each tick,
+                  ``ts(delta_applied) - ts(synopsis_built
+                  provisional)`` — how much sooner a coarse overview
+                  tile reflects the micro-batch than the exact apply
+                  lands.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/bench_synopsis.py \
+        [--points 30000] [--decode-iters 50] [--out BENCH_synopsis.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _pct(sorted_vals: list, q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def _materialize(spec: str) -> dict:
+    from heatmap_tpu.io import open_source
+
+    cols: dict = {}
+    for batch in open_source(spec).batches(1 << 20):
+        for c, v in batch.items():
+            cols.setdefault(c, []).extend(v)
+    return cols
+
+
+def bench_compression(level_dir: str) -> dict:
+    """Exact-vs-synopsis artifact bytes per zoom + the aggregate ratio."""
+    from heatmap_tpu.synopsis.build import synopsis_path
+
+    per_zoom, exact_total, syn_total = {}, 0, 0
+    for name in sorted(os.listdir(level_dir)):
+        if not (name.startswith("level_z") and name.endswith(".npz")):
+            continue
+        zoom = int(name[len("level_z"):len("level_z") + 2])
+        spath = synopsis_path(level_dir, zoom)
+        if not os.path.exists(spath):
+            continue
+        exact = os.path.getsize(os.path.join(level_dir, name))
+        syn = os.path.getsize(spath)
+        per_zoom[zoom] = {"exact_bytes": exact, "synopsis_bytes": syn,
+                          "ratio": round(exact / syn, 2)}
+        exact_total += exact
+        syn_total += syn
+    return {"per_zoom": per_zoom, "exact_bytes": exact_total,
+            "synopsis_bytes": syn_total,
+            "bytes_ratio": round(exact_total / syn_total, 2)
+            if syn_total else None}
+
+
+def bench_decode(level_dir: str, iters: int) -> dict:
+    """Decode latency for the LARGEST synopsized zoom (worst case: the
+    dense grid is biggest) + the re-verified worst error stamp."""
+    from heatmap_tpu.synopsis.build import load_synopses
+    from heatmap_tpu.synopsis.transform import grid_from_rows_np
+    from heatmap_tpu.io.sinks import LevelArraysSink
+
+    syn = load_synopses(level_dir)
+    zoom = max(syn)
+    samples = []
+    for _ in range(iters):
+        for pair in syn[zoom]:
+            t0 = time.perf_counter()
+            pair.decode()
+            samples.append(1e3 * (time.perf_counter() - t0))
+    samples.sort()
+
+    # Re-verify: the stamp is the achieved error, so a fresh decode
+    # against the exact level must reproduce it exactly, every pair.
+    levels = LevelArraysSink.load(level_dir)
+    worst = 0.0
+    for z, pairs in syn.items():
+        cols = levels[z]
+        users = np.asarray(cols["user"], str)
+        tss = np.asarray(cols["timespan"], str)
+        for pair in pairs:
+            sel = (users == pair.user) & (tss == pair.timespan)
+            grid = grid_from_rows_np(
+                np.asarray(cols["row"])[sel], np.asarray(cols["col"])[sel],
+                np.asarray(cols["value"])[sel], pair.n)
+            achieved = float(np.abs(pair.decode() - grid).max())
+            if achieved != pair.max_err:
+                raise SystemExit(
+                    f"error contract violated at z{z} "
+                    f"({pair.user},{pair.timespan}): stamped "
+                    f"{pair.max_err} != achieved {achieved}")
+            worst = max(worst, pair.max_err)
+    return {"zoom": zoom, "pairs": len(syn[zoom]),
+            "decode_ms": {"p50": _pct(samples, 0.50),
+                          "p99": _pct(samples, 0.99)},
+            "max_err": worst, "verified": True}
+
+
+def bench_early_serve(cols: dict, tmpdir: str) -> dict:
+    """Provisional-to-exact lag through the real ingest loop."""
+    from heatmap_tpu import delta, ingest
+    from heatmap_tpu.obs import events
+    from heatmap_tpu.pipeline import BatchJobConfig
+    from heatmap_tpu.serve import TileCache, TileStore
+
+    config = BatchJobConfig(detail_zoom=8, min_detail_zoom=4,
+                            result_delta=2)
+    root = os.path.join(tmpdir, "delta-store")
+    delta.init_store(root)
+    store, cache = TileStore(f"delta:{root}"), TileCache()
+    events_path = os.path.join(tmpdir, "events.jsonl")
+    log = events.EventLog(events_path)
+    events.set_event_log(log)
+    try:
+        # compact_every=1 publishes a synopsis-bearing base after the
+        # first tick, so every later tick early-serves.
+        ingest.run_ingest(
+            root, _FixedChunks(cols, 4096), config, store=store,
+            cache=cache,
+            ingest=ingest.IngestConfig(micro_batch=4096, queue_depth=2,
+                                       compact_every=1))
+    finally:
+        events.set_event_log(None)
+        log.close()
+    records = events.read_events(events_path)
+    lags, provisional = [], 0
+    last_prov_ts = None
+    for rec in records:
+        if rec["event"] == "synopsis_built" and rec.get("provisional"):
+            provisional += 1
+            last_prov_ts = rec["ts"]
+        elif rec["event"] == "delta_applied" and last_prov_ts is not None:
+            lags.append(1e3 * (rec["ts"] - last_prov_ts))
+            last_prov_ts = None
+    lags.sort()
+    return {"ticks": sum(r["event"] == "ingest_tick" for r in records),
+            "provisional_publishes": provisional,
+            "lag_ms": {"p50": _pct(lags, 0.50), "p99": _pct(lags, 0.99)}}
+
+
+class _FixedChunks:
+    """Re-chunk a materialized columnar batch into fixed micro-batches."""
+
+    def __init__(self, cols: dict, size: int):
+        self.cols = cols
+        self.size = size
+
+    def batches(self, batch_size: int = 1 << 20):
+        n = len(self.cols["latitude"])
+        for i in range(0, n, self.size):
+            yield {c: v[i:i + self.size] for c, v in self.cols.items()}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=30_000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--decode-iters", type=int, default=50)
+    ap.add_argument("--out", default="BENCH_synopsis.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu import obs
+    from heatmap_tpu.io import open_sink, open_source
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    obs.enable_metrics(True)
+    tmpdir = tempfile.mkdtemp(prefix="benchsynopsis-")
+    try:
+        level_dir = os.path.join(tmpdir, "levels")
+        config = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                                result_delta=2)
+        with open_sink(f"arrays-synopsis:{level_dir}") as sink:
+            run_job(open_source(f"synthetic:{args.points}:{args.seed}"),
+                    sink, config)
+        compression = bench_compression(level_dir)
+        print(json.dumps({"bytes_ratio": compression["bytes_ratio"]}),
+              flush=True)
+        decode = bench_decode(level_dir, args.decode_iters)
+        print(json.dumps({"decode_ms": decode["decode_ms"],
+                          "max_err": decode["max_err"]}), flush=True)
+        cols = _materialize(f"synthetic:{args.points}:{args.seed + 1}")
+        early = bench_early_serve(cols, tmpdir)
+        print(json.dumps({"early_serve": early}), flush=True)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    record = {"bench": "synopsis", "points": args.points,
+              "compression": compression, "decode": decode,
+              "early_serve": early}
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, default=str)
+        f.write("\n")
+    print(json.dumps({"wrote": args.out}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

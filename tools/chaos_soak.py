@@ -18,7 +18,10 @@ one process of a 3-backend serve fleet (serve/fleet.py) under Zipf
 load: the router's failover must keep clients at zero 5xx, the breaker
 must open and re-close through the supervisor restart + half-open
 probe, and the recovered fleet must serve bytes identical to the clean
-single-process run. The chaos run must converge to the *same bytes*:
+single-process run. A synopsis phase tears a wavelet-synopsis artifact
+mid-write: the recovery sweep must quarantine it, serving must fall
+back to exact bytes for that level while other levels keep their
+synopses, and no request may see a 500. The chaos run must converge to the *same bytes*:
 level arrays, journal state, and every served JSON tile. Along the way
 the HTTP tier must degrade gracefully (typed 503s / stale serves,
 ``/healthz`` reporting ``degraded``) and never return a 500.
@@ -565,6 +568,84 @@ def phase_backend_loss(ctx):
             "up_events": len(ups)}
 
 
+def phase_synopsis(ctx):
+    """Wavelet-synopsis chaos: serve coarse tiles from synopses, tear
+    one artifact plus a crashed staging tmp, and require the recovery
+    sweep to quarantine both while serving falls back to exact bytes
+    for the torn level — other levels keep their synopses, and no
+    request ever sees a 500."""
+    from heatmap_tpu.delta.recover import sweep
+    from heatmap_tpu.io import open_sink
+    from heatmap_tpu.synopsis.build import synopsis_path
+
+    faults.install(None)
+    root = os.path.join(os.path.dirname(ctx["base_root"]),
+                        "store-synopsis")
+    bdir = os.path.join(root, "base-000001")
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                         result_delta=2)
+    with open_sink(f"arrays-synopsis:{bdir}") as sink:
+        run_job(SyntheticSource(ctx["n"], seed=5), sink, cfg)
+    with open(os.path.join(root, "CURRENT"), "w") as f:
+        json.dump({"schema": "heatmap-tpu.delta_store.v1",
+                   "base": "base-000001", "applied_through": 1,
+                   "config": None}, f)
+    store = TileStore(f"delta:{root}")
+    app = ServeApp(store)
+    layer = store.layer("default")
+    delta_z = layer.result_delta
+    syn_zooms = sorted(layer.synopses)
+    assert len(syn_zooms) >= 2, f"need >=2 synopsized levels: {syn_zooms}"
+
+    def busy_path(src):
+        level = layer.levels[src]
+        code = level.codes[int(np.argmax(level.values)):][:1]
+        row, col = morton_decode_np(code)
+        z = src - delta_z
+        shift = delta_z  # source cells per tile axis = 2**delta
+        return (f"/tiles/default/{z}/{int(col[0]) >> shift}"
+                f"/{int(row[0]) >> shift}.json")
+
+    codes: dict = {}
+
+    def fetch(path):
+        res = app.handle("GET", path)
+        codes[res[0]] = codes.get(res[0], 0) + 1
+        return res
+
+    for src in syn_zooms:
+        syn = fetch(busy_path(src) + "?synopsis=1")
+        assert syn[0] == 200 and syn.headers is not None, \
+            f"z{src} synopsis tile not annotated: {syn[0]}"
+        assert fetch(busy_path(src))[0] == 200
+
+    # Tear the middle artifact + leave a crashed staging file behind.
+    victim = syn_zooms[len(syn_zooms) // 2]
+    with open(synopsis_path(bdir, victim), "wb") as f:
+        f.write(b"torn mid-write")
+    with open(os.path.join(bdir, "synopsis-z99.npz.tmp"), "wb") as f:
+        f.write(b"crashed staging")
+    swept = sweep(root)
+    reasons = sorted(i["reason"] for i in swept["quarantined"])
+    assert reasons == ["orphan_tmp", "torn_synopsis"], reasons
+    store.reload()
+    layer = store.layer("default")
+    assert victim not in layer.synopses, "torn synopsis still indexed"
+
+    # The torn level falls back to exact bytes (no annotation) ...
+    fallback = fetch(busy_path(victim) + "?synopsis=1")
+    exact = fetch(busy_path(victim))
+    assert fallback[0] == 200 and getattr(fallback, "headers", None) is None
+    assert fallback[2] == exact[2], "fallback diverged from exact bytes"
+    # ... while the surviving levels keep serving synopses.
+    survivor = fetch(busy_path(syn_zooms[0]) + "?synopsis=1")
+    assert survivor[0] == 200 and survivor.headers is not None
+    assert codes.get(500, 0) == 0, f"500s observed: {codes}"
+    return {"synopsis_zooms": syn_zooms, "torn_zoom": victim,
+            "quarantined": reasons,
+            "codes": {str(k): v for k, v in sorted(codes.items())}}
+
+
 PHASES = [
     ("baseline", phase_baseline),
     ("chaos_pipeline", phase_chaos_pipeline),
@@ -574,6 +655,7 @@ PHASES = [
     ("ingest_crash", phase_ingest_crash),
     ("host_loss", phase_host_loss),
     ("backend_loss", phase_backend_loss),
+    ("synopsis", phase_synopsis),
     ("byte_equality", phase_byte_equality),
 ]
 
