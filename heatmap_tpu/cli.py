@@ -288,6 +288,24 @@ def _add_trace_flags(p):
                    "latency, error_rate, staleness; repeatable). "
                    "Error-budget burn rates fold into /healthz, the "
                    "run report, and slo_breach events")
+    p.add_argument("--flight-recorder-spans", type=int, default=256,
+                   metavar="N",
+                   help="flight-recorder ring capacity: last N completed "
+                   "spans per subsystem kept regardless of head "
+                   "sampling, promoted into the trace on errors/5xx/"
+                   "tail latency (0 disables the recorder; it only "
+                   "arms when --trace-out, --events or --incident-dir "
+                   "is also given — docs/observability.md)")
+    p.add_argument("--incident-dir", default=None, metavar="DIR",
+                   help="flush self-contained incident bundles here on "
+                   "failure edges (SLO breach, shed, fault storm, "
+                   "degraded-enter, uncaught exception); rate-limited "
+                   "and pruned age-wins")
+    p.add_argument("--tail-latency-ms", type=float, default=None,
+                   metavar="MS",
+                   help="tail-based retention threshold: any trace "
+                   "slower than this is promoted from the flight "
+                   "recorder into the trace as if head-sampled")
 
 
 def _setup_tracing(args):
@@ -306,6 +324,26 @@ def _setup_tracing(args):
             obs.install_specs(args.slo)
         except ValueError as e:
             raise SystemExit(f"--slo: {e}") from e
+    # Flight recorder + incident bundles. The recorder arms only when
+    # some telemetry surface exists to promote/flush into, so a plain
+    # run keeps every obs hook at None (blob byte-equality pinned by
+    # tests/test_obs.py).
+    spans = getattr(args, "flight_recorder_spans", 0) or 0
+    if spans < 0:
+        raise SystemExit(f"--flight-recorder-spans {spans}: must be >= 0")
+    incident_dir = getattr(args, "incident_dir", None)
+    armed = (collector is not None or incident_dir
+             or getattr(args, "events", None))
+    if spans and armed:
+        tail_ms = getattr(args, "tail_latency_ms", None)
+        if tail_ms is not None and tail_ms <= 0:
+            raise SystemExit(
+                f"--tail-latency-ms {tail_ms}: must be positive")
+        obs.recorder.install(obs.FlightRecorder(
+            max_spans=spans,
+            tail_latency_s=None if tail_ms is None else tail_ms / 1000.0))
+    if incident_dir:
+        obs.incident.set_manager(obs.IncidentManager(incident_dir))
     return collector
 
 
@@ -316,6 +354,17 @@ def _export_trace(args, collector):
     line = {"trace_out": args.trace_out, "span_events": n,
             "dropped": collector.dropped}
     print(json.dumps(line), file=sys.stderr)
+
+
+def _fail_telemetry(root_span, error):
+    """Uncaught job exception: tail-promote the failed root's tree out
+    of the flight recorder and flush an exception incident bundle.
+    Both no-op when nothing is installed. Must run before end_span on
+    the root so the root rides the live-forward path."""
+    from heatmap_tpu.obs import incident, recorder
+
+    recorder.maybe_promote(root_span, error=True)
+    incident.trigger("exception", detail=repr(error))
 
 
 def cmd_run(args) -> int:
@@ -541,6 +590,7 @@ def cmd_run(args) -> int:
                                     max_points_in_flight=args.max_points_in_flight,
                                     merge_spill_dir=args.merge_spill_dir)
     except BaseException as e:  # noqa: BLE001 — run_end must record it
+        _fail_telemetry(root_span, e)
         if not telemetry:
             tracing_mod.end_span(root_span)
             _export_trace(args, collector)
@@ -940,6 +990,14 @@ def cmd_serve(args) -> int:
     app = ServeApp(store, cache,
                    render_timeout_s=getattr(args, "render_timeout", None),
                    synopsis_default=getattr(args, "synopsis_default", False))
+    # Incident bundles capture the same state /healthz serves, plus the
+    # mount fingerprint (no-ops without --incident-dir).
+    from heatmap_tpu.obs import incident as incident_mod
+
+    incident_mod.add_state_provider("healthz", app._health)
+    incident_mod.add_state_provider("config", lambda: {
+        "store": args.store, "layers": app.layer_names(),
+        "cache_bytes": cache.max_bytes, "ttl_s": cache.ttl_s})
     stop_stream = None
     if args.follow_stream:
         stop_stream = _follow_stream(args, app)
@@ -987,6 +1045,13 @@ def _serve_fleet(args, collector, ev_log) -> int:
         queue_deadline_s=args.queue_deadline,
         hedge_quantile=args.hedge_quantile,
         probe_interval_s=args.probe_interval)
+    from heatmap_tpu.obs import incident as incident_mod
+
+    incident_mod.add_state_provider("healthz", supervisor.router._health)
+    incident_mod.add_state_provider("config", lambda: {
+        "store": args.store, "fleet": args.fleet,
+        "backends": {bid: c.address for bid, c
+                     in supervisor.router.backends.items()}})
     supervisor.start()
     server = make_server(supervisor.router, host=args.host, port=args.port)
     host, port = server.server_address[:2]
@@ -1300,6 +1365,11 @@ def cmd_update(args) -> int:
     from heatmap_tpu.obs import tracing as tracing_mod
 
     collector = _setup_tracing(args)
+    from heatmap_tpu.obs import incident as incident_mod
+
+    incident_mod.add_state_provider("delta", lambda: {
+        "journal": args.journal,
+        "live_deltas": len(delta_mod.live_entries(args.journal))})
     root_span = tracing_mod.begin_span("update")
     t0 = time.perf_counter()
     job_error = None
@@ -1340,12 +1410,14 @@ def cmd_update(args) -> int:
         summary["live_deltas"] = live
     except ValueError as e:
         # Config mismatch / double --base: operator errors, one line.
+        _fail_telemetry(root_span, e)
         if not telemetry:
             tracing_mod.end_span(root_span)
             _export_trace(args, collector)
             raise SystemExit(str(e)) from e
         job_error = e
     except BaseException as e:  # noqa: BLE001 — run_end must record it
+        _fail_telemetry(root_span, e)
         if not telemetry:
             tracing_mod.end_span(root_span)
             _export_trace(args, collector)
@@ -1525,6 +1597,11 @@ def cmd_ingest(args) -> int:
     from heatmap_tpu.obs import tracing as tracing_mod
 
     collector = _setup_tracing(args)
+    from heatmap_tpu.obs import incident as incident_mod
+
+    incident_mod.add_state_provider("delta", lambda: {
+        "journal": args.journal,
+        "live_deltas": len(delta_mod.live_entries(args.journal))})
     root_span = tracing_mod.begin_span("ingest")
     t0 = time.perf_counter()
     job_error = None
@@ -1558,12 +1635,14 @@ def cmd_ingest(args) -> int:
             "compile_cache": bucketing.cache_stats(),
         })
     except ValueError as e:
+        _fail_telemetry(root_span, e)
         if not telemetry:
             tracing_mod.end_span(root_span)
             _export_trace(args, collector)
             raise SystemExit(str(e)) from e
         job_error = e
     except BaseException as e:  # noqa: BLE001 — run_end must record it
+        _fail_telemetry(root_span, e)
         if not telemetry:
             tracing_mod.end_span(root_span)
             _export_trace(args, collector)

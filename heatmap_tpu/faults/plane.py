@@ -46,7 +46,10 @@ Configuration: programmatic (``FaultPlane`` + ``install``), the CLI
 ``--chaos SPEC`` flag, or the ``HEATMAP_TPU_CHAOS`` env var; see
 ``parse_spec`` for the grammar. Every fired fault is recorded via
 ``obs.record_fault`` (a ``fault_injected`` event + the
-``faults_injected_total{site}`` counter).
+``faults_injected_total{site}`` counter). With a flight recorder
+installed that event also tail-promotes the ambient trace out of the
+ring (obs/recorder.py) and feeds the incident manager's fault-storm
+detector (obs/incident.py) — no per-site wiring here.
 """
 
 from __future__ import annotations

@@ -57,6 +57,7 @@ import threading
 import time
 
 from heatmap_tpu import faults, obs
+from heatmap_tpu.obs import recorder as recorder_mod
 from heatmap_tpu.obs import tracing
 
 _DONE = object()  # producer -> consumer end-of-stream sentinel
@@ -368,6 +369,10 @@ def run_ingest(root: str, source, config=None, *,
                         # without dropping any cache entries.
                         store.refresh_layers()
         seconds = time.monotonic() - t0
+        # Tail-based retention: a tick past the recorder's latency
+        # threshold promotes its whole (possibly unsampled) tree out
+        # of the flight recorder as if it had been head-sampled.
+        recorder_mod.maybe_promote(ms=seconds * 1e3)
         lag = max(0.0, time.monotonic() - ctx.enqueued_at)
         wm = _event_watermark(cols)
         if wm is not None and (stats.watermark is None

@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import time
 
-from heatmap_tpu.obs import events, metrics, slo, tracing
+from heatmap_tpu.obs import events, incident, metrics, recorder, slo, tracing
+from heatmap_tpu.obs.incident import IncidentManager
+from heatmap_tpu.obs.recorder import FlightRecorder
 from heatmap_tpu.obs.events import (EVENT_SCHEMA, EventLog, emit,
                                     get_event_log, read_events,
                                     set_event_log, validate_event)
@@ -97,6 +99,12 @@ FAULTS_INJECTED = _registry.counter(
 IO_RETRIES = _registry.counter(
     "io_retries_total", "I/O operations retried by faults.retry",
     labelnames=("site",))
+INCIDENTS_TOTAL = _registry.counter(
+    "incidents_total", "Incident bundles flushed, by trigger edge",
+    labelnames=("trigger",))
+RECORDER_DROPPED = _registry.counter(
+    "recorder_dropped_total",
+    "Flight-recorder ring evictions (spans + events)")
 PROCESS_UPTIME = _registry.gauge(
     "process_uptime_seconds", "Seconds since this process imported obs")
 BUILD_INFO = _registry.gauge(
@@ -337,18 +345,20 @@ def record_speculative_result(shard, winner, loser=None, won: bool = False,
 
 
 __all__ = [
-    "EVENT_SCHEMA", "EventLog", "MetricsRegistry", "SLOEngine", "SLOSpec",
+    "EVENT_SCHEMA", "EventLog", "FlightRecorder", "IncidentManager",
+    "MetricsRegistry", "SLOEngine", "SLOSpec",
     "TraceCollector", "blob_checksum", "build_run_report", "current_span",
     "current_traceparent", "device_topology", "disable_tracing", "emit",
     "enable_metrics", "enable_tracing", "events", "format_run_report",
     "get_collector", "get_event_log", "get_registry", "heartbeat",
-    "heartbeat_ages", "install_specs", "metrics", "metrics_enabled",
+    "heartbeat_ages", "incident", "install_specs", "metrics",
+    "metrics_enabled",
     "parse_slo_spec", "parse_traceparent", "read_events", "record_fault",
     "record_io_retry", "record_recovery", "record_retry",
     "record_shard_orphaned", "record_shard_reassigned",
     "record_speculative_launch", "record_speculative_result",
-    "record_stage", "refresh_process_gauges", "sample_device_memory",
-    "set_event_log",
+    "record_stage", "recorder", "refresh_process_gauges",
+    "sample_device_memory", "set_event_log",
     "slo", "slo_status", "telemetry_enabled", "tracing", "tracing_enabled",
     "validate_event", "write_run_report",
 ]

@@ -141,6 +141,12 @@ EVENT_SCHEMA = {
     # early-serve overlay not yet superseded by the exact apply.
     "synopsis_served": {"required": ("layer", "zoom", "max_err"),
                         "optional": ("stale", "source_zoom")},
+    # obs/incident.py: one incident bundle flushed (trigger is the
+    # edge kind — slo_breach | shed | fault_storm | degraded_enter |
+    # exception; path the bundle directory; seq the manager's own
+    # monotonic bundle counter).
+    "incident_flush": {"required": ("trigger", "path"),
+                       "optional": ("seq", "detail", "bytes")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",
@@ -228,8 +234,12 @@ _current: EventLog | None = None
 #   (trace_id, span_id) so _TRACE_STAMPED events link to span trees.
 # - _observer: set by obs.slo.set_engine; sees every emitted record so
 #   the SLO window fills without re-reading the log file.
+# - _recorder: set by obs.recorder when a flight recorder or incident
+#   manager is installed; sees every record (ring tail + trigger
+#   detection), even without a log or observer.
 _trace_ids = None
 _observer = None
+_recorder = None
 
 # Events that get the ambient trace identity stamped automatically
 # (explicit trace_id in fields always wins, e.g. serve passes the
@@ -258,7 +268,8 @@ def emit(event: str, **fields) -> dict | None:
     """
     log = _current
     observer = _observer
-    if log is None and observer is None:
+    recorder = _recorder
+    if log is None and observer is None and recorder is None:
         return None
     ids_fn = _trace_ids
     if (ids_fn is not None and event in _TRACE_STAMPED
@@ -271,6 +282,8 @@ def emit(event: str, **fields) -> dict | None:
                  "event": event, **fields})
     if observer is not None:
         observer(rec)
+    if recorder is not None:
+        recorder(rec)
     return rec if log is not None else None
 
 

@@ -29,6 +29,11 @@ import threading
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
 
+# Exemplar identity hook: installed by obs.tracing.enable_tracing (the
+# events._trace_ids pattern); returns the ambient (trace_id, span_id)
+# so each histogram bucket remembers the last trace that landed in it.
+_exemplar_ids = None
+
 # Wall-clock seconds; spans range from sub-ms host hops to multi-minute
 # ingest scans, so the grid is log-ish from 1ms to ~2min.
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
@@ -135,6 +140,11 @@ class Histogram(_Metric):
         if not bs or any(b != b or b == float("inf") for b in bs):
             raise ValueError("histogram buckets must be finite and non-empty")
         self.buckets = bs
+        # (series-key, bucket-idx) -> (trace_id, span_id, value): the
+        # last trace that landed in each bucket (OpenMetrics exemplar).
+        # Kept out of the per-series state list so samples() consumers
+        # still unpack [counts, sum, n].
+        self._exemplars: dict = {}
 
     def observe(self, value: float, **labels):
         reg = self._registry
@@ -142,6 +152,8 @@ class Histogram(_Metric):
             return
         key = self._key(labels)
         idx = bisect.bisect_left(self.buckets, value)
+        ids_fn = _exemplar_ids
+        ids = ids_fn() if ids_fn is not None else None
         with reg._lock:
             state = self._values.get(key)
             if state is None:
@@ -150,11 +162,25 @@ class Histogram(_Metric):
             state[0][idx] += 1
             state[1] += value
             state[2] += 1
+            if ids is not None:
+                self._exemplars[(key, idx)] = (ids[0], ids[1], value)
 
     def samples(self) -> dict:
         with self._registry._lock:
             return {k: [list(v[0]), v[1], v[2]]
                     for k, v in self._values.items()}
+
+    def exemplars(self) -> dict:
+        """Snapshot ``{(series-key, bucket-idx): (trace_id, span_id,
+        value)}`` — the last observation that landed in each bucket
+        while tracing supplied an ambient identity."""
+        with self._registry._lock:
+            return dict(self._exemplars)
+
+    def clear(self):
+        with self._registry._lock:
+            self._values.clear()
+            self._exemplars.clear()
 
 
 class MetricsRegistry:
@@ -210,6 +236,9 @@ class MetricsRegistry:
         with self._lock:
             for m in self._metrics.values():
                 m._values.clear()
+                exemplars = getattr(m, "_exemplars", None)
+                if exemplars is not None:
+                    exemplars.clear()
 
     def snapshot(self) -> dict:
         """JSON-ready dump: ``{name: {type, help, labelnames, samples}}``
@@ -220,6 +249,7 @@ class MetricsRegistry:
             metrics = list(self._metrics.values())
         for m in metrics:
             entries = []
+            exemplars = (m.exemplars() if m.kind == "histogram" else {})
             for key, val in sorted(m.samples().items()):
                 labels = dict(zip(m.labelnames, key))
                 if m.kind == "histogram":
@@ -228,8 +258,19 @@ class MetricsRegistry:
                     for b, c in zip(m.buckets + (float("inf"),), counts):
                         acc += c
                         cum[_fmt(b)] = acc
-                    entries.append({"labels": labels, "buckets": cum,
-                                    "sum": total, "count": n})
+                    entry = {"labels": labels, "buckets": cum,
+                             "sum": total, "count": n}
+                    ex = {}
+                    bounds = m.buckets + (float("inf"),)
+                    for idx, bound in enumerate(bounds):
+                        hit = exemplars.get((key, idx))
+                        if hit is not None:
+                            ex[_fmt(bound)] = {"trace_id": hit[0],
+                                               "span_id": hit[1],
+                                               "value": hit[2]}
+                    if ex:
+                        entry["exemplars"] = ex
+                    entries.append(entry)
                 else:
                     entries.append({"labels": labels, "value": val})
             out[m.name] = {"type": m.kind, "help": m.help,
@@ -255,12 +296,21 @@ class MetricsRegistry:
                     for ln, lv in zip(m.labelnames, key))
                 if m.kind == "histogram":
                     counts, total, n = val
+                    exemplars = m.exemplars()
                     acc = 0
-                    for b, c in zip(m.buckets + (float("inf"),), counts):
+                    for idx, (b, c) in enumerate(
+                            zip(m.buckets + (float("inf"),), counts)):
                         acc += c
                         le = (base + "," if base else "") + f'le="{_fmt(b)}"'
-                        lines.append(
-                            f"{m.name}_bucket{{{le}}} {acc}")
+                        line = f"{m.name}_bucket{{{le}}} {acc}"
+                        hit = exemplars.get((key, idx))
+                        if hit is not None:
+                            # OpenMetrics-style exemplar: the last
+                            # trace that landed in this bucket.
+                            line += (f' # {{trace_id="{hit[0]}",'
+                                     f'span_id="{hit[1]}"}} '
+                                     f"{_fmt(hit[2])}")
+                        lines.append(line)
                     suffix = f"{{{base}}}" if base else ""
                     lines.append(f"{m.name}_sum{suffix} {_fmt(total)}")
                     lines.append(f"{m.name}_count{suffix} {n}")

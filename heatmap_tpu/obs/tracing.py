@@ -65,7 +65,7 @@ class Span:
     seconds, exported as microseconds)."""
 
     __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_s",
-                 "dur_s", "attrs", "tid", "_token")
+                 "dur_s", "attrs", "tid", "shadow", "_token")
 
     def __init__(self, name: str, trace_id: str, parent_id: str | None,
                  attrs: dict | None = None):
@@ -77,6 +77,10 @@ class Span:
         self.dur_s = 0.0
         self.attrs = attrs or {}
         self.tid = threading.get_ident()
+        # Shadow spans run under an unsampled root while the flight
+        # recorder is installed: full fidelity into the ring, never the
+        # collector (unless the tree is promoted), flags 00 on the wire.
+        self.shadow = False
         self._token = None
 
     def to_record(self) -> dict:
@@ -100,6 +104,30 @@ class _NotSampled:
         self._token = None
 
 
+def chrome_doc(records, t0: float = 0.0) -> dict:
+    """Render span records as a Chrome/Perfetto trace-event document
+    (shared by the collector export and incident bundles)."""
+    pid = os.getpid()
+    events = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "heatmap_tpu"},
+    }]
+    for rec in records:
+        args = {"trace_id": rec["trace_id"],
+                "span_id": rec["span_id"],
+                "parent_id": rec["parent_id"]}
+        for k, v in rec["attrs"].items():
+            args[k] = v if isinstance(v, (int, float, bool, str,
+                                          type(None))) else str(v)
+        events.append({
+            "name": rec["name"], "cat": "heatmap", "ph": "X",
+            "ts": round((rec["start_s"] - t0) * 1e6, 3),
+            "dur": round(rec["dur_s"] * 1e6, 3),
+            "pid": pid, "tid": rec["tid"], "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 class TraceCollector:
     """Thread-safe buffer of finished spans plus the sampling policy."""
 
@@ -121,11 +149,17 @@ class TraceCollector:
         return self._rng.random() < self.sample
 
     def add(self, span: Span):
+        self.add_record(span.to_record())
+
+    def add_record(self, rec: dict):
+        """Buffer an already-materialised span record (what the flight
+        recorder's tail promotion forwards — byte-for-byte the dict a
+        head-sampled span would have contributed)."""
         with self._lock:
             if len(self._spans) >= MAX_SPANS:
                 self.dropped += 1
                 return
-            self._spans.append(span.to_record())
+            self._spans.append(rec)
 
     def spans(self) -> list[dict]:
         with self._lock:
@@ -139,25 +173,7 @@ class TraceCollector:
     # -- export ------------------------------------------------------------
     def to_chrome(self) -> dict:
         """Chrome trace-event JSON (``ph:"X"`` complete events, µs)."""
-        pid = os.getpid()
-        events = [{
-            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-            "args": {"name": "heatmap_tpu"},
-        }]
-        for rec in self.spans():
-            args = {"trace_id": rec["trace_id"],
-                    "span_id": rec["span_id"],
-                    "parent_id": rec["parent_id"]}
-            for k, v in rec["attrs"].items():
-                args[k] = v if isinstance(v, (int, float, bool, str,
-                                              type(None))) else str(v)
-            events.append({
-                "name": rec["name"], "cat": "heatmap", "ph": "X",
-                "ts": round((rec["start_s"] - self.t0) * 1e6, 3),
-                "dur": round(rec["dur_s"] * 1e6, 3),
-                "pid": pid, "tid": rec["tid"], "args": args,
-            })
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return chrome_doc(self.spans(), self.t0)
 
     def export_chrome(self, path: str) -> int:
         """Write trace-event JSON; returns the number of span events."""
@@ -193,6 +209,9 @@ class TraceCollector:
 
 _on = False  # THE hot-path guard: one global read when tracing is off
 _collector: TraceCollector | None = None
+# Installed by obs.recorder.install: routes shadow spans (unsampled
+# trees captured at full fidelity) into the flight-recorder ring.
+_recorder = None
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "heatmap_tpu_span", default=None)
 
@@ -204,12 +223,13 @@ def enable_tracing(sample: float = 1.0,
     global _on, _collector
     _collector = TraceCollector(sample=sample, seed=seed)
     _on = True
-    from heatmap_tpu.obs import events
+    from heatmap_tpu.obs import events, metrics
     from heatmap_tpu.utils import trace
 
     trace._tree_begin = begin_span
     trace._tree_end = end_span
     events._trace_ids = current_ids
+    metrics._exemplar_ids = current_ids
     return _collector
 
 
@@ -218,12 +238,13 @@ def disable_tracing():
     global _on, _collector
     _on = False
     _collector = None
-    from heatmap_tpu.obs import events
+    from heatmap_tpu.obs import events, metrics
     from heatmap_tpu.utils import trace
 
     trace._tree_begin = None
     trace._tree_end = None
     events._trace_ids = None
+    metrics._exemplar_ids = None
 
 
 def tracing_enabled() -> bool:
@@ -268,8 +289,13 @@ def begin_span(name: str, attrs: dict | None = None,
         return None
     parent = _current.get()
     if isinstance(parent, _NotSampled):
-        return None  # whole subtree is unsampled; nothing to unwind
-    if parent is None:
+        if _recorder is None:
+            return None  # whole subtree is unsampled; nothing to unwind
+        # Flight recorder installed: capture the unsampled subtree at
+        # full fidelity as shadow spans (ring-bound, promotable).
+        sp = Span(name, parent.trace_id, parent.span_id, attrs)
+        sp.shadow = True
+    elif parent is None:
         # Root: decide sampling here, once per trace.
         remote = parse_traceparent(traceparent) if traceparent else None
         if remote is not None:
@@ -278,12 +304,17 @@ def begin_span(name: str, attrs: dict | None = None,
             trace_id, parent_id = uuid.uuid4().hex, None
             sampled = collector.sample_decision()
         if not sampled:
-            sentinel = _NotSampled(trace_id)
-            sentinel._token = _current.set(sentinel)
-            return sentinel
-        sp = Span(name, trace_id, parent_id, attrs)
+            if _recorder is None:
+                sentinel = _NotSampled(trace_id)
+                sentinel._token = _current.set(sentinel)
+                return sentinel
+            sp = Span(name, trace_id, parent_id, attrs)
+            sp.shadow = True
+        else:
+            sp = Span(name, trace_id, parent_id, attrs)
     else:
         sp = Span(name, parent.trace_id, parent.span_id, attrs)
+        sp.shadow = parent.shadow
     sp._token = _current.set(sp)
     return sp
 
@@ -299,9 +330,18 @@ def end_span(sp):
     if isinstance(sp, _NotSampled):
         return
     sp.dur_s = _now_s() - sp.start_s
+    recorder = _recorder
+    if sp.shadow:
+        # Shadow spans never reach the collector directly; the ring
+        # forwards them on tail promotion.
+        if recorder is not None:
+            recorder.record_span(sp)
+        return
     collector = _collector
     if collector is not None:
         collector.add(sp)
+    if recorder is not None:
+        recorder.record_span(sp)
 
 
 @contextlib.contextmanager
@@ -339,7 +379,10 @@ def current_traceparent() -> str | None:
     cur = _current.get()
     if cur is None:
         return None
-    flags = FLAG_SAMPLED if isinstance(cur, Span) else 0
+    # Shadow spans are real Spans but head-UNSAMPLED: downstream must
+    # see flags 00 or remote hops would head-sample the continuation.
+    flags = (FLAG_SAMPLED if isinstance(cur, Span) and not cur.shadow
+             else 0)
     return (f"{TRACEPARENT_VERSION}-{cur.trace_id}-{cur.span_id}-"
             f"{flags:02x}")
 

@@ -535,6 +535,11 @@ def _run_simulated(plan, lineage, execute, *, n_hosts: int,
                         loser=orig if won else host,
                         won=won, quarantined=quarantined)
         except BaseException as e:  # noqa: BLE001 — surfaced to driver
+            # Tail-promote the dying worker's trace out of the flight
+            # recorder before the driver re-raises (no-op when none).
+            from heatmap_tpu.obs import recorder as recorder_mod
+
+            recorder_mod.maybe_promote(error=True)
             errors.append((host, e))
             abort.set()
 

@@ -57,7 +57,7 @@ import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from heatmap_tpu import faults, obs
-from heatmap_tpu.obs import slo, tracing
+from heatmap_tpu.obs import incident, recorder, slo, tracing
 from heatmap_tpu.serve.cache import TileCache
 from heatmap_tpu.serve.render import (SynopsisLayer, synopsis_source,
                                       tile_json_bytes, tile_png_bytes)
@@ -252,6 +252,11 @@ class ServeApp:
         if not admitted:
             self._degrade("shed",
                           f"in-flight bound {self.max_inflight} reached")
+            # Every typed-503 shed is an incident trigger edge (the
+            # manager rate-limits per kind, so a shed burst flushes
+            # one bundle, not one per rejected request).
+            incident.trigger(
+                "shed", detail=f"in-flight bound {self.max_inflight}")
             body = json.dumps({"error": "service unavailable",
                                "cause": "shed"}).encode()
             return 503, "application/json", body, None, "tiles", None
@@ -462,13 +467,17 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
             if obs.metrics_enabled():
                 HTTP_REQUESTS.inc(route=route, status=str(status))
+            ms = round((time.monotonic() - t0) * 1e3, 3)
             # Emitted while the request span is still ambient, so the
             # event is stamped with this tree's trace_id/span_id.
             obs.emit("http_request", route=route, status=int(status),
-                     path=self.path,
-                     ms=round((time.monotonic() - t0) * 1e3, 3),
-                     bytes=len(body),
+                     path=self.path, ms=ms, bytes=len(body),
                      **({"cache": cache} if cache else {}))
+            # Tail-based retention: a 5xx or a tail-latency outlier
+            # promotes this request's tree out of the flight-recorder
+            # ring even when head sampling dropped it. Must run before
+            # end_span so the root itself rides the live-forward path.
+            recorder.maybe_promote(req_span, status=status, ms=ms)
         finally:
             tracing.end_span(req_span)
 

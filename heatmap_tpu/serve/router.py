@@ -51,12 +51,14 @@ import hashlib
 import http.client
 import json
 import queue
+import re
 import threading
 import time
+import urllib.parse
 from collections import deque
 
 from heatmap_tpu import faults, obs
-from heatmap_tpu.obs import tracing
+from heatmap_tpu.obs import incident, tracing
 from heatmap_tpu.serve.http import _TILE_RE, Response
 
 _registry = obs.get_registry()
@@ -116,6 +118,44 @@ def route_key(path: str) -> str:
     if m is not None:
         return f"{m['layer']}/{m['z']}/{m['x']}/{m['y']}"
     return path
+
+
+def _flag_opt(query: str, name: str) -> bool:
+    """Boolean query option (last value wins, urllib convention)."""
+    if not query:
+        return False
+    vals = urllib.parse.parse_qs(query).get(name)
+    if not vals:
+        return False
+    return vals[-1] not in ("0", "false", "no")
+
+
+# One exposition sample line: name, optional {labels}, rest (value and
+# any OpenMetrics exemplar suffix — which carries its own {...} and
+# must not be touched by the relabel).
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?(?P<rest> .*)$")
+
+
+def relabel_metrics(text: str, **extra_labels) -> str:
+    """Inject labels (e.g. ``backend="b0"``) into every sample line of
+    a Prometheus text exposition. Comment lines are dropped — the
+    merged fleet page keeps one HELP/TYPE block per metric (the
+    scraping router's own) instead of one per backend."""
+    injected = ",".join(f'{k}="{v}"' for k, v in sorted(
+        extra_labels.items()))
+    out = []
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        labels = m["labels"]
+        merged = f"{injected},{labels}" if labels else injected
+        out.append(f"{m['name']}{{{merged}}}{m['rest']}")
+    return "\n".join(out) + ("\n" if out else "")
 
 
 class CircuitBreaker:
@@ -435,25 +475,57 @@ class RouterApp:
     def handle(self, method: str, path: str,
                if_none_match: str | None = None):
         """Same 6-tuple contract as ``ServeApp.handle``."""
-        if method == "GET" and path == "/healthz":
+        # Router-owned endpoints match on the bare path so a query
+        # string (``/metrics?fleet=1``) selects options instead of
+        # falling through to the placement ring.
+        bare, _, query = path.partition("?")
+        if method == "GET" and bare == "/healthz":
             body = json.dumps(self._health(), indent=2).encode()
             return 200, "application/json", body, None, "healthz", None
-        if method == "GET" and path == "/metrics":
+        if method == "GET" and bare == "/metrics":
             obs.refresh_process_gauges()
-            body = _registry.render_prometheus().encode()
+            text = _registry.render_prometheus()
+            if _flag_opt(query, "fleet"):
+                text += self._fleet_metrics()
+            body = text.encode()
             return (200, "text/plain; version=0.0.4", body, None,
                     "metrics", None)
-        if method == "POST" and path == "/reload":
+        if method == "POST" and bare == "/reload":
             return self._rolling_reload()
-        if method == "POST" and path.startswith("/fleet/"):
-            return self._fleet_op(path)
+        if method == "POST" and bare.startswith("/fleet/"):
+            return self._fleet_op(bare)
         return self._route(method, path, if_none_match)
+
+    def _fleet_metrics(self) -> str:
+        """Scrape each live backend's ``/metrics`` and merge the series
+        under a ``backend`` label next to the router's own registry
+        (``GET /metrics?fleet=1``). Unreachable backends are skipped —
+        a scrape must never trip breakers or block on a dead ring
+        member beyond the client timeout."""
+        chunks = []
+        for bid in sorted(self.backends):
+            backend = self.backends[bid]
+            if not backend.eligible():
+                continue
+            try:
+                status, _, body = backend.fetch("GET", "/metrics")
+            except Exception:
+                continue
+            if status != 200:
+                continue
+            chunks.append(relabel_metrics(
+                body.decode("utf-8", "replace"), backend=bid))
+        return "".join(chunks)
 
     # -- routing -----------------------------------------------------------
 
     def _shed(self, cause: str, detail: str = "", status: int = 503):
         if obs.metrics_enabled():
             FLEET_SHED.inc(cause=cause)
+        if status == 503:
+            # Router-side typed 503s are incident trigger edges too
+            # (rate-limited per kind by the manager).
+            incident.trigger("shed", detail=cause)
         body = json.dumps({"error": "service unavailable", "cause": cause,
                            **({"detail": detail} if detail else {})}).encode()
         return status, "application/json", body, None, "shed", None

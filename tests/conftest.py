@@ -51,7 +51,7 @@ def _reset_telemetry():
     yield
     from heatmap_tpu import faults, obs
     from heatmap_tpu.delta import recover
-    from heatmap_tpu.obs import slo, tracing
+    from heatmap_tpu.obs import incident, recorder, slo, tracing
     from heatmap_tpu.utils import trace
 
     trace.get_tracer().reset()
@@ -64,5 +64,7 @@ def _reset_telemetry():
         obs.set_event_log(None)
     tracing.disable_tracing()  # unhooks trace/events integrations too
     slo.set_engine(None)
+    incident.set_manager(None)
+    recorder.install(None)  # restores the tracing/events hooks to None
     faults.install(None)  # disarm any chaos a test left installed
     recover.clear_verified_cache()

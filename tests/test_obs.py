@@ -81,6 +81,9 @@ _PAYLOADS = {
                    "kind": "latency", "compliance": 0.9975,
                    "target": 0.999, "window_s": 300.0,
                    "detail": "threshold_ms=50"},
+    "incident_flush": {"trigger": "shed", "path": "incidents/ab12-0",
+                       "seq": 0, "detail": "in-flight bound 2",
+                       "bytes": 4096},
     "run_end": {"status": "ok", "blobs": 42, "checksum": "crc32:00000000",
                 "seconds": 1.0},
 }
@@ -546,17 +549,22 @@ class TestNoRawInstrumentation:
         assert self.SLEEP_PATTERN.search("time.sleep(backoff_s * attempt)")
 
     TRACING_MODULES = ("heatmap_tpu/obs/tracing.py",
-                       "heatmap_tpu/obs/slo.py")
+                       "heatmap_tpu/obs/slo.py",
+                       "heatmap_tpu/obs/recorder.py",
+                       "heatmap_tpu/obs/incident.py")
     TRACING_PATTERN = re.compile(
         r"(?:(?<![\w.])print\(|time\.perf_counter\(|(?<![\w.])time\.sleep\()")
 
     def test_tracing_and_slo_have_no_unsanctioned_clocks(self):
-        """obs/tracing.py and obs/slo.py sit inside the blanket
-        ``heatmap_tpu/obs/`` allowance above, so they get their own
-        tighter guard: no raw print()/perf_counter()/time.sleep()
-        except on lines explicitly marked ``# sanctioned:`` (tracing's
-        single ``_now_s`` clock site). The SLO engine must run entirely
-        on event timestamps — it never owns a clock or sleeps."""
+        """obs/tracing.py, obs/slo.py, obs/recorder.py and
+        obs/incident.py sit inside the blanket ``heatmap_tpu/obs/``
+        allowance above, so they get their own tighter guard: no raw
+        print()/perf_counter()/time.sleep() except on lines explicitly
+        marked ``# sanctioned:`` (tracing's single ``_now_s`` clock
+        site). The SLO engine and the flight recorder run entirely on
+        event/span timestamps — they never own a clock or sleep; the
+        incident manager's wall clock is time.time (injectable), never
+        perf_counter."""
         offenders, sanctioned = [], []
         for rel in self.TRACING_MODULES:
             full = os.path.join(REPO, rel)

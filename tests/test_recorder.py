@@ -1,0 +1,493 @@
+"""Flight recorder + incident bundle tests (docs/observability.md).
+
+Pins the tentpole contracts: bounded rings under concurrency,
+tail-based promotion of unsampled trees byte-for-byte into the
+collector, dedup against head-sampled roots, one-bundle-per-episode
+trigger edges with a deterministic injectable clock, atomic size-capped
+bundles with age-wins pruning, OpenMetrics exemplar round-trips, and
+the zero-cost-when-off acceptance bar: blobs byte-identical with the
+recorder + incident manager armed vs everything off.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from heatmap_tpu import obs
+from heatmap_tpu.obs import incident, tracing
+from heatmap_tpu.obs import recorder as recorder_mod
+from heatmap_tpu.obs.incident import IncidentManager
+from heatmap_tpu.obs.recorder import FlightRecorder
+
+
+def _shadow_tree(names=("serve.request", "tile.render")):
+    """Open an unsampled root + child chain; returns the open spans
+    root-first (caller ends them)."""
+    spans = []
+    for name in names:
+        spans.append(tracing.begin_span(name))
+    return spans
+
+
+class TestRingBounded:
+    def test_ring_bounded_under_thread_storm(self):
+        """8 threads, 1600 completed spans, one 64-slot subsystem ring:
+        the ring never exceeds its bound and every eviction is counted
+        (ring size + dropped == spans recorded, exactly)."""
+        obs.enable_metrics(True)
+        tracing.enable_tracing(sample=0.0)
+        rec = FlightRecorder(max_spans=64)
+        recorder_mod.install(rec)
+        n_threads, per_thread = 8, 100
+
+        def worker():
+            for _ in range(per_thread):
+                root = tracing.begin_span("storm.op")
+                child = tracing.begin_span("storm.child")
+                tracing.end_span(child)
+                tracing.end_span(root)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = n_threads * per_thread * 2
+        stats = rec.stats()
+        assert stats["subsystems"] == ["storm"]
+        assert stats["spans"] == 64
+        assert stats["dropped"] == total - 64
+        assert obs.RECORDER_DROPPED.value() == total - 64
+        # The eviction index stays consistent: every ringed span is
+        # still reachable through its trace.
+        assert len(rec.span_records()) == 64
+
+    def test_event_ring_bounded(self):
+        rec = FlightRecorder(max_events=8)
+        recorder_mod.install(rec)
+        for i in range(20):
+            rec.record_event({"event": "http_request", "ts": float(i),
+                              "seq": i, "status": 200})
+        assert len(rec.event_records()) == 8
+        # Oldest-first by the envelope (ts, seq).
+        assert [r["seq"] for r in rec.event_records()] == list(range(12, 20))
+        assert rec.dropped == 12
+
+    def test_capacity_must_be_positive(self):
+        with pytest.raises(ValueError, match="positive"):
+            FlightRecorder(max_spans=0)
+
+
+class TestTailPromotion:
+    def test_unsampled_error_tree_promotes_byte_for_byte(self):
+        """sample=0 (strictly harder than the acceptance 0.01): the
+        whole request tree runs as shadow spans, renders flags 00 on
+        the wire, and a 503 promotes it into the collector as the
+        exact records a head-sampled run would have contributed."""
+        collector = tracing.enable_tracing(sample=0.0)
+        rec = FlightRecorder(max_spans=64)
+        recorder_mod.install(rec)
+
+        root, child = _shadow_tree()
+        assert isinstance(root, tracing.Span) and root.shadow
+        assert tracing.current_traceparent().endswith("-00")
+        tracing.end_span(child)
+        assert collector.spans() == []  # head decision: dropped
+
+        assert recorder_mod.maybe_promote(root, status=503)
+        tracing.end_span(root)  # root rides the live-forward path
+        got = collector.spans()
+        assert {r["name"] for r in got} == {"serve.request", "tile.render"}
+        assert {r["trace_id"] for r in got} == {root.trace_id}
+        ringed = {r["span_id"]: r for r in rec.span_records()}
+        for r in got:
+            assert json.dumps(r, sort_keys=True) == json.dumps(
+                ringed[r["span_id"]], sort_keys=True)
+
+    def test_tail_latency_threshold_promotes(self):
+        collector = tracing.enable_tracing(sample=0.0)
+        recorder_mod.install(FlightRecorder(tail_latency_s=0.05))
+        root = tracing.begin_span("serve.request")
+        assert not recorder_mod.maybe_promote(root, ms=10.0)
+        assert recorder_mod.maybe_promote(root, ms=80.0)
+        tracing.end_span(root)
+        assert [r["name"] for r in collector.spans()] == ["serve.request"]
+
+    def test_fast_ok_tree_stays_out_of_collector(self):
+        collector = tracing.enable_tracing(sample=0.0)
+        recorder_mod.install(FlightRecorder(tail_latency_s=10.0))
+        root = tracing.begin_span("serve.request")
+        assert not recorder_mod.maybe_promote(root, status=200, ms=1.0)
+        tracing.end_span(root)
+        assert collector.spans() == []
+
+    def test_promotion_dedups_against_head_sampled_roots(self):
+        """A sampled tree reaches the collector once through the normal
+        path; promoting it again copies nothing (sampled spans are
+        never shadow) and is idempotent."""
+        collector = tracing.enable_tracing(sample=1.0)
+        rec = FlightRecorder()
+        recorder_mod.install(rec)
+        root = tracing.begin_span("serve.request")
+        assert not root.shadow
+        recorder_mod.maybe_promote(root, status=503)
+        tracing.end_span(root)
+        assert len(collector.spans()) == 1
+        assert rec.promote(root.trace_id) == 0  # second promote: no-op
+        assert len(collector.spans()) == 1
+
+    def test_fault_injected_event_promotes_ambient_tree(self):
+        obs.enable_metrics(True)  # record_fault gates on telemetry
+        collector = tracing.enable_tracing(sample=0.0)
+        recorder_mod.install(FlightRecorder())
+        root = tracing.begin_span("ingest.tick")
+        obs.record_fault("ingest.tick", 0, key=0)
+        tracing.end_span(root)
+        assert [r["name"] for r in collector.spans()] == ["ingest.tick"]
+
+
+def _fake_clock(start=1000.0):
+    state = [start]
+
+    def clock():
+        return state[0]
+
+    clock.advance = lambda s: state.__setitem__(0, state[0] + s)
+    return clock
+
+
+class TestIncidentTriggers:
+    def test_one_bundle_per_storm_episode(self, tmp_path):
+        """Seeded fault storm: threshold faults in-window flush exactly
+        one bundle; the episode resets; a repeat storm inside the
+        rate-limit window is suppressed, after it flushes again."""
+        clock = _fake_clock()
+        mgr = IncidentManager(str(tmp_path / "inc"), run_id="ep",
+                              storm_threshold=3, storm_window_s=10.0,
+                              min_interval_s=30.0, clock=clock)
+        incident.set_manager(mgr)
+        for i in range(6):  # two full episodes back to back
+            mgr.on_event({"event": "fault_injected", "ts": float(i),
+                          "site": "tile.render", "fault_seq": i})
+        assert len(mgr.flushed) == 1  # second episode rate-limited
+        assert mgr.suppressed == 1
+        clock.advance(31.0)
+        for i in range(3):
+            mgr.on_event({"event": "fault_injected", "ts": 100.0 + i,
+                          "site": "tile.render", "fault_seq": 6 + i})
+        assert len(mgr.flushed) == 2
+        triggers = [json.load(open(os.path.join(p, "manifest.json")))
+                    ["trigger"] for p in mgr.flushed]
+        assert triggers == ["fault_storm", "fault_storm"]
+
+    def test_slo_breach_and_degraded_enter_edges(self, tmp_path):
+        clock = _fake_clock()
+        mgr = IncidentManager(str(tmp_path / "inc"), run_id="edge",
+                              min_interval_s=30.0, clock=clock)
+        incident.set_manager(mgr)
+        mgr.on_event({"event": "slo_breach", "slo": "tiles-fast"})
+        mgr.on_event({"event": "degraded_enter", "cause": "render"})
+        # Distinct kinds rate-limit independently.
+        assert len(mgr.flushed) == 2
+        mgr.on_event({"event": "slo_breach", "slo": "tiles-fast"})
+        assert len(mgr.flushed) == 2 and mgr.suppressed == 1
+
+    def test_module_trigger_noop_without_manager(self):
+        assert incident.get_manager() is None
+        assert incident.trigger("exception", detail="x") is None
+
+    def test_trigger_emits_incident_flush_event(self, tmp_path):
+        clock = _fake_clock()
+        events_path = str(tmp_path / "events.jsonl")
+        obs.set_event_log(obs.EventLog(events_path, run_id="t"))
+        mgr = IncidentManager(str(tmp_path / "inc"), run_id="t",
+                              clock=clock)
+        incident.set_manager(mgr)
+        obs.enable_metrics(True)
+        path = mgr.trigger("shed", detail="bound 2")
+        obs.get_event_log().close()
+        obs.set_event_log(None)
+        assert path is not None
+        [rec] = [r for r in obs.read_events(events_path)
+                 if r["event"] == "incident_flush"]
+        assert rec["trigger"] == "shed" and rec["path"] == path
+        assert obs.INCIDENTS_TOTAL.value(trigger="shed") == 1
+
+
+class TestBundles:
+    def test_bundle_is_atomic_and_complete(self, tmp_path):
+        out = tmp_path / "inc"
+        tracing.enable_tracing(sample=0.0)
+        recorder_mod.install(FlightRecorder())
+        mgr = IncidentManager(str(out), run_id="ab12",
+                              clock=_fake_clock())
+        incident.set_manager(mgr)
+        root, child = _shadow_tree()
+        tracing.end_span(child)
+        tracing.end_span(root)
+        path = mgr.trigger("exception", detail="RuntimeError('x')")
+        assert os.path.basename(path) == "ab12-0"
+        assert sorted(os.listdir(path)) == [
+            "events.json", "manifest.json", "metrics.json", "state.json",
+            "trace.json"]
+        # No torn tmp dirs left behind.
+        assert not [n for n in os.listdir(out) if n.startswith(".tmp-")]
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert manifest["trigger"] == "exception"
+        assert manifest["run_id"] == "ab12" and manifest["seq"] == 0
+        for name, nbytes in manifest["files"].items():
+            assert os.path.getsize(os.path.join(path, name)) == nbytes
+        # trace.json replays as a valid Perfetto doc holding the tree.
+        doc = json.load(open(os.path.join(path, "trace.json")))
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert names == {"serve.request", "tile.render"}
+
+    def test_size_cap_trims_tails(self, tmp_path):
+        recorder_mod.install(FlightRecorder(max_events=512))
+        rec = recorder_mod.get_recorder()
+        for i in range(400):
+            rec.record_event({"event": "http_request", "ts": float(i),
+                              "seq": i, "pad": "x" * 256})
+        mgr = IncidentManager(str(tmp_path / "inc"), run_id="cap",
+                              max_bytes=20_000, clock=_fake_clock())
+        incident.set_manager(mgr)
+        path = mgr.trigger("shed")
+        manifest = json.load(open(os.path.join(path, "manifest.json")))
+        assert manifest["bytes"] <= 20_000
+        tail = json.load(open(os.path.join(path, "events.json")))
+        assert 0 < len(tail) < 400
+        # Oldest-first trimming: the newest events survive.
+        assert tail[-1]["seq"] == 399
+
+    def test_prune_age_wins(self, tmp_path):
+        out = tmp_path / "inc"
+        mgr = IncidentManager(str(out), run_id="pr", keep=2,
+                              min_age_s=5.0, min_interval_s=0.0)
+        incident.set_manager(mgr)
+        for _ in range(4):
+            mgr.trigger("shed")
+        assert len(mgr.flushed) == 4
+        # All four are younger than min_age_s: count says prune, age
+        # wins — nothing is deleted.
+        assert mgr.prune()["pruned"] == 0
+        assert len(os.listdir(out)) == 4
+        # Backdate the two oldest; now count AND age agree.
+        old = time.time() - 100.0
+        for name in ("pr-0", "pr-1"):
+            os.utime(os.path.join(out, name), (old, old))
+        assert mgr.prune()["pruned"] == 2
+        assert sorted(os.listdir(out)) == ["pr-2", "pr-3"]
+
+
+class TestExemplars:
+    def test_exemplar_render_round_trip(self):
+        """A histogram observation inside a span renders its trace
+        identity on the matching bucket line (OpenMetrics style) and
+        in the snapshot; registry reset clears it."""
+        obs.enable_metrics(True)
+        tracing.enable_tracing(sample=1.0)
+        reg = obs.get_registry()
+        h = reg.histogram("rt_seconds", "round trip", buckets=(0.01, 1.0))
+        root = tracing.begin_span("serve.request")
+        h.observe(0.005)
+        tracing.end_span(root)
+        prom = reg.render_prometheus()
+        [line] = [l for l in prom.splitlines()
+                  if l.startswith('rt_seconds_bucket{le="0.01"}')]
+        assert f'trace_id="{root.trace_id}"' in line
+        assert f'span_id="{root.span_id}"' in line
+        snap = reg.snapshot()["rt_seconds"]["samples"][0]
+        assert snap["exemplars"]["0.01"] == {
+            "trace_id": root.trace_id, "span_id": root.span_id,
+            "value": 0.005}
+        reg.reset()
+        assert " # {" not in reg.render_prometheus()
+
+    def test_shadow_span_supplies_exemplar_identity(self):
+        """Unsampled (shadow) requests still stamp exemplars — that is
+        the acceptance path: the 503's trace_id is on /metrics even at
+        sample=0.01, and promotion puts the matching tree in the
+        trace."""
+        obs.enable_metrics(True)
+        collector = tracing.enable_tracing(sample=0.0)
+        recorder_mod.install(FlightRecorder())
+        reg = obs.get_registry()
+        h = reg.histogram("sx_seconds", buckets=(0.01,))
+        root = tracing.begin_span("serve.request")
+        h.observe(0.001)
+        recorder_mod.maybe_promote(root, status=503)
+        tracing.end_span(root)
+        assert f'trace_id="{root.trace_id}"' in reg.render_prometheus()
+        assert {r["trace_id"] for r in collector.spans()} == {root.trace_id}
+
+    def test_no_exemplars_without_tracing(self):
+        obs.enable_metrics(True)
+        reg = obs.get_registry()
+        reg.histogram("nt_seconds", buckets=(0.01,)).observe(0.001)
+        assert " # {" not in reg.render_prometheus()
+        assert "exemplars" not in reg.snapshot()["nt_seconds"]["samples"][0]
+
+
+def _run_args(extra):
+    from heatmap_tpu.cli import build_parser
+
+    return build_parser().parse_args(
+        ["run", "--backend", "cpu", "--input", "synthetic:1500:3",
+         "--detail-zoom", "11", *extra])
+
+
+class TestRecorderCLI:
+    def test_blobs_byte_identical_recorder_on_vs_off(self, tmp_path,
+                                                     capsys):
+        """Acceptance bar: arming the flight recorder + incident
+        manager (with head sampling at 0) must not move a single output
+        byte."""
+        from heatmap_tpu.cli import cmd_run
+
+        out_off = tmp_path / "off.jsonl"
+        assert cmd_run(_run_args(["--output", f"jsonl:{out_off}"])) == 0
+        out_on = tmp_path / "on.jsonl"
+        assert cmd_run(_run_args(
+            ["--output", f"jsonl:{out_on}",
+             "--trace-out", str(tmp_path / "trace.json"),
+             "--trace-sample", "0.0",
+             "--flight-recorder-spans", "128",
+             "--tail-latency-ms", "60000",
+             "--incident-dir", str(tmp_path / "incidents")])) == 0
+        capsys.readouterr()
+        assert out_on.read_bytes() == out_off.read_bytes()
+
+    def test_recorder_not_armed_without_telemetry_surface(self):
+        from heatmap_tpu.cli import _setup_tracing
+
+        args = _run_args(["--output", "memory:"])
+        assert _setup_tracing(args) is None
+        assert recorder_mod.get_recorder() is None
+        assert incident.get_manager() is None
+
+    def test_flag_validation(self, tmp_path):
+        from heatmap_tpu.cli import _setup_tracing
+
+        args = _run_args(["--output", "memory:",
+                          "--trace-out", str(tmp_path / "t.json"),
+                          "--flight-recorder-spans", "-1"])
+        with pytest.raises(SystemExit, match="flight-recorder-spans"):
+            _setup_tracing(args)
+        args = _run_args(["--output", "memory:",
+                          "--trace-out", str(tmp_path / "t.json"),
+                          "--tail-latency-ms", "0"])
+        with pytest.raises(SystemExit, match="tail-latency-ms"):
+            _setup_tracing(args)
+
+    def test_failing_job_flushes_exception_bundle(self, tmp_path, capsys):
+        """Uncaught job error -> one exception bundle, and the failed
+        (unsampled) root rides tail promotion into the exported trace
+        (the acceptance trigger path end to end through cmd_run)."""
+        from heatmap_tpu.cli import cmd_run
+
+        inc_dir = tmp_path / "incidents"
+        trace_out = tmp_path / "trace.json"
+        args = _run_args(
+            ["--no-fast",  # skip the probe: fail inside the job proper
+             "--output", f"jsonl:{tmp_path / 'b.jsonl'}",
+             "--trace-out", str(trace_out),
+             "--trace-sample", "0.0",
+             "--incident-dir", str(inc_dir)])
+        args.input = f"csv:{tmp_path / 'does-not-exist.csv'}"
+        with pytest.raises(OSError):
+            cmd_run(args)
+        capsys.readouterr()
+        bundles = [d for d in os.listdir(inc_dir)
+                   if not d.startswith(".tmp-")]
+        assert len(bundles) == 1
+        manifest = json.load(open(
+            os.path.join(inc_dir, bundles[0], "manifest.json")))
+        assert manifest["trigger"] == "exception"
+        assert "FileNotFoundError" in manifest["detail"]
+        # The bundle flushes before the root closes; the root itself
+        # live-forwards into the collector and lands in --trace-out.
+        doc = json.load(open(trace_out))
+        names = {e["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert "run" in names
+
+
+class TestIncidentReportTool:
+    def test_report_folds_bundle(self, tmp_path, capsys):
+        import subprocess
+        import sys
+
+        tracing.enable_tracing(sample=0.0)
+        recorder_mod.install(FlightRecorder())
+        mgr = IncidentManager(str(tmp_path / "inc"), run_id="rep",
+                              clock=_fake_clock())
+        incident.set_manager(mgr)
+        root, child = _shadow_tree()
+        tracing.end_span(child)
+        recorder_mod.maybe_promote(root, status=503)
+        tracing.end_span(root)
+        path = mgr.trigger("shed", detail="bound 2")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "incident_report.py"),
+             path, "--json"],
+            capture_output=True, text=True, check=True)
+        report = json.loads(proc.stdout)
+        assert report["trigger"] == "shed"
+        assert report["run_id"] == "rep" and report["seq"] == 0
+        assert report["trace"]["n_spans"] == 2
+        [trace_row] = report["trace"]["traces"]
+        assert [h["name"] for h in trace_row["critical_path"]] == [
+            "serve.request", "tile.render"]
+
+    def test_trace_analyze_accepts_bundle_dir(self, tmp_path):
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import trace_analyze
+
+        tracing.enable_tracing(sample=0.0)
+        recorder_mod.install(FlightRecorder())
+        root, child = _shadow_tree()
+        tracing.end_span(child)
+        tracing.end_span(root)
+        mgr = IncidentManager(str(tmp_path / "inc"), run_id="ta",
+                              clock=_fake_clock())
+        incident.set_manager(mgr)
+        path = mgr.trigger("shed")
+        spans = trace_analyze.load_events(path)  # a directory, not a file
+        result = trace_analyze.analyze(spans)
+        assert result["n_spans"] == 2
+        [row] = result["traces"]
+        assert row["root"] == "serve.request" and not row["partial"]
+
+    def test_trace_analyze_tolerates_truncated_tree(self):
+        import sys
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "tools"))
+        import trace_analyze
+
+        # A ring eviction can drop a subtree's real parent; the orphan
+        # must analyze as a flagged partial root, not crash.
+        spans = [
+            {"name": "serve.request", "ts_us": 0.0, "dur_us": 100.0,
+             "tid": 1, "trace_id": "t1", "span_id": "a",
+             "parent_id": None, "attrs": {}},
+            {"name": "tile.render", "ts_us": 10.0, "dur_us": 40.0,
+             "tid": 1, "trace_id": "t2", "span_id": "c",
+             "parent_id": "gone", "attrs": {}},
+        ]
+        result = trace_analyze.analyze(spans)
+        rows = {r["root"]: r for r in result["traces"]}
+        assert not rows["serve.request"]["partial"]
+        assert rows["tile.render"]["partial"]  # dangling parent_id
+        assert rows["tile.render"]["critical_path"][0]["name"] == \
+            "tile.render"

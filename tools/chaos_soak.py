@@ -646,6 +646,103 @@ def phase_synopsis(ctx):
             "codes": {str(k): v for k, v in sorted(codes.items())}}
 
 
+def phase_incident(ctx):
+    """Flight-recorder incident discipline under a seeded fault storm:
+    12 injected ``tile.render`` faults inside request-shaped shadow
+    spans (head sampling at 0.0) form exactly three storm episodes at
+    threshold 4 — the first flushes exactly ONE bundle, the rate limit
+    suppresses the other two — and the bundle replays as a valid
+    Perfetto trace (tools/trace_analyze.py) holding the request trees
+    completed before the flush. Every faulted tree is tail-promoted
+    into the collector as if head-sampled, and the request histogram
+    carries a promoted trace's id as its /metrics exemplar."""
+    from heatmap_tpu.obs import incident as incident_mod
+    from heatmap_tpu.obs import recorder as recorder_mod
+    from heatmap_tpu.obs import tracing
+    from heatmap_tpu.obs.incident import IncidentManager
+    from heatmap_tpu.obs.recorder import FlightRecorder
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_analyze
+
+    inc_dir = ctx.get("incident_dir") or os.path.join(
+        os.path.dirname(ctx["base_root"]), "incidents")
+    n_faults, threshold = 12, 4
+    obs.enable_metrics(True)
+    collector = tracing.enable_tracing(sample=0.0)
+    recorder_mod.install(FlightRecorder(max_spans=256))
+    mgr = IncidentManager(inc_dir, run_id="soak",
+                          storm_threshold=threshold,
+                          storm_window_s=3600.0, min_interval_s=3600.0)
+    incident_mod.set_manager(mgr)
+    incident_mod.add_state_provider(
+        "soak", lambda: {"phase": "incident", "n_faults": n_faults})
+    reg = obs.get_registry()
+    hist = reg.histogram("soak_request_seconds", buckets=(0.001, 10.0))
+    plane = faults.install_spec(f"seed=17,scale=0,tile.render={n_faults}")
+    try:
+        for i in range(n_faults):
+            req = tracing.begin_span("serve.request", {"tile": i})
+            render = tracing.begin_span("tile.render")
+            try:
+                faults.check("tile.render", key=i)
+            except faults.InjectedFault:
+                pass  # the fault event itself promotes the tree
+            tracing.end_span(render)
+            hist.observe(0.0005)
+            tracing.end_span(req)
+        assert plane.injected == n_faults, plane.counts()
+
+        # Exactly one bundle: episodes 2 and 3 hit the rate limit.
+        assert len(mgr.flushed) == 1, mgr.flushed
+        assert mgr.suppressed == 2, mgr.suppressed
+        assert obs.INCIDENTS_TOTAL.value(trigger="fault_storm") == 1
+        bundles = [d for d in os.listdir(inc_dir)
+                   if not d.startswith(".tmp-")]
+        assert bundles == ["soak-0"], bundles
+
+        # The bundle replays as a valid Perfetto trace: the request
+        # trees completed before the 4th fault flushed it.
+        spans = trace_analyze.load_events(mgr.flushed[0])
+        replay = trace_analyze.analyze(spans)
+        assert replay["n_spans"] == 2 * (threshold - 1), replay["n_spans"]
+        for row in replay["traces"]:
+            assert row["root"] == "serve.request" and not row["partial"]
+            assert [h["name"] for h in row["critical_path"]] == [
+                "serve.request", "tile.render"]
+        manifest = json.load(open(os.path.join(mgr.flushed[0],
+                                               "manifest.json")))
+        assert manifest["trigger"] == "fault_storm"
+        state = json.load(open(os.path.join(mgr.flushed[0], "state.json")))
+        assert state["soak"]["n_faults"] == n_faults
+
+        # Tail promotion: every faulted (unsampled) tree reached the
+        # collector as if head-sampled.
+        promoted = {r["trace_id"] for r in collector.spans()}
+        assert len(promoted) == n_faults, len(promoted)
+        rcd_stats = recorder_mod.get_recorder().stats()
+        assert rcd_stats["promoted_traces"] == n_faults
+
+        # Exemplar tie-in: the histogram bucket names a promoted trace.
+        prom = reg.render_prometheus()
+        [line] = [l for l in prom.splitlines() if l.startswith(
+            'soak_request_seconds_bucket{le="0.001"}')]
+        exemplar_tid = line.split('trace_id="')[1].split('"')[0]
+        assert exemplar_tid in promoted
+        return {"bundles": len(bundles), "suppressed": mgr.suppressed,
+                "replay_spans": replay["n_spans"],
+                "promoted_traces": len(promoted),
+                "bundle_bytes": manifest["bytes"],
+                "incident_dir": inc_dir}
+    finally:
+        faults.install(None)
+        incident_mod.set_manager(None)
+        recorder_mod.install(None)
+        tracing.disable_tracing()
+        reg.reset()
+        obs.enable_metrics(False)
+
+
 PHASES = [
     ("baseline", phase_baseline),
     ("chaos_pipeline", phase_chaos_pipeline),
@@ -656,6 +753,7 @@ PHASES = [
     ("host_loss", phase_host_loss),
     ("backend_loss", phase_backend_loss),
     ("synopsis", phase_synopsis),
+    ("incident", phase_incident),
     ("byte_equality", phase_byte_equality),
 ]
 
@@ -673,6 +771,11 @@ def main():
     ap.add_argument("--only", action="append", default=None,
                     help="run only the named phase(s); byte_equality "
                          "needs the earlier ones")
+    ap.add_argument("--incident-dir", default=None, metavar="DIR",
+                    help="where the incident phase flushes its bundles "
+                         "(default: the scratch dir; point it at a "
+                         "workspace path so CI can upload bundles as "
+                         "artifacts on failure)")
     args = ap.parse_args()
 
     tmp = tempfile.mkdtemp(prefix="chaos-soak-")
@@ -682,6 +785,7 @@ def main():
         "chaos_root": os.path.join(tmp, "store-chaos"),
         "base_arrays": os.path.join(tmp, "arrays-base"),
         "chaos_arrays": os.path.join(tmp, "arrays-chaos"),
+        "incident_dir": args.incident_dir,
     }
     failed = 0
     try:

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Fold an incident bundle into a human post-mortem report.
+
+An incident bundle (obs/incident.py) is a self-contained directory —
+``trace.json`` (flight-recorder spans as Chrome trace-event JSON),
+``events.json`` (recent event tail), ``metrics.json`` (full registry
+snapshot), ``state.json`` (healthz / fleet / config providers), and
+``manifest.json`` (trigger envelope). This tool reads one and prints
+the story an on-call wants first:
+
+- the trigger edge (what flushed the bundle, when, under which run);
+- the critical path of the slowest captured trace (the exact
+  tools/trace_analyze.py analysis, partial-tree tolerant);
+- the event tail leading up to the flush (errors, sheds, faults last);
+- headline failure metrics (5xx, sheds, breaker opens, incidents);
+- the degraded/breaker state the serving tier reported.
+
+    python tools/incident_report.py incidents/<run_id>-<seq> [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import trace_analyze  # noqa: E402
+
+# Metrics worth a headline row when present (failure shapes first).
+_HEADLINE = (
+    "http_requests_total",
+    "requests_shed_total",
+    "breaker_transitions_total",
+    "faults_injected_total",
+    "incidents_total",
+    "recorder_dropped_total",
+    "slo_breaches_total",
+)
+
+
+def load_bundle(path: str) -> dict:
+    """``{manifest, trace_spans, events, metrics, state}`` from a
+    bundle directory; missing files load as empty (a size-capped
+    bundle still reports what it kept)."""
+    if not os.path.isdir(path):
+        raise SystemExit(f"{path!r} is not an incident bundle directory")
+
+    def _load(name, default):
+        full = os.path.join(path, name)
+        if not os.path.exists(full):
+            return default
+        with open(full) as f:
+            return json.load(f)
+
+    return {
+        "manifest": _load("manifest.json", {}),
+        "trace_spans": trace_analyze.load_events(path)
+        if os.path.exists(os.path.join(path, "trace.json")) else [],
+        "events": _load("events.json", []),
+        "metrics": _load("metrics.json", {}),
+        "state": _load("state.json", {}),
+    }
+
+
+def headline_metrics(metrics: dict) -> list[dict]:
+    """Flatten the snapshot's failure-shaped series into table rows."""
+    rows = []
+    for name in _HEADLINE:
+        entry = metrics.get(name)
+        if not entry:
+            continue
+        for sample in entry.get("samples", ()):
+            value = sample.get("value", sample.get("count"))
+            labels = ",".join(f"{k}={v}" for k, v
+                              in sorted(sample.get("labels", {}).items()))
+            rows.append({"metric": name, "labels": labels, "value": value})
+    return rows
+
+
+def event_tail(events: list, n: int = 20) -> list[dict]:
+    """The last ``n`` events, compacted to the fields that matter."""
+    out = []
+    for rec in events[-n:]:
+        row = {"ts": rec.get("ts"), "event": rec.get("event")}
+        for key in ("status", "route", "cause", "site", "slo", "error",
+                    "trigger", "detail", "trace_id"):
+            if key in rec:
+                row[key] = rec[key]
+        out.append(row)
+    return out
+
+
+def build_report(bundle: dict, top: int = 8) -> dict:
+    manifest = bundle["manifest"]
+    trace = (trace_analyze.analyze(bundle["trace_spans"], top=top)
+             if bundle["trace_spans"] else
+             {"n_spans": 0, "n_traces": 0, "traces": [], "top_self": []})
+    return {
+        "trigger": manifest.get("trigger"),
+        "detail": manifest.get("detail"),
+        "run_id": manifest.get("run_id"),
+        "seq": manifest.get("seq"),
+        "ts": manifest.get("ts"),
+        "bytes": manifest.get("bytes"),
+        "recorder": manifest.get("recorder"),
+        "trace": trace,
+        "event_tail": event_tail(bundle["events"]),
+        "metrics": headline_metrics(bundle["metrics"]),
+        "state": bundle["state"],
+    }
+
+
+def format_report(report: dict, max_traces: int = 2) -> str:
+    lines = [
+        f"incident {report['run_id']}-{report['seq']}  "
+        f"trigger={report['trigger']}"
+        + (f"  detail={report['detail']}" if report.get("detail") else ""),
+        f"ts={report['ts']}  bundle_bytes={report['bytes']}",
+    ]
+    rcd = report.get("recorder") or {}
+    if rcd:
+        lines.append(
+            f"recorder: spans={rcd.get('spans')} events={rcd.get('events')} "
+            f"dropped={rcd.get('dropped')} "
+            f"promoted={rcd.get('promoted_traces')} trace(s)")
+    if report["metrics"]:
+        lines += ["", "failure metrics:"]
+        for row in report["metrics"]:
+            label = f"{{{row['labels']}}}" if row["labels"] else ""
+            lines.append(f"  {row['metric']}{label} = {row['value']}")
+    if report["event_tail"]:
+        lines += ["", "event tail (oldest first):"]
+        for row in report["event_tail"]:
+            extra = " ".join(f"{k}={v}" for k, v in row.items()
+                             if k not in ("ts", "event"))
+            lines.append(f"  {row['ts']}: {row['event']}  {extra}".rstrip())
+    trace = report["trace"]
+    if trace["n_spans"]:
+        lines += ["", trace_analyze.format_report(trace,
+                                                  max_traces=max_traces)]
+    else:
+        lines += ["", "no spans captured (recorder ring was empty)"]
+    state = report.get("state") or {}
+    for name in sorted(state):
+        lines += ["", f"state[{name}]:",
+                  json.dumps(state[name], indent=1, sort_keys=True,
+                             default=str)]
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="post-mortem table from an incident bundle")
+    ap.add_argument("bundle", help="incident bundle directory "
+                    "(incidents/<run_id>-<seq>/)")
+    ap.add_argument("--top", type=int, default=8,
+                    help="rows in the self-time table")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    ap.add_argument("--max-traces", type=int, default=2,
+                    help="traces printed in table mode")
+    args = ap.parse_args()
+    report = build_report(load_bundle(args.bundle), top=args.top)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(format_report(report, max_traces=args.max_traces))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -204,6 +204,51 @@ def _warm(base_url: str, universe):
     conn.close()
 
 
+def _recorder_overhead(host, port, universe, passes: int = 3) -> dict:
+    """A/B the flight recorder against the live server: sequential
+    full-universe sweeps (keep-alive, warmed cache) with the recorder
+    off, then with shadow tracing (sample=0.0) + the ring installed —
+    the always-on worst case where EVERY request runs real Span objects
+    into the ring but none is head-sampled. Min-of-passes wall time on
+    each side; the delta is the recorder's per-request tax. The ring's
+    promise is that this stays in the low single digits — the bench
+    gate alarms if it grows (obs:recorder_overhead_pct)."""
+    from heatmap_tpu.obs import recorder as recorder_mod
+    from heatmap_tpu.obs import tracing
+    from heatmap_tpu.obs.recorder import FlightRecorder
+
+    def sweep() -> float:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        t0 = time.perf_counter()
+        for layer, z, x, y, fmt in universe:
+            conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
+            conn.getresponse().read()
+        dt = time.perf_counter() - t0
+        conn.close()
+        return dt
+
+    sweep()  # settle after the threaded window
+    off_s = min(sweep() for _ in range(passes))
+    tracing.enable_tracing(sample=0.0)
+    recorder_mod.install(FlightRecorder())
+    try:
+        sweep()
+        on_s = min(sweep() for _ in range(passes))
+        stats = recorder_mod.get_recorder().stats()
+    finally:
+        recorder_mod.install(None)
+        tracing.disable_tracing()
+    pct = max(0.0, (on_s - off_s) / off_s * 100.0) if off_s else None
+    result = {
+        "recorder_overhead_pct": round(pct, 2) if pct is not None else None,
+        "off_s": round(off_s, 4), "on_s": round(on_s, 4),
+        "requests_per_pass": len(universe), "passes": passes,
+        "ring_spans": stats["spans"], "ring_dropped": stats["dropped"],
+    }
+    print(json.dumps({"stage": "recorder_overhead", **result}), flush=True)
+    return result
+
+
 def _fleet_bench(args, spec: str, universe, tmpdir: str) -> dict:
     """The N=1/2/4 scaling curve + kill-one-backend availability, all
     through real child serve processes and a threaded router frontend.
@@ -366,6 +411,7 @@ def main() -> int:
     for w in workers:
         w.join()
     measured_s = time.perf_counter() - t0
+    obs_overhead = _recorder_overhead(host, port, universe)
     server.shutdown()
 
     lat = np.sort(np.concatenate(
@@ -404,6 +450,7 @@ def main() -> int:
                        "max": round(float(lat[-1]), 3) if len(lat) else None},
         "hit_rate": round(hits / total, 4) if total else None,
         "cache": {"entries": len(cache), "bytes": cache.nbytes},
+        "obs": obs_overhead,
         **({"fleet": fleet} if fleet else {}),
         # Same folded block bench_job.py embeds: serve benches stay
         # schema-compatible with job benches in the bench trajectory.

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from collections import defaultdict
 
@@ -35,7 +36,10 @@ from collections import defaultdict
 def load_events(path: str) -> list[dict]:
     """Span dicts from a trace-event file (``{"traceEvents": [...]}``
     or a bare event list); non-span events (metadata, no span_id) are
-    skipped."""
+    skipped. An incident bundle directory (obs/incident.py) works too:
+    its ``trace.json`` is analyzed."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "trace.json")
     with open(path) as f:
         doc = json.load(f)
     events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
@@ -129,6 +133,11 @@ def analyze(spans: list[dict], top: int = 10) -> dict:
             trace_rows.append({
                 "trace_id": tid,
                 "root": root["name"],
+                # A flight-recorder ring can evict a subtree's real
+                # parent; the orphan surfaces as a root with a dangling
+                # parent_id. Flag it so the sum(self) == wall invariant
+                # (only meaningful for complete trees) can skip it.
+                "partial": root["parent_id"] is not None,
                 "wall_us": round(root["dur_us"], 1),
                 "n_spans": len(trace["spans"]),
                 "self_sum_us": round(
@@ -208,7 +217,8 @@ def format_report(result: dict, max_traces: int = 3) -> str:
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="critical-path analysis over --trace-out JSON")
-    ap.add_argument("trace", help="Chrome trace-event JSON file")
+    ap.add_argument("trace", help="Chrome trace-event JSON file, or an "
+                    "incident bundle directory (its trace.json)")
     ap.add_argument("--top", type=int, default=10,
                     help="rows in the self-time table")
     ap.add_argument("--json", action="store_true",
