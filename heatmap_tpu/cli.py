@@ -987,9 +987,20 @@ def cmd_serve(args) -> int:
         raise SystemExit(str(e)) from e
     cache = TileCache(max_bytes=args.cache_bytes,
                       ttl_s=ttl if (ttl and ttl > 0) else None)
+    from heatmap_tpu.serve import degrade as degrade_mod
+
+    try:
+        controller = degrade_mod.controller_from_flags(
+            getattr(args, "degrade", False),
+            getattr(args, "degrade_dwell", 10.0),
+            getattr(args, "degrade_hold", 30.0),
+            getattr(args, "degrade_ladder", ""))
+    except ValueError as e:
+        raise SystemExit(f"--degrade-ladder: {e}") from e
     app = ServeApp(store, cache,
                    render_timeout_s=getattr(args, "render_timeout", None),
-                   synopsis_default=getattr(args, "synopsis_default", False))
+                   synopsis_default=getattr(args, "synopsis_default", False),
+                   degrade=controller)
     # Incident bundles capture the same state /healthz serves, plus the
     # mount fingerprint (no-ops without --incident-dir).
     from heatmap_tpu.obs import incident as incident_mod
@@ -1033,8 +1044,19 @@ def _serve_fleet(args, collector, ev_log) -> int:
     breakers, hedging, and admission control (docs/serving.md)."""
     from heatmap_tpu import obs
     from heatmap_tpu.serve import make_server
+    from heatmap_tpu.serve import degrade as degrade_mod
     from heatmap_tpu.serve.fleet import FleetSupervisor
 
+    degrade_opts = None
+    if getattr(args, "degrade", False):
+        degrade_opts = {"dwell_s": getattr(args, "degrade_dwell", 10.0),
+                        "hold_s": getattr(args, "degrade_hold", 30.0),
+                        "ladder_spec": getattr(args, "degrade_ladder", "")}
+        try:
+            # Fail fast in the supervisor, not in every backend child.
+            degrade_mod.parse_ladder_spec(degrade_opts["ladder_spec"])
+        except ValueError as e:
+            raise SystemExit(f"--degrade-ladder: {e}") from e
     supervisor = FleetSupervisor(
         args.store, args.fleet,
         host=args.host, cache_bytes=args.cache_bytes,
@@ -1044,7 +1066,9 @@ def _serve_fleet(args, collector, ev_log) -> int:
         max_inflight=args.max_inflight or 32,
         queue_deadline_s=args.queue_deadline,
         hedge_quantile=args.hedge_quantile,
-        probe_interval_s=args.probe_interval)
+        probe_interval_s=args.probe_interval,
+        degrade_opts=degrade_opts,
+        slo_specs=list(getattr(args, "slo", None) or []))
     from heatmap_tpu.obs import incident as incident_mod
 
     incident_mod.add_state_provider("healthz", supervisor.router._health)
@@ -1890,6 +1914,23 @@ def build_parser() -> argparse.ArgumentParser:
                          help="fleet router: active health-probe period "
                          "(half-open probes re-admit recovered "
                          "backends)")
+    p_serve.add_argument("--degrade", action="store_true",
+                         help="arm the brownout controller: SLO burn "
+                         "(--slo) steps a rung ladder that trades tile "
+                         "fidelity for availability under overload "
+                         "(docs/robustness.md). Off by default")
+    p_serve.add_argument("--degrade-dwell", type=float, default=10.0,
+                         metavar="S",
+                         help="seconds the burn must stay above the up "
+                         "threshold before the ladder steps up one rung")
+    p_serve.add_argument("--degrade-hold", type=float, default=30.0,
+                         metavar="S",
+                         help="seconds the burn must stay below the down "
+                         "threshold before the ladder steps back down")
+    p_serve.add_argument("--degrade-ladder", default="", metavar="SPEC",
+                         help="ladder tuning, comma list of k=v: "
+                         "up=BURN,down=BURN,ttl=SCALE,shed=FRAC,max=RUNG "
+                         "(default up=1.0,down=0.5,ttl=4,shed=0.5,max=3)")
     p_serve.add_argument("--events", default=None, metavar="PATH",
                          help="append http_request events to PATH (JSONL, "
                          "docs/observability.md)")

@@ -96,6 +96,12 @@ EVENT_SCHEMA = {
     # active cause set). Emitted on cause-set edges, not per request.
     "degraded_enter": {"required": ("cause",), "optional": ("detail",)},
     "degraded_exit": {"required": ("cause",), "optional": ("detail",)},
+    # serve/degrade.py brownout ladder: one record per rung transition
+    # (edge-triggered — never per request). ``cause`` is the hottest
+    # objective on the way up, "recovery" on the way down; ``burn`` the
+    # max burn fraction that drove the step.
+    "degrade_step": {"required": ("rung", "direction", "cause", "burn"),
+                     "optional": ("from_rung", "detail")},
     # delta/recover.py startup sweep: one per quarantined artifact
     # (orphan *.tmp, torn/hash-mismatched journal entry, unjournaled
     # delta dir, stale base dir).
@@ -140,7 +146,7 @@ EVENT_SCHEMA = {
     # (?synopsis=1 or layer policy). stale=True marks a provisional
     # early-serve overlay not yet superseded by the exact apply.
     "synopsis_served": {"required": ("layer", "zoom", "max_err"),
-                        "optional": ("stale", "source_zoom")},
+                        "optional": ("stale", "source_zoom", "stretched")},
     # obs/incident.py: one incident bundle flushed (trigger is the
     # edge kind — slo_breach | shed | fault_storm | degraded_enter |
     # exception; path the bundle directory; seq the manager's own

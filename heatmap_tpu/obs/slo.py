@@ -233,6 +233,20 @@ class SLOEngine:
         return {"objectives": statuses, "breaching": breaching,
                 "ok": not breaching}
 
+    def burn_snapshot(self, now: float | None = None) -> dict:
+        """Machine-readable burn fractions: ``{objective_name: burn}``.
+
+        A pure read over the same evaluation as ``status`` but with no
+        breach-edge bookkeeping and no event emission — safe to call
+        from a controller poll loop or a health probe at any frequency.
+        Burn 1.0 spends the error budget exactly at the window's pace;
+        >1.0 is a breach.
+        """
+        if now is None:
+            now = time.time()
+        return {spec.name: self._evaluate_one(spec, now)["burn_rate"]
+                for spec in self.specs}
+
     def reset(self):
         with self._lock:
             self._window.clear()
@@ -277,3 +291,13 @@ def slo_status(now: float | None = None) -> dict | None:
     if engine is None:
         return None
     return engine.status(now=now)
+
+
+def burn_values(now: float | None = None) -> dict:
+    """Default engine's numeric burn fractions (``{name: burn}``), or
+    ``{}`` when no engine is installed — the brownout controller's
+    default burn source and the /healthz ``slo_burn`` block."""
+    engine = _engine
+    if engine is None:
+        return {}
+    return engine.burn_snapshot(now=now)

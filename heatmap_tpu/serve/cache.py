@@ -108,6 +108,7 @@ class TileCache:
         self._entries: "OrderedDict" = OrderedDict()
         self._flights: dict = {}
         self._bytes = 0
+        self._ttl_scale = 1.0
 
     # -- introspection -----------------------------------------------------
 
@@ -117,6 +118,31 @@ class TileCache:
 
     def __len__(self):
         return len(self._entries)
+
+    @property
+    def ttl_scale(self) -> float:
+        return self._ttl_scale
+
+    def set_ttl_scale(self, scale: float) -> None:
+        """Stretch (or restore) the effective TTL without touching the
+        stamped ``expires`` of existing entries: the brownout ladder's
+        serve-stale widening. Scale 1.0 is byte-for-byte the original
+        behavior; >1.0 lets entries live ``scale * ttl_s`` from insert.
+        Generation-based invalidation is unaffected — a reload still
+        retires every entry."""
+        if scale < 1.0:
+            raise ValueError("ttl scale must be >= 1.0")
+        with self._lock:
+            self._ttl_scale = float(scale)
+
+    def _effective_expiry(self, entry):
+        # Caller holds the lock. entry.expires is insert + ttl_s; the
+        # scale widens it by (scale - 1) * ttl_s more.
+        expires = entry.expires
+        if (expires is not None and self._ttl_scale != 1.0
+                and self.ttl_s is not None):
+            expires += (self._ttl_scale - 1.0) * self.ttl_s
+        return expires
 
     # -- core --------------------------------------------------------------
 
@@ -136,9 +162,10 @@ class TileCache:
             with self._lock:
                 entry = self._entries.get(key)
                 if entry is not None:
+                    expires = self._effective_expiry(entry)
                     if entry.generation != generation or (
-                            entry.expires is not None
-                            and self._clock() >= entry.expires):
+                            expires is not None
+                            and self._clock() >= expires):
                         if stale_if_error:
                             # Keep the entry: a successful render
                             # replaces it via _insert; a failed one

@@ -39,6 +39,8 @@ import threading
 import time
 
 from heatmap_tpu import faults, obs
+from heatmap_tpu.obs import slo
+from heatmap_tpu.serve import degrade as degrade_mod
 from heatmap_tpu.serve.cache import TileCache
 from heatmap_tpu.serve.http import ServeApp, make_server, serve_in_thread
 from heatmap_tpu.serve.router import (FLEET_RESTARTS, BackendClient,
@@ -52,13 +54,15 @@ class _ThreadBackend:
     def __init__(self, backend_id: str, store_factory, *,
                  host: str = "127.0.0.1", cache_bytes: int = 64 << 20,
                  max_inflight: int | None = None,
-                 render_timeout_s: float | None = None):
+                 render_timeout_s: float | None = None,
+                 degrade_opts: dict | None = None):
         self.id = backend_id
         self._store_factory = store_factory
         self._host = host
         self._cache_bytes = cache_bytes
         self._max_inflight = max_inflight
         self._render_timeout_s = render_timeout_s
+        self._degrade_opts = degrade_opts
         self.app: ServeApp | None = None
         self._server = None
         self._alive = False
@@ -66,9 +70,14 @@ class _ThreadBackend:
 
     def start(self, stop_event: threading.Event | None = None):
         store = self._store_factory()
+        # Each backend gets its own ladder; in thread mode they share
+        # the process-global SLO engine, so they step together.
+        controller = (degrade_mod.controller_from_flags(
+            True, **self._degrade_opts) if self._degrade_opts else None)
         self.app = ServeApp(store, TileCache(max_bytes=self._cache_bytes),
                             max_inflight=self._max_inflight,
-                            render_timeout_s=self._render_timeout_s)
+                            render_timeout_s=self._render_timeout_s,
+                            degrade=controller)
         self._server, _ = serve_in_thread(self.app, host=self._host)
         self._alive = True
         self.started_at = time.monotonic()
@@ -97,7 +106,9 @@ class _ProcessBackend:
                  max_inflight: int | None = None,
                  render_timeout_s: float | None = None,
                  chaos: str | None = None, workdir: str = ".",
-                 spawn_timeout_s: float = 30.0):
+                 spawn_timeout_s: float = 30.0,
+                 degrade_opts: dict | None = None,
+                 slo_specs: list | None = None):
         self.id = backend_id
         self._store_spec = store_spec
         self._host = host
@@ -107,6 +118,8 @@ class _ProcessBackend:
         self._chaos = chaos
         self._workdir = workdir
         self._spawn_timeout_s = spawn_timeout_s
+        self._degrade_opts = degrade_opts
+        self._slo_specs = list(slo_specs or [])
         self.proc: subprocess.Popen | None = None
         self.started_at = 0.0
         self._seq = 0
@@ -125,6 +138,17 @@ class _ProcessBackend:
             argv += ["--render-timeout", str(self._render_timeout_s)]
         if self._chaos:
             argv += ["--chaos", self._chaos]
+        for spec in self._slo_specs:
+            argv += ["--slo", spec]
+        if self._degrade_opts:
+            argv += ["--degrade",
+                     "--degrade-dwell",
+                     str(self._degrade_opts.get("dwell_s", 10.0)),
+                     "--degrade-hold",
+                     str(self._degrade_opts.get("hold_s", 30.0))]
+            ladder = self._degrade_opts.get("ladder_spec", "")
+            if ladder:
+                argv += ["--degrade-ladder", ladder]
         env = os.environ.copy()
         pkg_parent = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -195,7 +219,9 @@ class FleetSupervisor:
                  probe_interval_s: float = 0.25,
                  restart_base_s: float = 0.2, restart_cap_s: float = 5.0,
                  monitor_interval_s: float = 0.1,
-                 spawn_timeout_s: float = 30.0):
+                 spawn_timeout_s: float = 30.0,
+                 degrade_opts: dict | None = None,
+                 slo_specs: list | None = None):
         if mode not in ("process", "thread"):
             raise ValueError(f"unknown fleet mode {mode!r}")
         if mode == "process" and not store_spec:
@@ -213,6 +239,8 @@ class FleetSupervisor:
         self._render_timeout_s = render_timeout_s
         self._chaos = chaos
         self._spawn_timeout_s = spawn_timeout_s
+        self._degrade_opts = degrade_opts
+        self._slo_specs = list(slo_specs or [])
         self.restart_base_s = restart_base_s
         self.restart_cap_s = restart_cap_s
         self.monitor_interval_s = monitor_interval_s
@@ -255,13 +283,15 @@ class FleetSupervisor:
                 backend_id, self._store_factory, host=self._host,
                 cache_bytes=self._cache_bytes,
                 max_inflight=self._backend_max_inflight,
-                render_timeout_s=self._render_timeout_s)
+                render_timeout_s=self._render_timeout_s,
+                degrade_opts=self._degrade_opts)
         return _ProcessBackend(
             backend_id, self._store_spec, host=self._host,
             cache_bytes=self._cache_bytes,
             max_inflight=self._backend_max_inflight,
             render_timeout_s=self._render_timeout_s, chaos=self._chaos,
-            workdir=self._workdir, spawn_timeout_s=self._spawn_timeout_s)
+            workdir=self._workdir, spawn_timeout_s=self._spawn_timeout_s,
+            degrade_opts=self._degrade_opts, slo_specs=self._slo_specs)
 
     def stop(self):
         self._stop.set()
@@ -365,14 +395,28 @@ def backend_main(argv=None) -> int:
     parser.add_argument("--max-inflight", type=int, default=None)
     parser.add_argument("--render-timeout", type=float, default=None)
     parser.add_argument("--chaos", default=None)
+    parser.add_argument("--slo", action="append", default=[])
+    parser.add_argument("--degrade", action="store_true")
+    parser.add_argument("--degrade-dwell", type=float, default=10.0)
+    parser.add_argument("--degrade-hold", type=float, default=30.0)
+    parser.add_argument("--degrade-ladder", default="")
     args = parser.parse_args(argv)
 
     faults.install_from_env(args.chaos)
     obs.enable_metrics(True)
+    # Per-child SLO engine: the brownout ladder's burn source. The
+    # supervisor forwards the serve process's --slo specs so every
+    # backend evaluates the same objectives over its own traffic.
+    if args.slo:
+        slo.install_specs(args.slo)
+    controller = degrade_mod.controller_from_flags(
+        args.degrade, args.degrade_dwell, args.degrade_hold,
+        args.degrade_ladder)
     store = TileStore(args.store)
     app = ServeApp(store, TileCache(max_bytes=args.cache_bytes),
                    max_inflight=args.max_inflight,
-                   render_timeout_s=args.render_timeout)
+                   render_timeout_s=args.render_timeout,
+                   degrade=controller)
     server = make_server(app, host=args.host, port=args.port)
     host, port = server.server_address[:2]
     tmp = args.port_file + ".tmp"

@@ -58,6 +58,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from heatmap_tpu import faults, obs
 from heatmap_tpu.obs import incident, recorder, slo, tracing
+from heatmap_tpu.serve import degrade as degrade_mod
 from heatmap_tpu.serve.cache import TileCache
 from heatmap_tpu.serve.render import (SynopsisLayer, synopsis_source,
                                       tile_json_bytes, tile_png_bytes)
@@ -114,7 +115,8 @@ class ServeApp:
                  *, render_timeout_s: float | None = None,
                  max_inflight: int | None = None,
                  retry_after_s: float = 1.0,
-                 synopsis_default: bool = False):
+                 synopsis_default: bool = False,
+                 degrade: "degrade_mod.BrownoutController | None" = None):
         self.store = store
         self.cache = cache if cache is not None else TileCache()
         self.render_timeout_s = render_timeout_s
@@ -123,6 +125,10 @@ class ServeApp:
         # Layer policy for tile requests with no ?synopsis= parameter;
         # an explicit synopsis=0/1 on the URL always wins.
         self.synopsis_default = synopsis_default
+        # Brownout ladder (serve/degrade.py); None = compiled out. At
+        # rung 0 every request is byte-identical to degrade=None
+        # (pinned in tests/test_degrade.py).
+        self.degrade = degrade
         self._extra_layers: dict = {}
         self._degraded_lock = threading.Lock()
         self._degraded: dict[str, str] = {}  # cause -> detail
@@ -182,6 +188,15 @@ class ServeApp:
             body = json.dumps({"error": "service unavailable",
                                "detail": str(e)}).encode()
             return 503, "application/json", body, None, "error", None
+        ctl = self.degrade
+        if ctl is not None:
+            # Rate-limited burn re-evaluation; between polls this is one
+            # clock read. Rung side effects (cache TTL stretch) apply on
+            # the edge so the rung-0 path never touches the cache.
+            ctl.poll()
+            scale = ctl.ttl_scale()
+            if scale != self.cache.ttl_scale:
+                self.cache.set_ttl_scale(scale)
         # The query string never participates in routing (so the fleet
         # router's rendezvous key colocates ?synopsis=1 with the exact
         # tile); it only carries per-request options.
@@ -241,22 +256,41 @@ class ServeApp:
             body = json.dumps({"error": "service unavailable",
                                "cause": "drain"}).encode()
             return 503, "application/json", body, None, "tiles", None
-        if self.max_inflight is None:
+        ctl = self.degrade
+        if ctl is not None:
+            if ctl.shed((m["layer"], m["z"], m["x"], m["y"], m["fmt"])):
+                # Top rung: deterministic fractional shed by tile key
+                # (same seeded hash router-side, so the fleet agrees).
+                if obs.metrics_enabled():
+                    degrade_mod.DEGRADE_SHED.inc()
+                self._degrade("brownout",
+                              f"rung {ctl.rung}: shedding "
+                              f"{ctl.shed_fraction:.0%} of tile keys")
+                incident.trigger("shed",
+                                 detail=f"brownout rung {ctl.rung}")
+                body = json.dumps({"error": "service unavailable",
+                                   "cause": "brownout"}).encode()
+                return 503, "application/json", body, None, "tiles", None
+            if ctl.rung < ctl.max_rung:
+                self._recover("brownout")
+        limit = (self.max_inflight if ctl is None
+                 else ctl.inflight_limit(self.max_inflight))
+        if limit is None:
             return self._handle_tile(m, if_none_match, synopsis)
         with self._inflight_lock:
-            if self._inflight >= self.max_inflight:
+            if self._inflight >= limit:
                 admitted = False
             else:
                 admitted = True
                 self._inflight += 1
         if not admitted:
             self._degrade("shed",
-                          f"in-flight bound {self.max_inflight} reached")
+                          f"in-flight bound {limit} reached")
             # Every typed-503 shed is an incident trigger edge (the
             # manager rate-limits per kind, so a shed burst flushes
             # one bundle, not one per rejected request).
             incident.trigger(
-                "shed", detail=f"in-flight bound {self.max_inflight}")
+                "shed", detail=f"in-flight bound {limit}")
             body = json.dumps({"error": "service unavailable",
                                "cause": "shed"}).encode()
             return 503, "application/json", body, None, "tiles", None
@@ -299,13 +333,27 @@ class ServeApp:
         # ?synopsis=1 only takes effect when the SAME source zoom the
         # exact path would use carries a decoded synopsis; otherwise
         # fall through to the exact path under the exact cache key and
-        # ETag — byte-identical to an un-annotated request.
+        # ETag — byte-identical to an un-annotated request. The brownout
+        # ladder overrides the opt-in: rung >= 1 forces the synopsis
+        # path, rung >= 2 additionally stretches it (a coarser
+        # synopsis-carrying source upsamples into zooms that have no
+        # natural synopsis — the raised zoom ceiling).
+        ctl = self.degrade
+        stretch = False
+        if ctl is not None:
+            synopsis = synopsis or ctl.force_synopsis()
+            stretch = ctl.stretch_synopsis()
         syn_view = syn_src = None
+        stretched = False
         if synopsis:
             src, view = synopsis_source(layer, z)
+            if view is None and stretch:
+                src, view = synopsis_source(layer, z, stretch=True)
+                stretched = view is not None
             if view is not None:
                 syn_view, syn_src = view, src
-                layer = SynopsisLayer(layer)
+                layer = SynopsisLayer(
+                    layer, max_level=src if stretched else None)
         if syn_view is None:
             key = (layer_name, z, x, y, fmt)
         else:
@@ -342,11 +390,16 @@ class ServeApp:
             marker = f"max_err={syn_view.max_err:.6g}"
             if syn_view.stale:
                 marker += "; stale=1"
+            if stretched:
+                # Raised-ceiling answers add quadrant-upsample error on
+                # top of the stamped coefficient error; say so.
+                marker += "; stretch=1"
             extra = {"X-Heatmap-Synopsis": marker}
             obs.emit("synopsis_served", layer=layer_name, zoom=int(z),
                      max_err=float(syn_view.max_err),
                      source_zoom=int(syn_src),
-                     **({"stale": True} if syn_view.stale else {}))
+                     **({"stale": True} if syn_view.stale else {}),
+                     **({"stretched": True} if stretched else {}))
             etag = _syn_etag(body)
         else:
             etag = _etag(body)
@@ -414,6 +467,15 @@ class ServeApp:
         slo_state = slo.slo_status()
         if slo_state is not None:
             stats["slo"] = slo_state
+        # Numeric distance-to-breach, not just breach: per-objective
+        # burn fractions ({} folded away when no engine is installed)
+        # plus the brownout ladder state the router probes read.
+        burns = slo.burn_values()
+        if burns:
+            stats["slo_burn"] = {k: round(float(v), 4)
+                                 for k, v in sorted(burns.items())}
+        if self.degrade is not None:
+            stats["degrade"] = self.degrade.snapshot()
         return stats
 
 
@@ -453,10 +515,15 @@ class _Handler(BaseHTTPRequestHandler):
                     self.send_header(name, value)
             if status == 503:
                 # Shed/drain/degraded answers are retryable by
-                # construction; tell well-behaved clients when.
+                # construction; tell well-behaved clients when. The
+                # advertised delay carries seeded jitter (the
+                # faults/retry.py shape) so a burst of shed clients
+                # does not come back as a synchronized thundering herd.
                 retry_after = getattr(self.app, "retry_after_s", 1.0)
-                self.send_header("Retry-After",
-                                 str(max(1, round(retry_after))))
+                self.send_header(
+                    "Retry-After",
+                    str(degrade_mod.retry_after_jitter(
+                        retry_after, self.path, int(t0))))
             if etag is not None:
                 self.send_header("ETag", etag)
             tp = tracing.current_traceparent()

@@ -166,24 +166,37 @@ class SynopsisLayer:
 
     source_zoom = Layer.source_zoom
 
-    def __init__(self, layer: Layer):
+    def __init__(self, layer: Layer, *, max_level: int | None = None):
         self.user = layer.user
         self.timespan = layer.timespan
         self.result_delta = layer.result_delta
         self.levels = {
             z: (layer.synopses[z].level if z in layer.synopses else lvl)
             for z, lvl in layer.levels.items()
+            # max_level caps the source ladder: the brownout stretch
+            # path (synopsis_source(..., stretch=True)) pins rendering
+            # to a synopsis-carrying zoom even when a finer exact level
+            # exists — the upsample machinery paints the rest.
+            if max_level is None or z <= max_level
         }
         self.blob_json = {}
 
 
-def synopsis_source(layer: Layer, z: int):
+def synopsis_source(layer: Layer, z: int, *, stretch: bool = False):
     """Decide whether tile zoom ``z`` can be served from a synopsis:
     returns ``(source_zoom, SynopsisView)`` when the SAME source level
     the exact path would pick carries a decoded synopsis, else
     ``(None, None)`` — the caller falls back to the exact path (and
     byte-identical output), which is what happens for every
-    ``z + result_delta >= synopsis_max_z`` tile."""
+    ``z + result_delta >= synopsis_max_z`` tile.
+
+    ``stretch=True`` raises the synopsis zoom ceiling (the brownout
+    ladder's rung 2): when the natural source carries no synopsis, the
+    finest *coarser* synopsis-carrying level answers instead — the
+    caller must then cap the layer at that zoom
+    (``SynopsisLayer(layer, max_level=src)``) so the quadrant-upsample
+    path paints the missing detail rather than the exact level
+    reclaiming the render."""
     delta = layer.result_delta
     # Attached live layers (serve/live.py) have no synopses attribute;
     # they always take the exact path.
@@ -191,6 +204,11 @@ def synopsis_source(layer: Layer, z: int):
         return None, None
     src = layer.source_zoom(z + delta)
     view = layer.synopses.get(src) if src is not None else None
+    if view is None and stretch and src is not None:
+        coarser = [s for s in layer.synopses if s < src]
+        if coarser:
+            src = max(coarser)
+            view = layer.synopses[src]
     if view is None:
         return None, None
     return src, view

@@ -59,6 +59,7 @@ from collections import deque
 
 from heatmap_tpu import faults, obs
 from heatmap_tpu.obs import incident, tracing
+from heatmap_tpu.serve import degrade as degrade_mod
 from heatmap_tpu.serve.http import _TILE_RE, Response
 
 _registry = obs.get_registry()
@@ -280,6 +281,9 @@ class BackendClient:
         self.ejected: str | None = None  # cause; non-None = out of the ring
         self.inflight = 0  # guarded by the router's slot condition
         self.down_announced = False  # guards the down/up event pair
+        # Last brownout snapshot the prober read from this backend's
+        # /healthz (serve/degrade.py); None until one is seen.
+        self.degrade: dict | None = None
         self._lock = threading.Lock()
         self._host, self._port = host, int(port)
         self._epoch = 0
@@ -460,12 +464,21 @@ class RouterApp:
     def _probe_once(self, backend: BackendClient) -> bool:
         try:
             faults.check("backend.probe", key=backend.id)
-            status, _, _ = backend.fetch("GET", "/healthz")
+            status, _, body = backend.fetch("GET", "/healthz")
             ok = status == 200
         except Exception:
             ok = False
+            body = b""
         if ok:
             self.note_success(backend)
+            # Probe piggyback: read the backend's brownout ladder state
+            # so the router agrees fleet-wide on the active rung
+            # without a second endpoint or any push machinery.
+            try:
+                snap = json.loads(body).get("degrade")
+            except (ValueError, AttributeError):
+                snap = None
+            backend.degrade = snap if isinstance(snap, dict) else None
         else:
             self.note_failure(backend, "probe")
         return ok
@@ -530,8 +543,35 @@ class RouterApp:
                            **({"detail": detail} if detail else {})}).encode()
         return status, "application/json", body, None, "shed", None
 
+    def fleet_degrade(self) -> dict | None:
+        """Fleet-wide brownout agreement: the hottest backend's ladder
+        snapshot (max rung wins — one overloaded ring member is enough
+        to start protecting it). None until a probe has seen one."""
+        hottest = None
+        for backend in self.backends.values():
+            snap = backend.degrade
+            if snap is None:
+                continue
+            if hottest is None or snap.get("rung", 0) > hottest.get(
+                    "rung", 0):
+                hottest = snap
+        return hottest
+
     def _route(self, method, path, if_none_match):
         key = route_key(path)
+        snap = self.fleet_degrade()
+        if snap is not None and snap.get("rung", 0) >= snap.get(
+                "max_rung", degrade_mod.MAX_RUNG):
+            # Top rung somewhere in the ring: apply the backends' own
+            # deterministic key shed router-side, before spending a
+            # forward slot — the seeded hash agrees with every backend,
+            # so the router sheds exactly the keys they would.
+            m = _TILE_RE.match(path.partition("?")[0])
+            if m is not None and degrade_mod.shed_tile(
+                    float(snap.get("shed_fraction", 0.0)),
+                    (m["layer"], m["z"], m["x"], m["y"], m["fmt"])):
+                return self._shed(
+                    "brownout", f"fleet rung {snap.get('rung')}")
         order = [self.backends[bid] for bid in
                  rendezvous_order(key, list(self.backends))]
         primary, rank = self._admit(order)
@@ -797,8 +837,11 @@ class RouterApp:
                 "ejected": backend.ejected,
                 "eligible": backend.eligible(),
             }
+            if backend.degrade is not None:
+                states[backend.id]["degrade_rung"] = backend.degrade.get(
+                    "rung", 0)
         eligible = [bid for bid, st in states.items() if st["eligible"]]
-        return {
+        doc = {
             "role": "router",
             "status": "ok" if eligible else "degraded",
             "fleet": {
@@ -811,3 +854,9 @@ class RouterApp:
                 "queue_deadline_s": self.queue_deadline_s,
             },
         }
+        snap = self.fleet_degrade()
+        if snap is not None:
+            # The agreed fleet-wide ladder state (max rung across the
+            # ring) — what operators and upstream layers should read.
+            doc["degrade"] = snap
+        return doc

@@ -1,0 +1,415 @@
+"""Brownout controller tests (serve/degrade.py): ladder hysteresis,
+flap resistance, edge-triggered events, the rung policies on the serve
+path, and the rung-0 byte-identity contract.
+
+All tier-1: fake clocks and scripted burn schedules pin the ladder
+deterministically — no sleeps, no wall-clock races. The one socket
+test (Retry-After jitter) uses the in-process loopback server, same as
+tests/test_fleet.py.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from heatmap_tpu import faults, obs
+from heatmap_tpu.obs import incident, slo
+from heatmap_tpu.serve import ServeApp, TileCache, TileStore, serve_in_thread
+from heatmap_tpu.serve import degrade
+from heatmap_tpu.serve.router import BackendClient, RouterApp
+
+
+@pytest.fixture(scope="module")
+def syn_store(tmp_path_factory):
+    """Batch job through the arrays-synopsis sink: exact levels at
+    zooms 6-10, synopses for 7/8/9 — zoom-10 detail stays exact-only,
+    which is what gives the stretch tests a synopsis-free source."""
+    from heatmap_tpu.io import open_sink, open_source
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    root = tmp_path_factory.mktemp("degrade_store")
+    config = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                            result_delta=2)
+    with open_sink(f"arrays-synopsis:{root}/levels") as sink:
+        run_job(open_source("synthetic:3000:7"), sink, config)
+    return f"arrays:{root}/levels"
+
+
+def _busy_tile(layer, src_zoom, tile_zoom):
+    import numpy as np
+
+    level = layer.levels[src_zoom]
+    code = int(level.codes[int(np.argmax(level.values))])
+    row = col = 0
+    for bit in range(src_zoom):
+        col |= ((code >> (2 * bit)) & 1) << bit
+        row |= ((code >> (2 * bit + 1)) & 1) << bit
+    shift = src_zoom - tile_zoom
+    return col >> shift, row >> shift
+
+
+def _controller(**kw):
+    """Controller with an inert burn source (dead band: holds whatever
+    rung a test pins) and no poll rate limit."""
+    kw.setdefault("burn_source", lambda: {"pinned": 0.75})
+    kw.setdefault("poll_interval_s", 0.0)
+    return degrade.BrownoutController(**kw)
+
+
+# -- ladder state machine ---------------------------------------------------
+
+
+class TestLadder:
+    def test_steps_up_through_every_rung_then_walks_down(self):
+        c = degrade.BrownoutController(dwell_s=10.0, hold_s=30.0)
+        hot = {"tiles-fast": 2.0}
+        assert c.observe(hot, 0.0) == 0      # dwell not elapsed
+        assert c.observe(hot, 9.9) == 0
+        assert c.observe(hot, 10.0) == 1     # one dwell -> one rung
+        assert c.observe(hot, 19.9) == 1     # window restarted at 10.0
+        assert c.observe(hot, 20.0) == 2
+        assert c.observe(hot, 30.0) == 3
+        assert c.observe(hot, 300.0) == 3    # clamped at max_rung
+        cool = {"tiles-fast": 0.1}
+        assert c.observe(cool, 310.0) == 3   # hold not elapsed
+        assert c.observe(cool, 340.0) == 2   # 30s low -> step down
+        assert c.observe(cool, 370.0) == 1
+        assert c.observe(cool, 400.0) == 0
+        assert c.observe(cool, 1000.0) == 0  # clamped at full fidelity
+
+    def test_dead_band_holds_the_rung_and_resets_both_windows(self):
+        c = degrade.BrownoutController(dwell_s=10.0, hold_s=10.0)
+        c.observe({"s": 2.0}, 0.0)
+        c.observe({"s": 2.0}, 10.0)
+        assert c.rung == 1
+        # Burn falls into the dead band (0.5 < burn < 1.0): the rung
+        # holds indefinitely and neither window accumulates.
+        for t in range(11, 100):
+            assert c.observe({"s": 0.75}, float(t)) == 1
+        # A fresh excursion must re-earn the full dwell from scratch.
+        assert c.observe({"s": 2.0}, 100.0) == 1
+        assert c.observe({"s": 2.0}, 109.9) == 1
+        assert c.observe({"s": 2.0}, 110.0) == 2
+
+    def test_oscillation_at_threshold_steps_at_most_once_per_dwell(self):
+        """The flap-resistance contract: a burn signal bouncing exactly
+        on the up threshold (always >= up) moves the ladder at most
+        once per dwell window — never once per sample."""
+        c = degrade.BrownoutController(dwell_s=10.0, hold_s=10.0)
+        rungs = []
+        for t in range(26):  # 1 Hz samples, alternating 1.0 / 1.3
+            burn = 1.0 if t % 2 == 0 else 1.3
+            rungs.append(c.observe({"s": burn}, float(t)))
+        assert rungs[-1] == 2  # floor(25 / dwell) steps, not 25
+        steps = sum(1 for a, b in zip(rungs, rungs[1:]) if a != b)
+        assert steps == 2
+        # And bouncing ACROSS the threshold into the dead band resets
+        # the dwell window every sample: the ladder never moves.
+        c2 = degrade.BrownoutController(dwell_s=10.0, hold_s=10.0)
+        for t in range(100):
+            burn = 1.5 if t % 2 == 0 else 0.75
+            assert c2.observe({"s": burn}, float(t)) == 0
+
+    def test_transitions_emit_exactly_one_edge_event_each(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = obs.EventLog(path, run_id="ladder")
+        obs.set_event_log(log)
+        try:
+            c = degrade.BrownoutController(dwell_s=1.0, hold_s=1.0)
+            for t in range(4):  # 0 -> 1 -> 2 -> 3
+                c.observe({"hot": 3.0}, float(t))
+            for t in range(4, 8):  # 3 -> 2 -> 1 -> 0
+                c.observe({"hot": 0.0}, float(t))
+            # Holding at the bottom emits nothing more (edge-triggered).
+            for t in range(8, 20):
+                c.observe({"hot": 0.0}, float(t))
+        finally:
+            obs.set_event_log(None)
+            log.close()
+        steps = [r for r in obs.read_events(path)
+                 if r["event"] == "degrade_step"]
+        assert len(steps) == 6
+        ups, downs = steps[:3], steps[3:]
+        assert [s["rung"] for s in ups] == [1, 2, 3]
+        assert all(s["direction"] == "up" and s["cause"] == "hot"
+                   and s["burn"] == 3.0 for s in ups)
+        assert [s["rung"] for s in downs] == [2, 1, 0]
+        assert all(s["direction"] == "down" and s["cause"] == "recovery"
+                   for s in downs)
+        assert all(s["from_rung"] == s["rung"] + 1 for s in downs)
+        for s in steps:
+            obs.validate_event(s)
+
+    def test_top_rung_fires_one_incident_bundle(self, tmp_path):
+        mgr = incident.IncidentManager(str(tmp_path / "incidents"),
+                                       run_id="brownout-test")
+        incident.set_manager(mgr)
+        try:
+            c = degrade.BrownoutController(dwell_s=1.0, hold_s=1.0,
+                                           max_rung=2)
+            for t in range(3):
+                c.observe({"hot": 9.0}, float(t))
+            assert c.rung == 2
+        finally:
+            incident.set_manager(None)
+        bundles = list((tmp_path / "incidents").iterdir())
+        assert len(bundles) == 1
+        manifest = json.loads(
+            (bundles[0] / "manifest.json").read_text())
+        assert manifest["trigger"] == "brownout"
+        assert "stale_wide" in manifest["detail"]
+
+    def test_ladder_spec_parsing_and_validation(self):
+        assert degrade.parse_ladder_spec("") == {}
+        got = degrade.parse_ladder_spec("up=2,down=0.25,ttl=8,shed=1,max=2")
+        assert got == {"up_threshold": 2.0, "down_threshold": 0.25,
+                       "ttl_stretch": 8.0, "shed_fraction": 1.0,
+                       "max_rung": 2}
+        with pytest.raises(ValueError, match="unknown ladder knob"):
+            degrade.parse_ladder_spec("uq=2")
+        with pytest.raises(ValueError, match="not a number"):
+            degrade.parse_ladder_spec("up=fast")
+        with pytest.raises(ValueError, match="out of range"):
+            degrade.parse_ladder_spec("shed=1.5")
+        with pytest.raises(ValueError, match="dead band"):
+            degrade.BrownoutController(up_threshold=1.0, down_threshold=1.0)
+        assert degrade.controller_from_flags(False, 1.0, 1.0, "") is None
+        c = degrade.controller_from_flags(True, 2.0, 3.0, "max=1")
+        assert (c.dwell_s, c.hold_s, c.max_rung) == (2.0, 3.0, 1)
+
+    def test_shed_is_deterministic_and_seed_keyed(self):
+        keys = [("default", str(z), str(x), str(y), "png")
+                for z in (3, 4) for x in range(8) for y in range(8)]
+        picks = {k for k in keys if degrade.shed_tile(0.5, k)}
+        assert picks == {k for k in keys if degrade.shed_tile(0.5, k)}
+        assert 0 < len(picks) < len(keys)  # a fraction, not all-or-none
+        assert not any(degrade.shed_tile(0.0, k) for k in keys)
+        assert all(degrade.shed_tile(1.0, k) for k in keys)
+        # A different chaos-plane seed sheds a different subset.
+        faults.install(faults.FaultPlane(seed=99))
+        try:
+            reseeded = {k for k in keys if degrade.shed_tile(0.5, k)}
+        finally:
+            faults.install(None)
+        assert reseeded != picks
+
+
+# -- rung policies on the serve path ---------------------------------------
+
+
+class TestServePolicies:
+    def test_rung0_is_byte_identical_to_no_controller(self, syn_store):
+        store = TileStore(syn_store)
+        plain = ServeApp(store, TileCache())
+        armed = ServeApp(store, TileCache(), degrade=_controller())
+        assert armed.degrade.rung == 0
+        layer = store.layer("default")
+        x, y = _busy_tile(layer, 7, 5)
+        dx, dy = _busy_tile(layer, 10, 8)
+        paths = [f"/tiles/default/5/{x}/{y}.json",
+                 f"/tiles/default/5/{x}/{y}.png",
+                 f"/tiles/default/5/{x}/{y}.json?synopsis=1",
+                 f"/tiles/default/8/{dx}/{dy}.json",
+                 f"/tiles/default/8/{dx}/{dy}.json?synopsis=1"]
+        for path in paths:
+            a, b = plain.handle("GET", path), armed.handle("GET", path)
+            assert a[0] == b[0] == 200
+            assert a[2] == b[2], path   # body bytes
+            assert a[3] == b[3], path   # ETag (incl. syn- namespace)
+            assert getattr(a, "headers", None) == getattr(
+                b, "headers", None), path
+        assert armed.cache.ttl_scale == 1.0  # rung 0 never touches TTLs
+
+    def test_rung1_forces_synopsis_with_stamped_error(self, syn_store):
+        store = TileStore(syn_store)
+        app = ServeApp(store, TileCache(), degrade=_controller())
+        layer = store.layer("default")
+        x, y = _busy_tile(layer, 7, 5)
+        path = f"/tiles/default/5/{x}/{y}.json"
+        app.degrade.rung = 1
+        res = app.handle("GET", path)  # no ?synopsis= opt-in needed
+        assert res[0] == 200 and res[3].startswith('"syn-')
+        assert res.headers["X-Heatmap-Synopsis"] == (
+            f"max_err={layer.synopses[7].max_err:.6g}")
+        # Rung 1 does NOT raise the ceiling: a zoom whose source has no
+        # synopsis still answers exact, byte-identical to rung 0.
+        dx, dy = _busy_tile(layer, 10, 8)
+        deep = f"/tiles/default/8/{dx}/{dy}.json"
+        exact = ServeApp(store, TileCache()).handle("GET", deep)
+        forced = app.handle("GET", deep)
+        assert tuple(forced)[:4] == tuple(exact)[:4]
+        assert getattr(forced, "headers", None) is None
+
+    def test_rung2_raises_ceiling_and_stretches_ttl(self, syn_store):
+        store = TileStore(syn_store)
+        ctl = _controller(ttl_stretch=6.0)
+        app = ServeApp(store, TileCache(ttl_s=30.0), degrade=ctl)
+        layer = store.layer("default")
+        dx, dy = _busy_tile(layer, 10, 8)
+        deep = f"/tiles/default/8/{dx}/{dy}.json"
+        ctl.rung = 2
+        res = app.handle("GET", deep)
+        assert res[0] == 200 and res[3].startswith('"syn-')
+        marker = res.headers["X-Heatmap-Synopsis"]
+        assert "stretch=1" in marker
+        assert f"max_err={layer.synopses[9].max_err:.6g}" in marker
+        assert app.cache.ttl_scale == 6.0  # serve-stale widened
+        # Walk back to rung 0: the next request restores the TTLs and
+        # the exact bytes (fresh cache key — no synopsis aliasing).
+        ctl.rung = 0
+        back = app.handle("GET", deep)
+        assert app.cache.ttl_scale == 1.0
+        exact = ServeApp(store, TileCache()).handle("GET", deep)
+        assert tuple(back)[:4] == tuple(exact)[:4]
+
+    def test_ttl_scale_widens_expiry_without_restamping(self):
+        now = [0.0]
+        cache = TileCache(ttl_s=10.0, clock=lambda: now[0])
+        renders = []
+
+        def render():
+            renders.append(now[0])
+            return b"tile"
+
+        cache.get_or_render("k", 1, render)
+        now[0] = 15.0  # past ttl_s but within 4x
+        cache.set_ttl_scale(4.0)
+        assert cache.get_or_render("k", 1, render)[1] is True
+        cache.set_ttl_scale(1.0)  # restore -> the entry is stale again
+        assert cache.get_or_render("k", 1, render)[1] is False
+        assert renders == [0.0, 15.0]
+        with pytest.raises(ValueError):
+            cache.set_ttl_scale(0.5)
+
+    def test_rung3_sheds_deterministically_and_halves_admission(
+            self, syn_store):
+        store = TileStore(syn_store)
+        ctl = _controller(shed_fraction=1.0)
+        app = ServeApp(store, TileCache(), max_inflight=4, degrade=ctl)
+        layer = store.layer("default")
+        x, y = _busy_tile(layer, 7, 5)
+        path = f"/tiles/default/5/{x}/{y}.json"
+        ctl.rung = 3
+        status, _, body, _, route, _ = app.handle("GET", path)
+        assert (status, route) == (503, "tiles")
+        assert json.loads(body)["cause"] == "brownout"
+        health = json.loads(app.handle("GET", "/healthz")[2])
+        assert health["status"] == "degraded"
+        assert "brownout" in health["degraded"]
+        # shed_fraction=0 at top rung: admitted, but the in-flight
+        # bound is halved (4 -> 2), so two in flight already shed.
+        ctl.shed_fraction = 0.0
+        app._inflight = 2
+        status, _, body, _, _, _ = app.handle("GET", path)
+        assert status == 503 and json.loads(body)["cause"] == "shed"
+        app._inflight = 0
+        assert app.handle("GET", path)[0] == 200
+        # Recovery clears the brownout cause on the next admit.
+        ctl.rung = 0
+        app.handle("GET", path)
+        health = json.loads(app.handle("GET", "/healthz")[2])
+        assert health["status"] == "ok"
+
+    def test_healthz_surfaces_burn_fractions_and_ladder(self, syn_store):
+        store = TileStore(syn_store)
+        ctl = _controller()
+        app = ServeApp(store, TileCache(), degrade=ctl)
+        engine = slo.install_specs(
+            ["tiles-fast:latency:target=0.99,threshold_ms=50",
+             "tiles-up:error_rate:target=0.999"])
+        try:
+            obs.emit("http_request", route="tiles", status=200,
+                     path="/t", ms=1.0, bytes=10)
+            health = json.loads(app.handle("GET", "/healthz")[2])
+            burns = health["slo_burn"]
+            assert set(burns) == {"tiles-fast", "tiles-up"}
+            assert all(isinstance(v, float) for v in burns.values())
+            ladder = health["degrade"]
+            assert ladder["rung"] == 0
+            assert ladder["rung_name"] == "full"
+            assert ladder["thresholds"] == {"up": 1.0, "down": 0.5}
+            assert engine is not None
+        finally:
+            slo.set_engine(None)
+        # Without a controller the block is simply absent.
+        bare = json.loads(
+            ServeApp(store, TileCache()).handle("GET", "/healthz")[2])
+        assert "degrade" not in bare
+
+
+# -- fleet-wide rung agreement ---------------------------------------------
+
+
+class TestFleetAgreement:
+    def test_router_adopts_hottest_backend_and_sheds_same_keys(self):
+        b0 = BackendClient("b0", "127.0.0.1", 1)
+        b1 = BackendClient("b1", "127.0.0.1", 2)
+        router = RouterApp([b0, b1], probe_interval_s=1e9)
+        assert router.fleet_degrade() is None  # no probe has seen one
+        b0.degrade = {"rung": 1, "max_rung": 3, "shed_fraction": 0.5}
+        b1.degrade = {"rung": 3, "max_rung": 3, "shed_fraction": 0.5}
+        snap = router.fleet_degrade()
+        assert snap["rung"] == 3  # max rung wins
+        # Router-side shed agrees key-for-key with the backends' own
+        # deterministic hash — no forward slot is spent on a key the
+        # backend would shed anyway.
+        shed = kept = 0
+        for x in range(8):
+            for y in range(8):
+                path = f"/tiles/default/3/{x}/{y}.png"
+                key = ("default", "3", str(x), str(y), "png")
+                status, _, body, _, _, _ = router.handle("GET", path)
+                if degrade.shed_tile(0.5, key):
+                    shed += 1
+                    assert status == 503
+                    assert json.loads(body)["cause"] == "brownout"
+                else:
+                    kept += 1
+                    # Survivors route normally (and 502 here, since no
+                    # real backend listens — the point is no shed).
+                    assert json.loads(body).get("cause") != "brownout"
+        assert shed and kept
+        health = router._health()
+        assert health["degrade"]["rung"] == 3
+        assert health["fleet"]["backends"]["b1"]["degrade_rung"] == 3
+        assert health["fleet"]["backends"]["b0"]["degrade_rung"] == 1
+        # Below the top rung the router forwards everything.
+        b1.degrade = {"rung": 2, "max_rung": 3, "shed_fraction": 0.5}
+        status, _, body, _, _, _ = router.handle(
+            "GET", "/tiles/default/3/0/0.png")
+        assert json.loads(body).get("cause") != "brownout"
+
+
+# -- Retry-After jitter ----------------------------------------------------
+
+
+class TestRetryAfterJitter:
+    def test_jitter_spreads_across_paths_within_bounds(self, syn_store):
+        assert degrade.retry_after_jitter(8.0, "/a", 0) == (
+            degrade.retry_after_jitter(8.0, "/a", 0))  # deterministic
+        app = ServeApp(TileStore(syn_store), TileCache(),
+                       retry_after_s=8.0)
+        server, base = serve_in_thread(app)
+        try:
+            app.handle("POST", "/drain")
+            values = []
+            for i in range(12):
+                req = urllib.request.Request(
+                    f"{base}/tiles/default/5/{i}/{i}.json")
+                try:
+                    urllib.request.urlopen(req, timeout=10)
+                    pytest.fail("drained app must shed")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503
+                    values.append(int(e.headers["Retry-After"]))
+        finally:
+            server.shutdown()
+            server.server_close()
+        # Full-jitter shape: [0.5, 1.5) x nominal, never the bare
+        # nominal for every client (that is the thundering herd).
+        assert all(4 <= v <= 12 for v in values)
+        assert len(set(values)) > 1

@@ -59,6 +59,8 @@ _PAYLOADS = {
                        "rule": "source.read=3x5"},
     "degraded_enter": {"cause": "render", "detail": "serving stale tiles"},
     "degraded_exit": {"cause": "render"},
+    "degrade_step": {"rung": 1, "from_rung": 0, "direction": "up",
+                     "cause": "tiles-fast", "burn": 1.5},
     "quarantine": {"root": "store/", "path": "journal/ckpt-3.npz",
                    "reason": "digest_mismatch", "kind": "journal_entry",
                    "detail": "recorded sha256:aa..., actual sha256:bb..."},
@@ -341,6 +343,21 @@ class TestRunTelemetry:
         assert any(e.get("name") == "run"
                    for e in traced["traceEvents"])
 
+        # -- and with a brownout controller armed at rung 0: an idle
+        # ladder (no burn) must be purely observational too.
+        from heatmap_tpu.serve import degrade
+
+        controller = degrade.BrownoutController(poll_interval_s=0.0)
+        out_ctl = tmp_path / "ctl.jsonl"
+        controller.poll()
+        assert cmd_run(_run_args(
+            ["--output", f"jsonl:{out_ctl}",
+             "--slo", "stage-budget:error_rate:target=0.9"])) == 0
+        capsys.readouterr()
+        controller.poll()
+        assert controller.rung == 0
+        assert out_ctl.read_bytes() == out_off.read_bytes()
+
         # -- event log: ordering + coverage
         records = obs.read_events(str(events))
         for rec in records:
@@ -608,7 +625,8 @@ class TestNoRawInstrumentation:
     # tilemath.mercator and legitimately pulls jax.
     JAX_FREE = ("heatmap_tpu/serve/store.py", "heatmap_tpu/serve/render.py",
                 "heatmap_tpu/serve/http.py", "heatmap_tpu/serve/cache.py",
-                "heatmap_tpu/serve/router.py", "heatmap_tpu/synopsis/")
+                "heatmap_tpu/serve/router.py",
+                "heatmap_tpu/serve/degrade.py", "heatmap_tpu/synopsis/")
     JAX_IMPORT = re.compile(r"^(?:import jax\b|from jax\b)")
 
     def test_decode_path_has_no_module_level_jax(self):

@@ -13,6 +13,10 @@ Reads every bench artifact the repo's tooling writes —
   (``serve:fleet:rps[N]`` / ``p99_ms[N]``), kill-one-backend
   availability when ``--fleet`` was run, and the flight-recorder A/B
   tax (``obs:recorder_overhead_pct``, lower, noise-floored at 5%);
+- ``BENCH_adaptive.json`` (tools/load_gen.py --adaptive): overload-
+  stage availability for the brownout ramp, controller on and off
+  (``adaptive:availability[on|off]``, higher), and the hot-stage p99
+  with the ladder active (``adaptive:p99_ms[on]``, lower);
 - ``BENCH_ingest.json`` (tools/bench_ingest.py): per micro-batch and
   padding mode, sustained points/sec (higher) and ingest->servable
   p99 lag ms (lower);
@@ -127,6 +131,22 @@ def snapshot_metrics(root: str) -> dict:
         if isinstance(pct, (int, float)):
             out["obs:recorder_overhead_pct"] = (max(float(pct), 5.0),
                                                 False)
+    doc = _load(os.path.join(root, "BENCH_adaptive.json"))
+    if isinstance(doc, dict):
+        # Brownout ramp (load_gen --adaptive): availability over the
+        # overload stages for both legs — the controller-on leg must
+        # not quietly lose ground, and the controller-off leg anchors
+        # what the same ramp does without the ladder — plus the hot
+        # p99 with the ladder active.
+        for leg in ("on", "off"):
+            row = (doc.get("legs") or {}).get(leg) or {}
+            if isinstance(row.get("overload_availability"), (int, float)):
+                out[f"adaptive:availability[{leg}]"] = (
+                    float(row["overload_availability"]), True)
+        p99 = ((doc.get("legs") or {}).get("on") or {}).get(
+            "overload_p99_ms")
+        if isinstance(p99, (int, float)):
+            out["adaptive:p99_ms[on]"] = (float(p99), False)
     doc = _load(os.path.join(root, "BENCH_ingest.json"))
     if isinstance(doc, dict):
         for row in doc.get("results", []):

@@ -21,7 +21,12 @@ probe, and the recovered fleet must serve bytes identical to the clean
 single-process run. A synopsis phase tears a wavelet-synopsis artifact
 mid-write: the recovery sweep must quarantine it, serving must fall
 back to exact bytes for that level while other levels keep their
-synopses, and no request may see a 500. The chaos run must converge to the *same bytes*:
+synopses, and no request may see a 500. An adaptive phase scripts one
+overload episode against the brownout controller (serve/degrade.py)
+under a fake clock: the ladder must step up 0->1->2->3 and walk back
+down identically across repeat runs, with zero 500s and — recovered at
+rung 0 — bytes identical to a controller-less server. The chaos run
+must converge to the *same bytes*:
 level arrays, journal state, and every served JSON tile. Along the way
 the HTTP tier must degrade gracefully (typed 503s / stale serves,
 ``/healthz`` reporting ``degraded``) and never return a 500.
@@ -743,6 +748,131 @@ def phase_incident(ctx):
         obs.enable_metrics(False)
 
 
+def phase_adaptive(ctx):
+    """Brownout-ladder chaos: one overload episode under a fake clock
+    and a scripted burn schedule must walk the ladder up 0->1->2->3
+    and back down to 0, with the rungs' serving policies observable at
+    each plateau (synopsis stamps, the raised ceiling, deterministic
+    brownout sheds), zero 500s, identical status/rung traces across a
+    repeat run, and — once recovered to rung 0 — bytes and ETags
+    identical to a controller-less server."""
+    from heatmap_tpu.io import open_sink
+    from heatmap_tpu.serve import degrade
+
+    faults.install(None)
+    scratch = os.path.dirname(ctx["base_root"])
+    root = os.path.join(scratch, "store-adaptive")
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                         result_delta=2)
+    with open_sink(f"arrays-synopsis:{root}") as sink:
+        run_job(SyntheticSource(ctx["n"], seed=9), sink, cfg)
+    store = TileStore(f"arrays:{root}")
+    layer = store.layer("default")
+    syn_zooms = sorted(layer.synopses)  # sources 7/8/9 under cfg
+    delta_z = layer.result_delta
+
+    def busy_path(src):
+        level = layer.levels[src]
+        code = level.codes[int(np.argmax(level.values)):][:1]
+        row, col = morton_decode_np(code)
+        z = src - delta_z
+        return (f"/tiles/default/{z}/{int(col[0]) >> delta_z}"
+                f"/{int(row[0]) >> delta_z}.json")
+
+    # Fixed request mix: every synopsis-backed coarse zoom, one deep
+    # zoom with NO natural synopsis (the rung-2 ceiling target), and a
+    # spread of neighbors so the rung-3 fractional shed has keys to
+    # split. Deterministic by construction.
+    deep_src = max(layer.detail_zooms)
+    assert deep_src not in layer.synopses
+    paths = [busy_path(src) for src in syn_zooms + [deep_src]]
+    bx, by = paths[0].split("/")[4:6]
+    z0 = int(paths[0].split("/")[3])
+    for dx in range(4):
+        for dy in range(3):
+            x = (int(bx) + dx) % (1 << z0)
+            y = (int(by.split(".")[0]) + dy) % (1 << z0)
+            paths.append(f"/tiles/default/{z0}/{x}/{y}.json")
+    # Burn schedule: hot long enough for three 2s dwells, then cool
+    # through three 3s holds — one full staircase per episode.
+    schedule = [(float(t), 2.5) for t in range(9)]
+    schedule += [(float(t), 0.1) for t in range(9, 22)]
+
+    def episode(run_idx):
+        tnow, burn = [0.0], [0.0]
+        ctl = degrade.BrownoutController(
+            dwell_s=2.0, hold_s=3.0, poll_interval_s=0.0,
+            shed_fraction=0.5,
+            burn_source=lambda: {"tiles-fast": burn[0]},
+            clock=lambda: tnow[0])
+        app = ServeApp(store, TileCache(), max_inflight=8, degrade=ctl)
+        ev_path = os.path.join(scratch, f"adaptive-{run_idx}.jsonl")
+        log = obs.EventLog(ev_path, run_id=f"adaptive-{run_idx}")
+        obs.set_event_log(log)
+        trace, codes, stamped, sheds = [], {}, 0, 0
+        try:
+            faults.install(faults.FaultPlane(seed=11))
+            for t, b in schedule:
+                tnow[0], burn[0] = t, b
+                for path in paths:
+                    res = app.handle("GET", path)
+                    codes[res[0]] = codes.get(res[0], 0) + 1
+                    if res[0] == 503:
+                        sheds += 1
+                        assert json.loads(res[2])["cause"] == "brownout"
+                        assert ctl.rung == ctl.max_rung, \
+                            f"shed below top rung at t={t}"
+                    elif getattr(res, "headers", None) is not None:
+                        stamped += 1
+                        assert ctl.rung >= 1, f"stamp at rung 0, t={t}"
+                    trace.append((t, path, res[0], ctl.rung))
+        finally:
+            faults.install(None)
+            obs.set_event_log(None)
+            log.close()
+        steps = [(r["rung"], r["direction"], r["cause"])
+                 for r in obs.read_events(ev_path)
+                 if r["event"] == "degrade_step"]
+        return app, trace, codes, steps, stamped, sheds
+
+    app1, trace1, codes1, steps1, stamped1, sheds1 = episode(1)
+    _, trace2, codes2, steps2, _, _ = episode(2)
+
+    # One clean staircase, edge-triggered: exactly three ups with the
+    # burning objective as cause, three recovery downs, nothing else.
+    assert steps1 == [(1, "up", "tiles-fast"), (2, "up", "tiles-fast"),
+                      (3, "up", "tiles-fast"), (2, "down", "recovery"),
+                      (1, "down", "recovery"),
+                      (0, "down", "recovery")], steps1
+    # Deterministic ladder: the repeat run reproduces every status and
+    # every rung at every tick, not just the final shape.
+    assert steps2 == steps1
+    assert trace2 == trace1
+    assert codes1 == codes2
+    assert codes1.get(500, 0) == 0, f"500s observed: {codes1}"
+    assert sheds1 > 0, "top rung never shed"
+    assert stamped1 > 0, "no synopsis-stamped responses"
+    # The stretch rung actually raised the ceiling for the deep zoom.
+    deep = paths[len(syn_zooms)]
+    stretch_hits = [s for (t, p, s, rung) in trace1
+                    if p == deep and rung == 2]
+    assert stretch_hits and all(s == 200 for s in stretch_hits)
+
+    # Recovered at rung 0: body AND ETag byte-identical to a server
+    # that never had a controller, for every path in the mix.
+    bare = ServeApp(store, TileCache())
+    assert app1.degrade.rung == 0
+    for path in paths:
+        a, b = bare.handle("GET", path), app1.handle("GET", path)
+        assert tuple(a)[:4] == tuple(b)[:4], path
+        assert (getattr(a, "headers", None)
+                == getattr(b, "headers", None)), path
+    return {"steps": [f"{d}->{r}" for r, d, _ in steps1],
+            "requests": sum(codes1.values()),
+            "codes": {str(k): v for k, v in sorted(codes1.items())},
+            "synopsis_stamped": stamped1, "shed": sheds1}
+
+
 PHASES = [
     ("baseline", phase_baseline),
     ("chaos_pipeline", phase_chaos_pipeline),
@@ -754,6 +884,7 @@ PHASES = [
     ("backend_loss", phase_backend_loss),
     ("synopsis", phase_synopsis),
     ("incident", phase_incident),
+    ("adaptive", phase_adaptive),
     ("byte_equality", phase_byte_equality),
 ]
 

@@ -27,6 +27,12 @@ benches.
 Without --store it generates its own small synthetic artifact through
 the real batch pipeline first (requires a jax backend; serving itself
 is numpy-only).
+
+``--adaptive`` switches to the brownout bench (BENCH_adaptive.json):
+the same closed-loop clients run an overload ramp against a small
+admission bound, once with the degradation ladder off and once on,
+recording availability and the exact/synopsis/shed fidelity split per
+stage (docs/robustness.md, serve/degrade.py).
 """
 
 from __future__ import annotations
@@ -46,8 +52,12 @@ import urllib.parse
 import numpy as np
 
 
-def synth_store(tmpdir: str, n_points: int) -> str:
-    """Run the real batch job on synthetic points into arrays egress."""
+def synth_store(tmpdir: str, n_points: int, *, sink: str = "arrays",
+                config=None) -> str:
+    """Run the real batch job on synthetic points into arrays egress.
+
+    The adaptive (brownout) bench passes ``sink="arrays-synopsis"`` and
+    a synopsis-bearing config so rung 1 has something to stamp."""
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -57,9 +67,9 @@ def synth_store(tmpdir: str, n_points: int) -> str:
     from heatmap_tpu.pipeline import BatchJobConfig, run_job
 
     path = os.path.join(tmpdir, "levels")
-    config = BatchJobConfig(detail_zoom=12, min_detail_zoom=5)
-    with open_sink(f"arrays:{path}") as sink:
-        run_job(open_source(f"synthetic:{n_points}"), sink, config)
+    config = config or BatchJobConfig(detail_zoom=12, min_detail_zoom=5)
+    with open_sink(f"{sink}:{path}") as out:
+        run_job(open_source(f"synthetic:{n_points}"), out, config)
     return f"arrays:{path}"
 
 
@@ -98,6 +108,12 @@ class Worker(threading.Thread):
         self.latencies_ms: list = []
         self.statuses: dict = {}
         self.errors = 0
+        # Fidelity accounting for the adaptive (brownout) bench: how
+        # many answers were synopsis-stamped, the worst stamped error,
+        # and the typed causes behind any 503s.
+        self.synopsis = 0
+        self.max_err = 0.0
+        self.causes: dict = {}
 
     def _pick(self):
         # 80% of traffic on the first 20% of the (shuffled) universe —
@@ -115,8 +131,9 @@ class Worker(threading.Thread):
             try:
                 conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
                 resp = conn.getresponse()
-                resp.read()
+                body = resp.read()
                 status = resp.status
+                marker = resp.getheader("X-Heatmap-Synopsis")
             except Exception:
                 self.errors += 1
                 conn.close()
@@ -125,6 +142,21 @@ class Worker(threading.Thread):
                 continue
             self.latencies_ms.append((time.monotonic() - t0) * 1e3)
             self.statuses[status] = self.statuses.get(status, 0) + 1
+            if marker is not None:
+                self.synopsis += 1
+                try:
+                    err = float(marker.split("max_err=")[1].split(";")[0])
+                except (IndexError, ValueError):
+                    pass
+                else:
+                    self.max_err = max(self.max_err, err)
+            if status == 503:
+                try:
+                    cause = json.loads(body).get("cause")
+                except (ValueError, AttributeError):
+                    cause = None
+                if cause:
+                    self.causes[cause] = self.causes.get(cause, 0) + 1
         conn.close()
 
 
@@ -328,6 +360,127 @@ def _fleet_bench(args, spec: str, universe, tmpdir: str) -> dict:
     }
 
 
+def _adaptive_bench(args, spec: str) -> dict:
+    """``--adaptive``: the brownout availability/fidelity curves for
+    BENCH_adaptive.json. One overload ramp (worker counts step up into
+    saturation against a small admission bound, then back down) run
+    twice over the same store: controller off, then controller on.
+
+    The controller's burn source is a per-stage scripted schedule —
+    the same fixed-burn discipline as the chaos soak's adaptive phase —
+    so the ladder walks the ramp deterministically instead of
+    depending on this host's latency noise; the *measured* side
+    (latencies, statuses, synopsis stamps, shed causes) is real closed
+    -loop traffic. Per stage the record carries availability (served /
+    issued), the exact/synopsis/shed fractions, the worst stamped
+    synopsis error, and the rung the ladder sat on."""
+    from heatmap_tpu.serve import (ServeApp, TileCache, TileStore,
+                                   serve_in_thread)
+    from heatmap_tpu.serve import degrade
+
+    # (workers, scripted burn): ramp into overload, hold, recover.
+    stages = [(2, 0.2), (8, 1.5), (16, 2.5), (16, 3.5),
+              (8, 0.2), (2, 0.2)]
+    stage_s = args.adaptive_stage_s
+    legs: dict = {}
+    for leg in ("off", "on"):
+        store = TileStore(spec)
+        universe = tile_universe(store, args.tiles)
+        burn_now = [0.0]
+        ctl = None
+        if leg == "on":
+            # dwell = hold = half a stage: at most two ladder steps per
+            # stage, so the ramp reads as a staircase in rung_trace
+            # rather than slamming to max_rung in the first hot stage.
+            ctl = degrade.BrownoutController(
+                dwell_s=stage_s / 2, hold_s=stage_s / 2,
+                poll_interval_s=0.05,
+                burn_source=lambda: {"overload": burn_now[0]})
+        app = ServeApp(store, TileCache(max_bytes=args.cache_bytes),
+                       max_inflight=args.adaptive_inflight, degrade=ctl)
+        server, base = serve_in_thread(app)
+        host, port = server.server_address[:2]
+        _warm(base, universe)
+        rows = []
+        for n_workers, burn in stages:
+            burn_now[0] = burn
+            stop_at = time.monotonic() + stage_s
+            workers = [Worker(host, port, universe, stop_at, seed=i)
+                       for i in range(n_workers)]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+            lat = np.sort(np.concatenate(
+                [np.asarray(w.latencies_ms) for w in workers]
+                or [np.zeros(0)]))
+            statuses: dict = {}
+            causes: dict = {}
+            for w in workers:
+                for s, c in w.statuses.items():
+                    statuses[str(s)] = statuses.get(str(s), 0) + c
+                for k, c in w.causes.items():
+                    causes[k] = causes.get(k, 0) + c
+            total = int(sum(statuses.values()))
+            served = sum(c for s, c in statuses.items()
+                         if s.startswith(("2", "304")))
+            synopsis = int(sum(w.synopsis for w in workers))
+            shed = statuses.get("503", 0)
+            row = {
+                "workers": n_workers, "burn": burn, "requests": total,
+                "statuses": statuses,
+                "errors": int(sum(w.errors for w in workers)),
+                "availability": round(served / total, 4) if total else None,
+                "frac_exact": round(max(0, served - synopsis) / total, 4)
+                if total else None,
+                "frac_synopsis": round(synopsis / total, 4)
+                if total else None,
+                "frac_shed": round(shed / total, 4) if total else None,
+                "shed_causes": causes,
+                "max_stamped_err": round(
+                    max((w.max_err for w in workers), default=0.0), 6),
+                "latency_ms": _lat_summary(lat),
+                **({"rung": ctl.rung} if ctl is not None else {}),
+            }
+            rows.append(row)
+            print(json.dumps({"adaptive": leg, **{k: row[k] for k in (
+                "workers", "burn", "availability", "frac_synopsis",
+                "frac_shed")}, **({"rung": ctl.rung}
+                                  if ctl is not None else {})}),
+                flush=True)
+        server.shutdown()
+        server.server_close()
+        # Headline per leg: the overload stages (burn >= 1) are where
+        # brownout control earns its keep; light stages always serve.
+        hot = [r for (_, b), r in zip(stages, rows) if b >= 1.0]
+        issued = sum(r["requests"] for r in hot)
+        ok = sum(round(r["availability"] * r["requests"])
+                 for r in hot if r["availability"] is not None)
+        legs[leg] = {
+            "stages": rows,
+            "overload_availability": round(ok / issued, 4) if issued else None,
+            "overload_p99_ms": max(
+                (r["latency_ms"]["p99"] for r in hot
+                 if r["latency_ms"]["p99"] is not None), default=None),
+            "max_stamped_err": max(r["max_stamped_err"] for r in rows),
+            **({"rung_trace": [r["rung"] for r in rows]}
+               if leg == "on" else {}),
+        }
+    return {
+        "bench": "adaptive",
+        "store": spec,
+        "stage_s": stage_s,
+        "max_inflight": args.adaptive_inflight,
+        "host_cores": os.cpu_count(),
+        "stages": [{"workers": w, "burn": b} for w, b in stages],
+        "legs": legs,
+        "note": "burn is a scripted per-stage schedule (deterministic "
+                "ladder), traffic and latencies are real closed-loop "
+                "load; availability = served / issued over the "
+                "overload stages",
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--store", default=None,
@@ -352,6 +505,17 @@ def main() -> int:
     ap.add_argument("--drive-procs", type=int, default=2,
                     help="client subprocesses per fleet cell (keeps the "
                     "load generator off a single GIL)")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="run the brownout bench instead of the serve "
+                    "bench: overload ramp with the degradation ladder "
+                    "off vs on, availability + fidelity per stage "
+                    "(docs/robustness.md)")
+    ap.add_argument("--adaptive-out", default="BENCH_adaptive.json")
+    ap.add_argument("--adaptive-stage-s", type=float, default=3.0,
+                    help="seconds per ramp stage")
+    ap.add_argument("--adaptive-inflight", type=int, default=4,
+                    help="server admission bound for the ramp (small on "
+                    "purpose: the hot stages must actually overload)")
     # --drive mode internals (subprocess client; not for direct use).
     ap.add_argument("--drive", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--universe-file", default=None, help=argparse.SUPPRESS)
@@ -372,10 +536,42 @@ def main() -> int:
     if spec is None:
         tmpdir = tempfile.mkdtemp(prefix="loadgen-")
         t0 = time.perf_counter()
-        spec = synth_store(tmpdir, args.n_points)
+        if args.adaptive:
+            from heatmap_tpu.pipeline import BatchJobConfig
+
+            # Synopsis-carrying store (same shape as the chaos soak's
+            # adaptive phase): sources 7/8/9 synopsized, detail exact.
+            spec = synth_store(
+                tmpdir, args.n_points, sink="arrays-synopsis",
+                config=BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                                      result_delta=2))
+        else:
+            spec = synth_store(tmpdir, args.n_points)
         print(json.dumps({"stage": "synth_store", "spec": spec,
                           "s": round(time.perf_counter() - t0, 2)}),
               flush=True)
+
+    if args.adaptive:
+        try:
+            record = _adaptive_bench(args, spec)
+        finally:
+            if tmpdir:
+                import shutil
+
+                shutil.rmtree(tmpdir, ignore_errors=True)
+        with open(args.adaptive_out, "w") as f:
+            json.dump(record, f, indent=2, default=str)
+            f.write("\n")
+        on, off = record["legs"]["on"], record["legs"]["off"]
+        print(json.dumps({
+            "availability_on": on["overload_availability"],
+            "availability_off": off["overload_availability"],
+            "p99_ms_on": on["overload_p99_ms"],
+            "p99_ms_off": off["overload_p99_ms"],
+            "rung_trace": on["rung_trace"],
+        }), flush=True)
+        print(json.dumps({"wrote": args.adaptive_out}), flush=True)
+        return 0
 
     store = TileStore(spec)
     cache = TileCache(max_bytes=args.cache_bytes, ttl_s=args.ttl)
