@@ -197,6 +197,16 @@ def _add_run_flags(p):
                    "the real crossover on your hardware with the "
                    "docs/OPERATIONS.md 'Calibrating auto-DP' recipe; "
                    "auto mode only")
+    p.add_argument("--spatial-partition", choices=("auto", "morton", "off"),
+                   default="auto",
+                   help="Morton-range sharding of the data-parallel "
+                   "cascade: each device owns one contiguous Z-order "
+                   "code range and the cross-device merge shrinks to "
+                   "boundary tiles only (docs/parallel-partitioning.md). "
+                   "auto (default) plans ranges when the mesh engages "
+                   "on real volume; morton forces it; off pins the "
+                   "uniform round-robin dispatch. Blobs byte-identical "
+                   "in every mode")
     p.add_argument("--fast", action="store_true",
                    help="force the integer-only native-decoder path "
                    "(csv/hmpb sources; dated timespans use the i64 "
@@ -404,6 +414,7 @@ def cmd_run(args) -> int:
                 args.data_parallel],
             dp_merge=args.dp_merge,
             dp_min_emissions=args.dp_min_emissions,
+            spatial_partition=args.spatial_partition,
         )
     except ValueError as e:
         raise SystemExit(str(e)) from e

@@ -89,6 +89,14 @@ DEVICE_BYTES = _registry.gauge(
 ELASTIC_REASSIGNMENTS = _registry.counter(
     "elastic_reassignments_total",
     "Orphaned shards reassigned to surviving hosts (parallel/elastic)")
+PARTITION_SKEW = _registry.gauge(
+    "partition_skew_ratio",
+    "Max/mean sampled shard mass of the last Morton partition plan "
+    "(parallel/partition; the load-imbalance signal the planner bounds)")
+BOUNDARY_TILES = _registry.counter(
+    "cascade_boundary_tiles_total",
+    "Straddling parent tiles cross-merged by range-sharded cascades "
+    "(the entire cross-shard merge volume of the Morton path)")
 SPECULATIVE_LAUNCHES = _registry.counter(
     "speculative_launches_total",
     "Speculative duplicate shard executions by race outcome",
@@ -311,6 +319,35 @@ def record_shard_reassigned(shard, from_host, to_host):
          to_host=str(to_host))
 
 
+def record_partition_planned(plan, boundary_tiles=None):
+    """A Morton partition plan was built for a cascade dispatch.
+
+    Sets partition_skew_ratio to the plan's max/mean sampled shard mass
+    and, when the caller passes the per-pyramid boundary-tile count,
+    folds it into cascade_boundary_tiles_total.
+    """
+    if not telemetry_enabled():
+        return
+    PARTITION_SKEW.set(plan.skew_ratio)
+    fields = {}
+    if boundary_tiles is not None:
+        BOUNDARY_TILES.inc(int(boundary_tiles))
+        fields["boundary_tiles"] = int(boundary_tiles)
+    emit("partition_planned",
+         n_shards=plan.n_shards,
+         splits=[int(s) for s in plan.splits],
+         sampled_points=plan.sampled_points,
+         balance_factor=plan.balance_factor,
+         max_shard_mass=max(plan.shard_mass) if plan.shard_mass else 0.0,
+         mean_shard_mass=(sum(plan.shard_mass) / len(plan.shard_mass)
+                          if plan.shard_mass else 0.0),
+         skew_ratio=plan.skew_ratio,
+         resplits=plan.resplits,
+         degenerate=plan.degenerate,
+         fingerprint=plan.fingerprint,
+         **fields)
+
+
 def record_speculative_launch(shard, host, runtime_s=None,
                               threshold_s=None):
     """A duplicate execution of a straggling shard was launched on an
@@ -354,7 +391,8 @@ __all__ = [
     "heartbeat_ages", "incident", "install_specs", "metrics",
     "metrics_enabled",
     "parse_slo_spec", "parse_traceparent", "read_events", "record_fault",
-    "record_io_retry", "record_recovery", "record_retry",
+    "record_io_retry", "record_partition_planned", "record_recovery",
+    "record_retry",
     "record_shard_orphaned", "record_shard_reassigned",
     "record_speculative_launch", "record_speculative_result",
     "record_stage", "recorder", "refresh_process_gauges",

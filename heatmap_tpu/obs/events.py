@@ -37,12 +37,21 @@ EVENT_SCHEMA = {
     # Job-level routing decision: how cascade_backend="auto" resolved.
     "backend_resolved": {"required": ("requested", "resolved"),
                          "optional": ("reason", "weighted", "data_parallel",
-                                      "n_emissions")},
+                                      "n_emissions", "spatial_partition")},
     # Per-call cascade dispatch record (the audit trail behind
     # backend_resolved: what run_cascade actually executed).
     "cascade_dispatch": {"required": ("backend",),
                          "optional": ("jit", "mesh", "merge", "n_emissions",
-                                      "n_slots", "trace_id", "span_id")},
+                                      "n_slots", "trace_id", "span_id",
+                                      "partition")},
+    # Morton-range partition plan for a cascade dispatch
+    # (parallel/partition.plan_partition): the split codes, the sampled
+    # evidence they were chosen from, and the post-resplit balance.
+    "partition_planned": {"required": ("n_shards", "splits",
+                                       "sampled_points", "balance_factor",
+                                       "max_shard_mass", "mean_shard_mass"),
+                          "optional": ("skew_ratio", "resplits", "degenerate",
+                                       "fingerprint", "boundary_tiles")},
     # jax.local_devices()[i].memory_stats() snapshot (empty on CPU).
     "device_memory": {"required": ("samples",), "optional": ()},
     # utils/recovery.py shard retry loop.

@@ -99,12 +99,22 @@ _MIN_SPECULATION_SAMPLES = 3
 @dataclasses.dataclass(frozen=True)
 class WorkShard:
     """One unit of elastic work: the contiguous batch range [lo, hi)
-    of the job's source at the job's pinned batch size."""
+    of the job's source at the job's pinned batch size.
+
+    Under ``partition="morton"`` the shard additionally owns the
+    contiguous detail-zoom Morton code range ``[code_lo, code_hi)``
+    (from a parallel.partition plan): every shard reads the same batch
+    range but keeps only its own tile range, so failover re-execution
+    touches exactly the dead host's tile ranges instead of a
+    batch-range slice of the whole map. ``None`` (default) keeps the
+    historical batch-range semantics."""
 
     index: int
     lo: int
     hi: int
     fingerprint: str
+    code_lo: int | None = None
+    code_hi: int | None = None
 
     @property
     def dirname(self) -> str:
@@ -142,14 +152,38 @@ def job_fingerprint(source, config, batch_size: int, n_total: int) -> str:
     return hashlib.sha256(payload.encode()).hexdigest()
 
 
-def shard_fingerprint(job_fp: str, lo: int, hi: int) -> str:
-    return hashlib.sha256(
-        f"{job_fp}:{int(lo)}:{int(hi)}".encode()).hexdigest()
+def shard_fingerprint(job_fp: str, lo: int, hi: int,
+                      code_lo=None, code_hi=None) -> str:
+    ident = f"{job_fp}:{int(lo)}:{int(hi)}"
+    if code_lo is not None:
+        # Distinct namespace from batch-range shards: the same batch
+        # slice filtered to a tile range is different content.
+        ident += f":morton:{int(code_lo)}:{int(code_hi)}"
+    return hashlib.sha256(ident.encode()).hexdigest()
 
 
-def plan_shards(n_batches: int, n_shards: int, job_fp: str) -> list:
+def plan_shards(n_batches: int, n_shards: int, job_fp: str, *,
+                code_ranges=None) -> list:
     """Contiguous balanced split of the batch index space into
-    ``n_shards`` WorkShards (the process_shard_bounds shape)."""
+    ``n_shards`` WorkShards (the process_shard_bounds shape).
+
+    ``code_ranges`` switches to ``partition="morton"`` shards: one
+    WorkShard per ``[code_lo, code_hi)`` detail-code range (from
+    ``parallel.partition.PartitionPlan.code_ranges()``), each spanning
+    the FULL batch range — ownership is spatial, not positional, so a
+    re-executed shard reproduces exactly one tile range. Empty ranges
+    (``code_lo == code_hi``) are planned too: they publish empty
+    partials, keeping shard count == plan shard count so failover
+    bookkeeping stays positional."""
+    if code_ranges is not None:
+        n_batches = max(0, int(n_batches))
+        return [
+            WorkShard(index=i, lo=0, hi=n_batches,
+                      fingerprint=shard_fingerprint(
+                          job_fp, 0, n_batches, code_lo=clo, code_hi=chi),
+                      code_lo=int(clo), code_hi=int(chi))
+            for i, (clo, chi) in enumerate(code_ranges)
+        ]
     n_shards = max(1, min(int(n_shards), max(1, int(n_batches))))
     base, rem = divmod(max(0, int(n_batches)), n_shards)
     out, lo = [], 0
@@ -445,15 +479,40 @@ class ElasticCoordinator:
 def _make_executor(source, config, batch_size: int, exec_lock):
     """shard -> (levels, meta): read the shard's batch slice, run the
     ordinary cascade on it, capture the partial pyramid. The global
-    lock serializes JAX execution across simulated-host threads."""
+    lock serializes JAX execution across simulated-host threads.
+
+    Morton shards (``shard.code_lo is not None``) read their batch
+    slice and keep only rows whose projected detail code falls in
+    ``[code_lo, code_hi)``. Rows with invalid projection belong to NO
+    range — they contribute nothing in any path (``valid=False`` lanes
+    in the ordinary cascade), so dropping them keeps the merged result
+    byte-identical to batch-range sharding."""
     from heatmap_tpu.parallel.multihost import _CaptureLevels
-    from heatmap_tpu.pipeline.batch import _run_loaded, ingest_columns
+    from heatmap_tpu.pipeline.batch import (
+        _run_loaded,
+        ingest_columns,
+        project_detail_codes,
+    )
 
     def execute(shard: WorkShard):
         batches = itertools.islice(source.batches(batch_size),
                                    shard.lo, shard.hi)
         with exec_lock:
             data = ingest_columns(batches, config)
+            if data is not None and shard.code_lo is not None:
+                codes, valid = project_detail_codes(
+                    np.asarray(data["latitude"], np.float64),
+                    np.asarray(data["longitude"], np.float64),
+                    config.detail_zoom, prefer_device=False)
+                codes = np.asarray(codes)
+                keep = (np.asarray(valid)
+                        & (codes >= shard.code_lo)
+                        & (codes < shard.code_hi))
+                if keep.any():
+                    data = {k: np.asarray(v)[keep]
+                            for k, v in data.items()}
+                else:
+                    data = None  # empty range: publish an empty partial
             cap = _CaptureLevels()
             meta = {"points": 0, "content_digest": None}
             if data is not None:
@@ -642,6 +701,40 @@ def _run_multiprocess(plan, lineage, execute, *, rank: int, n_procs: int,
 # ---------------------------------------------------------------------------
 
 
+_PLAN_SAMPLE_ROWS = 1 << 17
+
+
+def _plan_source_partition(source, config, batch_size: int, n_shards: int):
+    """Sample the source's leading batches and build a Morton-range
+    PartitionPlan for ``n_shards`` ranges, or None when the source
+    yields no projectable rows. Sources are re-iterable (the batch
+    executors re-read them), so consuming a prefix here is safe."""
+    from heatmap_tpu.parallel.partition import plan_partition
+    from heatmap_tpu.pipeline.batch import project_detail_codes
+
+    lats: list[np.ndarray] = []
+    lons: list[np.ndarray] = []
+    seen = 0
+    for batch in source.batches(batch_size):
+        lat = np.asarray(batch["latitude"], np.float64)
+        lon = np.asarray(batch["longitude"], np.float64)
+        lats.append(lat)
+        lons.append(lon)
+        seen += lat.size
+        if seen >= _PLAN_SAMPLE_ROWS:
+            break
+    if not seen:
+        return None
+    lat = np.concatenate(lats)[:_PLAN_SAMPLE_ROWS]
+    lon = np.concatenate(lons)[:_PLAN_SAMPLE_ROWS]
+    codes, valid = project_detail_codes(lat, lon, config.detail_zoom,
+                                        prefer_device=False)
+    return plan_partition(np.asarray(codes), n_shards,
+                          detail_zoom=config.detail_zoom,
+                          valid=np.asarray(valid),
+                          n_levels=config.cascade_config().n_levels)
+
+
 def run_job_elastic(source, sink=None, config=None, *,
                     batch_size: int = 1 << 20,
                     n_total: int | None = None,
@@ -655,10 +748,25 @@ def run_job_elastic(source, sink=None, config=None, *,
                     wedge_host=None, wedge_after: int = 0,
                     wedge_spec: str | None = None,
                     beat_interval_s: float = 0.05,
+                    partition: str = "batch",
                     clock=time.monotonic) -> dict:
     """Run a batch job elastically: shard-lineage manifest under
     ``lineage_dir``, failover re-execution on straggler timeout,
     optional speculative duplication of stragglers.
+
+    ``partition`` picks the shard geometry: "batch" (default — the
+    historical contiguous batch-range slices) or "morton" — a
+    Morton-range plan sampled from the source's leading batches
+    (parallel/partition.py) assigns each shard one contiguous
+    detail-code range spanning ALL batches, so a dead host's failover
+    re-executes only its tile ranges and the recovered bytes are
+    pinned identical (tools/chaos_soak.py ``host_loss_morton``). A
+    degenerate plan (all sampled mass effectively in one range) falls
+    back to "batch" with a ``backend_resolved`` audit event. Morton
+    shards each re-read the job's batch range and filter to their
+    range: the trade is ingest read amplification for range-local
+    recovery, the right side of the trade when recompute (cascade)
+    dominates re-read (docs/parallel-partitioning.md).
 
     Single JAX process: ``n_hosts`` simulated hosts (threads) share the
     local devices; real multi-process: each process is one host (see
@@ -693,13 +801,36 @@ def run_job_elastic(source, sink=None, config=None, *,
                 "elastic sharding needs n_total (source row count) or a "
                 "source with an ``n`` attribute — shards are batch "
                 "ranges, so the batch count must be known up front")
+    if partition not in ("batch", "morton"):
+        raise ValueError(
+            f"unknown partition mode {partition!r}: expected 'batch' or "
+            "'morton'")
     n_procs = jax.process_count()
     if n_hosts is None:
         n_hosts = n_procs if n_procs > 1 else 2
     n_batches = max(1, -(-int(n_total) // int(batch_size)))
     job_fp = job_fingerprint(source, config, batch_size, n_total)
-    plan = plan_shards(n_batches, n_hosts * max(1, int(shards_per_host)),
-                       job_fp)
+    n_shards = n_hosts * max(1, int(shards_per_host))
+    code_ranges = None
+    if partition == "morton":
+        plan_obj = _plan_source_partition(source, config, batch_size,
+                                          n_shards)
+        if plan_obj is None or plan_obj.degenerate:
+            if obs.telemetry_enabled():
+                mass = (max(plan_obj.shard_mass or [0.0])
+                        if plan_obj is not None else 0.0)
+                obs.emit(
+                    "backend_resolved",
+                    requested="partition=morton",
+                    resolved="partition=batch",
+                    reason=("degenerate partition plan (max shard mass "
+                            f"{mass:.3f}) — Morton ranges would serialize "
+                            "the job on one shard; falling back to batch "
+                            "ranges"))
+        else:
+            code_ranges = plan_obj.code_ranges()
+    plan = plan_shards(n_batches, n_shards, job_fp,
+                       code_ranges=code_ranges)
     lineage = ShardLineage(lineage_dir)
     exec_lock = threading.Lock()
     execute = _make_executor(source, config, batch_size, exec_lock)

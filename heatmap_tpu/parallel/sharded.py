@@ -396,6 +396,173 @@ def pyramid_sparse_morton_sharded(
     ]
 
 
+def pyramid_sparse_morton_range_sharded(
+    codes,
+    mesh: Mesh,
+    splits,
+    *,
+    code_bits: int,
+    slot_bound: int,
+    weights=None,
+    valid=None,
+    levels: int = 0,
+    capacity=None,
+    acc_dtype=None,
+    backend: str = "scatter",
+    weight_bound: int | None = None,
+):
+    """Range-sharded sparse pyramid: local rollup, boundary-only merge.
+
+    Inputs are PRE-ROUTED host-side by a Morton partition plan
+    (parallel/partition.route_emissions): shard ``k``'s contiguous
+    block holds only composite keys whose detail Morton code lies in
+    range ``k`` of ``splits`` (``#{splits <= code} == k``). Because the
+    pyramid parent is ``code >> 2`` (order-preserving), each shard's
+    rollup is entirely local except for *boundary tiles* — parents
+    whose children straddle a split code. There are at most
+    ``len(splits)`` such codes per level globally and at most 2 per
+    shard (a shard's local keys live in tiles intersecting its own
+    contiguous range, and the only straddling tiles that can intersect
+    it are the ones covering its two endpoints), so the cross-chip
+    exchange is an all_gather of ``<= 2 * slot_bound`` rows per shard
+    per level instead of full-pyramid partials.
+
+    Boundary merge is patch-then-rollup: at every coarse level each
+    shard extracts its boundary rows, all_gathers them, and the FIRST
+    holder (lowest gathered block that holds the key) replaces its
+    partial with the cross-shard total while every other holder drops
+    its row — each key then lives on exactly one shard again, so the
+    next level's local rollup stays exact by induction. Totals are
+    plain sums of the gathered partials, exact in any order for counts
+    and bounded-integer weighted sums (the same contract every other
+    merge in this file relies on).
+
+    ``splits`` is a TRACED ``(n_shards - 1,)`` int array of detail
+    Morton codes (code part only, no slot bits) so every plan shares
+    one compilation; ``code_bits = 2 * detail_zoom`` and ``slot_bound``
+    (the slot vocabulary size) are static. Final per-level results are
+    compacted to canonical sorted order outside the shard_map (keys are
+    globally disjoint, so a global argsort + truncate reproduces the
+    replicated path's arrays byte-for-byte).
+    """
+    axes, ndev = _shard_axes(mesh)
+    codes = jnp.asarray(codes)
+    n = codes.shape[0]
+    if n % ndev != 0:
+        raise ValueError(
+            f"range-sharded cascade needs n % n_shards == 0, got "
+            f"{n} % {ndev} (the host router pads each segment)")
+    splits = jnp.asarray(splits)
+    if splits.shape != (ndev - 1,):
+        raise ValueError(
+            f"need {ndev - 1} split codes for {ndev} shards, got "
+            f"shape {splits.shape}")
+    caps = pyramid_ops._level_caps(capacity, n, levels)
+    local_capacity = max(1, min(caps[0], n // ndev))
+    lcaps = [max(1, min(caps[lvl], local_capacity))
+             for lvl in range(levels + 1)]
+    # Per-shard boundary rows: <= 2 straddling tiles x slot_bound slots
+    # (docstring argument); clamped to the level's local width.
+    bcaps = [max(1, min(lcaps[lvl], 2 * slot_bound))
+             for lvl in range(levels + 1)]
+    if acc_dtype is None:
+        acc_dtype = jnp.int32 if weights is None else jnp.float32
+    counts_only = weights is None
+    w = _ones_like_weights(weights, n, acc_dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+    sentinel = jnp.iinfo(codes.dtype).max
+    stage = _local_detail_stage(backend, counts_only, local_capacity,
+                                acc_dtype, sentinel,
+                                weight_bound=weight_bound)
+
+    def body(k, w, v, spl):
+        me = lax.axis_index(axes[0])
+        for ax in axes[1:]:
+            me = me * mesh.shape[ax] + lax.axis_index(ax)
+        spl = spl.astype(k.dtype)
+        u, s, ln = stage(k, w, v)
+        over = ln > local_capacity
+        # Detail level: routing is by detail code, so no two shards
+        # share a key and the boundary set is empty (an integer split
+        # cannot fall strictly inside a single-code range).
+        outs = [u, s, jnp.sum(u != sentinel)[None]]
+        cur_u, cur_s = u, s
+        for lvl in range(1, levels + 1):
+            parents = jnp.where(cur_u == sentinel, sentinel, cur_u >> 2)
+            pu, ps, pn = sparse_ops.aggregate_sorted_keys(
+                parents, cur_s, lcaps[lvl], sentinel=sentinel)
+            over = over | (pn > lcaps[lvl])
+            # Boundary codes at this level, from the traced splits: the
+            # split's ancestor, unless the split is tile-aligned.
+            blk = (1 << (2 * lvl)) - 1
+            b = jnp.where((spl & blk) != 0, spl >> (2 * lvl), sentinel)
+            code_mask = (1 << (code_bits - 2 * lvl)) - 1
+            is_b = (pu != sentinel) & jnp.any(
+                (pu & code_mask)[:, None] == b[None, :], axis=1)
+            cb = bcaps[lvl]
+            over = over | (jnp.sum(is_b) > cb)
+            # Sort boundary rows to the front (sentinel-masked argsort)
+            # and gather the fixed-width buffers + each block's shard id.
+            bkey = jnp.where(is_b, pu, sentinel)
+            border = jnp.argsort(bkey)[:cb]
+            send_u = bkey[border]
+            send_s = jnp.where(is_b, ps, jnp.zeros((), ps.dtype))[border]
+            g_u = lax.all_gather(send_u, axes)     # (ndev, cb)
+            g_s = lax.all_gather(send_s, axes)
+            g_id = lax.all_gather(me, axes)        # (ndev,)
+
+            def lookup(bu, bs):
+                pos = jnp.clip(jnp.searchsorted(bu, pu), 0, cb - 1)
+                hit = (bu[pos] == pu) & (pu != sentinel)
+                return jnp.where(hit, bs[pos], jnp.zeros((), bs.dtype)), hit
+
+            vals, hits = jax.vmap(lookup)(g_u, g_s)  # (ndev, lcap)
+            total = jnp.sum(vals, axis=0)
+            holder = g_id[jnp.argmax(hits, axis=0)]
+            keep = ~is_b | (holder == me)
+            new_u = jnp.where(keep, pu, sentinel)
+            new_s = jnp.where(keep & is_b, total, ps)
+            new_s = jnp.where(keep, new_s, jnp.zeros((), ps.dtype))
+            reorder = jnp.argsort(new_u)
+            cur_u, cur_s = new_u[reorder], new_s[reorder]
+            outs.extend([cur_u, cur_s, jnp.sum(cur_u != sentinel)[None]])
+        return (*outs, over[None])
+
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axes), P(axes), P(axes), P()),
+        out_specs=(P(axes),) * (3 * (levels + 1) + 1),
+        check_vma=backend == "scatter",
+    )
+    parts = fn(codes, w, v, splits)
+    gover = parts[-1]
+    any_over = gover.any()
+    out = []
+    for lvl in range(levels + 1):
+        gu, gs, gn = parts[3 * lvl], parts[3 * lvl + 1], parts[3 * lvl + 2]
+        cap = caps[lvl]
+        # Keys are globally disjoint post-patch, so a global argsort of
+        # the sentinel-padded shard blocks IS the canonical merged
+        # order (sentinels sort last, their sums are zero).
+        order = jnp.argsort(gu)
+        su, ss = gu[order], gs[order]
+        if su.shape[0] >= cap:
+            su, ss = su[:cap], ss[:cap]
+        else:
+            su = jnp.concatenate(
+                [su, jnp.full((cap - su.shape[0],), sentinel, su.dtype)])
+            ss = jnp.concatenate(
+                [ss, jnp.zeros((cap - ss.shape[0],), ss.dtype)])
+        ln = gn.sum()
+        # Same loud-overflow contract as the other sharded pyramids:
+        # any shard-local overflow already dropped keys, so force the
+        # count past capacity rather than return a clean-looking level.
+        out.append((su, ss,
+                    jnp.where(any_over, jnp.maximum(ln, cap + 1), ln)))
+    return out
+
+
 def pyramid_sparse_morton_prefix_sharded(
     codes,
     mesh: Mesh,

@@ -124,6 +124,22 @@ class BatchJobConfig:
     #: Bucket floor for pad_bucketing != "exact": batches below this
     #: many emissions share one compilation (bucketing.bucket_size).
     pad_bucket_min: int = 1 << 12
+    #: Morton-range spatial sharding of the data-parallel cascade
+    #: (parallel/partition.py): "auto" (default — when the mesh path
+    #: engages AND the emission count reaches the auto-DP threshold,
+    #: plan P-1 split codes from sampled quantiles, route each shard a
+    #: contiguous Z-order range host-side, and shrink the cross-chip
+    #: merge to boundary tiles only), "morton" (force range sharding
+    #: whenever a mesh engages, any size), "off" (uniform round-robin
+    #: DP, the historical path). Byte-neutral: counts and
+    #: integer-valued weighted sums are bit-identical to "off"
+    #: (tests/test_partition.py pins blobs across backends); a
+    #: degenerate plan (one range holds ~all sampled mass) falls back
+    #: to uniform DP with a backend_resolved audit event
+    #: (_dp_mesh_for). Composes with pad_bucketing: per-range segments
+    #: pad to bucketed lengths so routed shapes hit the same compile
+    #: cache.
+    spatial_partition: str = "auto"
 
     def __post_init__(self):
         from heatmap_tpu.pipeline.bucketing import BUCKETING_MODES
@@ -208,6 +224,26 @@ class BatchJobConfig:
                 "adaptive_capacity reads concrete per-level counts "
                 "and does not compose — disable one of them"
             )
+        if self.spatial_partition not in ("auto", "morton", "off"):
+            raise ValueError(
+                f"unknown spatial_partition {self.spatial_partition!r} "
+                "(valid: auto, morton, off) — rejected at config time "
+                "so a typo fails before a multi-hour ingest"
+            )
+        if self.spatial_partition == "morton":
+            if self.data_parallel is False:
+                raise ValueError(
+                    "spatial_partition='morton' range-shards the "
+                    "data-parallel cascade; data_parallel=False pins "
+                    "the single-device path — rejected at config time "
+                    "so a silently ignored partition cannot ship"
+                )
+            if self.adaptive_capacity:
+                raise ValueError(
+                    "spatial_partition='morton' rides the shape-static "
+                    "mesh path; adaptive_capacity does not compose — "
+                    "disable one of them"
+                )
 
     @property
     def resolved_cascade_backend(self) -> str:
@@ -371,15 +407,41 @@ def _dp_mesh(config: BatchJobConfig):
     return make_mesh(devices=jax.local_devices())
 
 
-def _dp_mesh_for(mesh, config: BatchJobConfig, n_emissions: int):
+def _dp_mesh_for(mesh, config: BatchJobConfig, n_emissions: int,
+                 plan=None):
     """The mesh to pass this cascade call, or None: auto engages only
-    at AUTO_DP_MIN_EMISSIONS and up; explicit True always engages."""
+    at AUTO_DP_MIN_EMISSIONS and up; explicit True always engages.
+
+    ``plan`` makes the decision plan-aware rather than a function of
+    ``n_emissions`` alone: a proposed Morton partition plan
+    (parallel.partition.PartitionPlan) whose sampled mass is degenerate
+    — effectively one non-empty range — must NOT ride the range-sharded
+    path, because routing all mass to one shard serializes the cascade
+    (strictly worse than the uniform-DP mesh this threshold was
+    calibrated for). The call then keeps the uniform mesh and records
+    the fallback as a ``backend_resolved`` event
+    (reason="degenerate partition plan...") so the routing decision
+    stays auditable; callers must drop the plan when it is degenerate
+    (_run_grouped and the elastic planner do).
+    """
     if mesh is None:
         return None
     threshold = (AUTO_DP_MIN_EMISSIONS if config.dp_min_emissions is None
                  else config.dp_min_emissions)
     if config.data_parallel is None and n_emissions < threshold:
         return None
+    if plan is not None and plan.degenerate and obs.telemetry_enabled():
+        obs.emit(
+            "backend_resolved",
+            requested=f"spatial_partition={config.spatial_partition}",
+            resolved="uniform-dp",
+            reason=("degenerate partition plan (max shard mass "
+                    f"{max(plan.shard_mass or [0.0]):.3f}) would "
+                    "serialize the cascade on one shard — falling "
+                    "back to uniform DP"),
+            spatial_partition=config.spatial_partition,
+            n_emissions=int(n_emissions),
+        )
     return mesh
 
 
@@ -2054,7 +2116,48 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
                 bucketing_mod.pad_emissions(
                     e_codes, e_slots, e_valid, e_weights, target))
             n_slots = bucketing_mod.bucket_slots(n_slots)
-    dp_mesh = _dp_mesh_for(_dp_mesh(config), config, len(e_codes))
+    mesh0 = _dp_mesh(config)
+    plan = None
+    if mesh0 is not None and config.spatial_partition != "off":
+        from heatmap_tpu.parallel import partition as partition_mod
+        from heatmap_tpu.parallel.sharded import _shard_axes
+
+        _, ndev = _shard_axes(mesh0)
+        threshold = (AUTO_DP_MIN_EMISSIONS
+                     if config.dp_min_emissions is None
+                     else config.dp_min_emissions)
+        # "auto" plans only at real scale: below the DP threshold the
+        # host-side routing pass would cost more than the boundary
+        # merge saves (the same never-slow-down rule as auto-DP).
+        # "morton" forces the plan whenever a mesh engages.
+        if ndev >= 2 and (config.spatial_partition == "morton"
+                          or len(e_codes) >= threshold):
+            with tracer.span("cascade.partition_plan",
+                             items=len(e_codes)):
+                plan = partition_mod.plan_partition(
+                    np.asarray(e_codes), ndev,
+                    detail_zoom=config.detail_zoom,
+                    valid=None if e_valid is None
+                    else np.asarray(e_valid),
+                    n_levels=config.cascade_config().n_levels)
+    dp_mesh = _dp_mesh_for(mesh0, config, len(e_codes), plan=plan)
+    if plan is not None and (dp_mesh is None or plan.degenerate):
+        plan = None  # fallback recorded by _dp_mesh_for
+    if plan is not None:
+        # Host-side range routing: scatter each emission into its
+        # owning shard's contiguous segment (pad lanes valid=False),
+        # bucketing the segment length so routed shapes reuse the
+        # bucketed compile cache.
+        with tracer.span("cascade.partition_route", items=len(e_codes)):
+            bucket = None
+            if config.pad_bucketing != "exact":
+                def bucket(L):
+                    return bucketing_mod.bucket_size(
+                        L, config.pad_bucketing, config.pad_bucket_min)
+            e_codes, e_slots, e_valid, e_weights, _seg = (
+                partition_mod.route_emissions(
+                    plan, e_codes, e_slots, e_valid, e_weights,
+                    bucket=bucket))
     backend = _resolve_backend(config, n_emissions=len(e_codes),
                                data_parallel=dp_mesh is not None)
     with tracer.span("cascade.device", backend=backend):
@@ -2085,9 +2188,15 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
                     else tuple(sorted(dp_mesh.shape.items())),
                     config.dp_merge,
                     config.weight_bound,
+                    # Partition term: the range-sharded kernel is a
+                    # distinct trace, but splits are TRACED, so every
+                    # plan of the same shard count shares one compile.
+                    None if plan is None else ("morton", len(plan.splits)),
                 ),
                 config.pad_bucketing,
             )
+        partition_splits = (None if plan is None
+                            else jnp.asarray(plan.splits, jnp.int64))
         levels = cascade_mod.run_cascade(
             e_codes,
             e_slots,
@@ -2105,6 +2214,7 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             mesh=dp_mesh,
             merge=config.dp_merge,
             weight_bound=config.weight_bound,
+            partition_splits=partition_splits,
             # Stage tracing needs the cascade EAGER: under the fused jit
             # the sort/segment-reduce spans would time tracing, not
             # execution (utils/trace.py stage_span).

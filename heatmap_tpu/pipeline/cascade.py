@@ -99,7 +99,8 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                   weights=None, valid=None, capacity=None, acc_dtype=None,
                   adaptive: bool = False, backend: str = "scatter",
                   mesh=None, merge: str = "replicated",
-                  weight_bound: int | None = None):
+                  weight_bound: int | None = None,
+                  partition_splits=None):
     """Device-side cascade: per-level (composite key, sum) aggregates.
 
     Args:
@@ -146,10 +147,24 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     parallel.sharded.pyramid_sparse_morton_prefix_sharded). Same
     results either way (counts/integer weights bit-identical,
     fractional weighted to f64 summation order).
+
+    ``partition_splits``: a TRACED ``(n_shards - 1,)`` int array of
+    detail-zoom Morton split codes from a parallel.partition plan.
+    Requires a mesh and emissions PRE-ROUTED host-side into per-shard
+    contiguous range segments (partition.route_emissions); the mesh
+    path then runs the range-sharded pyramid whose cross-chip exchange
+    is boundary tiles only (parallel.sharded.
+    pyramid_sparse_morton_range_sharded) instead of the full-pyramid
+    replicated/prefix merge. Traced — every plan shares one compile.
     """
     if merge not in ("replicated", "prefix"):
         raise ValueError(
             f"unknown mesh merge {merge!r} (valid: replicated, prefix)"
+        )
+    if partition_splits is not None and mesh is None:
+        raise ValueError(
+            "partition_splits is the mesh path's range plan; it needs "
+            "a mesh — plan routing happens in pipeline/batch.py"
         )
     if mesh is not None and adaptive:
         raise ValueError(
@@ -209,6 +224,7 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             capacity=capacity, acc_dtype=acc_dtype, merge=merge,
             backend=backend,
             weight_bound=weight_bound if weights is not None else None,
+            partition_splits=partition_splits, n_slots=n_slots,
         )
     if backend == "partitioned":
         return pyramid_ops.pyramid_sparse_morton_partitioned(
@@ -234,7 +250,8 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
                            weights=None, valid=None, capacity=None,
                            acc_dtype=None, merge: str = "replicated",
                            backend: str = "scatter",
-                           weight_bound: int | None = None):
+                           weight_bound: int | None = None,
+                           partition_splits=None, n_slots: int = 1):
     """Pad composite keys to the mesh shard count and run the sharded
     pyramid (see build_cascade's ``mesh`` doc). Pad lanes carry
     valid=False, the masking path every kernel already drops."""
@@ -251,6 +268,18 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
         return pyramid_ops.pyramid_sparse_morton(
             ck, weights=weights, valid=valid, levels=config.n_levels,
             capacity=capacity, acc_dtype=acc_dtype,
+        )
+    if partition_splits is not None:
+        # Emissions arrive pre-routed into per-shard contiguous range
+        # segments of equal length (partition.route_emissions) — no
+        # tail pad here, a pad would shift lanes across segment
+        # boundaries and break the range invariant.
+        return sharded_kernels.pyramid_sparse_morton_range_sharded(
+            ck, mesh, partition_splits,
+            code_bits=2 * config.detail_zoom, slot_bound=n_slots,
+            weights=weights, valid=valid, levels=config.n_levels,
+            capacity=capacity, acc_dtype=acc_dtype, backend=backend,
+            weight_bound=weight_bound,
         )
     pad = (-n) % ndev
     v = (jnp.ones((n,), bool) if valid is None
@@ -291,7 +320,8 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 adaptive: bool = False, jit: bool = True,
                 backend: str = "scatter", mesh=None,
                 merge: str = "replicated",
-                weight_bound: int | None = None):
+                weight_bound: int | None = None,
+                partition_splits=None):
     """The production cascade entry: jitted whole, unless ``adaptive``
     (which must read concrete per-level unique counts and therefore
     runs eagerly — see ops.pyramid.pyramid_sparse_morton) or
@@ -314,13 +344,15 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 "cascade_dispatch", backend=backend,
                 jit=bool(jit and not adaptive), mesh=mesh is not None,
                 merge=merge, n_emissions=int(codes.shape[0]),
-                n_slots=int(n_slots))
+                n_slots=int(n_slots),
+                partition=partition_splits is not None)
         if adaptive or not jit:
             return build_cascade(
                 codes, slots, config, n_slots, weights=weights, valid=valid,
                 capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
                 backend=backend, mesh=mesh, merge=merge,
                 weight_bound=weight_bound,
+                partition_splits=partition_splits,
             )
         if isinstance(capacity, list):
             capacity = tuple(capacity)  # static args must be hashable
@@ -329,6 +361,7 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             valid=valid, capacity=capacity, acc_dtype=acc_dtype,
             backend=backend, mesh=mesh, merge=merge,
             weight_bound=weight_bound,
+            partition_splits=partition_splits,
         )
     finally:
         tracing.end_span(tsp)

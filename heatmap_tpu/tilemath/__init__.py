@@ -28,5 +28,7 @@ from heatmap_tpu.tilemath.morton import (  # noqa: F401
     morton_decode,
     morton_encode,
     morton_parent,
+    morton_range_shards_np,
+    split_boundary_codes_np,
 )
 from heatmap_tpu.tilemath.tile import Tile  # noqa: F401

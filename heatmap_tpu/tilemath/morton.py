@@ -161,6 +161,38 @@ def morton_decode_np(code) -> tuple[np.ndarray, np.ndarray]:
     return _morton_decode_np_pure(code)
 
 
+def morton_range_shards_np(splits, codes) -> np.ndarray:
+    """Shard index per detail code under sorted split codes.
+
+    A code belongs to shard ``k`` iff exactly ``k`` splits are <= it
+    (``searchsorted(side="right")``), i.e. a split code itself opens the
+    range to its right. This is THE ownership convention: the planner,
+    the host router, and the range-sharded kernel must all agree on it
+    or boundary tiles get double-counted.
+    """
+    return np.searchsorted(
+        np.asarray(splits, np.int64), np.asarray(codes, np.int64),
+        side="right").astype(np.int32)
+
+
+def split_boundary_codes_np(splits, levels: int) -> np.ndarray:
+    """Ancestor codes ``levels`` zooms coarser whose tile straddles a split.
+
+    A tile at ``levels`` above detail covers the contiguous detail range
+    ``[c << 2L, (c+1) << 2L)``; a split ``s`` falls strictly inside it
+    iff ``s >> 2L == c`` and ``s`` is not aligned to the tile's start
+    (``s % 4^L != 0``). At ``levels == 0`` no integer split can be
+    strictly inside a single-code range, so the set is empty — the
+    detail level never needs a cross-shard merge.
+    """
+    s = np.unique(np.asarray(splits, np.int64))
+    if levels <= 0 or s.size == 0:
+        return np.empty(0, np.int64)
+    block = np.int64(1) << np.int64(2 * levels)
+    inner = s[(s % block) != 0]
+    return np.unique(inner >> np.int64(2 * levels))
+
+
 def _morton_decode_np_pure(code) -> tuple[np.ndarray, np.ndarray]:
     """The numpy-only decode: fallback and oracle for the native path."""
     code = np.asarray(code, np.uint64)

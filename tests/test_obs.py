@@ -29,6 +29,12 @@ _PAYLOADS = {
                          "reason": "non-tpu platform -> xla scatter"},
     "cascade_dispatch": {"backend": "scatter", "jit": True,
                          "n_emissions": 10},
+    "partition_planned": {"n_shards": 4, "splits": [12, 90, 400],
+                          "sampled_points": 4096, "balance_factor": 1.25,
+                          "max_shard_mass": 0.27, "mean_shard_mass": 0.25,
+                          "skew_ratio": 1.08, "resplits": 0,
+                          "degenerate": False, "fingerprint": "sha256:00",
+                          "boundary_tiles": 6},
     "device_memory": {"samples": []},
     "retry": {"shard": 3, "attempt": 1, "error": "RuntimeError('x')"},
     "recovery": {"shard": 3, "attempts": 2},

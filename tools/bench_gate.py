@@ -22,6 +22,11 @@ Reads every bench artifact the repo's tooling writes —
   p99 lag ms (lower);
 - ``BENCH_synopsis.json`` (tools/bench_synopsis.py): wavelet-synopsis
   exact/synopsis bytes ratio (higher) and pair decode p99 ms (lower);
+- ``BENCH_partition.json`` (tools/bench_job.py --partition-sweep):
+  Morton-range vs uniform-DP modeled merge-volume ratio per dataset
+  (``partition:merge_ratio[...]``, higher), the Morton leg's wall
+  seconds (lower), and the Zipf plan's skew ratio (lower; rows that
+  failed the byte gate are never folded);
 - ``onchip_state/sweep.jsonl`` stream cells (tools/bench_stream.py):
   per (backend, batch, device) update-loop points/sec (higher);
 
@@ -160,6 +165,26 @@ def snapshot_metrics(root: str) -> dict:
             p99 = (row.get("lag_ms") or {}).get("p99")
             if isinstance(p99, (int, float)):
                 out[f"ingest:lag_p99_ms[{cell}]"] = (float(p99), False)
+    doc = _load(os.path.join(root, "BENCH_partition.json"))
+    if isinstance(doc, dict):
+        # Morton-range sharding A/B (bench_job --partition-sweep): the
+        # modeled merge-volume ratio must not shrink, the Morton wall
+        # time must not regress, and the Zipf plan's skew must stay
+        # bounded (the ISSUE gate is <= 2.0 after re-splitting).
+        for row in doc.get("results", []):
+            ds = row.get("dataset")
+            if ds is None or not row.get("byte_identical"):
+                continue
+            if isinstance(row.get("merge_ratio"), (int, float)):
+                out[f"partition:merge_ratio[{ds}]"] = (
+                    float(row["merge_ratio"]), True)
+            wall = (row.get("wall_s") or {}).get("morton")
+            if isinstance(wall, (int, float)):
+                out[f"partition:wall_s[{ds}]"] = (float(wall), False)
+            if ds == "zipf" and isinstance(row.get("skew_ratio"),
+                                           (int, float)):
+                out["partition:skew_ratio[zipf]"] = (
+                    float(row["skew_ratio"]), False)
     doc = _load(os.path.join(root, "BENCH_synopsis.json"))
     if isinstance(doc, dict):
         ratio = (doc.get("compression") or {}).get("bytes_ratio")

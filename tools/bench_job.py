@@ -202,6 +202,159 @@ def _drive_one(args, hmpb: str, n: int, run: int, backend: str | None):
     return rec
 
 
+def partition_sweep(args) -> int:
+    """Uniform-DP vs Morton-range cascade A/B (ISSUE 13 satellite).
+
+    Two point sets — uniform and a Zipf-clustered mixture whose
+    clusters are wide enough to hold distinct detail codes (a single
+    heavy code is irreducible mass no planner can split) — each run
+    through the sharded cascade with ``partition_splits`` off and on.
+    The record carries measured wall seconds, the plan's skew ratio,
+    and the MODELED per-pyramid merge volume: uniform DP gathers every
+    shard's full per-level partial buffers, the Morton path gathers
+    only the boundary-tile buffers (``bcap = min(lcap, 2*n_slots)``
+    keys per shard per coarse level, level 0 exchanging nothing) — the
+    same arithmetic parallel/sharded.py sizes its buffers with. Bytes
+    are 16 per key slot (int64 key + 8-byte accumulator). The byte
+    gate rides along: both dispatches must produce identical level
+    arrays or the row is marked failed.
+    """
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from heatmap_tpu.parallel import make_mesh, route_emissions
+    from heatmap_tpu.parallel.partition import plan_partition
+    from heatmap_tpu.pipeline.batch import project_detail_codes
+    from heatmap_tpu.pipeline.cascade import CascadeConfig, run_cascade
+
+    n = args.sweep_n
+    dz, mz = 16, 10
+    cfg = CascadeConfig(detail_zoom=dz, min_detail_zoom=mz, result_delta=2)
+    levels = cfg.n_levels
+    mesh = make_mesh()
+    ndev = int(np.prod(list(mesh.shape.values())))
+    rng = np.random.default_rng(17)
+
+    def zipf_points(m):
+        # 80% of the mass over Zipf-ranked cluster centers, sigma wide
+        # enough that a cluster spans thousands of z16 tiles.
+        n_c = 32
+        ranks = np.arange(1, n_c + 1, dtype=np.float64)
+        p = (1.0 / ranks) / np.sum(1.0 / ranks)
+        centers_lat = rng.uniform(-55.0, 55.0, n_c)
+        centers_lon = rng.uniform(-170.0, 170.0, n_c)
+        k = int(m * 0.8)
+        c = rng.choice(n_c, size=k, p=p)
+        lat = np.concatenate([centers_lat[c] + rng.normal(0, 0.3, k),
+                              rng.uniform(-55.0, 55.0, m - k)])
+        lon = np.concatenate([centers_lon[c] + rng.normal(0, 0.3, k),
+                              rng.uniform(-170.0, 170.0, m - k)])
+        return lat, lon
+
+    datasets = {
+        "uniform": (rng.uniform(-55.0, 55.0, n),
+                    rng.uniform(-170.0, 170.0, n)),
+        "zipf": zipf_points(n),
+    }
+
+    def levels_equal(a, b):
+        for (au, asl, an), (bu, bsl, bn) in zip(a, b):
+            m = int(an)
+            if m != int(bn):
+                return False
+            if not (np.array_equal(np.asarray(au)[:m], np.asarray(bu)[:m])
+                    and np.array_equal(np.asarray(asl)[:m],
+                                       np.asarray(bsl)[:m])):
+                return False
+        return True
+
+    def timed(fn, reps):
+        fn()  # warmup: compile outside the timed region
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            jax.block_until_ready(fn())
+        return (time.perf_counter() - t0) / reps
+
+    rows = []
+    for name, (lat, lon) in datasets.items():
+        codes, valid = project_detail_codes(lat, lon, dz,
+                                            prefer_device=False)
+        codes, valid = np.asarray(codes), np.asarray(valid)
+        plan = plan_partition(codes, ndev, detail_zoom=dz, valid=valid,
+                              n_levels=levels)
+        slots = np.zeros(n, np.int32)
+        rc, rs, rv, _, seg = route_emissions(plan, codes, slots,
+                                             valid=valid)
+        d_codes = jnp.asarray(codes)
+        d_valid = jnp.asarray(valid)
+        d_rc, d_rs, d_rv = (jnp.asarray(rc), jnp.asarray(rs),
+                            jnp.asarray(rv))
+        splits = jnp.asarray(plan.splits, jnp.int64)
+        d_slots = jnp.zeros(n, jnp.int32)
+
+        def run_off():
+            return run_cascade(d_codes, d_slots, cfg, 1, valid=d_valid,
+                               capacity=n, mesh=mesh)
+
+        def run_morton():
+            return run_cascade(d_rc, d_rs, cfg, 1, valid=d_rv,
+                               capacity=n, mesh=mesh,
+                               partition_splits=splits)
+
+        identical = levels_equal(run_off(), run_morton())
+        wall_off = timed(run_off, args.sweep_reps)
+        wall_morton = timed(run_morton, args.sweep_reps)
+
+        # Buffer sizing, mirrored from pyramid_sparse_morton_range_
+        # sharded: every shard's per-level partial buffer vs only the
+        # boundary-tile buffers (n_slots=1 here).
+        routed_n = len(rc)
+        local_capacity = max(1, min(n, routed_n // ndev))
+        lcaps = [max(1, min(n, local_capacity)) for _ in range(levels + 1)]
+        bcaps = [max(1, min(lc, 2 * 1)) for lc in lcaps]
+        uniform_bytes = sum(ndev * lc * 16 for lc in lcaps)
+        morton_bytes = sum(ndev * bc * 16 for bc in bcaps[1:])
+        rows.append({
+            "dataset": name,
+            "n_points": n,
+            "skew_ratio": round(plan.skew_ratio, 4),
+            "resplits": plan.resplits,
+            "degenerate": plan.degenerate,
+            "boundary_tiles": plan.boundary_tiles_total(levels),
+            "wall_s": {"off": round(wall_off, 4),
+                       "morton": round(wall_morton, 4)},
+            "modeled_merge_bytes": {"uniform": uniform_bytes,
+                                    "morton": morton_bytes},
+            "merge_ratio": round(uniform_bytes / max(morton_bytes, 1), 2),
+            "byte_identical": bool(identical),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+
+    doc = {
+        "bench": "partition",
+        "device": jax.devices()[0].platform,
+        "ndev": ndev,
+        "detail_zoom": dz,
+        "levels": levels,
+        "reps": args.sweep_reps,
+        "results": rows,
+    }
+    with open(args.partition_sweep, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"wrote": args.partition_sweep}), flush=True)
+    return 0 if all(r["byte_identical"] for r in rows) else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=20_000_000)
@@ -229,12 +382,27 @@ def main() -> int:
     ap.add_argument("--child-timeout", type=float, default=1500.0)
     ap.add_argument("--min-n", type=int, default=None,
                     help="bisect floor (default --n // 16)")
+    ap.add_argument("--partition-sweep", nargs="?",
+                    const="BENCH_partition.json", default=None,
+                    metavar="OUT.json",
+                    help="uniform-DP vs Morton-range cascade A/B on "
+                    "uniform + Zipf-clustered point sets: wall time, "
+                    "plan skew, modeled merge bytes, byte gate "
+                    "(bench_gate reads the artifact as partition:* "
+                    "series)")
+    ap.add_argument("--sweep-n", type=int, default=1 << 20,
+                    help="points per partition-sweep dataset")
+    ap.add_argument("--sweep-reps", type=int, default=3,
+                    help="timed repetitions per partition-sweep leg")
     # --single: internal re-exec mode (one measurement, in-process).
     ap.add_argument("--single", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--hmpb", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--run", type=int, default=0, help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.partition_sweep:
+        return partition_sweep(args)
 
     if args.single:
         if args.cascade_backend == "both":

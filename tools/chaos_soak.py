@@ -472,6 +472,86 @@ def phase_host_loss(ctx):
             "levels": len(a), "tiles": len(want)}
 
 
+def phase_host_loss_morton(ctx):
+    """host_loss under Morton-range elastic shards: the same
+    mid-cascade host death, but every shard owns a contiguous
+    detail-code range (parallel/partition.py), so failover must
+    re-execute ONLY the dead host's tile ranges. Pinned through the
+    ``shard_reassigned`` audit events — every reassigned shard index
+    must have belonged to the wedged host — on top of the usual bar:
+    merged arrays and served tiles byte-identical to an unfailed
+    Morton run."""
+    faults.install(None)
+    tmp = os.path.dirname(ctx["base_root"])
+    src = lambda: SyntheticSource(n=ctx["n"], seed=3)  # noqa: E731
+    bs = max(1, ctx["n"] // 6)
+    events_path = os.path.join(tmp, "morton-loss-events.jsonl")
+    obs.enable_metrics(True)
+    try:
+        obs.get_registry().reset()
+        ok = run_job_multihost(
+            src(),
+            LevelArraysSink(os.path.join(tmp, "arrays-morton-ok")),
+            CFG, batch_size=bs, on_straggler="reassign",
+            elastic_dir=os.path.join(tmp, "elastic-morton-ok"),
+            elastic_hosts=3, elastic_opts={"partition": "morton"})
+        obs.get_registry().reset()
+        obs.set_event_log(obs.EventLog(events_path))
+        lost = run_job_multihost(
+            src(),
+            LevelArraysSink(os.path.join(tmp, "arrays-morton-loss")),
+            CFG, batch_size=bs, heartbeat_deadline_s=0.3,
+            on_straggler="reassign",
+            elastic_dir=os.path.join(tmp, "elastic-morton-loss"),
+            elastic_hosts=3,
+            elastic_opts={"wedge_host": 2, "wedge_after": 1,
+                          "wedge_spec": HOST_LOSS_WEDGE,
+                          "beat_interval_s": 0.05,
+                          "partition": "morton"})
+    finally:
+        faults.install(None)
+        log = obs.get_event_log()
+        obs.set_event_log(None)
+        if log is not None:
+            log.close()
+        obs.enable_metrics(False)
+    assert lost["reassigned"] > 0, f"no shards were reassigned: {lost}"
+    events = list(obs.read_events(events_path))
+    planned = [e for e in events if e["event"] == "partition_planned"]
+    assert planned, "morton elastic run never planned a partition"
+    reas = [e for e in events if e["event"] == "shard_reassigned"]
+    assert reas, "no shard_reassigned audit events"
+    # The locality pin: reassignment touched ONLY the dead host's
+    # ranges (shard index i belongs to host i % n_hosts).
+    foreign = [e for e in reas if str(e["from_host"]) != "2"]
+    assert not foreign, f"non-dead-host ranges re-executed: {foreign}"
+    a = _levels_bytes(os.path.join(tmp, "arrays-morton-ok"))
+    b = _levels_bytes(os.path.join(tmp, "arrays-morton-loss"))
+    assert sorted(a) == sorted(b), "morton level-array file sets diverged"
+    for name in a:
+        assert a[name] == b[name], f"morton arrays diverged at {name}"
+    docs = {}
+    for which in ("arrays-morton-ok", "arrays-morton-loss"):
+        store = TileStore(f"arrays:{os.path.join(tmp, which)}")
+        app = ServeApp(store, TileCache(max_bytes=64 << 20),
+                       render_timeout_s=30.0)
+        server, base = serve_in_thread(app)
+        try:
+            docs[which] = _fetch_all(
+                base, _tile_coords(store),
+                {"codes": {}, "saw_degraded": False})
+        finally:
+            server.shutdown()
+    want, got = docs["arrays-morton-ok"], docs["arrays-morton-loss"]
+    assert sorted(want) == sorted(got), "served tile sets diverged"
+    mism = [k for k in want if want[k] != got[k]]
+    assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
+    return {"shards": lost["shards"], "reassigned": lost["reassigned"],
+            "reassigned_from_dead_host_only": True,
+            "planned_events": len(planned), "ok_shards": ok["shards"],
+            "levels": len(a), "tiles": len(want)}
+
+
 def phase_backend_loss(ctx):
     """Serve-fleet resilience: SIGKILL one backend of a 3-process fleet
     under Zipf load. The router's connection-failure retry must keep
@@ -881,6 +961,7 @@ PHASES = [
     ("fault_floor", phase_fault_floor),
     ("ingest_crash", phase_ingest_crash),
     ("host_loss", phase_host_loss),
+    ("host_loss_morton", phase_host_loss_morton),
     ("backend_loss", phase_backend_loss),
     ("synopsis", phase_synopsis),
     ("incident", phase_incident),
