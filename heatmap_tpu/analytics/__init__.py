@@ -1,0 +1,30 @@
+"""Integral-histogram pyramids and an O(1) range-query engine.
+
+Tiles answer "render this 256x256 square"; production users ask "how
+many points in this drawn bbox, and where are the top-k hotspots?".
+Following the integral-histogram construction (arxiv 1711.01919), this
+package materializes a summed-area table per (user, timespan) pair per
+coarse level on the batch/delta cascade path, so any axis-aligned
+rectangle sum is four corner lookups — with top-k-hotspot and quantile
+queries built on the same pyramid by pruned coarse-to-fine descent.
+
+- integral.py  SAT build twins (jit'd JAX scan for the cascade path,
+               numpy for serving), Morton-shard merge by linearity,
+               integral-z*.npz artifact read/write/verify.
+- query.py     numpy-only evaluators: range_sum / top_k_hotspots /
+               quantile, each with an exact row-scan fall-through.
+- metrics.py   obs registry handles (docs/observability.md).
+
+Import discipline: everything importable from here is numpy-only; jax
+loads lazily inside the ``*_jax`` functions (tests/test_obs.py greps).
+"""
+
+from heatmap_tpu.analytics.integral import (  # noqa: F401
+    DEFAULT_MAX_Z, HARD_MAX_Z, SCHEMA, IntegralPair, build_pair,
+    grid_from_sat, integral2d_jax, integral2d_np, integral_path,
+    load_integrals, merge_shard_sats, verify_integral, write_integrals,
+)
+from heatmap_tpu.analytics.query import (  # noqa: F401
+    VALID_OPS, parse_bbox, quantile, quantile_rows, range_sum,
+    range_sum_rows, top_k_hotspots, top_k_rows, validate_op,
+)

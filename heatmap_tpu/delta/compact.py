@@ -245,12 +245,14 @@ def compact(root: str, *, retention: int = 2) -> dict:
         # The sweep above quarantined any orphan tmp/base dirs from a
         # crashed pass, so both staging and final paths start absent.
         tmp_path = new_path + ".tmp"
-        # synopses=True rebuilds the wavelet synopsis artifacts from
-        # the MERGED pyramid into the staging dir, so the published
-        # base atomically carries synopses consistent with base ⊕
-        # deltas (heatmap_tpu.synopsis; stale ones would violate the
-        # stamped error contract).
-        rows = LevelArraysSink(tmp_path, synopses=True).write_levels(merged)
+        # synopses=True / integrals=True rebuild the wavelet synopsis
+        # and summed-area artifacts from the MERGED pyramid into the
+        # staging dir, so the published base atomically carries exact
+        # levels, synopses, and integrals consistent with base ⊕
+        # deltas (heatmap_tpu.synopsis, heatmap_tpu.analytics; stale
+        # ones would violate the stamped error / exact-sum contracts).
+        rows = LevelArraysSink(tmp_path, synopses=True,
+                               integrals=True).write_levels(merged)
         faults.retry_call(publish_dir, tmp_path, new_path,
                           site="compact.publish", key="base")
         cur = dict(cur)

@@ -30,7 +30,10 @@ What gets quarantined:
   base (and their orphan ``.tmp`` staging files). Serving already skips
   unreadable synopses — exact levels answer instead — so this step only
   makes the corruption visible and stops every reload from re-reading a
-  bad file.
+  bad file;
+- torn or schema-invalid ``integral-z*.npz`` artifacts inside CURRENT's
+  base, same contract (reason ``torn_integral``): /query falls through
+  to the exact rows, so quarantining only surfaces the corruption.
 
 Digest verification re-hashes artifact bytes, so results are memoised
 per entry file identity (path, size, mtime_ns) — journaled entries and
@@ -251,10 +254,11 @@ def sweep(root: str, *, verify: bool = True) -> dict:
             if name != cur.get("base"):
                 _quarantine(root, full, "orphan_base", "base", items)
 
-    # 5. Torn synopsis artifacts inside CURRENT's base.
+    # 5. Torn synopsis / integral artifacts inside CURRENT's base.
     base = cur.get("base")
     bdir = os.path.join(root, base) if base else None
     if bdir and os.path.isdir(bdir):
+        from heatmap_tpu.analytics.integral import verify_integral
         from heatmap_tpu.synopsis.build import verify_synopsis
 
         for name in sorted(os.listdir(bdir)):
@@ -265,6 +269,13 @@ def sweep(root: str, *, verify: bool = True) -> dict:
                 detail = verify_synopsis(full)
                 if detail is not None:
                     _quarantine(root, full, "torn_synopsis", "synopsis",
+                                items, detail)
+            elif name.startswith("integral-") and name.endswith(".tmp"):
+                _quarantine(root, full, "orphan_tmp", "integral", items)
+            elif name.startswith("integral-z") and name.endswith(".npz"):
+                detail = verify_integral(full)
+                if detail is not None:
+                    _quarantine(root, full, "torn_integral", "integral",
                                 items, detail)
 
     quarantine_bytes(root)  # refresh the growth gauge every sweep

@@ -234,6 +234,10 @@ class LevelArraysSink:
     #: Also publish wavelet ``synopsis-z*.npz`` artifacts alongside the
     #: exact levels (``arrays-synopsis:DIR`` spec; heatmap_tpu.synopsis).
     synopses: bool = False
+    #: Also publish ``integral-z*.npz`` summed-area artifacts alongside
+    #: the exact levels (``arrays-integral:DIR`` spec;
+    #: heatmap_tpu.analytics).
+    integrals: bool = False
 
     def __post_init__(self):
         if self.format not in ("npz", "npz-compressed", "parquet"):
@@ -253,8 +257,8 @@ class LevelArraysSink:
 
     def write_levels(self, levels) -> int:
         rows = 0
-        if self.synopses:
-            levels = list(levels)  # consumed twice: levels + synopses
+        if self.synopses or self.integrals:
+            levels = list(levels)  # consumed twice: levels + derived
         for lvl in levels:
             out = {k: np.asarray(lvl[k]) for k in self.COLUMNS}
             out["zoom"] = np.asarray(lvl["zoom"])
@@ -311,6 +315,11 @@ class LevelArraysSink:
 
             write_synopses(self.path,
                            {int(lvl["zoom"]): lvl for lvl in levels})
+        if self.integrals:
+            from heatmap_tpu.analytics import write_integrals
+
+            write_integrals(self.path,
+                            {int(lvl["zoom"]): lvl for lvl in levels})
         return rows
 
     def write(self, records):
@@ -440,7 +449,8 @@ def per_process_sink_spec(spec: str, process_index: int) -> str:
     if kind == "jsonl" or (not rest and spec.endswith((".jsonl", ".ndjson"))):
         path = rest or spec
         return f"jsonl:{path}.{tag}"
-    if kind in ("arrays", "arrays-parquet", "arrays-synopsis", "dir"):
+    if kind in ("arrays", "arrays-parquet", "arrays-synopsis",
+                "arrays-integral", "dir"):
         return f"{kind}:{os.path.join(rest, 'host' + f'{process_index:03d}')}"
     if kind in ("memory", "cassandra"):
         return spec
@@ -449,7 +459,7 @@ def per_process_sink_spec(spec: str, process_index: int) -> str:
 
 #: Sink spec kinds ``open_sink`` accepts, in help order.
 SINK_KINDS = ("jsonl", "arrays", "arrays-parquet", "arrays-synopsis",
-              "dir", "memory", "cassandra")
+              "arrays-integral", "dir", "memory", "cassandra")
 
 
 def validate_sink_spec(spec: str) -> str:
@@ -482,6 +492,8 @@ def open_sink(spec: str) -> BlobSink:
         return LevelArraysSink(rest, format="parquet")
     if kind == "arrays-synopsis":
         return LevelArraysSink(rest, synopses=True)
+    if kind == "arrays-integral":
+        return LevelArraysSink(rest, integrals=True)
     if kind == "dir":
         return DirectoryBlobSink(rest)
     if kind == "memory":

@@ -156,6 +156,17 @@ EVENT_SCHEMA = {
     # early-serve overlay not yet superseded by the exact apply.
     "synopsis_served": {"required": ("layer", "zoom", "max_err"),
                         "optional": ("stale", "source_zoom", "stretched")},
+    # analytics/integral.py: one summed-area (integral) artifact
+    # published for a coarse level (egress or compaction rebuild).
+    "integral_built": {"required": ("zoom", "pairs", "bytes"),
+                       "optional": ("path",)},
+    # serve/http.py: one /query answered. path names the evaluator:
+    # integral (SAT corner lookups / pruned descent), fallback (exact
+    # row scan, pre-integral store), synopsis (brownout grid, with the
+    # propagated error bound in max_err).
+    "query_served": {"required": ("op", "zoom", "path"),
+                     "optional": ("layer", "bbox_area", "cells", "k",
+                                  "q", "max_err", "ms")},
     # obs/incident.py: one incident bundle flushed (trigger is the
     # edge kind — slo_breach | shed | fault_storm | degraded_enter |
     # exception; path the bundle directory; seq the manager's own

@@ -12,6 +12,17 @@ Routes:
   revalidate against each other). Without a synopsis at that zoom —
   including every ``z >= synopsis_max_z`` request — the exact path
   answers byte-identically to an un-annotated request.
+- ``GET /query?layer=&bbox=&z=&op=sum|topk|quantile&k=&q=`` — O(1)
+  range analytics over the integral pyramids (docs/analytics.md):
+  ``bbox`` is an inclusive cell rect ``x0,y0,x1,y1`` at source grid
+  zoom ``z``. Served from the level's summed-area table when the store
+  carries one, falling through to an exact row scan (slower, same
+  answer) when it predates integral artifacts; brownout rung >= 1
+  answers ``op=sum`` from the synopsis-reconstructed grid with the
+  achieved L-inf error bound in ``X-Heatmap-Query-Error``. Malformed
+  parameters get typed 400s; ETags live in a ``"q-``-prefixed
+  namespace and results ride the same byte-capped LRU with
+  stale-if-error semantics as tiles.
 - ``GET /healthz``                        — store/cache stats (JSON)
 - ``GET /metrics``                        — Prometheus 0.0.4 text from
   the process-wide obs registry (so serving metrics sit next to any
@@ -57,6 +68,8 @@ import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from heatmap_tpu import faults, obs
+from heatmap_tpu.analytics import metrics as analytics_metrics
+from heatmap_tpu.analytics import query as analytics_query
 from heatmap_tpu.obs import incident, recorder, slo, tracing
 from heatmap_tpu.serve import degrade as degrade_mod
 from heatmap_tpu.serve.cache import TileCache
@@ -85,6 +98,12 @@ def _syn_etag(body: bytes) -> str:
     # must re-fetch when it asks for a synopsis (and vice versa), even
     # on the astronomically-unlikely crc collision.
     return f'"syn-{zlib.crc32(body):08x}"'
+
+
+def _query_etag(body: bytes) -> str:
+    # Query results get their own namespace too: a /query body must
+    # never revalidate against a tile's (or a synopsis tile's) ETag.
+    return f'"q-{zlib.crc32(body):08x}"'
 
 
 class Response(tuple):
@@ -205,6 +224,8 @@ class ServeApp:
         if method == "GET" and m is not None:
             return self._admitted_tile(m, if_none_match,
                                        self._synopsis_opt(query))
+        if method == "GET" and path == "/query":
+            return self._handle_query(query, if_none_match)
         if method == "GET" and path == "/healthz":
             body = json.dumps(self._health(), indent=2).encode()
             return 200, "application/json", body, None, "healthz", None
@@ -316,6 +337,174 @@ class ServeApp:
         self._recover("reload")
         body = json.dumps({"generation": generation}).encode()
         return 200, "application/json", body, None, "reload", None
+
+    # -- range queries -----------------------------------------------------
+
+    def _handle_query(self, query: str, if_none_match):
+        """``GET /query``: O(1) range analytics (docs/analytics.md).
+
+        Path selection, most to least exact-and-fast: the level's
+        integral pyramid (four SAT corner lookups / pruned descent);
+        the exact level rows when the store predates integral
+        artifacts (slower, identical answer); the synopsis grid for
+        ``op=sum`` under brownout rung >= 1, with the achieved error
+        bound (stamped cell bound x rect area) in
+        ``X-Heatmap-Query-Error``. Results are cached in the shared
+        byte-capped LRU under the store generation (plus the synopsis
+        epoch on the brownout path) with tile-style stale-if-error."""
+        t0 = time.monotonic()
+        params = urllib.parse.parse_qs(query) if query else {}
+
+        def _param(name, default=None):
+            vals = params.get(name)
+            return vals[-1] if vals else default
+
+        try:
+            op = analytics_query.validate_op(_param("op", "sum"))
+            layer_name = urllib.parse.unquote(_param("layer", "default"))
+            z_raw = _param("z")
+            if z_raw is None:
+                raise ValueError(
+                    "missing required parameter z (source grid zoom)")
+            try:
+                z = int(z_raw)
+            except ValueError:
+                raise ValueError(f"z must be an integer zoom, got {z_raw!r}")
+            if not 0 <= z <= 30:
+                raise ValueError(f"z must be in [0, 30], got {z}")
+            bbox_raw = _param("bbox")
+            if bbox_raw is None:
+                raise ValueError("missing required parameter bbox "
+                                 "('x0,y0,x1,y1' inclusive cells)")
+            rect = analytics_query.parse_bbox(bbox_raw, z)
+            try:
+                k = int(_param("k", "10"))
+            except ValueError:
+                raise ValueError(f"k must be an integer, got {_param('k')!r}")
+            if op == "topk" and k < 1:
+                raise ValueError(f"k must be >= 1, got {k}")
+            try:
+                q = float(_param("q", "0.5"))
+            except ValueError:
+                raise ValueError(f"q must be a float, got {_param('q')!r}")
+            if op == "quantile" and not 0.0 <= q <= 1.0:
+                raise ValueError(f"q must be in [0, 1], got {q}")
+        except ValueError as e:
+            body = json.dumps({"error": "bad query",
+                               "detail": str(e)}).encode()
+            return 400, "application/json", body, None, "query", None
+        layer = self.layer(layer_name)
+        if layer is None:
+            body = json.dumps({"error": "unknown layer",
+                               "layers": self.layer_names()}).encode()
+            return 404, "application/json", body, None, "query", None
+        integrals = getattr(layer, "integrals", None) or {}
+        synopses = getattr(layer, "synopses", None) or {}
+        ctl = self.degrade
+        syn_view = None
+        if (ctl is not None and ctl.force_synopsis() and op == "sum"
+                and z in synopses):
+            # Brownout: answer from the synopsis-reconstructed grid
+            # when one exists at this zoom; otherwise stay exact (an
+            # exact answer under load beats a missing one).
+            syn_view = synopses[z]
+        if syn_view is not None:
+            mode = "synopsis"
+        elif z in integrals:
+            mode = "integral"
+        elif z in getattr(layer, "levels", {}):
+            mode = "fallback"
+        else:
+            body = json.dumps({
+                "error": f"no stored level at zoom {z}",
+                "detail_zooms": sorted(getattr(layer, "levels", {})),
+            }).encode()
+            return 404, "application/json", body, None, "query", None
+        r0, c0, r1, c1 = rect
+        area = (r1 - r0 + 1) * (c1 - c0 + 1)
+        doc = {"op": op, "layer": layer_name, "z": z,
+               "bbox": [c0, r0, c1, r1], "path": mode}
+        if op == "topk":
+            doc["k"] = k
+        elif op == "quantile":
+            doc["q"] = q
+        extra = None
+        if mode == "synopsis":
+            # Per-cell bound from the artifact stamp; a rect sum over
+            # ``area`` cells can be off by at most ``max_err * area``.
+            bound = float(syn_view.max_err) * area
+            extra = {"X-Heatmap-Query-Error": f"max_err={bound:.6g}"}
+            doc["max_err"] = bound
+            key = ("query", layer_name, z, rect, op, "syn",
+                   self.store.synopsis_epoch)
+        else:
+            key = ("query", layer_name, z, rect, op,
+                   k if op == "topk" else None,
+                   q if op == "quantile" else None)
+
+        def _evaluate() -> bytes:
+            out = dict(doc)
+            if mode == "integral":
+                pair = integrals[z]
+                out["cells"] = pair.cell_count(*rect)
+                if op == "sum":
+                    out["sum"] = analytics_query.range_sum(pair, rect)
+                elif op == "topk":
+                    out["hotspots"] = [
+                        [int(c), int(r), v] for r, c, v in
+                        analytics_query.top_k_hotspots(pair, rect, k)]
+                else:
+                    out["value"] = analytics_query.quantile(pair, rect, q)
+            else:
+                level = (syn_view.level if mode == "synopsis"
+                         else layer.levels[z])
+                rows, cols, vals = analytics_query.level_cells(level, rect)
+                out["cells"] = int(len(vals))
+                if op == "sum":
+                    out["sum"] = float(vals.sum()) if len(vals) else 0.0
+                elif op == "topk":
+                    out["hotspots"] = [
+                        [int(c), int(r), v] for r, c, v in
+                        analytics_query.top_k_rows(level, rect, k)]
+                else:
+                    out["value"] = analytics_query.quantile_rows(
+                        level, rect, q)
+            return json.dumps(out).encode()
+
+        try:
+            body, hit = self.cache.get_or_render(
+                key, self.store.generation, _evaluate, fmt="query",
+                stale_if_error=True)
+        except Exception as e:
+            self._degrade("render", repr(e))
+            payload = json.dumps({"error": "query failed",
+                                  "detail": repr(e)}).encode()
+            return 503, "application/json", payload, None, "query", None
+        if hit == TileCache.STALE:
+            self._degrade("render", "serving stale query results")
+            cache = "stale"
+        else:
+            if hit is False:
+                self._recover("render")
+            cache = "hit" if hit else "miss"
+        ms = round((time.monotonic() - t0) * 1e3, 3)
+        if obs.metrics_enabled():
+            analytics_metrics.QUERY_SECONDS.observe(
+                time.monotonic() - t0, op=op)
+        cells = json.loads(body).get("cells")
+        obs.emit("query_served", op=op, zoom=int(z), path=mode,
+                 layer=layer_name, bbox_area=int(area), ms=ms,
+                 **({"cells": int(cells)} if cells is not None else {}),
+                 **({"k": k} if op == "topk" else {}),
+                 **({"q": q} if op == "quantile" else {}),
+                 **({"max_err": doc["max_err"]}
+                    if mode == "synopsis" else {}))
+        etag = _query_etag(body)
+        if if_none_match is not None and etag in if_none_match:
+            return Response(304, "application/json", b"", etag, "query",
+                            cache, headers=extra)
+        return Response(200, "application/json", body, etag, "query",
+                        cache, headers=extra)
 
     def _handle_tile(self, m, if_none_match, synopsis=False):
         # Layer names may carry characters clients percent-encode in a

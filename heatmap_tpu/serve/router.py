@@ -112,12 +112,25 @@ def rendezvous_order(key: str, backend_ids) -> list:
 
 def route_key(path: str) -> str:
     """The placement key for a request path: ``layer/z/x/y`` for tiles
-    (format stripped, so .png and .json colocate), the raw path
-    otherwise. The query string is excluded, so ``?synopsis=1`` and the
-    exact tile land on the same backend and share its LRU locality."""
-    m = _TILE_RE.match(path.partition("?")[0])
+    (format stripped, so .png and .json colocate), ``query:layer/z/bbox``
+    for /query (op/k/q excluded, so repeated analytics of the same
+    region — sum, then top-k, then a quantile — land on one backend and
+    share its LRU locality), the raw path otherwise. For tiles the
+    query string is excluded, so ``?synopsis=1`` and the exact tile
+    colocate too."""
+    bare, _, query = path.partition("?")
+    m = _TILE_RE.match(bare)
     if m is not None:
         return f"{m['layer']}/{m['z']}/{m['x']}/{m['y']}"
+    if bare == "/query":
+        params = urllib.parse.parse_qs(query) if query else {}
+
+        def last(name, default=""):
+            vals = params.get(name)
+            return vals[-1] if vals else default
+
+        return (f"query:{last('layer', 'default')}/{last('z')}/"
+                f"{last('bbox')}")
     return path
 
 
@@ -751,12 +764,15 @@ class RouterApp:
         ctype = resp_headers.get("Content-Type", "application/octet-stream")
         route = ("tiles" if _TILE_RE.match(path.partition("?")[0])
                  else "proxy")
-        synopsis = resp_headers.get("X-Heatmap-Synopsis")
-        if synopsis is not None:
-            # Part of the byte-equality contract: the error annotation
+        forwarded = {
+            name: resp_headers[name]
+            for name in ("X-Heatmap-Synopsis", "X-Heatmap-Query-Error")
+            if resp_headers.get(name) is not None}
+        if forwarded:
+            # Part of the byte-equality contract: the error annotations
             # a backend stamped must survive the fleet hop.
             return Response(status, ctype, body, etag, route, None,
-                            headers={"X-Heatmap-Synopsis": synopsis})
+                            headers=forwarded)
         return status, ctype, body, etag, route, None
 
     # -- fleet operations --------------------------------------------------

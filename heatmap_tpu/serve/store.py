@@ -45,6 +45,7 @@ import time
 import numpy as np
 
 from heatmap_tpu import obs
+from heatmap_tpu.analytics import integral as integral_build
 from heatmap_tpu.io.sinks import LevelArraysSink
 from heatmap_tpu.synopsis import build as synopsis_build
 from heatmap_tpu.synopsis import metrics as synopsis_metrics
@@ -116,10 +117,16 @@ class Layer:
     ``synopses`` maps detail zooms to decoded :class:`SynopsisView`\\ s
     when the artifact carries ``synopsis-z*.npz`` files; empty
     otherwise. Exact serving never reads it.
+
+    ``integrals`` maps detail zooms to
+    :class:`heatmap_tpu.analytics.IntegralPair` summed-area tables when
+    the artifact carries ``integral-z*.npz`` files (with live delta
+    rows already folded in — exact); empty otherwise, in which case
+    /query falls through to the exact level rows.
     """
 
     __slots__ = ("user", "timespan", "levels", "result_delta", "blob_json",
-                 "synopses")
+                 "synopses", "integrals")
 
     def __init__(self, user: str, timespan: str, result_delta: int | None):
         self.user = user
@@ -128,6 +135,7 @@ class Layer:
         self.result_delta = result_delta
         self.blob_json: dict[tuple, str] = {}
         self.synopses: dict[int, SynopsisView] = {}
+        self.integrals: dict[int, "integral_build.IntegralPair"] = {}
 
     @property
     def detail_zooms(self) -> list[int]:
@@ -331,6 +339,7 @@ class TileStore:
                 _iter_blob_records(self.kind, self.path))
         if syn_dir is not None:
             self._attach_synopses(by_pair, syn_dir, delta_dirs)
+            self._attach_integrals(by_pair, syn_dir, delta_dirs)
         named: dict[str, Layer] = {}
         if self._layer_spec is None:
             for (user, ts), layer in by_pair.items():
@@ -469,6 +478,55 @@ class TileStore:
                         time.monotonic() - t0)
                 layer.synopses[zoom] = SynopsisView(level, sp.max_err)
 
+    # -- integral pyramids -------------------------------------------------
+
+    def _attach_integrals(self, by_pair: dict, syn_dir: str,
+                          delta_dirs: list[str]):
+        """Load every readable ``integral-z*.npz`` in ``syn_dir`` onto
+        the matching layers (heatmap_tpu.analytics).
+
+        For delta stores the integrals describe the BASE pyramid, so
+        the live delta dirs' rows are folded in by recovering the grid
+        from the SAT, scatter-adding, and rescanning — an exact
+        operation for integer grids, keeping /query answers equal to a
+        full recompute over base ⊕ deltas. Unreadable artifacts are
+        skipped (/query falls through to exact rows; the recovery
+        sweep owns quarantining them)."""
+        ints = integral_build.load_integrals(syn_dir)
+        if not ints:
+            return
+        extras: dict[int, list] = {}
+        for d in delta_dirs:
+            try:
+                loaded = LevelArraysSink.load(d)
+            except OSError:
+                continue
+            for zoom, cols in loaded.items():
+                if int(zoom) in ints:
+                    extras.setdefault(int(zoom), []).append(cols)
+        for zoom, pairs in ints.items():
+            for ip in pairs:
+                layer = by_pair.get((ip.user, ip.timespan))
+                if layer is None:
+                    continue
+                parts = [[], [], []]
+                for cols in extras.get(zoom, ()):
+                    users = np.asarray(cols["user"], str)
+                    tss = np.asarray(cols["timespan"], str)
+                    sel = (users == ip.user) & (tss == ip.timespan)
+                    if sel.any():
+                        parts[0].append(np.asarray(cols["row"],
+                                                   np.int64)[sel])
+                        parts[1].append(np.asarray(cols["col"],
+                                                   np.int64)[sel])
+                        parts[2].append(np.asarray(cols["value"],
+                                                   np.float64)[sel])
+                if parts[0]:
+                    ip = ip.with_extras(np.concatenate(parts[0]),
+                                        np.concatenate(parts[1]),
+                                        np.concatenate(parts[2]))
+                layer.integrals[zoom] = ip
+
     def publish_provisional(self, rows_by: dict) -> int:
         """Early-serving hook (ingest/loop.py): overlay a just-journaled
         micro-batch's coarse cell counts onto the current synopsis
@@ -534,6 +592,7 @@ class TileStore:
                     "synopsis_zooms": sorted(layer.synopses),
                     "synopsis_stale": any(v.stale for v in
                                           layer.synopses.values()),
+                    "integral_zooms": sorted(layer.integrals),
                 }
                 for name, layer in sorted(self._layers.items())
             },

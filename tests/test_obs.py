@@ -85,6 +85,12 @@ _PAYLOADS = {
     "synopsis_served": {"layer": "all-alltime", "zoom": 6,
                         "max_err": 12.5, "source_zoom": 6,
                         "stale": False},
+    "integral_built": {"zoom": 6, "pairs": 4, "bytes": 2048,
+                       "path": "store/base-000001/integral-z06.npz"},
+    "query_served": {"op": "sum", "zoom": 8, "path": "integral",
+                     "layer": "all-alltime", "bbox_area": 100,
+                     "cells": 5, "k": 10, "q": 0.5, "max_err": 12.5,
+                     "ms": 0.2},
     "slo_breach": {"slo": "tiles-fast", "burn_rate": 2.5,
                    "kind": "latency", "compliance": 0.9975,
                    "target": 0.999, "window_s": 300.0,
@@ -632,7 +638,8 @@ class TestNoRawInstrumentation:
     JAX_FREE = ("heatmap_tpu/serve/store.py", "heatmap_tpu/serve/render.py",
                 "heatmap_tpu/serve/http.py", "heatmap_tpu/serve/cache.py",
                 "heatmap_tpu/serve/router.py",
-                "heatmap_tpu/serve/degrade.py", "heatmap_tpu/synopsis/")
+                "heatmap_tpu/serve/degrade.py", "heatmap_tpu/synopsis/",
+                "heatmap_tpu/analytics/")
     JAX_IMPORT = re.compile(r"^(?:import jax\b|from jax\b)")
 
     def test_decode_path_has_no_module_level_jax(self):
@@ -664,6 +671,21 @@ class TestNoRawInstrumentation:
         assert self.JAX_IMPORT.search("import jax.numpy as jnp")
         assert self.JAX_IMPORT.search("from jax import lax")
         assert not self.JAX_IMPORT.search("    import jax")
+
+    def test_analytics_tree_is_guarded(self):
+        """The analytics/ package sits on the /query serve path — query
+        latency belongs to the query_seconds histogram and the
+        query_served event, never an ad-hoc perf_counter: pin that the
+        tree exists, is scanned by the walk above, and is not allowed.
+        (Its jax discipline is pinned by JAX_FREE: integral2d_jax
+        imports jax lazily, so /query decoding works without jax.)"""
+        ana = os.path.join(REPO, "heatmap_tpu", "analytics")
+        assert os.path.isdir(ana)
+        scanned = [f for f in os.listdir(ana) if f.endswith(".py")]
+        assert "integral.py" in scanned and "query.py" in scanned
+        assert not any(a.startswith("heatmap_tpu/analytics")
+                       for a in self.ALLOWED)
+        assert self.PATTERN.search("t0 = time.perf_counter()  # query")
 
     def test_delta_tree_is_guarded(self):
         """The delta/ package times applies and compactions — that must

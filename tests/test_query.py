@@ -1,0 +1,669 @@
+"""Range-query engine tests (heatmap_tpu/analytics/ + GET /query).
+
+The anchors from docs/analytics.md, in test form:
+
+- every ``/query?op=sum`` answer is EXACTLY equal to the brute-force
+  sum over the served exact level rows — weighted, retraction,
+  pad-bucketed, and Morton-sharded stores, before AND after
+  compaction (integer grids make the SAT exact in f64, not approx);
+- ``op=topk`` matches the exhaustive argsort oracle including the
+  (value desc, row asc, col asc) tie-break; ``op=quantile`` matches
+  the sorted-values oracle for every q including 0 and 1;
+- a store predating integral artifacts answers identically through
+  the exact-rows fall-through (only the ``path`` marker differs);
+- query bytes live in their own ``"q-`` ETag namespace, the fleet
+  router colocates every op over the same (layer, z, bbox), torn
+  integrals are quarantined as ``torn_integral``, and brownout rung 1
+  answers ``op=sum`` from the synopsis grid under a stamped bound.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import delta
+from heatmap_tpu.analytics import (HARD_MAX_Z, SCHEMA, IntegralPair,
+                                   build_pair, grid_from_sat, integral2d_jax,
+                                   integral2d_np, integral_path,
+                                   load_integrals, merge_shard_sats,
+                                   parse_bbox, quantile, range_sum,
+                                   top_k_hotspots, validate_op,
+                                   verify_integral, write_integrals)
+from heatmap_tpu.analytics.query import level_cells
+from heatmap_tpu.delta.compute import ColumnsSource, read_columns
+from heatmap_tpu.io import open_sink, open_source
+from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.pipeline import BatchJobConfig, run_job
+from heatmap_tpu.serve import ServeApp, TileCache, TileStore
+from heatmap_tpu.serve import degrade
+from heatmap_tpu.synopsis.transform import grid_from_rows_np
+from heatmap_tpu.tilemath.morton import morton_decode_np
+
+
+def _sparse_grid(rng, zoom, nnz, vmax=50):
+    """Random sparse integer level rows + the dense grid they imply."""
+    n = 1 << zoom
+    flat = rng.choice(n * n, size=nnz, replace=False)
+    rows, cols = flat // n, flat % n
+    values = rng.integers(1, vmax, size=nnz).astype(np.float64)
+    return rows, cols, values, grid_from_rows_np(rows, cols, values, n)
+
+
+def _pair(rows, cols, values, zoom):
+    sat, cnt = build_pair(rows, cols, values, zoom)
+    return IntegralPair("all", "alltime", zoom, sat, cnt)
+
+
+def _rects(rng, n, count):
+    """Random inclusive rects inside an (n, n) grid, plus the full grid
+    and a single cell."""
+    out = [(0, 0, n - 1, n - 1), (n // 2, n // 2, n // 2, n // 2)]
+    for _ in range(count):
+        r0, r1 = sorted(int(v) for v in rng.integers(0, n, 2))
+        c0, c1 = sorted(int(v) for v in rng.integers(0, n, 2))
+        out.append((r0, c0, r1, c1))
+    return out
+
+
+def _brute(grid, rect):
+    r0, c0, r1, c1 = rect
+    return float(grid[r0:r1 + 1, c0:c1 + 1].sum())
+
+
+def _level_grid(layer, zoom):
+    """Dense grid of a served level — the brute-force ground truth
+    decoded straight from the stored Morton rows."""
+    level = layer.levels[zoom]
+    rows, cols = morton_decode_np(level.codes)
+    return grid_from_rows_np(rows.astype(np.int64), cols.astype(np.int64),
+                             level.values, 1 << zoom)
+
+
+def _level_cols(rng, zoom, pairs, nnz=80):
+    """A finalized-shape level dict with one row block per pair."""
+    rs, cs, vs, us, ts = [], [], [], [], []
+    for user, span in pairs:
+        rows, cols, values, _ = _sparse_grid(rng, zoom, nnz)
+        rs.append(rows)
+        cs.append(cols)
+        vs.append(values)
+        us += [user] * nnz
+        ts += [span] * nnz
+    return {"zoom": zoom, "coarse_zoom": max(zoom - 2, 0),
+            "row": np.concatenate(rs), "col": np.concatenate(cs),
+            "value": np.concatenate(vs),
+            "user": np.asarray(us), "timespan": np.asarray(ts)}
+
+
+class TestParsing:
+    def test_validate_op(self):
+        for op in ("sum", "topk", "quantile"):
+            assert validate_op(op) == op
+        with pytest.raises(ValueError) as e:
+            validate_op("avg")
+        msg = str(e.value)
+        assert "\n" not in msg
+        assert "sum" in msg and "topk" in msg and "quantile" in msg
+
+    def test_parse_bbox_round_trip(self):
+        # x0,y0,x1,y1 -> (r0, c0, r1, c1): x is the column axis.
+        assert parse_bbox("1,2,3,4", 3) == (2, 1, 4, 3)
+        assert parse_bbox("0,0,7,7", 3) == (0, 0, 7, 7)
+
+    def test_parse_bbox_one_line_errors(self):
+        for text, zoom in (("1,2,3", 3), ("a,b,c,d", 3), ("0,0,8,8", 3),
+                           ("3,0,1,0", 3), ("-1,0,1,1", 3)):
+            with pytest.raises(ValueError) as e:
+                parse_bbox(text, zoom)
+            assert "\n" not in str(e.value)
+
+
+class TestIntegralCore:
+    def test_sat_matches_brute_force_and_inverts(self):
+        rng = np.random.default_rng(7)
+        _, _, _, grid = _sparse_grid(rng, 5, 120)
+        sat = integral2d_np(grid)
+        # The defining identity, checked exhaustively at one corner.
+        assert np.array_equal(sat, np.cumsum(np.cumsum(grid, 0), 1))
+        assert np.array_equal(grid_from_sat(sat), grid)  # exact, not approx
+        with pytest.raises(ValueError, match="2D"):
+            integral2d_np(np.zeros(8))
+
+    def test_jax_twin_matches_numpy(self):
+        rng = np.random.default_rng(11)
+        _, _, _, grid = _sparse_grid(rng, 4, 60)
+        np.testing.assert_array_equal(np.asarray(integral2d_jax(grid)),
+                                      integral2d_np(grid))
+
+    def test_merge_shard_sats_is_the_boundary_fixup(self):
+        """Linearity: the SAT of a Morton-sharded level equals the
+        elementwise sum of per-shard SATs — each shard scans only its
+        own Z-range, the sum applies the cross-shard offsets."""
+        rng = np.random.default_rng(13)
+        rows, cols, values, grid = _sparse_grid(rng, 5, 200)
+        order = np.argsort(rows * 32 + cols)  # any disjoint 3-way split
+        parts = []
+        for chunk in np.array_split(order, 3):
+            parts.append(integral2d_np(grid_from_rows_np(
+                rows[chunk], cols[chunk], values[chunk], 32)))
+        np.testing.assert_array_equal(merge_shard_sats(parts),
+                                      integral2d_np(grid))
+        with pytest.raises(ValueError, match="at least one"):
+            merge_shard_sats([])
+        with pytest.raises(ValueError, match="shapes differ"):
+            merge_shard_sats([np.zeros((4, 4)), np.zeros((8, 8))])
+
+    def test_build_pair_hard_max_z_refusal(self):
+        with pytest.raises(ValueError, match=str(HARD_MAX_Z)):
+            build_pair([0], [0], [1.0], HARD_MAX_Z + 1)
+
+    def test_range_sum_and_count_property_sweep(self):
+        rng = np.random.default_rng(21)
+        rows, cols, values, grid = _sparse_grid(rng, 6, 400)
+        pair = _pair(rows, cols, values, 6)
+        for rect in _rects(rng, 64, 200):
+            assert range_sum(pair, rect) == _brute(grid, rect)
+            r0, c0, r1, c1 = rect
+            assert pair.cell_count(*rect) == int(
+                (grid[r0:r1 + 1, c0:c1 + 1] != 0.0).sum())
+
+    def test_topk_matches_argsort_oracle_with_ties(self):
+        """Small value alphabet forces heavy ties — the descent's
+        (value desc, row asc, col asc) tie-break must match the
+        lexsort oracle cell for cell."""
+        rng = np.random.default_rng(23)
+        rows, cols, values, grid = _sparse_grid(rng, 5, 250, vmax=4)
+        pair = _pair(rows, cols, values, 5)
+        for rect in _rects(rng, 32, 40):
+            got = top_k_hotspots(pair, rect, 12)
+            rr, cc, vv = level_cells_from_grid(grid, rect)
+            order = np.lexsort((cc, rr, -vv))[:12]
+            want = [(int(rr[i]), int(cc[i]), float(vv[i])) for i in order]
+            assert got == want
+
+    def test_quantile_matches_sorted_oracle(self):
+        rng = np.random.default_rng(29)
+        rows, cols, values, grid = _sparse_grid(rng, 5, 180, vmax=6)
+        pair = _pair(rows, cols, values, 5)
+        qs = [0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0]
+        for rect in _rects(rng, 32, 25):
+            _, _, vv = level_cells_from_grid(grid, rect)
+            srt = np.sort(vv)
+            for q in qs:
+                got = quantile(pair, rect, q)
+                if len(srt) == 0:
+                    assert got is None
+                else:
+                    want = float(srt[max(0, math.ceil(q * len(srt)) - 1)])
+                    assert got == want
+        with pytest.raises(ValueError, match="\\[0, 1\\]"):
+            quantile(pair, (0, 0, 3, 3), 1.5)
+
+    def test_dense_window_paths_match_descents(self):
+        # Unless a rect is huge AND sparse (area > sparsity * nnz),
+        # topk and quantile sort one vectorized SAT-window
+        # reconstruction instead of descending; force each path with
+        # the sparsity kwarg and pin both to each other and to the
+        # oracles on every rect, including edge-touching ones (the
+        # window's zero padding).
+        rng = np.random.default_rng(37)
+        rows, cols, values, grid = _sparse_grid(rng, 5, 400, vmax=9)
+        pair = _pair(rows, cols, values, 5)
+        rects = _rects(rng, 32, 20) + [(0, 0, 31, 31), (0, 5, 0, 5)]
+        for rect in rects:
+            rr, cc, vv = level_cells_from_grid(grid, rect)
+            srt = np.sort(vv)
+            for q in (0.0, 0.3, 0.5, 0.8, 1.0):
+                dense = quantile(pair, rect, q, sparsity=10**9)
+                descent = quantile(pair, rect, q, sparsity=0)
+                if len(srt) == 0:
+                    assert dense is None and descent is None
+                else:
+                    want = float(srt[max(0, math.ceil(q * len(srt)) - 1)])
+                    assert dense == want == descent
+            order = np.lexsort((cc, rr, -vv))[:7]
+            want_top = [(int(rr[i]), int(cc[i]), float(vv[i]))
+                        for i in order]
+            assert top_k_hotspots(pair, rect, 7, sparsity=10**9) == want_top
+            assert top_k_hotspots(pair, rect, 7, sparsity=0) == want_top
+
+
+def level_cells_from_grid(grid, rect):
+    """Occupied cells of a dense grid inside the rect (oracle side)."""
+    r0, c0, r1, c1 = rect
+    sub = grid[r0:r1 + 1, c0:c1 + 1]
+    rr, cc = np.nonzero(sub)
+    return rr + r0, cc + c0, sub[rr, cc]
+
+
+class TestArtifacts:
+    def test_write_load_round_trip_and_verify(self, tmp_path):
+        rng = np.random.default_rng(31)
+        cols = _level_cols(rng, 5, [("all", "alltime"), ("u1", "year")])
+        out = write_integrals(str(tmp_path), levels={5: cols})
+        assert set(out) == {5} and out[5]["pairs"] == 2
+        path = integral_path(str(tmp_path), 5)
+        assert os.path.exists(path) and verify_integral(path) is None
+        loaded = load_integrals(str(tmp_path))
+        assert sorted((p.user, p.timespan) for p in loaded[5]) == [
+            ("all", "alltime"), ("u1", "year")]
+        for p in loaded[5]:
+            sel = (cols["user"] == p.user) & (cols["timespan"] == p.timespan)
+            grid = grid_from_rows_np(cols["row"][sel], cols["col"][sel],
+                                     cols["value"][sel], 32)
+            np.testing.assert_array_equal(p.grid(), grid)
+
+    def test_max_z_gates_which_levels_get_integrals(self, tmp_path):
+        rng = np.random.default_rng(32)
+        levels = {5: _level_cols(rng, 5, [("all", "alltime")]),
+                  7: _level_cols(rng, 7, [("all", "alltime")])}
+        out = write_integrals(str(tmp_path), levels=levels, max_z=6)
+        assert set(out) == {5}
+        assert not os.path.exists(integral_path(str(tmp_path), 7))
+
+    def test_verify_flags_torn_and_wrong_schema(self, tmp_path):
+        torn = tmp_path / "integral-z05.npz"
+        torn.write_bytes(b"\x00garbage not a zip")
+        assert verify_integral(str(torn)) is not None
+        wrong = tmp_path / "integral-z06.npz"
+        np.savez(wrong, schema=np.asarray("other.v9"))
+        detail = verify_integral(str(wrong))
+        assert detail is not None and SCHEMA in detail
+        assert load_integrals(str(tmp_path)) == {}  # both skipped
+
+    def test_with_extras_is_exact(self):
+        rng = np.random.default_rng(33)
+        rows, cols, values, grid = _sparse_grid(rng, 4, 30)
+        pair = _pair(rows, cols, values, 4)
+        folded = pair.with_extras([2, 2, 7], [3, 3, 1], [1.0, 2.0, 5.0])
+        truth = grid.copy()
+        np.add.at(truth, ([2, 2, 7], [3, 3, 1]), [1.0, 2.0, 5.0])
+        np.testing.assert_array_equal(folded.grid(), truth)
+        assert folded.cell_count(0, 0, 15, 15) == int((truth != 0).sum())
+
+
+@pytest.fixture(scope="module")
+def int_store(tmp_path_factory):
+    """One real batch job egressed through the arrays-integral sink:
+    exact levels at zooms 7-10 plus integral artifacts for 7/8/9."""
+    root = tmp_path_factory.mktemp("int_store")
+    config = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                            result_delta=2)
+    with open_sink(f"arrays-integral:{root}/levels") as sink:
+        run_job(open_source("synthetic:3000:7"), sink, config)
+    return f"{root}/levels"
+
+
+def _query(app, z, rect, op="sum", layer="default", extra=""):
+    r0, c0, r1, c1 = rect
+    return app.handle(
+        "GET", f"/query?layer={layer}&z={z}&bbox={c0},{r0},{c1},{r1}"
+               f"&op={op}{extra}")
+
+
+class TestServing:
+    def test_store_indexes_integrals_below_max_z(self, int_store):
+        store = TileStore(f"arrays:{int_store}")
+        layer = store.layer("default")
+        assert sorted(layer.integrals) == [7, 8, 9]
+        stats = store.stats()["layers"]["default"]
+        assert stats["integral_zooms"] == [7, 8, 9]
+
+    def test_query_sum_is_pinned_to_brute_force(self, int_store):
+        store = TileStore(f"arrays:{int_store}")
+        app = ServeApp(store)
+        layer = store.layer("default")
+        for z in (7, 8, 9):
+            grid = _level_grid(layer, z)
+            rng = np.random.default_rng(z)
+            for rect in _rects(rng, 1 << z, 15):
+                res = _query(app, z, rect)
+                assert res[0] == 200
+                doc = json.loads(res[2])
+                assert doc["path"] == "integral"
+                assert doc["sum"] == _brute(grid, rect)  # EXACT equality
+                r0, c0, r1, c1 = rect
+                assert doc["cells"] == int(
+                    (grid[r0:r1 + 1, c0:c1 + 1] != 0.0).sum())
+                assert doc["bbox"] == [c0, r0, c1, r1]
+
+    def test_fall_through_answers_are_identical(self, int_store, tmp_path):
+        """A store predating integral artifacts serves the same
+        answers through the exact rows — only the path marker moves."""
+        stripped = tmp_path / "levels"
+        shutil.copytree(int_store, stripped)
+        for name in os.listdir(stripped):
+            if name.startswith("integral-"):
+                os.remove(stripped / name)
+        fast = ServeApp(TileStore(f"arrays:{int_store}"))
+        slow = ServeApp(TileStore(f"arrays:{stripped}"))
+        rng = np.random.default_rng(41)
+        for rect in _rects(rng, 1 << 7, 8):
+            for op, extra in (("sum", ""), ("topk", "&k=7"),
+                              ("quantile", "&q=0.35")):
+                a = json.loads(_query(fast, 7, rect, op, extra=extra)[2])
+                b = json.loads(_query(slow, 7, rect, op, extra=extra)[2])
+                assert a.pop("path") == "integral"
+                assert b.pop("path") == "fallback"
+                assert a == b
+
+    def test_etag_namespace_304_and_invalidation(self, int_store):
+        store = TileStore(f"arrays:{int_store}")
+        app = ServeApp(store)
+        rect = (0, 0, 127, 127)
+        res = _query(app, 7, rect)
+        assert res[0] == 200 and res[3].startswith('"q-')
+        assert res[5] == "miss"
+        again = _query(app, 7, rect)
+        assert again[5] == "hit" and again[3] == res[3]
+        not_mod = app.handle(
+            "GET", "/query?layer=default&z=7&bbox=0,0,127,127&op=sum",
+            if_none_match=res[3])
+        assert not_mod[0] == 304 and not_mod[2] == b""
+        # Tile ETags and query ETags never cross-revalidate.
+        layer = store.layer("default")
+        level = layer.levels[7]
+        code = int(level.codes[int(np.argmax(level.values))])
+        rr, cc = morton_decode_np(np.asarray([code], np.int64))
+        row, col = int(rr[0]), int(cc[0])
+        x, y = col >> 2, row >> 2
+        tile = app.handle("GET", f"/tiles/default/5/{x}/{y}.json")
+        assert tile[0] == 200 and not tile[3].startswith('"q-')
+        assert app.handle("GET", f"/tiles/default/5/{x}/{y}.json",
+                          if_none_match=res[3])[0] == 200
+        assert app.handle(
+            "GET", "/query?layer=default&z=7&bbox=0,0,127,127&op=sum",
+            if_none_match=tile[3])[0] == 200
+        # A reload bumps the generation: cached query bytes retire.
+        store.reload()
+        fresh = _query(app, 7, rect)
+        assert fresh[0] == 200 and fresh[5] == "miss"
+
+    def test_malformed_params_are_typed_400s(self, int_store):
+        app = ServeApp(TileStore(f"arrays:{int_store}"))
+        bad = [
+            "/query?layer=default&bbox=0,0,1,1",            # missing z
+            "/query?layer=default&z=abc&bbox=0,0,1,1",      # bad z
+            "/query?layer=default&z=99&bbox=0,0,1,1",       # z out of range
+            "/query?layer=default&z=7",                      # missing bbox
+            "/query?layer=default&z=7&bbox=1,2,3",           # 3 parts
+            "/query?layer=default&z=7&bbox=a,b,c,d",         # non-integer
+            "/query?layer=default&z=7&bbox=0,0,999,0",       # off-grid
+            "/query?layer=default&z=7&bbox=0,0,1,1&op=avg",  # bad op
+            "/query?layer=default&z=7&bbox=0,0,1,1&op=topk&k=0",
+            "/query?layer=default&z=7&bbox=0,0,1,1&op=topk&k=x",
+            "/query?layer=default&z=7&bbox=0,0,1,1&op=quantile&q=2",
+            "/query?layer=default&z=7&bbox=0,0,1,1&op=quantile&q=x",
+        ]
+        for path in bad:
+            status, _, body, _, route, _ = app.handle("GET", path)
+            assert (status, route) == (400, "query"), path
+            doc = json.loads(body)
+            assert doc["error"] == "bad query" and doc["detail"], path
+
+    def test_unknown_layer_and_missing_zoom_404(self, int_store):
+        app = ServeApp(TileStore(f"arrays:{int_store}"))
+        res = app.handle("GET", "/query?layer=nobody&z=7&bbox=0,0,1,1")
+        assert res[0] == 404
+        assert "layers" in json.loads(res[2])
+        res = app.handle("GET", "/query?layer=default&z=3&bbox=0,0,1,1")
+        assert res[0] == 404
+        doc = json.loads(res[2])
+        assert doc["detail_zooms"] == [7, 8, 9, 10]
+
+    def test_router_colocates_every_op_on_one_backend(self):
+        from heatmap_tpu.serve.router import route_key
+
+        base = "/query?layer=default&z=7&bbox=0,0,31,31"
+        assert route_key(base + "&op=sum") == route_key(base + "&op=topk&k=5")
+        assert route_key(base + "&op=quantile&q=0.9") == route_key(base)
+        assert route_key(base) != route_key(
+            "/query?layer=default&z=7&bbox=0,0,15,15")
+        assert route_key(base) != route_key(
+            "/query?layer=other&z=7&bbox=0,0,31,31")
+
+
+class TestBrownout:
+    @pytest.fixture()
+    def syn_int_store(self, tmp_path):
+        """Small store carrying BOTH synopsis and integral artifacts."""
+        config = BatchJobConfig(detail_zoom=8, min_detail_zoom=4,
+                                result_delta=2)
+        sink = LevelArraysSink(str(tmp_path / "levels"), synopses=True,
+                               integrals=True)
+        run_job(open_source("synthetic:800:5"), sink, config)
+        return TileStore(f"arrays:{tmp_path}/levels")
+
+    @staticmethod
+    def _controller(**kw):
+        kw.setdefault("burn_source", lambda: {"pinned": 0.75})
+        kw.setdefault("clock", lambda: 0.0)
+        return degrade.BrownoutController(**kw)
+
+    def test_rung1_answers_sum_from_synopsis_with_bound(self,
+                                                       syn_int_store):
+        store = syn_int_store
+        app = ServeApp(store, TileCache(), degrade=self._controller())
+        layer = store.layer("default")
+        z = sorted(set(layer.synopses) & set(layer.integrals))[0]
+        grid = _level_grid(layer, z)
+        rect = (0, 0, (1 << z) - 1, (1 << z) - 1)
+        exact = json.loads(_query(app, z, rect)[2])
+        assert exact["path"] == "integral"
+        app.degrade.rung = 1
+        res = _query(app, z, rect)
+        assert res[0] == 200
+        doc = json.loads(res[2])
+        assert doc["path"] == "synopsis"
+        area = (1 << z) * (1 << z)
+        bound = float(layer.synopses[z].max_err) * area
+        assert doc["max_err"] == bound
+        assert res.headers["X-Heatmap-Query-Error"] == \
+            f"max_err={bound:.6g}"
+        # The bound is honest: the synopsis answer is within it.
+        assert abs(doc["sum"] - _brute(grid, rect)) <= bound + 1e-9
+        # topk/quantile never degrade — exact beats loosely bounded.
+        topk = json.loads(_query(app, z, rect, "topk", extra="&k=3")[2])
+        assert topk["path"] == "integral"
+        assert getattr(_query(app, z, rect, "topk", extra="&k=3"),
+                       "headers", None) is None
+        # Walking back to rung 0 restores the exact bytes.
+        app.degrade.rung = 0
+        back = json.loads(_query(app, z, rect)[2])
+        assert back == exact
+
+
+BASE_SPEC = "synthetic:1500:7"
+DELTA_SPEC = "synthetic:200:11"
+RETRACT_ROWS = 300
+
+
+class _Chain:
+    def __init__(self, *sources):
+        self.sources = sources
+
+    def batches(self, batch_size: int = 1 << 20):
+        for src in self.sources:
+            yield from src.batches(batch_size)
+
+
+@pytest.fixture(scope="module")
+def delta_store(tmp_path_factory):
+    """Delta-store lifecycle for /query: base + insert delta +
+    retraction, snapshotted before compaction (no base yet — /query
+    falls through to exact rows), after compaction (integrals published
+    with the new base), and after one more live delta on top of the
+    compacted base (integrals answer via with_extras folding)."""
+    root = str(tmp_path_factory.mktemp("q_delta") / "store")
+    config = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                            result_delta=2)
+    delta.apply_batch(root, open_source(BASE_SPEC), config)
+    delta.apply_batch(root, open_source(DELTA_SPEC), config)
+    base_cols = read_columns(open_source(BASE_SPEC))
+    retract = ColumnsSource({k: v[:RETRACT_ROWS]
+                             for k, v in base_cols.items()})
+    delta.apply_batch(root, retract, config, sign=-1)
+    return root, config
+
+
+class TestDeltaStores:
+    Z = 7
+
+    def _answers(self, root):
+        app = ServeApp(TileStore(f"delta:{root}"))
+        layer = app.store.layer("default")
+        grid = _level_grid(layer, self.Z)
+        rng = np.random.default_rng(53)
+        out = []
+        for rect in _rects(rng, 1 << self.Z, 10):
+            docs = {}
+            for op, extra in (("sum", ""), ("topk", "&k=5"),
+                              ("quantile", "&q=0.5")):
+                res = _query(app, self.Z, rect, op, extra=extra)
+                assert res[0] == 200
+                docs[op] = json.loads(res[2])
+            assert docs["sum"]["sum"] == _brute(grid, rect)  # the pin
+            out.append(docs)
+        return out
+
+    def test_retraction_store_before_and_after_compaction(
+            self, delta_store):
+        root, _ = delta_store
+        before = self._answers(root)
+        assert all(d["sum"]["path"] == "fallback" for d in before)
+
+        summary = delta.compact(root, retention=2)
+        assert summary["status"] == "ok"
+        assert os.path.exists(integral_path(
+            os.path.join(root, summary["base"]), self.Z))
+        after = self._answers(root)
+        assert all(d["sum"]["path"] == "integral" for d in after)
+        # Identical answers through either path, marker aside.
+        for b, a in zip(before, after):
+            for op in ("sum", "topk", "quantile"):
+                bb, aa = dict(b[op]), dict(a[op])
+                bb.pop("path"), aa.pop("path")
+                assert bb == aa
+
+    def test_live_delta_on_compacted_base_folds_into_integrals(
+            self, delta_store):
+        root, config = delta_store
+        delta.compact(root, retention=2)
+        delta.apply_batch(root, open_source("synthetic:150:13"), config)
+        # Integrals describe the base; the live delta's rows fold in
+        # through with_extras — answers stay pinned to brute force
+        # over the OVERLAY (base ⊕ delta) levels.
+        for d in self._answers(root):
+            assert d["sum"]["path"] == "integral"
+
+
+class _RowsSource:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def batches(self, batch_size=1 << 20):
+        for i in range(0, len(self.rows), batch_size):
+            chunk = self.rows[i:i + batch_size]
+            out = {k: [r[k] for r in chunk]
+                   for k in ("latitude", "longitude", "user_id",
+                             "timestamp", "source")}
+            if any("value" in r for r in chunk):
+                out["value"] = [float(r.get("value", 1.0)) for r in chunk]
+            yield out
+
+
+def _rows(n, seed, value_max=None):
+    rng = np.random.default_rng(seed)
+    users = ("alice", "bob", "carol")
+    rows = []
+    for _ in range(n):
+        r = {"latitude": float(rng.uniform(40.0, 55.0)),
+             "longitude": float(rng.uniform(-5.0, 15.0)),
+             "user_id": users[int(rng.integers(0, len(users)))],
+             "timestamp": 1_500_000_000_000 + int(rng.integers(0, 10**9)),
+             "source": "gps"}
+        if value_max is not None:
+            r["value"] = int(rng.integers(1, value_max + 1))
+        rows.append(r)
+    return rows
+
+
+class TestStoreShapes:
+    """The exact-sum pin across every pipeline shape the ISSUE names:
+    integer-weighted jobs, pad-bucketed compiles, and Morton-range
+    sharded meshes all publish integrals whose answers equal the
+    brute-force sum over their own exact rows."""
+
+    CASES = {
+        "weighted": dict(weighted=True),
+        "pad_bucketed": dict(pad_bucketing="pow2", pad_bucket_min=64),
+        "morton_sharded": dict(data_parallel=True,
+                               spatial_partition="morton"),
+    }
+
+    @pytest.mark.parametrize("case", sorted(CASES))
+    def test_integrals_match_levels(self, case, tmp_path):
+        kw = dict(self.CASES[case])
+        config = BatchJobConfig(detail_zoom=8, min_detail_zoom=5,
+                                result_delta=2, **kw)
+        value_max = 5 if kw.get("weighted") else None
+        out = str(tmp_path / "levels")
+        run_job(_RowsSource(_rows(400, seed=61, value_max=value_max)),
+                LevelArraysSink(out, integrals=True), config)
+        ints = load_integrals(out)
+        levels = LevelArraysSink.load(out)
+        assert ints, f"{case}: no integral artifacts written"
+        rng = np.random.default_rng(67)
+        for zoom, pairs in ints.items():
+            cols = levels[zoom]
+            users = np.asarray(cols["user"], str)
+            tss = np.asarray(cols["timespan"], str)
+            for ip in pairs:
+                sel = (users == ip.user) & (tss == ip.timespan)
+                grid = grid_from_rows_np(
+                    np.asarray(cols["row"], np.int64)[sel],
+                    np.asarray(cols["col"], np.int64)[sel],
+                    np.asarray(cols["value"], np.float64)[sel],
+                    1 << zoom)
+                np.testing.assert_array_equal(ip.grid(), grid)
+                for rect in _rects(rng, 1 << zoom, 10):
+                    assert range_sum(ip, rect) == _brute(grid, rect)
+                top = top_k_hotspots(ip, (0, 0, ip.n - 1, ip.n - 1), 5)
+                for r, c, v in top:
+                    assert grid[r, c] == v
+
+
+class TestRecovery:
+    def test_sweep_quarantines_torn_integrals_in_current_base(
+            self, tmp_path):
+        from heatmap_tpu.delta.recover import sweep
+
+        root = tmp_path / "store"
+        bdir = root / "base-000001"
+        bdir.mkdir(parents=True)
+        (root / "CURRENT").write_text(json.dumps(
+            {"schema": "heatmap-tpu.delta_store.v1", "base": "base-000001",
+             "applied_through": 1, "config": None}))
+        cols = _level_cols(np.random.default_rng(71), 5,
+                           [("all", "alltime")])
+        write_integrals(str(bdir), levels={5: cols})
+        (bdir / "integral-z06.npz").write_bytes(b"torn mid-write")
+        (bdir / "integral-z07.npz.tmp").write_bytes(b"crashed staging")
+
+        result = sweep(str(root))
+        got = {(i["reason"], os.path.basename(i["path"]))
+               for i in result["quarantined"]}
+        assert got == {("torn_integral", "integral-z06.npz"),
+                       ("orphan_tmp", "integral-z07.npz.tmp")}
+        assert all(i["kind"] == "integral" for i in result["quarantined"])
+        # The healthy artifact survives in place and still verifies.
+        good = integral_path(str(bdir), 5)
+        assert os.path.exists(good) and verify_integral(good) is None
+        # A reload of the swept store serves /query from what is left.
+        assert sweep(str(root))["quarantined"] == []

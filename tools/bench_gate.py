@@ -22,6 +22,11 @@ Reads every bench artifact the repo's tooling writes —
   p99 lag ms (lower);
 - ``BENCH_synopsis.json`` (tools/bench_synopsis.py): wavelet-synopsis
   exact/synopsis bytes ratio (higher) and pair decode p99 ms (lower);
+- ``BENCH_query.json`` (tools/bench_query.py): per-op integral-path
+  /query p99 ms (lower), the integral-vs-fallback sum speedup
+  (``query:speedup_p99[sum]``, higher — the acceptance bar is >= 10x
+  on a warmed store), and fleet-router query RPS (higher) with its
+  p99 (lower);
 - ``BENCH_partition.json`` (tools/bench_job.py --partition-sweep):
   Morton-range vs uniform-DP modeled merge-volume ratio per dataset
   (``partition:merge_ratio[...]``, higher), the Morton leg's wall
@@ -193,6 +198,25 @@ def snapshot_metrics(root: str) -> dict:
         p99 = ((doc.get("decode") or {}).get("decode_ms") or {}).get("p99")
         if isinstance(p99, (int, float)):
             out["synopsis:decode_p99"] = (float(p99), False)
+    doc = _load(os.path.join(root, "BENCH_query.json"))
+    if isinstance(doc, dict):
+        # Range-query engine (bench_query): integral-path latency per
+        # op, the sum A/B speedup (the ISSUE bar is >= 10x), and the
+        # fleet-router throughput leg.
+        for op, row in (doc.get("direct") or {}).items():
+            p99 = (row.get("integral_ms") or {}).get("p99")
+            if isinstance(p99, (int, float)):
+                out[f"query:{op}_p99_ms"] = (float(p99), False)
+            if op == "sum" and isinstance(row.get("speedup_p99"),
+                                          (int, float)):
+                out["query:speedup_p99[sum]"] = (
+                    float(row["speedup_p99"]), True)
+        router = doc.get("router") or {}
+        if isinstance(router.get("rps"), (int, float)):
+            out["query:router_rps"] = (float(router["rps"]), True)
+        p99 = (router.get("latency_ms") or {}).get("p99")
+        if isinstance(p99, (int, float)):
+            out["query:router_p99_ms"] = (float(p99), False)
     out.update(stream_metrics(root))
     return out
 

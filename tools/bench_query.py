@@ -1,0 +1,300 @@
+#!/usr/bin/env python
+"""Range-query bench: /query latency, integral-vs-fallback A/B, and
+fleet-router throughput: BENCH_query.json.
+
+Three headline sections (docs/analytics.md):
+
+- ``direct``   per op (``--ops``), p50/p99 of one ServeApp /query
+               request over distinct random rects (every request a
+               cache miss — the evaluator is what is being measured)
+               on the integral path, next to the SAME rects against a
+               copy of the store with its integral artifacts stripped
+               (the exact-rows fall-through). ``speedup_p99`` is the
+               fallback/integral p99 quotient; the acceptance bar is
+               >= 10x for ``sum`` on a warmed store;
+- ``router``   sustained RPS + latency percentiles for ``op=sum``
+               through a real thread-mode fleet (RouterApp in front of
+               ``--backends`` backends relayed over HTTP) — the
+               placement key colocates every op over one (layer, z,
+               bbox), so repeated analytics of a region ride one
+               backend's LRU;
+- ``bytes``    integral artifact bytes per zoom next to the exact
+               level bytes they index.
+
+The store is built from UNIFORMLY spread points (not the stock
+clustered ``synthetic:`` mixture): the hot-spot mixture leaves coarse
+levels nearly empty — dozens of occupied cells at z<=9 — so the
+exact-rows fall-through costs less than request overhead and the A/B
+cannot show the evaluator gap. Uniform points at the default
+``--points 200000`` give ~100k occupied cells at the top integral
+zoom, the regime integral pyramids exist for.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/bench_query.py \
+        [--points 200000] [--iters 300] [--ops sum,topk,quantile] \
+        [--out BENCH_query.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+class _UniformSource:
+    """Uniform world-spanning GPS points, a pure function of
+    (seed, chunk index) like the stock sources — see the module
+    docstring for why the bench wants WIDE levels."""
+
+    def __init__(self, n: int, seed: int):
+        self.n, self.seed = int(n), int(seed)
+
+    def close(self) -> None:
+        pass
+
+    def batches(self, batch_size: int = 1 << 16):
+        import numpy as np
+
+        done = 0
+        chunk = 0
+        while done < self.n:
+            m = min(self.n - done, 1 << 16)
+            rng = np.random.default_rng([self.seed, chunk])
+            yield {
+                "latitude": rng.uniform(-60.0, 70.0, m),
+                "longitude": rng.uniform(-179.0, 179.0, m),
+                "user_id": ["u%d" % (j % 7) for j in range(done, done + m)],
+                "timestamp": [1_500_000_000 + j for j in range(done, done + m)],
+                "source": ["gps"] * m,
+            }
+            done += m
+            chunk += 1
+
+
+def _pct(sorted_vals: list, q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def _ops_list(text: str) -> list:
+    """Comma-separated op list; each token is validated against
+    analytics.VALID_OPS with its one-line error."""
+    from heatmap_tpu.analytics import validate_op
+
+    ops = [validate_op(tok.strip()) for tok in text.split(",") if tok.strip()]
+    if not ops:
+        raise ValueError(f"--ops got no operations in {text!r}")
+    return ops
+
+
+def _top_k(text: str) -> int:
+    k = int(text)
+    if k < 1:
+        raise ValueError(f"--top-k must be >= 1, got {k}")
+    return k
+
+
+def _quantile_q(text: str) -> float:
+    q = float(text)
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"--quantile-q must be in [0, 1], got {q}")
+    return q
+
+
+def _rects(rng, n: int, count: int) -> list:
+    out = []
+    for _ in range(count):
+        r0, r1 = sorted(int(v) for v in rng.integers(0, n, 2))
+        c0, c1 = sorted(int(v) for v in rng.integers(0, n, 2))
+        out.append((r0, c0, r1, c1))
+    return out
+
+
+def _query_path(z: int, rect, op: str, k: int, q: float) -> str:
+    r0, c0, r1, c1 = rect
+    path = f"/query?layer=default&z={z}&bbox={c0},{r0},{c1},{r1}&op={op}"
+    if op == "topk":
+        path += f"&k={k}"
+    elif op == "quantile":
+        path += f"&q={q}"
+    return path
+
+
+def _time_requests(app, paths: list) -> list:
+    samples = []
+    for path in paths:
+        t0 = time.perf_counter()
+        res = app.handle("GET", path)
+        dt = 1e3 * (time.perf_counter() - t0)
+        if res[0] != 200:
+            raise SystemExit(f"bench query failed {res[0]}: {path} "
+                             f"{res[2][:200]!r}")
+        samples.append(dt)
+    samples.sort()
+    return samples
+
+
+def bench_direct(level_dir: str, stripped_dir: str, z: int, args) -> dict:
+    """Integral vs fall-through A/B over identical rect sequences."""
+    import numpy as np
+
+    from heatmap_tpu.serve import ServeApp, TileStore
+
+    rng = np.random.default_rng(args.seed + 1)
+    rects = _rects(rng, 1 << z, args.iters)
+    out = {}
+    for op in args.ops:
+        paths = [_query_path(z, r, op, args.top_k, args.quantile_q)
+                 for r in rects]
+        # Fresh apps per leg: identical cold caches, every distinct
+        # rect a miss — the evaluator is what is being measured.
+        fast = _time_requests(ServeApp(TileStore(f"arrays:{level_dir}")),
+                              paths)
+        slow = _time_requests(ServeApp(TileStore(f"arrays:{stripped_dir}")),
+                              paths)
+        row = {
+            "integral_ms": {"p50": _pct(fast, 0.50), "p99": _pct(fast, 0.99)},
+            "fallback_ms": {"p50": _pct(slow, 0.50), "p99": _pct(slow, 0.99)},
+        }
+        if row["integral_ms"]["p99"]:
+            row["speedup_p99"] = round(
+                row["fallback_ms"]["p99"] / row["integral_ms"]["p99"], 2)
+        out[op] = row
+    return out
+
+
+def bench_router(level_dir: str, z: int, args) -> dict:
+    """op=sum RPS + latency through a thread-mode fleet router."""
+    import numpy as np
+
+    from heatmap_tpu.serve import FleetSupervisor, TileStore, route_key
+
+    rng = np.random.default_rng(args.seed + 2)
+    rects = _rects(rng, 1 << z, 64)
+    paths = [_query_path(z, r, "sum", args.top_k, args.quantile_q)
+             for r in rects]
+    # Placement sanity: every op over one (layer, z, bbox) colocates.
+    assert route_key(paths[0]) == route_key(
+        _query_path(z, rects[0], "topk", args.top_k, args.quantile_q))
+    sup = FleetSupervisor(
+        None, args.backends, mode="thread",
+        store_factory=lambda: TileStore(f"arrays:{level_dir}"),
+        cache_bytes=32 << 20, probe_interval_s=0.1,
+        monitor_interval_s=0.05)
+    try:
+        sup.start()
+        for path in paths:  # warm every backend's route + caches
+            sup.router.handle("GET", path)
+        samples = []
+        t0 = time.perf_counter()
+        for i in range(args.iters):
+            path = paths[i % len(paths)]
+            s0 = time.perf_counter()
+            res = sup.router.handle("GET", path)
+            samples.append(1e3 * (time.perf_counter() - s0))
+            if res[0] != 200:
+                raise SystemExit(
+                    f"router query failed {res[0]}: {path}")
+        wall = time.perf_counter() - t0
+    finally:
+        sup.stop()
+    samples.sort()
+    return {"backends": args.backends, "requests": args.iters,
+            "rps": round(args.iters / wall, 1),
+            "latency_ms": {"p50": _pct(samples, 0.50),
+                           "p99": _pct(samples, 0.99)}}
+
+
+def bench_bytes(level_dir: str) -> dict:
+    """Integral artifact bytes per zoom vs the exact level bytes."""
+    per_zoom = {}
+    for name in sorted(os.listdir(level_dir)):
+        if not (name.startswith("integral-z") and name.endswith(".npz")):
+            continue
+        zoom = int(name[len("integral-z"):len("integral-z") + 2])
+        level = os.path.join(level_dir, f"level_z{zoom:02d}.npz")
+        per_zoom[zoom] = {
+            "integral_bytes": os.path.getsize(
+                os.path.join(level_dir, name)),
+            "exact_bytes": (os.path.getsize(level)
+                            if os.path.exists(level) else None),
+        }
+    return per_zoom
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=200_000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--iters", type=int, default=300,
+                    help="requests per op and per router window")
+    ap.add_argument("--ops", type=_ops_list, default=None,
+                    help="comma-separated /query ops to bench "
+                    "(default: all)")
+    ap.add_argument("--top-k", type=_top_k, default=10)
+    ap.add_argument("--quantile-q", type=_quantile_q, default=0.5)
+    ap.add_argument("--backends", type=int, default=2)
+    ap.add_argument("--out", default="BENCH_query.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu import obs
+    from heatmap_tpu.analytics import VALID_OPS
+    from heatmap_tpu.io import open_sink
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    if args.ops is None:
+        args.ops = list(VALID_OPS)
+    obs.enable_metrics(True)
+    tmpdir = tempfile.mkdtemp(prefix="benchquery-")
+    try:
+        level_dir = os.path.join(tmpdir, "levels")
+        config = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                                result_delta=2)
+        with open_sink(f"arrays-integral:{level_dir}") as sink:
+            run_job(_UniformSource(args.points, args.seed), sink, config)
+        # The A/B twin: same exact rows, integral artifacts stripped.
+        stripped = os.path.join(tmpdir, "levels-stripped")
+        shutil.copytree(level_dir, stripped)
+        for name in os.listdir(stripped):
+            if name.startswith("integral-"):
+                os.remove(os.path.join(stripped, name))
+        z = max(int(n[len("integral-z"):len("integral-z") + 2])
+                for n in os.listdir(level_dir)
+                if n.startswith("integral-z"))
+
+        direct = bench_direct(level_dir, stripped, z, args)
+        print(json.dumps({"zoom": z, "direct": {
+            op: {"integral_p99": row["integral_ms"]["p99"],
+                 "speedup_p99": row.get("speedup_p99")}
+            for op, row in direct.items()}}), flush=True)
+        router = bench_router(level_dir, z, args)
+        print(json.dumps({"router_rps": router["rps"],
+                          "router_p99": router["latency_ms"]["p99"]}),
+              flush=True)
+        artifact_bytes = bench_bytes(level_dir)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    record = {"bench": "query", "points": args.points, "zoom": z,
+              "iters": args.iters, "direct": direct, "router": router,
+              "bytes": artifact_bytes}
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, default=str)
+        f.write("\n")
+    print(json.dumps({"wrote": args.out}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,12 @@ probe, and the recovered fleet must serve bytes identical to the clean
 single-process run. A synopsis phase tears a wavelet-synopsis artifact
 mid-write: the recovery sweep must quarantine it, serving must fall
 back to exact bytes for that level while other levels keep their
-synopses, and no request may see a 500. An adaptive phase scripts one
+synopses, and no request may see a 500. A query phase does the same to
+an integral-histogram artifact: the sweep must quarantine the torn
+integral and its orphaned staging tmp, /query must fall through to
+exact level rows with answers identical modulo the path marker, and
+the surviving zooms must keep their O(1) fast path. An adaptive phase
+scripts one
 overload episode against the brownout controller (serve/degrade.py)
 under a fake clock: the ladder must step up 0->1->2->3 and walk back
 down identically across repeat runs, with zero 500s and — recovered at
@@ -731,6 +736,103 @@ def phase_synopsis(ctx):
             "codes": {str(k): v for k, v in sorted(codes.items())}}
 
 
+def phase_query(ctx):
+    """Range-query chaos: tear one integral-histogram artifact plus a
+    crashed staging tmp, and require the recovery sweep to quarantine
+    both while /query falls through to the exact level rows for the
+    torn zoom — answers identical to the integral path modulo the
+    ``path`` marker, sums pinned to an independent brute force, other
+    zooms keep their integrals, and no request ever sees a 500."""
+    from heatmap_tpu.analytics.integral import integral_path
+    from heatmap_tpu.analytics.query import level_cells
+    from heatmap_tpu.delta.recover import sweep
+    from heatmap_tpu.io import open_sink
+
+    faults.install(None)
+    root = os.path.join(os.path.dirname(ctx["base_root"]), "store-query")
+    bdir = os.path.join(root, "base-000001")
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                         result_delta=2)
+    with open_sink(f"arrays-integral:{bdir}") as sink:
+        run_job(SyntheticSource(ctx["n"], seed=5), sink, cfg)
+    with open(os.path.join(root, "CURRENT"), "w") as f:
+        json.dump({"schema": "heatmap-tpu.delta_store.v1",
+                   "base": "base-000001", "applied_through": 1,
+                   "config": None}, f)
+    store = TileStore(f"delta:{root}")
+    app = ServeApp(store)
+    layer = store.layer("default")
+    int_zooms = sorted(layer.integrals)
+    assert len(int_zooms) >= 2, f"need >=2 integral zooms: {int_zooms}"
+
+    codes: dict = {}
+
+    def fetch(path):
+        res = app.handle("GET", path)
+        codes[res[0]] = codes.get(res[0], 0) + 1
+        return res
+
+    def queries(z):
+        n = 1 << z
+        rects = [(0, 0, n - 1, n - 1)]
+        level = layer.levels[z]
+        row, col = (int(v[0]) for v in morton_decode_np(
+            level.codes[int(np.argmax(level.values)):][:1]))
+        rects.append((max(0, row - 40), max(0, col - 40),
+                      min(n - 1, row + 40), min(n - 1, col + 40)))
+        out = []
+        for r0, c0, r1, c1 in rects:
+            base = f"/query?layer=default&z={z}&bbox={c0},{r0},{c1},{r1}"
+            out += [f"{base}&op=sum", f"{base}&op=topk&k=5",
+                    f"{base}&op=quantile&q=0.5"]
+        return out
+
+    def answers(z):
+        docs = {}
+        for path in queries(z):
+            res = fetch(path)
+            assert res[0] == 200, f"query failed {res[0]}: {path}"
+            docs[path] = json.loads(res[2])
+        return docs
+
+    before = {z: answers(z) for z in int_zooms}
+    for z, docs in before.items():
+        assert all(d["path"] == "integral" for d in docs.values()), docs
+
+    # Tear the middle artifact + leave a crashed staging file behind.
+    victim = int_zooms[len(int_zooms) // 2]
+    with open(integral_path(bdir, victim), "wb") as f:
+        f.write(b"torn mid-write")
+    with open(os.path.join(bdir, "integral-z99.npz.tmp"), "wb") as f:
+        f.write(b"crashed staging")
+    swept = sweep(root)
+    reasons = sorted(i["reason"] for i in swept["quarantined"])
+    assert reasons == ["orphan_tmp", "torn_integral"], reasons
+    kinds = sorted(i["kind"] for i in swept["quarantined"])
+    assert kinds == ["integral", "integral"], kinds
+    store.reload()
+    layer = store.layer("default")
+    assert victim not in layer.integrals, "torn integral still indexed"
+
+    # The torn zoom falls through to exact rows with identical answers
+    # ... while the surviving zooms keep their integral fast path.
+    for z in int_zooms:
+        want_path = "fallback" if z == victim else "integral"
+        for url, doc in answers(z).items():
+            assert doc["path"] == want_path, (url, doc)
+            was = dict(before[z][url], path=want_path)
+            assert doc == was, f"answers diverged after tear: {url}"
+            if doc["op"] == "sum":  # independent brute-force pin
+                c0, r0, c1, r1 = doc["bbox"]
+                _, _, vals = level_cells(layer.levels[z],
+                                         (r0, c0, r1, c1))
+                assert doc["sum"] == float(vals.sum()), url
+    assert codes.get(500, 0) == 0, f"500s observed: {codes}"
+    return {"integral_zooms": int_zooms, "torn_zoom": victim,
+            "quarantined": reasons,
+            "codes": {str(k): v for k, v in sorted(codes.items())}}
+
+
 def phase_incident(ctx):
     """Flight-recorder incident discipline under a seeded fault storm:
     12 injected ``tile.render`` faults inside request-shaped shadow
@@ -964,6 +1066,7 @@ PHASES = [
     ("host_loss_morton", phase_host_loss_morton),
     ("backend_loss", phase_backend_loss),
     ("synopsis", phase_synopsis),
+    ("query", phase_query),
     ("incident", phase_incident),
     ("adaptive", phase_adaptive),
     ("byte_equality", phase_byte_equality),
