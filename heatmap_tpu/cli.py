@@ -1008,10 +1008,24 @@ def cmd_serve(args) -> int:
             getattr(args, "degrade_ladder", ""))
     except ValueError as e:
         raise SystemExit(f"--degrade-ladder: {e}") from e
+    disk_cache = prewarm = None
+    if getattr(args, "disk_cache", None):
+        from heatmap_tpu.tilefs import DiskTileCache
+
+        disk_cache = DiskTileCache(args.disk_cache,
+                                   max_bytes=args.disk_cache_bytes)
+    if getattr(args, "prewarm_events", None):
+        from heatmap_tpu.tilefs import PrewarmConfig
+
+        prewarm = PrewarmConfig(events=tuple(args.prewarm_events),
+                                top_k=args.prewarm_top_k,
+                                budget_s=args.prewarm_budget_s,
+                                budget_bytes=args.prewarm_bytes)
     app = ServeApp(store, cache,
                    render_timeout_s=getattr(args, "render_timeout", None),
                    synopsis_default=getattr(args, "synopsis_default", False),
-                   degrade=controller)
+                   degrade=controller, disk_cache=disk_cache,
+                   prewarm=prewarm)
     # Incident bundles capture the same state /healthz serves, plus the
     # mount fingerprint (no-ops without --incident-dir).
     from heatmap_tpu.obs import incident as incident_mod
@@ -1025,6 +1039,10 @@ def cmd_serve(args) -> int:
         stop_stream = _follow_stream(args, app)
     server = make_server(app, host=args.host, port=args.port)
     host, port = server.server_address[:2]
+    # Warm before announcing readiness on stderr: a supervisor that
+    # waits for the banner sees a server whose popular tiles are hot.
+    # Budgeted (--prewarm-budget-s), so a huge log can't stall startup.
+    app.prewarm_now(source="startup")
     print(json.dumps({
         "serving": f"http://{host}:{port}",
         "store": args.store,
@@ -1079,7 +1097,15 @@ def _serve_fleet(args, collector, ev_log) -> int:
         hedge_quantile=args.hedge_quantile,
         probe_interval_s=args.probe_interval,
         degrade_opts=degrade_opts,
-        slo_specs=list(getattr(args, "slo", None) or []))
+        slo_specs=list(getattr(args, "slo", None) or []),
+        disk_cache_opts=({"root": args.disk_cache,
+                          "max_bytes": args.disk_cache_bytes}
+                         if getattr(args, "disk_cache", None) else None),
+        prewarm_opts=({"events": list(args.prewarm_events),
+                       "top_k": args.prewarm_top_k,
+                       "budget_s": args.prewarm_budget_s,
+                       "budget_bytes": args.prewarm_bytes}
+                      if getattr(args, "prewarm_events", None) else None))
     from heatmap_tpu.obs import incident as incident_mod
 
     incident_mod.add_state_provider("healthz", supervisor.router._health)
@@ -1942,6 +1968,32 @@ def build_parser() -> argparse.ArgumentParser:
                          help="ladder tuning, comma list of k=v: "
                          "up=BURN,down=BURN,ttl=SCALE,shed=FRAC,max=RUNG "
                          "(default up=1.0,down=0.5,ttl=4,shed=0.5,max=3)")
+    p_serve.add_argument("--disk-cache", default=None, metavar="DIR",
+                         help="persist rendered tile bytes under DIR as "
+                         "a second cache tier below the heap LRU "
+                         "(docs/tilefs.md): survives restarts, torn "
+                         "entries read as misses, keys carry the exact "
+                         "invalidation epochs. Fleet mode gives each "
+                         "backend DIR/<backend-id>")
+    p_serve.add_argument("--disk-cache-bytes", type=int, default=1 << 30,
+                         metavar="B",
+                         help="disk cache size cap (mtime-LRU eviction)")
+    p_serve.add_argument("--prewarm-events", action="append", default=None,
+                         metavar="PATH",
+                         help="replay the Zipf head of these http_request "
+                         "event logs (--events from a prior run) into "
+                         "the caches at startup and after /reload; "
+                         "repeatable (docs/tilefs.md)")
+    p_serve.add_argument("--prewarm-top-k", type=int, default=64,
+                         metavar="K",
+                         help="how many of the most popular tile paths "
+                         "the prewarm replays (decayed frequency rank)")
+    p_serve.add_argument("--prewarm-budget-s", type=float, default=10.0,
+                         metavar="S",
+                         help="wall-clock budget for one prewarm pass")
+    p_serve.add_argument("--prewarm-bytes", type=int, default=64 << 20,
+                         metavar="B",
+                         help="rendered-byte budget for one prewarm pass")
     p_serve.add_argument("--events", default=None, metavar="PATH",
                          help="append http_request events to PATH (JSONL, "
                          "docs/observability.md)")

@@ -251,8 +251,16 @@ def compact(root: str, *, retention: int = 2) -> dict:
         # levels, synopses, and integrals consistent with base ⊕
         # deltas (heatmap_tpu.synopsis, heatmap_tpu.analytics; stale
         # ones would violate the stamped error / exact-sum contracts).
-        rows = LevelArraysSink(tmp_path, synopses=True,
-                               integrals=True).write_levels(merged)
+        # tilefs mirrors are inherited: if the old base was converted
+        # (tools/tilefs_convert.py) or written by an arrays-tilefs
+        # sink, the new base carries fresh zero-copy mirrors too — a
+        # one-time conversion survives every later compaction.
+        from heatmap_tpu.tilefs import sniff_tilefs
+
+        keep_tilefs = bool(base_name) and sniff_tilefs(
+            os.path.join(root, base_name))
+        rows = LevelArraysSink(tmp_path, synopses=True, integrals=True,
+                               tilefs=keep_tilefs).write_levels(merged)
         faults.retry_call(publish_dir, tmp_path, new_path,
                           site="compact.publish", key="base")
         cur = dict(cur)

@@ -33,7 +33,11 @@ What gets quarantined:
   bad file;
 - torn or schema-invalid ``integral-z*.npz`` artifacts inside CURRENT's
   base, same contract (reason ``torn_integral``): /query falls through
-  to the exact rows, so quarantining only surfaces the corruption.
+  to the exact rows, so quarantining only surfaces the corruption;
+- torn ``tilefs-z*.bin`` zero-copy mirrors inside CURRENT's base, same
+  contract (reason ``torn_tilefs``, heatmap_tpu.tilefs): the store
+  falls back to the exact npz level for that zoom, so quarantining
+  costs mmap page sharing, never bytes.
 
 Digest verification re-hashes artifact bytes, so results are memoised
 per entry file identity (path, size, mtime_ns) — journaled entries and
@@ -276,6 +280,19 @@ def sweep(root: str, *, verify: bool = True) -> dict:
                 detail = verify_integral(full)
                 if detail is not None:
                     _quarantine(root, full, "torn_integral", "integral",
+                                items, detail)
+            elif name.startswith("tilefs-") and name.endswith(".tmp"):
+                _quarantine(root, full, "orphan_tmp", "tilefs", items)
+            elif name.startswith("tilefs-z") and name.endswith(".bin"):
+                from heatmap_tpu.tilefs import verify_tilefs
+
+                detail = verify_tilefs(full)
+                if detail is not None:
+                    # Same contract as synopsis/integral: serving falls
+                    # back to the exact npz level for that zoom, so
+                    # quarantining a torn mirror costs mmap sharing,
+                    # never correctness.
+                    _quarantine(root, full, "torn_tilefs", "tilefs",
                                 items, detail)
 
     quarantine_bytes(root)  # refresh the growth gauge every sweep

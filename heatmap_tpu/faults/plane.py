@@ -75,6 +75,8 @@ SITES = (
     "elastic.reassign",
     "router.forward",
     "backend.probe",
+    "tilefs.read",
+    "diskcache.write",
 )
 _SITE_SET = frozenset(SITES)
 

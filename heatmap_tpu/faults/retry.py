@@ -141,6 +141,17 @@ POLICIES = {
     # re-probes every interval); a failed probe just feeds the breaker.
     "backend.probe": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
                                  deadline_s=None),
+    # tilefs mmap open. Zero retries: a torn/unreadable tilefs file is
+    # deterministic, and the store's heap-npz fallback for that zoom IS
+    # the recovery (serving stays byte-identical; the offline sweep
+    # owns quarantining the file).
+    "tilefs.read": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
+                               deadline_s=None),
+    # Disk-cache write-through. Zero retries: the tile was already
+    # rendered when the fill runs, so a failed write is just a skipped
+    # optimization — never worth sleeping for on the serve path.
+    "diskcache.write": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
+                                   deadline_s=None),
 }
 
 

@@ -238,6 +238,10 @@ class LevelArraysSink:
     #: the exact levels (``arrays-integral:DIR`` spec;
     #: heatmap_tpu.analytics).
     integrals: bool = False
+    #: Also publish zero-copy ``tilefs-z*.bin`` mirrors alongside the
+    #: exact levels (``arrays-tilefs:DIR`` spec; heatmap_tpu.tilefs) —
+    #: the serving tier mmaps these instead of decompressing npz.
+    tilefs: bool = False
 
     def __post_init__(self):
         if self.format not in ("npz", "npz-compressed", "parquet"):
@@ -257,7 +261,7 @@ class LevelArraysSink:
 
     def write_levels(self, levels) -> int:
         rows = 0
-        if self.synopses or self.integrals:
+        if self.synopses or self.integrals or self.tilefs:
             levels = list(levels)  # consumed twice: levels + derived
         for lvl in levels:
             out = {k: np.asarray(lvl[k]) for k in self.COLUMNS}
@@ -320,6 +324,23 @@ class LevelArraysSink:
 
             write_integrals(self.path,
                             {int(lvl["zoom"]): lvl for lvl in levels})
+        if self.tilefs:
+            # Zero-copy mirrors from the same in-memory levels. The
+            # writer re-materializes the dictionary-encoded columns —
+            # tilefs pairs are split on the string keys, exactly like
+            # TileStore._build_from_levels.
+            from heatmap_tpu.tilefs import format as tilefs_format
+
+            tilefs_format.write_tilefs_from_loaded(self.path, {
+                int(lvl["zoom"]): {
+                    "row": lvl["row"], "col": lvl["col"],
+                    "value": lvl["value"],
+                    "coarse_zoom": lvl["coarse_zoom"],
+                    "user": np.asarray(lvl["user_names"])[
+                        np.asarray(lvl["user_idx"])],
+                    "timespan": np.asarray(lvl["timespan_names"])[
+                        np.asarray(lvl["timespan_idx"])],
+                } for lvl in levels})
         return rows
 
     def write(self, records):
@@ -450,7 +471,7 @@ def per_process_sink_spec(spec: str, process_index: int) -> str:
         path = rest or spec
         return f"jsonl:{path}.{tag}"
     if kind in ("arrays", "arrays-parquet", "arrays-synopsis",
-                "arrays-integral", "dir"):
+                "arrays-integral", "arrays-tilefs", "dir"):
         return f"{kind}:{os.path.join(rest, 'host' + f'{process_index:03d}')}"
     if kind in ("memory", "cassandra"):
         return spec
@@ -459,7 +480,8 @@ def per_process_sink_spec(spec: str, process_index: int) -> str:
 
 #: Sink spec kinds ``open_sink`` accepts, in help order.
 SINK_KINDS = ("jsonl", "arrays", "arrays-parquet", "arrays-synopsis",
-              "arrays-integral", "dir", "memory", "cassandra")
+              "arrays-integral", "arrays-tilefs", "dir", "memory",
+              "cassandra")
 
 
 def validate_sink_spec(spec: str) -> str:
@@ -494,6 +516,8 @@ def open_sink(spec: str) -> BlobSink:
         return LevelArraysSink(rest, synopses=True)
     if kind == "arrays-integral":
         return LevelArraysSink(rest, integrals=True)
+    if kind == "arrays-tilefs":
+        return LevelArraysSink(rest, tilefs=True)
     if kind == "dir":
         return DirectoryBlobSink(rest)
     if kind == "memory":

@@ -173,6 +173,13 @@ EVENT_SCHEMA = {
     # monotonic bundle counter).
     "incident_flush": {"required": ("trigger", "path"),
                        "optional": ("seq", "detail", "bytes")},
+    # tilefs/prewarm.py: one cache pre-warm pass finished (startup or
+    # post-/reload). keys counts 2xx replays; planned the full plan
+    # length; budget_exhausted marks a time/byte budget cutoff before
+    # the plan drained.
+    "prewarm_done": {"required": ("keys", "seconds"),
+                     "optional": ("bytes", "errors", "planned",
+                                  "budget_exhausted", "source")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",

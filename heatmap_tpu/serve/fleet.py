@@ -46,6 +46,40 @@ from heatmap_tpu.serve.http import ServeApp, make_server, serve_in_thread
 from heatmap_tpu.serve.router import (FLEET_RESTARTS, BackendClient,
                                       RouterApp)
 from heatmap_tpu.serve.store import TileStore
+from heatmap_tpu.tilefs import DiskTileCache, PrewarmConfig
+
+
+def _backend_serving_extras(backend_id: str, disk_cache_opts,
+                            prewarm_opts):
+    """Materialize the per-backend disk cache + prewarm config from the
+    supervisor's option dicts. Each backend caches under its own subdir
+    — entries are cheap to refill and a shared directory would race the
+    deterministic tmp names across processes."""
+    disk_cache = None
+    if disk_cache_opts and disk_cache_opts.get("root"):
+        disk_cache = DiskTileCache(
+            os.path.join(disk_cache_opts["root"], backend_id),
+            max_bytes=int(disk_cache_opts.get("max_bytes", 1 << 30)))
+    prewarm = None
+    if prewarm_opts and prewarm_opts.get("events"):
+        prewarm = PrewarmConfig(
+            events=tuple(prewarm_opts["events"]),
+            top_k=int(prewarm_opts.get("top_k", 64)),
+            half_life=float(prewarm_opts.get("half_life", 512.0)),
+            budget_s=float(prewarm_opts.get("budget_s", 10.0)),
+            budget_bytes=int(prewarm_opts.get("budget_bytes", 64 << 20)))
+    return disk_cache, prewarm
+
+
+def _warm_in_background(app: ServeApp):
+    """Replay the popularity plan without delaying readiness: the
+    backend reports its port first, then fills caches while early
+    requests are already being answered (worst case: they miss)."""
+    if app.prewarm is None:
+        return
+    threading.Thread(target=app.prewarm_now,
+                     kwargs={"source": "startup"},
+                     name="prewarm", daemon=True).start()
 
 
 class _ThreadBackend:
@@ -55,7 +89,9 @@ class _ThreadBackend:
                  host: str = "127.0.0.1", cache_bytes: int = 64 << 20,
                  max_inflight: int | None = None,
                  render_timeout_s: float | None = None,
-                 degrade_opts: dict | None = None):
+                 degrade_opts: dict | None = None,
+                 disk_cache_opts: dict | None = None,
+                 prewarm_opts: dict | None = None):
         self.id = backend_id
         self._store_factory = store_factory
         self._host = host
@@ -63,6 +99,8 @@ class _ThreadBackend:
         self._max_inflight = max_inflight
         self._render_timeout_s = render_timeout_s
         self._degrade_opts = degrade_opts
+        self._disk_cache_opts = disk_cache_opts
+        self._prewarm_opts = prewarm_opts
         self.app: ServeApp | None = None
         self._server = None
         self._alive = False
@@ -74,14 +112,18 @@ class _ThreadBackend:
         # the process-global SLO engine, so they step together.
         controller = (degrade_mod.controller_from_flags(
             True, **self._degrade_opts) if self._degrade_opts else None)
+        disk_cache, prewarm = _backend_serving_extras(
+            self.id, self._disk_cache_opts, self._prewarm_opts)
         self.app = ServeApp(store, TileCache(max_bytes=self._cache_bytes),
                             max_inflight=self._max_inflight,
                             render_timeout_s=self._render_timeout_s,
-                            degrade=controller)
+                            degrade=controller, disk_cache=disk_cache,
+                            prewarm=prewarm)
         self._server, _ = serve_in_thread(self.app, host=self._host)
         self._alive = True
         self.started_at = time.monotonic()
         host, port = self._server.server_address[:2]
+        _warm_in_background(self.app)
         return host, port
 
     def alive(self) -> bool:
@@ -108,7 +150,9 @@ class _ProcessBackend:
                  chaos: str | None = None, workdir: str = ".",
                  spawn_timeout_s: float = 30.0,
                  degrade_opts: dict | None = None,
-                 slo_specs: list | None = None):
+                 slo_specs: list | None = None,
+                 disk_cache_opts: dict | None = None,
+                 prewarm_opts: dict | None = None):
         self.id = backend_id
         self._store_spec = store_spec
         self._host = host
@@ -120,6 +164,8 @@ class _ProcessBackend:
         self._spawn_timeout_s = spawn_timeout_s
         self._degrade_opts = degrade_opts
         self._slo_specs = list(slo_specs or [])
+        self._disk_cache_opts = disk_cache_opts
+        self._prewarm_opts = prewarm_opts
         self.proc: subprocess.Popen | None = None
         self.started_at = 0.0
         self._seq = 0
@@ -149,6 +195,23 @@ class _ProcessBackend:
             ladder = self._degrade_opts.get("ladder_spec", "")
             if ladder:
                 argv += ["--degrade-ladder", ladder]
+        if self._disk_cache_opts and self._disk_cache_opts.get("root"):
+            # Per-backend subdir (same reasoning as
+            # _backend_serving_extras): a shared directory would race
+            # the deterministic tmp names across processes.
+            argv += ["--disk-cache",
+                     os.path.join(self._disk_cache_opts["root"], self.id),
+                     "--disk-cache-bytes",
+                     str(self._disk_cache_opts.get("max_bytes", 1 << 30))]
+        if self._prewarm_opts and self._prewarm_opts.get("events"):
+            for path in self._prewarm_opts["events"]:
+                argv += ["--prewarm-events", path]
+            argv += ["--prewarm-top-k",
+                     str(self._prewarm_opts.get("top_k", 64)),
+                     "--prewarm-budget-s",
+                     str(self._prewarm_opts.get("budget_s", 10.0)),
+                     "--prewarm-bytes",
+                     str(self._prewarm_opts.get("budget_bytes", 64 << 20))]
         env = os.environ.copy()
         pkg_parent = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
@@ -221,7 +284,9 @@ class FleetSupervisor:
                  monitor_interval_s: float = 0.1,
                  spawn_timeout_s: float = 30.0,
                  degrade_opts: dict | None = None,
-                 slo_specs: list | None = None):
+                 slo_specs: list | None = None,
+                 disk_cache_opts: dict | None = None,
+                 prewarm_opts: dict | None = None):
         if mode not in ("process", "thread"):
             raise ValueError(f"unknown fleet mode {mode!r}")
         if mode == "process" and not store_spec:
@@ -241,6 +306,8 @@ class FleetSupervisor:
         self._spawn_timeout_s = spawn_timeout_s
         self._degrade_opts = degrade_opts
         self._slo_specs = list(slo_specs or [])
+        self._disk_cache_opts = disk_cache_opts
+        self._prewarm_opts = prewarm_opts
         self.restart_base_s = restart_base_s
         self.restart_cap_s = restart_cap_s
         self.monitor_interval_s = monitor_interval_s
@@ -284,14 +351,18 @@ class FleetSupervisor:
                 cache_bytes=self._cache_bytes,
                 max_inflight=self._backend_max_inflight,
                 render_timeout_s=self._render_timeout_s,
-                degrade_opts=self._degrade_opts)
+                degrade_opts=self._degrade_opts,
+                disk_cache_opts=self._disk_cache_opts,
+                prewarm_opts=self._prewarm_opts)
         return _ProcessBackend(
             backend_id, self._store_spec, host=self._host,
             cache_bytes=self._cache_bytes,
             max_inflight=self._backend_max_inflight,
             render_timeout_s=self._render_timeout_s, chaos=self._chaos,
             workdir=self._workdir, spawn_timeout_s=self._spawn_timeout_s,
-            degrade_opts=self._degrade_opts, slo_specs=self._slo_specs)
+            degrade_opts=self._degrade_opts, slo_specs=self._slo_specs,
+            disk_cache_opts=self._disk_cache_opts,
+            prewarm_opts=self._prewarm_opts)
 
     def stop(self):
         self._stop.set()
@@ -400,6 +471,12 @@ def backend_main(argv=None) -> int:
     parser.add_argument("--degrade-dwell", type=float, default=10.0)
     parser.add_argument("--degrade-hold", type=float, default=30.0)
     parser.add_argument("--degrade-ladder", default="")
+    parser.add_argument("--disk-cache", default=None)
+    parser.add_argument("--disk-cache-bytes", type=int, default=1 << 30)
+    parser.add_argument("--prewarm-events", action="append", default=[])
+    parser.add_argument("--prewarm-top-k", type=int, default=64)
+    parser.add_argument("--prewarm-budget-s", type=float, default=10.0)
+    parser.add_argument("--prewarm-bytes", type=int, default=64 << 20)
     args = parser.parse_args(argv)
 
     faults.install_from_env(args.chaos)
@@ -413,16 +490,26 @@ def backend_main(argv=None) -> int:
         args.degrade, args.degrade_dwell, args.degrade_hold,
         args.degrade_ladder)
     store = TileStore(args.store)
+    disk_cache = (DiskTileCache(args.disk_cache,
+                                max_bytes=args.disk_cache_bytes)
+                  if args.disk_cache else None)
+    prewarm = (PrewarmConfig(events=tuple(args.prewarm_events),
+                             top_k=args.prewarm_top_k,
+                             budget_s=args.prewarm_budget_s,
+                             budget_bytes=args.prewarm_bytes)
+               if args.prewarm_events else None)
     app = ServeApp(store, TileCache(max_bytes=args.cache_bytes),
                    max_inflight=args.max_inflight,
                    render_timeout_s=args.render_timeout,
-                   degrade=controller)
+                   degrade=controller, disk_cache=disk_cache,
+                   prewarm=prewarm)
     server = make_server(app, host=args.host, port=args.port)
     host, port = server.server_address[:2]
     tmp = args.port_file + ".tmp"
     with open(tmp, "w") as fh:
         json.dump({"host": host, "port": port, "pid": os.getpid()}, fh)
     os.replace(tmp, args.port_file)
+    _warm_in_background(app)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

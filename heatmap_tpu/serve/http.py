@@ -135,9 +135,20 @@ class ServeApp:
                  max_inflight: int | None = None,
                  retry_after_s: float = 1.0,
                  synopsis_default: bool = False,
-                 degrade: "degrade_mod.BrownoutController | None" = None):
+                 degrade: "degrade_mod.BrownoutController | None" = None,
+                 disk_cache=None, prewarm=None):
         self.store = store
         self.cache = cache if cache is not None else TileCache()
+        # Disk tier (tilefs.DiskTileCache | None): consulted by the
+        # heap cache's flight leader before rendering, write-through
+        # after — single-flight for free. Keys carry (generation,
+        # delta_epoch), so epochs invalidate structurally.
+        self.disk_cache = disk_cache
+        # Pre-warm config (tilefs.PrewarmConfig | None): replayed by
+        # prewarm_now() at startup (cli/fleet call it once bound) and
+        # after every successful /reload.
+        self.prewarm = prewarm
+        self._prewarm_last: dict | None = None
         self.render_timeout_s = render_timeout_s
         self.max_inflight = max_inflight
         self.retry_after_s = retry_after_s  # advertised on every 503
@@ -335,8 +346,35 @@ class ServeApp:
             }).encode()
             return 503, "application/json", body, None, "reload", None
         self._recover("reload")
+        # Re-warm after the swap: the new generation/delta_epoch keys
+        # are all cold, and the reload already paid the expensive part
+        # (index rebuild), so replaying the popular head now converts
+        # the first post-reload requests from misses into hits.
+        self.prewarm_now(source="reload")
         body = json.dumps({"generation": generation}).encode()
         return 200, "application/json", body, None, "reload", None
+
+    def prewarm_now(self, source: str = "startup"):
+        """Replay the configured popularity plan (tilefs.PrewarmConfig)
+        through :meth:`handle`, filling the heap + disk caches. No-op
+        without a config or recorded traffic; returns the warm summary
+        (also kept for ``/healthz``). Callers decide *when*: the cli and
+        fleet backends warm once bound, ``_handle_reload`` re-warms, and
+        a bare ServeApp never warms implicitly."""
+        cfg = self.prewarm
+        if cfg is None:
+            return None
+        from heatmap_tpu.tilefs import prewarm as prewarm_mod
+
+        plan = prewarm_mod.build_plan(cfg.events, top_k=cfg.top_k,
+                                      half_life=cfg.half_life)
+        if not plan:
+            return None
+        summary = prewarm_mod.warm(self, plan, budget_s=cfg.budget_s,
+                                   budget_bytes=cfg.budget_bytes,
+                                   source=source)
+        self._prewarm_last = summary
+        return summary
 
     # -- range queries -----------------------------------------------------
 
@@ -553,10 +591,30 @@ class ServeApp:
             key = (layer_name, z, x, y, fmt, "syn",
                    self.store.synopsis_epoch)
         render = tile_png_bytes if fmt == "png" else tile_json_bytes
+        render_fn = lambda: self._render(render, layer, z, x, y, fmt)  # noqa: E731
+        if self.disk_cache is not None:
+            # Disk tier between the heap LRU and the renderer. The heap
+            # cache's single-flight leader runs this fill, so at most
+            # one thread touches disk per key. The key folds in the
+            # store's invalidation epochs: generation retires bytes on
+            # reload/compaction, delta_epoch on every journal apply
+            # (synopsis keys already carry synopsis_epoch in `key`).
+            # A torn or missing entry reads as a miss; a failed
+            # write-through is a skipped optimization, never an error.
+            dkey = (key, self.store.generation, self.store.delta_epoch)
+            inner = render_fn
+
+            def render_fn():
+                cached = self.disk_cache.get(dkey)
+                if cached is not None:
+                    return cached
+                body = inner()
+                if body is not None:
+                    self.disk_cache.put(dkey, body)
+                return body
         try:
             body, hit = self.cache.get_or_render(
-                key, self.store.generation,
-                lambda: self._render(render, layer, z, x, y, fmt),
+                key, self.store.generation, render_fn,
                 fmt=fmt, stale_if_error=True)
         except Exception as e:
             # No last-good bytes to fall back on: typed 503, never 500.
@@ -646,6 +704,10 @@ class ServeApp:
             }
         stats["cache"] = {"entries": len(self.cache),
                           "bytes": self.cache.nbytes}
+        if self.disk_cache is not None:
+            stats["disk_cache"] = self.disk_cache.stats()
+        if self._prewarm_last is not None:
+            stats["prewarm"] = self._prewarm_last
         with self._inflight_lock:
             stats["inflight"] = self._inflight
         stats["draining"] = self._draining

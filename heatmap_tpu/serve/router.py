@@ -297,6 +297,9 @@ class BackendClient:
         # Last brownout snapshot the prober read from this backend's
         # /healthz (serve/degrade.py); None until one is seen.
         self.degrade: dict | None = None
+        # Last prewarm summary from the same probe (tilefs/prewarm.py);
+        # lets operators check cache warm-up fleet-wide from the router.
+        self.prewarm: dict | None = None
         self._lock = threading.Lock()
         self._host, self._port = host, int(port)
         self._epoch = 0
@@ -488,10 +491,15 @@ class RouterApp:
             # so the router agrees fleet-wide on the active rung
             # without a second endpoint or any push machinery.
             try:
-                snap = json.loads(body).get("degrade")
+                health = json.loads(body)
             except (ValueError, AttributeError):
-                snap = None
+                health = {}
+            if not isinstance(health, dict):
+                health = {}
+            snap = health.get("degrade")
             backend.degrade = snap if isinstance(snap, dict) else None
+            warm = health.get("prewarm")
+            backend.prewarm = warm if isinstance(warm, dict) else None
         else:
             self.note_failure(backend, "probe")
         return ok
@@ -856,6 +864,8 @@ class RouterApp:
             if backend.degrade is not None:
                 states[backend.id]["degrade_rung"] = backend.degrade.get(
                     "rung", 0)
+            if backend.prewarm is not None:
+                states[backend.id]["prewarm"] = backend.prewarm
         eligible = [bid for bid, st in states.items() if st["eligible"]]
         doc = {
             "role": "router",

@@ -10,6 +10,14 @@ Loads any batch egress artifact the job side writes —
 - ``delta:ROOT``   an incremental delta store (heatmap_tpu.delta):
                    the current base pyramid overlaid with the live
                    delta stack, additively merged on read;
+- ``tilefs:ROOT``  a zero-copy mmap'd tilefs store (heatmap_tpu.tilefs):
+                   ``tilefs-z*.bin`` column segments served straight
+                   from the kernel page cache (N backends on one host
+                   share the pyramid's pages instead of N heap copies);
+                   handles both plain converted dirs and delta-shaped
+                   roots (mmap'd base ⊕ in-heap live deltas), falling
+                   back to the sibling npz level per zoom when a tilefs
+                   file is torn — served bytes are identical either way;
 
 — into per-layer, per-detail-zoom **Morton-keyed sorted arrays**
 (tilemath/morton.py): a tile request at coarse tile (z, row, col) is a
@@ -55,7 +63,7 @@ from heatmap_tpu.tilemath.morton import morton_encode_np
 #: Store spec kinds ``TileStore`` accepts (subset of the sink kinds —
 #: the batch egress surfaces that persist to disk — plus the delta
 #: store overlay).
-STORE_KINDS = ("arrays", "jsonl", "dir", "delta")
+STORE_KINDS = ("arrays", "jsonl", "dir", "delta", "tilefs")
 
 
 class Level:
@@ -86,6 +94,23 @@ class Level:
 
     def __len__(self):
         return len(self.codes)
+
+
+class MappedLevel(Level):
+    """Zero-copy Level over tilefs mmap column views.
+
+    The writer already applied Level's stable argsort-by-code, so the
+    views are used verbatim, and vmax comes from the footer index —
+    construction touches no data pages; the kernel faults them in only
+    when a tile's Morton range is actually probed."""
+
+    __slots__ = ()
+
+    def __init__(self, zoom: int, codes, values, vmax: float):
+        self.zoom = int(zoom)
+        self.codes = codes
+        self.values = values
+        self.vmax = float(vmax)
 
 
 class SynopsisView:
@@ -162,9 +187,22 @@ def _parse_store_spec(spec: str) -> tuple[str, str]:
     if spec.endswith((".jsonl", ".ndjson")):
         return "jsonl", spec
     if os.path.isdir(spec):
+        from heatmap_tpu.tilefs.format import sniff_tilefs
+
         names = os.listdir(spec)
         if "CURRENT" in names or "journal" in names:
+            # A converted delta store (tilefs files in the CURRENT
+            # base) serves zero-copy by default — byte-identity makes
+            # the mmap path a pure speedup, never a behavior change.
+            from heatmap_tpu.delta.compact import read_current
+
+            cur = read_current(spec)
+            if cur.get("base") and sniff_tilefs(
+                    os.path.join(spec, cur["base"])):
+                return "tilefs", spec
             return "delta", spec
+        if sniff_tilefs(spec):
+            return "tilefs", spec
         if any(n.startswith("level_z") for n in names) or any(
                 n.startswith("host") and
                 os.path.isdir(os.path.join(spec, n)) for n in names):
@@ -174,6 +212,18 @@ def _parse_store_spec(spec: str) -> tuple[str, str]:
         f"unrecognized store spec {spec!r}: kind must be one of "
         f"{', '.join(STORE_KINDS)} (e.g. arrays:levels/)"
     )
+
+
+def _live_delta_epoch(root: str, cur: dict) -> int:
+    """Newest epoch visible in a delta-shaped store: max of CURRENT's
+    ``applied_through`` and the live journal head. The disk cache tier
+    keys rendered bytes on this, so every apply invalidates exactly the
+    epoch's worth of entries while compaction (which folds the head
+    into ``applied_through`` without changing it) invalidates none."""
+    from heatmap_tpu.delta.compact import live_entries
+
+    epochs = [int(e["epoch"]) for e in live_entries(root)]
+    return max([int(cur.get("applied_through", 0) or 0)] + epochs)
 
 
 def _combine_cells(codes: np.ndarray, values: np.ndarray):
@@ -255,6 +305,13 @@ class TileStore:
         # decoded from (exact tiles keep the cheaper generation +
         # targeted-invalidation scheme).
         self.synopsis_epoch = 0
+        # Delta-apply token for the disk cache tier: the newest epoch
+        # visible in the store (max of CURRENT's applied_through and
+        # the live journal head) for delta-shaped roots, 0 otherwise.
+        # Invariant across compaction — the fold sets applied_through
+        # to the epoch of the newest delta it consumed — so disk-cached
+        # renders survive compaction but can never outlive an apply.
+        self.delta_epoch = 0
         self._layers: dict[str, Layer] = {}
         self.reload(_initial=True)
 
@@ -318,6 +375,7 @@ class TileStore:
     def _build(self) -> dict[str, Layer]:
         syn_dir: str | None = None
         delta_dirs: list[str] = []
+        delta_epoch = 0
         if self.kind == "arrays":
             by_pair = self._build_from_levels(_load_levels(self.path))
             syn_dir = self.path
@@ -325,15 +383,42 @@ class TileStore:
             from heatmap_tpu.delta.compact import (load_overlay_levels,
                                                    overlay_dirs,
                                                    read_current)
+            from heatmap_tpu.tilefs import sniff_tilefs
 
-            by_pair = self._build_from_levels(
-                _finalized_to_loaded(load_overlay_levels(self.path)))
             cur = read_current(self.path)
+            delta_epoch = _live_delta_epoch(self.path, cur)
             if cur.get("base"):
                 syn_dir = os.path.join(self.path, cur["base"])
                 delta_dirs = [
                     d for d in overlay_dirs(self.path)
                     if os.path.normpath(d) != os.path.normpath(syn_dir)]
+            if syn_dir is not None and sniff_tilefs(syn_dir):
+                # A converted base serves zero-copy even under the
+                # explicit delta: spec — same bytes, mmap'd pages.
+                by_pair = self._build_from_tilefs(syn_dir, delta_dirs)
+            else:
+                by_pair = self._build_from_levels(
+                    _finalized_to_loaded(load_overlay_levels(self.path)))
+        elif self.kind == "tilefs":
+            names = (os.listdir(self.path)
+                     if os.path.isdir(self.path) else [])
+            if "CURRENT" in names or "journal" in names:
+                from heatmap_tpu.delta.compact import (overlay_dirs,
+                                                       read_current)
+
+                cur = read_current(self.path)
+                delta_epoch = _live_delta_epoch(self.path, cur)
+                base = (os.path.join(self.path, cur["base"])
+                        if cur.get("base") else None)
+                delta_dirs = [
+                    d for d in overlay_dirs(self.path)
+                    if base is None
+                    or os.path.normpath(d) != os.path.normpath(base)]
+                by_pair = self._build_from_tilefs(base, delta_dirs)
+                syn_dir = base
+            else:
+                by_pair = self._build_from_tilefs(self.path, [])
+                syn_dir = self.path
         else:
             by_pair = self._build_from_blobs(
                 _iter_blob_records(self.kind, self.path))
@@ -357,7 +442,152 @@ class TileStore:
                         f"{sorted('|'.join(p) for p in by_pair)}"
                     )
                 named[name] = layer
+        self.delta_epoch = delta_epoch
         return named
+
+    def _build_from_tilefs(self, base_dir: str | None,
+                           delta_dirs: list[str]) -> dict:
+        """mmap'd base ⊕ in-heap live deltas, byte-identical to the
+        heap merge.
+
+        Pairs untouched by any delta serve :class:`MappedLevel` views
+        straight off the page cache (zero copies, zero data pages
+        faulted at build time). Pairs a delta touched are composed in
+        the exact order the heap path sums them — base rows first, then
+        deltas oldest-first, stable-sorted by code, ``np.add.reduceat``
+        per cell, exact zeros dropped — so float summation order (and
+        therefore every served byte) matches ``load_overlay_levels``.
+        A torn/unreadable tilefs file falls back to the sibling npz
+        levels for that zoom; the recovery sweep owns quarantining it.
+        """
+        from heatmap_tpu.tilefs import format as tilefs_format
+
+        # Live delta rows per (zoom, pair), in overlay (oldest-first)
+        # order — the summation order the heap merge uses.
+        delta_rows: dict[int, dict[tuple, list]] = {}
+        delta_rd: dict[int, int] = {}
+        for d in delta_dirs:
+            try:
+                loaded = LevelArraysSink.load(d)
+            except OSError:
+                continue
+            for zoom, cols in loaded.items():
+                zoom = int(zoom)
+                users = np.asarray(cols["user"], str)
+                tss = np.asarray(cols["timespan"], str)
+                codes = morton_encode_np(
+                    np.asarray(cols["row"], np.int64),
+                    np.asarray(cols["col"], np.int64))
+                values = np.asarray(cols["value"], np.float64)
+                delta_rd[zoom] = int(cols["zoom"]) - int(
+                    cols["coarse_zoom"])
+                pair_key = np.char.add(np.char.add(users, "|"), tss)
+                for pk in np.unique(pair_key):
+                    sel = pair_key == pk
+                    user, _, ts = str(pk).partition("|")
+                    delta_rows.setdefault(zoom, {}).setdefault(
+                        (user, ts), []).append((codes[sel], values[sel]))
+
+        tilefs_files = (tilefs_format.list_tilefs(base_dir)
+                        if base_dir else {})
+        npz_zooms = set()
+        if base_dir and os.path.isdir(base_dir):
+            for name in os.listdir(base_dir):
+                if name.startswith("level_z") or (
+                        name.startswith("host")
+                        and os.path.isdir(os.path.join(base_dir, name))):
+                    npz_zooms.add(name)
+        heap_cols: dict[int, dict] | None = None
+
+        def heap_zoom(zoom: int):
+            # Lazy: the npz dir is only loaded when a zoom has no
+            # servable tilefs file (partial conversion or a torn one).
+            nonlocal heap_cols
+            if heap_cols is None:
+                heap_cols = (_load_levels(base_dir)
+                             if base_dir and npz_zooms else {})
+            return heap_cols.get(zoom)
+
+        by_pair: dict[tuple, Layer] = {}
+
+        def compose(zoom: int, parts: list) -> Level:
+            codes = np.concatenate([p[0] for p in parts])
+            values = np.concatenate([p[1] for p in parts])
+            order = np.argsort(codes, kind="stable")
+            codes, values = codes[order], values[order]
+            uniq, starts = np.unique(codes, return_index=True)
+            sums = (np.add.reduceat(values, starts)
+                    if len(values) else values)
+            keep = sums != 0.0  # retraction zeros, like drop_zero_rows
+            return Level(zoom, uniq[keep], sums[keep])
+
+        all_zooms = sorted(set(tilefs_files) | set(delta_rows))
+        if npz_zooms:
+            # Partially converted dirs: heap levels may carry zooms the
+            # tilefs mirrors don't (and vice versa).
+            if heap_cols is None:
+                heap_cols = _load_levels(base_dir)
+            all_zooms = sorted(set(all_zooms) | set(heap_cols))
+        for zoom in all_zooms:
+            reader = None
+            if zoom in tilefs_files:
+                from heatmap_tpu import faults
+
+                try:
+                    reader = tilefs_format.open_tilefs(tilefs_files[zoom])
+                except (tilefs_format.TilefsError, faults.InjectedFault):
+                    # Torn file, or an injected tilefs.read fault
+                    # (retries=0 by policy): either way the sibling
+                    # npz level serves this zoom, bytes unchanged.
+                    reader = None
+            zoom_deltas = dict(delta_rows.get(zoom, {}))
+            if reader is not None:
+                rd = reader.zoom - reader.coarse_zoom
+                for seg in reader.pairs:
+                    pair = (seg["user"], seg["timespan"])
+                    codes, values = reader.arrays(seg)
+                    layer = by_pair.setdefault(
+                        pair, Layer(pair[0], pair[1], rd))
+                    extra = zoom_deltas.pop(pair, None)
+                    if extra:
+                        layer.levels[zoom] = compose(
+                            zoom, [(codes, values)] + extra)
+                    else:
+                        layer.levels[zoom] = MappedLevel(
+                            zoom, codes, values, float(seg["vmax"]))
+            else:
+                cols = heap_zoom(zoom)
+                rd = (int(cols["zoom"]) - int(cols["coarse_zoom"])
+                      if cols is not None else delta_rd.get(zoom))
+                if cols is not None:
+                    users = np.asarray(cols["user"], str)
+                    tss = np.asarray(cols["timespan"], str)
+                    codes = morton_encode_np(
+                        np.asarray(cols["row"], np.int64),
+                        np.asarray(cols["col"], np.int64))
+                    values = np.asarray(cols["value"], np.float64)
+                    pair_key = np.char.add(np.char.add(users, "|"), tss)
+                    for pk in np.unique(pair_key):
+                        sel = pair_key == pk
+                        user, _, ts = str(pk).partition("|")
+                        pair = (user, ts)
+                        layer = by_pair.setdefault(
+                            pair, Layer(user, ts, rd))
+                        extra = zoom_deltas.pop(pair, None)
+                        if extra:
+                            layer.levels[zoom] = compose(
+                                zoom, [(codes[sel], values[sel])] + extra)
+                        else:
+                            layer.levels[zoom] = Level(
+                                zoom, codes[sel], values[sel])
+            # Pairs present only in live deltas at this zoom.
+            for pair, parts in zoom_deltas.items():
+                rd_pair = (reader.zoom - reader.coarse_zoom
+                           if reader is not None else delta_rd.get(zoom))
+                layer = by_pair.setdefault(
+                    pair, Layer(pair[0], pair[1], rd_pair))
+                layer.levels[zoom] = compose(zoom, parts)
+        return by_pair
 
     def _build_from_levels(self, levels: dict[int, dict]) -> dict:
         by_pair: dict[tuple, Layer] = {}
@@ -580,8 +810,10 @@ class TileStore:
         """Small JSON-ready summary for /healthz."""
         return {
             "spec": self.spec,
+            "kind": self.kind,
             "generation": self.generation,
             "synopsis_epoch": self.synopsis_epoch,
+            "delta_epoch": self.delta_epoch,
             "layers": {
                 name: {
                     "user": layer.user,

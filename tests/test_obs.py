@@ -98,6 +98,9 @@ _PAYLOADS = {
     "incident_flush": {"trigger": "shed", "path": "incidents/ab12-0",
                        "seq": 0, "detail": "in-flight bound 2",
                        "bytes": 4096},
+    "prewarm_done": {"keys": 12, "seconds": 0.8, "bytes": 65536,
+                     "errors": 0, "planned": 16,
+                     "budget_exhausted": False, "source": "startup"},
     "run_end": {"status": "ok", "blobs": 42, "checksum": "crc32:00000000",
                 "seconds": 1.0},
 }
@@ -617,6 +620,23 @@ class TestNoRawInstrumentation:
             len(sanctioned) == 1
             and sanctioned[0].startswith("heatmap_tpu/obs/tracing.py:"))
 
+    def test_tilefs_tree_is_guarded(self):
+        """The tilefs/ package sits on the serve path twice over (mmap
+        store reads, disk-cache fills) and replays requests at startup
+        (prewarm) — ad-hoc warm-progress prints or hand-rolled fill
+        timing would bypass the obs discipline: pin that the tree
+        exists, is scanned by the walks above, and is not allowed."""
+        tfs = os.path.join(REPO, "heatmap_tpu", "tilefs")
+        assert os.path.isdir(tfs)
+        scanned = [f for f in os.listdir(tfs) if f.endswith(".py")]
+        assert "format.py" in scanned and "diskcache.py" in scanned
+        assert "prewarm.py" in scanned
+        assert not any(a.startswith("heatmap_tpu/tilefs")
+                       for a in self.ALLOWED)
+        assert not any(a.startswith("heatmap_tpu/tilefs")
+                       for a in self.SLEEP_ALLOWED)
+        assert self.PATTERN.search("print('prewarmed 64 keys')")
+
     def test_synopsis_tree_is_guarded(self):
         """The synopsis/ package sits on the serve decode path — ad-hoc
         decode timing or build-progress prints would bypass the obs
@@ -639,7 +659,7 @@ class TestNoRawInstrumentation:
                 "heatmap_tpu/serve/http.py", "heatmap_tpu/serve/cache.py",
                 "heatmap_tpu/serve/router.py",
                 "heatmap_tpu/serve/degrade.py", "heatmap_tpu/synopsis/",
-                "heatmap_tpu/analytics/")
+                "heatmap_tpu/analytics/", "heatmap_tpu/tilefs/")
     JAX_IMPORT = re.compile(r"^(?:import jax\b|from jax\b)")
 
     def test_decode_path_has_no_module_level_jax(self):

@@ -11,8 +11,12 @@ Reads every bench artifact the repo's tooling writes —
 - ``BENCH_serve.json``  (tools/load_gen.py): rps (higher) and p99
   latency ms (lower), plus the fleet scaling curve
   (``serve:fleet:rps[N]`` / ``p99_ms[N]``), kill-one-backend
-  availability when ``--fleet`` was run, and the flight-recorder A/B
-  tax (``obs:recorder_overhead_pct``, lower, noise-floored at 5%);
+  availability when ``--fleet`` was run, the flight-recorder A/B
+  tax (``obs:recorder_overhead_pct``, lower, noise-floored at 5%),
+  and — when ``--cold-vs-warm`` ran — the tilefs restart A/B
+  (``serve:cold_p99_ms[cold|warmed]`` lower, the cold/warmed
+  ``serve:cold_warm_speedup`` higher) plus the mapped/heap fleet
+  memory ratio (``serve:fleet_rss_ratio``, lower);
 - ``BENCH_adaptive.json`` (tools/load_gen.py --adaptive): overload-
   stage availability for the brownout ramp, controller on and off
   (``adaptive:availability[on|off]``, higher), and the hot-stage p99
@@ -141,6 +145,23 @@ def snapshot_metrics(root: str) -> dict:
         if isinstance(pct, (int, float)):
             out["obs:recorder_overhead_pct"] = (max(float(pct), 5.0),
                                                 False)
+        # tilefs cold-vs-warmed restart A/B (load_gen --cold-vs-warm):
+        # first-touch p99 for both legs, the cold/warmed speedup (the
+        # ISSUE bar is warmed materially below cold — a shrinking
+        # speedup means the disk tier + prewarm stopped earning their
+        # keep), and the fleet Pss ratio of N mmap'd backends vs N
+        # heap backends (sub-linear fleet memory; lower is better).
+        cw = doc.get("cold_warm") or {}
+        for leg in ("cold", "warmed"):
+            p99 = ((cw.get(leg) or {}).get("latency_ms") or {}).get("p99")
+            if isinstance(p99, (int, float)):
+                out[f"serve:cold_p99_ms[{leg}]"] = (float(p99), False)
+        if isinstance(cw.get("speedup_p99"), (int, float)):
+            out["serve:cold_warm_speedup"] = (float(cw["speedup_p99"]),
+                                              True)
+        ratio = (doc.get("fleet_rss") or {}).get("pss_ratio")
+        if isinstance(ratio, (int, float)):
+            out["serve:fleet_rss_ratio"] = (float(ratio), False)
     doc = _load(os.path.join(root, "BENCH_adaptive.json"))
     if isinstance(doc, dict):
         # Brownout ramp (load_gen --adaptive): availability over the

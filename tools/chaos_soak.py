@@ -25,7 +25,13 @@ synopses, and no request may see a 500. A query phase does the same to
 an integral-histogram artifact: the sweep must quarantine the torn
 integral and its orphaned staging tmp, /query must fall through to
 exact level rows with answers identical modulo the path marker, and
-the surviving zooms must keep their O(1) fast path. An adaptive phase
+the surviving zooms must keep their O(1) fast path. A tilefs phase
+serves a converted store zero-copy through the disk render cache while
+``tilefs.read`` faults force per-zoom npz fallbacks mid-reload,
+``diskcache.write`` faults skip fills, a torn disk-cache entry must
+read as a miss, and a torn mirror + crashed staging tmp must be
+quarantined — bytes identical to heap serving at every step, never a
+500 (heatmap_tpu.tilefs, docs/tilefs.md). An adaptive phase
 scripts one
 overload episode against the brownout controller (serve/degrade.py)
 under a fake clock: the ladder must step up 0->1->2->3 and walk back
@@ -833,6 +839,125 @@ def phase_query(ctx):
             "codes": {str(k): v for k, v in sorted(codes.items())}}
 
 
+def phase_tilefs(ctx):
+    """tilefs chaos (heatmap_tpu.tilefs): a converted store serving
+    zero-copy through the disk render cache while the fault plane fires
+    on both new sites. Requirements: bytes identical to heap (npz)
+    serving at every step — clean, with ``tilefs.read`` faults forcing
+    per-zoom npz fallbacks mid-reload and ``diskcache.write`` faults
+    skipping fills, after a torn disk-cache entry (reads as a miss that
+    refills), and after a torn mirror + crashed staging tmp that the
+    recovery sweep must quarantine — and no request ever sees a 500."""
+    from heatmap_tpu.delta.recover import sweep
+    from heatmap_tpu.io import open_sink
+    from heatmap_tpu.tilefs import DiskTileCache, sniff_tilefs
+    from heatmap_tpu.tilefs.diskcache import DISK_CACHE_TORN
+
+    faults.install(None)
+    obs.enable_metrics(True)  # the torn-entry check reads a counter
+    root = os.path.join(os.path.dirname(ctx["base_root"]), "store-tilefs")
+    bdir = os.path.join(root, "base-000001")
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                         result_delta=2)
+    with open_sink(f"arrays-tilefs:{bdir}") as sink:
+        run_job(SyntheticSource(ctx["n"], seed=5), sink, cfg)
+    with open(os.path.join(root, "CURRENT"), "w") as f:
+        json.dump({"schema": "heatmap-tpu.delta_store.v1",
+                   "base": "base-000001", "applied_through": 1,
+                   "config": None}, f)
+    assert sniff_tilefs(bdir), "arrays-tilefs sink left no mirrors"
+
+    # Heap truth: the same base read through the explicit arrays kind
+    # (npz only — the bare path would sniff the mirrors right back).
+    heap_app = ServeApp(TileStore(f"arrays:{bdir}"), TileCache())
+    store = TileStore(root)  # bare path sniffs the tilefs kind
+    assert store.stats()["kind"] == "tilefs", store.stats()
+    disk_root = os.path.join(root, "diskcache")
+    app = ServeApp(store, TileCache(),
+                   disk_cache=DiskTileCache(disk_root))
+
+    layer = store.layer("default")
+    dz = layer.result_delta
+    paths = []
+    for d in sorted(layer.detail_zooms):
+        z = d - dz
+        if z < 0:
+            continue
+        coarse = np.unique(layer.levels[d].codes >> np.int64(2 * dz))
+        rows, cols = morton_decode_np(coarse)
+        paths += [f"/tiles/default/{z}/{int(c)}/{int(r)}.{fmt}"
+                  for r, c in zip(rows, cols)
+                  for fmt in ("json", "png")]
+    paths = paths[:48]
+
+    codes: dict = {}
+
+    def identical(note):
+        for p in paths:
+            a = heap_app.handle("GET", p)
+            b = app.handle("GET", p)
+            codes[b[0]] = codes.get(b[0], 0) + 1
+            assert a[0] == b[0] == 200, (note, p, a[0], b[0])
+            assert a[2] == b[2], (note, "bytes diverged", p)
+
+    # 1. Clean pass: mmap'd serving matches heap, disk tier fills.
+    identical("clean")
+    assert app.disk_cache.stats()["entries"] > 0, "disk tier never filled"
+
+    # 2. Torn disk-cache entry: truncate one published entry, drop the
+    #    heap cache so the disk tier is actually consulted — the torn
+    #    entry must read as a miss (unlinked + refilled), never bytes.
+    victims = [os.path.join(dp, fn) for dp, _dirs, fns
+               in os.walk(disk_root) for fn in fns
+               if not fn.startswith(".tmp-")]
+    with open(victims[0], "r+b") as f:
+        f.truncate(7)
+    torn0 = DISK_CACHE_TORN.value()
+    app.cache.clear()
+    identical("after torn disk-cache entry")
+    assert DISK_CACHE_TORN.value() > torn0, "torn entry never detected"
+    # ... and the refill re-published a whole entry under the same key.
+    assert os.path.getsize(victims[0]) > 7, "torn entry never refilled"
+
+    # 3. Fault plane on both new sites: tilefs.read fires during the
+    #    reload's per-zoom opens (retries=0 by policy — each faulted
+    #    zoom must fall back to its sibling npz level), diskcache.write
+    #    fires on the refills (a skipped fill, never an error).
+    faults.install_spec(
+        "seed=17,scale=0,tilefs.read=3x2,diskcache.write=6x2")
+    try:
+        store.reload()
+        app.cache.clear()
+        identical("under fault plane (mixed mmap/npz zooms)")
+    finally:
+        faults.install(None)
+    store.reload()
+    identical("recovered (all zooms mapped again)")
+
+    # 4. Torn mirror + crashed staging tmp: the sweep quarantines both,
+    #    and the reloaded store serves the torn zoom from npz.
+    mirrors = sorted(n for n in os.listdir(bdir)
+                     if n.startswith("tilefs-z") and n.endswith(".bin"))
+    victim = mirrors[len(mirrors) // 2]
+    with open(os.path.join(bdir, victim), "r+b") as f:
+        f.write(b"torn mid-write")
+    with open(os.path.join(bdir, "tilefs-z99.bin.tmp"), "wb") as f:
+        f.write(b"crashed staging")
+    swept = sweep(root)
+    reasons = sorted(i["reason"] for i in swept["quarantined"])
+    assert reasons == ["orphan_tmp", "torn_tilefs"], reasons
+    kinds = sorted(i["kind"] for i in swept["quarantined"])
+    assert kinds == ["tilefs", "tilefs"], kinds
+    store.reload()
+    identical("after torn mirror (npz fallback)")
+
+    assert codes.get(500, 0) == 0, f"500s observed: {codes}"
+    return {"paths": len(paths), "torn_mirror": victim,
+            "quarantined": reasons,
+            "disk_cache": app.disk_cache.stats(),
+            "codes": {str(k): v for k, v in sorted(codes.items())}}
+
+
 def phase_incident(ctx):
     """Flight-recorder incident discipline under a seeded fault storm:
     12 injected ``tile.render`` faults inside request-shaped shadow
@@ -1067,6 +1192,7 @@ PHASES = [
     ("backend_loss", phase_backend_loss),
     ("synopsis", phase_synopsis),
     ("query", phase_query),
+    ("tilefs", phase_tilefs),
     ("incident", phase_incident),
     ("adaptive", phase_adaptive),
     ("byte_equality", phase_byte_equality),

@@ -33,6 +33,14 @@ the same closed-loop clients run an overload ramp against a small
 admission bound, once with the degradation ladder off and once on,
 recording availability and the exact/synopsis/shed fidelity split per
 stage (docs/robustness.md, serve/degrade.py).
+
+``--cold-vs-warm`` switches to the tilefs restart A/B (docs/tilefs.md,
+heatmap_tpu.tilefs): first-touch sweep latency on a fresh server with
+no warm tiers vs a fresh server restarting over a filled disk cache
+with a prewarm replay of the hot head, plus the fleet Pss probe
+(tools/mem_probe.py) of N mmap'd backends vs N heap backends. Merges
+``cold_warm`` / ``fleet_rss`` blocks into BENCH_serve.json next to the
+closed-loop record.
 """
 
 from __future__ import annotations
@@ -234,6 +242,141 @@ def _warm(base_url: str, universe):
         conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
         conn.getresponse().read()
     conn.close()
+
+
+def _sweep_latencies(host, port, universe):
+    """Sequential sweep with per-request wall ms (keep-alive); sorted.
+    Unlike the closed-loop Worker this touches every tile exactly once,
+    so a fresh server's sweep IS its first-touch (cold) distribution."""
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    out = []
+    for layer, z, x, y, fmt in universe:
+        t0 = time.perf_counter()
+        conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
+        conn.getresponse().read()
+        out.append((time.perf_counter() - t0) * 1e3)
+    conn.close()
+    return np.sort(np.asarray(out))
+
+
+def _write_request_log(path: str, universe, hot):
+    """Synthesize the ``http_request`` event log the prewarm planner
+    replays: one pass over the whole universe, then repeated passes
+    over the hot head, so the planner's recency-decayed scores rank the
+    head first — the same 80/20 shape the closed-loop Worker drives,
+    but deterministic instead of sampled."""
+    from heatmap_tpu import obs
+
+    with obs.EventLog(path) as log:
+        for pass_set in (universe, hot, hot, hot):
+            for layer, z, x, y, fmt in pass_set:
+                log.emit("http_request", route="tiles",
+                         path=f"/tiles/{layer}/{z}/{x}/{y}.{fmt}",
+                         status=200, ms=1.0)
+
+
+def _cold_warm_bench(args, tmpdir: str) -> dict:
+    """``--cold-vs-warm``: the tilefs serving-tier A/B
+    (heatmap_tpu.tilefs, docs/tilefs.md) for BENCH_serve.json. Three
+    servers over the same mmap'd store:
+
+    - cold: fresh process state, no disk tier, no prewarm — every
+      request renders from the pyramid (the post-deploy worst case);
+    - prep: sweeps the universe once through a disk cache to fill it,
+      then is thrown away (a restart, as far as the tiers can tell);
+    - warmed: fresh process state again, same disk cache root, prewarm
+      replay of the hot head into the heap cache before the sweep.
+
+    Both measured legs are sequential first-touch sweeps over the SAME
+    universe, so warmed-vs-cold isolates exactly what the disk tier +
+    prewarm buy across a restart. Also embeds the fleet Pss probe
+    (tools/mem_probe.py): N mapped backends vs N heap backends over
+    the same store dir — sub-linear fleet memory is the mmap story's
+    other half.
+    """
+    from heatmap_tpu.serve import (ServeApp, TileCache, TileStore,
+                                   serve_in_thread)
+    from heatmap_tpu.tilefs import DiskTileCache, PrewarmConfig
+
+    # Two views of one artifact: the arrays-tilefs sink writes npz
+    # levels AND tilefs mirrors into the same dir, so the heap and
+    # mapped legs differ only in how they read it. A caller-supplied
+    # --store must be an arrays:DIR whose dir carries tilefs mirrors
+    # (tools/tilefs_convert.py adds them in place).
+    heap_spec = args.store
+    store_dir = heap_spec.split(":", 1)[1]
+    mapped_spec = f"tilefs:{store_dir}"
+
+    universe = tile_universe(TileStore(mapped_spec), args.tiles)
+    if not universe:
+        raise SystemExit("store has no blob-bearing tiles")
+    hot = universe[:max(1, len(universe) // 5)]
+
+    def leg(disk_cache=None, prewarm=None):
+        app = ServeApp(TileStore(mapped_spec),
+                       TileCache(max_bytes=args.cache_bytes),
+                       disk_cache=disk_cache, prewarm=prewarm)
+        server, _base = serve_in_thread(app)
+        host, port = server.server_address[:2]
+        summary = app.prewarm_now(source="startup") if prewarm else None
+        lat = _sweep_latencies(host, port, universe)
+        server.shutdown()
+        server.server_close()
+        return lat, summary, app
+
+    cold_lat, _, _ = leg()
+
+    disk_root = os.path.join(tmpdir, "diskcache")
+    events = os.path.join(tmpdir, "prewarm-events.jsonl")
+    _write_request_log(events, universe, hot)
+    _prep_lat, _, prep_app = leg(disk_cache=DiskTileCache(disk_root))
+    disk_stats = prep_app.disk_cache.stats()
+
+    cfg = PrewarmConfig(events=(events,), top_k=len(hot),
+                        budget_s=60.0, budget_bytes=256 << 20)
+    warm_lat, warm_summary, _ = leg(disk_cache=DiskTileCache(disk_root),
+                                    prewarm=cfg)
+
+    cold, warmed = _lat_summary(cold_lat), _lat_summary(warm_lat)
+    speedup = (round(cold["p99"] / warmed["p99"], 2)
+               if cold["p99"] and warmed["p99"] else None)
+    print(json.dumps({"cold_p99_ms": cold["p99"],
+                      "warmed_p99_ms": warmed["p99"],
+                      "speedup_p99": speedup}), flush=True)
+
+    import mem_probe  # sibling script; tools/ is sys.path[0] here
+
+    paths = [f"/tiles/{layer}/{z}/{x}/{y}.{fmt}"
+             for layer, z, x, y, fmt in universe]
+    mapped = mem_probe.measure_fleet_pss(mapped_spec, args.rss_backends,
+                                         paths)
+    heap = mem_probe.measure_fleet_pss(heap_spec, args.rss_backends,
+                                       paths)
+    ratio = (round(mapped["total_mb"] / heap["total_mb"], 4)
+             if mapped["total_mb"] and heap["total_mb"] else None)
+    print(json.dumps({"fleet_rss_ratio": ratio,
+                      "mapped_mb": mapped["total_mb"],
+                      "heap_mb": heap["total_mb"]}), flush=True)
+
+    return {
+        "cold_warm": {
+            "store": mapped_spec,
+            "tiles": len(universe),
+            "hot_tiles": len(hot),
+            "cold": {"latency_ms": cold},
+            "warmed": {"latency_ms": warmed,
+                       "prewarm": warm_summary,
+                       "disk_cache": disk_stats},
+            "speedup_p99": speedup,
+        },
+        "fleet_rss": {
+            "n": args.rss_backends,
+            "mapped": mapped,
+            "heap": heap,
+            "pss_ratio": ratio,
+            "source": mapped["source"],
+        },
+    }
 
 
 def _recorder_overhead(host, port, universe, passes: int = 3) -> dict:
@@ -516,6 +659,14 @@ def main() -> int:
     ap.add_argument("--adaptive-inflight", type=int, default=4,
                     help="server admission bound for the ramp (small on "
                     "purpose: the hot stages must actually overload)")
+    ap.add_argument("--cold-vs-warm", action="store_true",
+                    help="run the tilefs cold-vs-warmed restart A/B + "
+                    "fleet Pss probe instead of the closed-loop bench; "
+                    "merges cold_warm / fleet_rss blocks into --out "
+                    "without clobbering a prior serve record "
+                    "(docs/tilefs.md)")
+    ap.add_argument("--rss-backends", type=int, default=3,
+                    help="backends per fleet Pss leg (--cold-vs-warm)")
     # --drive mode internals (subprocess client; not for direct use).
     ap.add_argument("--drive", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--universe-file", default=None, help=argparse.SUPPRESS)
@@ -525,6 +676,44 @@ def main() -> int:
 
     if args.drive:
         return _drive(args)
+
+    if args.cold_vs_warm:
+        import shutil
+
+        from heatmap_tpu import obs
+
+        obs.enable_metrics(True)
+        cw_tmp = tempfile.mkdtemp(prefix="loadgen-cw-")
+        try:
+            if args.store is None:
+                t0 = time.perf_counter()
+                args.store = synth_store(cw_tmp, args.n_points,
+                                         sink="arrays-tilefs")
+                print(json.dumps({
+                    "stage": "synth_store", "spec": args.store,
+                    "s": round(time.perf_counter() - t0, 2)}), flush=True)
+            blocks = _cold_warm_bench(args, cw_tmp)
+        finally:
+            shutil.rmtree(cw_tmp, ignore_errors=True)
+        # Merge, don't overwrite: the standard serve record (rps/p99/
+        # fleet curve) and this A/B share BENCH_serve.json, and the
+        # bench gate folds series from both.
+        doc: dict = {}
+        if os.path.exists(args.out):
+            try:
+                with open(args.out) as f:
+                    loaded = json.load(f)
+            except (OSError, ValueError):
+                loaded = None
+            if isinstance(loaded, dict):
+                doc = loaded
+        doc.setdefault("bench", "serve")
+        doc.update(blocks)
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, default=str)
+            f.write("\n")
+        print(json.dumps({"wrote": args.out}), flush=True)
+        return 0
 
     from heatmap_tpu import obs
     from heatmap_tpu.serve import ServeApp, TileCache, TileStore, serve_in_thread

@@ -17,6 +17,19 @@ Usage:
 
 The probe is CPU-only (forces jax_platforms=cpu): merge behavior is
 host-side; no relay needed.
+
+``--fleet-rss SPEC`` switches to the serve-fleet memory probe
+(heatmap_tpu.tilefs): spawn N real backend processes over the spec,
+sweep the tile universe against every backend so store pages actually
+fault in, and report the fleet's total Pss from
+``/proc/<pid>/smaps_rollup`` — Pss, not Rss, because the mmap'd tilefs
+store's whole point is that N backends *share* the page-cache copy of
+the level arrays, and Pss divides shared pages by their mapper count
+while Rss would charge every backend the full store. Pass
+``--fleet-rss-heap SPEC`` too and the probe prints both legs plus the
+mapped/heap ratio (sub-linear fleet memory is the tilefs acceptance
+claim; tools/load_gen.py --cold-vs-warm embeds the same measurement in
+BENCH_serve.json as ``serve:fleet_rss_ratio``).
 """
 
 from __future__ import annotations
@@ -93,6 +106,96 @@ def run_mode(hmpb: str, mode: str, chunk: int, work: str) -> dict:
     return rec
 
 
+def pss_kb(pid: int) -> tuple:
+    """``(kilobytes, source)`` for one process: Pss from smaps_rollup
+    (shared file pages split across their mappers — the honest number
+    for an mmap'd fleet), falling back to VmRSS where the kernel lacks
+    the rollup file, ``(None, "unavailable")`` off-Linux."""
+    try:
+        with open(f"/proc/{pid}/smaps_rollup") as f:
+            for line in f:
+                if line.startswith("Pss:"):
+                    return int(line.split()[1]), "pss"
+    except OSError:
+        pass
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]), "rss"
+    except OSError:
+        pass
+    return None, "unavailable"
+
+
+def measure_fleet_pss(spec: str, n: int, paths, *,
+                      cache_bytes: int = 8 << 20) -> dict:
+    """Total proportional RSS of ``n`` real backend processes serving
+    ``spec`` after each has answered every path in ``paths``.
+
+    Sweeps every backend *directly* (not through the router) so all of
+    them fault the same store pages in — least-load routing would leave
+    the measurement at the mercy of which backend won each request. The
+    heap tile cache is kept small on purpose: the probe measures the
+    store's memory, not cached render bytes.
+    """
+    from heatmap_tpu.serve.fleet import FleetSupervisor
+
+    import http.client as http_client
+
+    rows = []
+    with FleetSupervisor(spec, n, cache_bytes=cache_bytes,
+                         probe_interval_s=0.25) as sup:
+        sup.start()
+        for bid in sorted(sup.router.backends):
+            client = sup.router.backends[bid]
+            host, port = client.address.rsplit(":", 1)
+            conn = http_client.HTTPConnection(host, int(port), timeout=30)
+            for p in paths:
+                conn.request("GET", p)
+                conn.getresponse().read()
+            conn.close()
+        for bid in sorted(sup._handles):
+            proc = getattr(sup._handles[bid], "proc", None)
+            if proc is None:  # thread-mode fleet: nothing to attribute
+                continue
+            kb, source = pss_kb(proc.pid)
+            rows.append({"backend": bid, "pid": proc.pid,
+                         "kb": kb, "source": source})
+    measured = [r for r in rows if r["kb"] is not None]
+    total_kb = sum(r["kb"] for r in measured)
+    return {
+        "spec": spec, "n": n, "paths": len(paths),
+        "total_mb": round(total_kb / 1024, 1) if measured else None,
+        "per_backend_mb": [round(r["kb"] / 1024, 1) for r in measured],
+        "source": measured[0]["source"] if measured else "unavailable",
+    }
+
+
+def fleet_rss_mode(args) -> int:
+    """``--fleet-rss``: mapped (and optionally heap) fleet Pss legs."""
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from load_gen import tile_universe
+
+    from heatmap_tpu.serve import TileStore
+
+    universe = tile_universe(TileStore(args.fleet_rss), args.fleet_tiles)
+    paths = [f"/tiles/{layer}/{z}/{x}/{y}.{fmt}"
+             for layer, z, x, y, fmt in universe]
+    mapped = measure_fleet_pss(args.fleet_rss, args.fleet_n, paths)
+    print(json.dumps({"leg": "mapped", **mapped}), flush=True)
+    if args.fleet_rss_heap:
+        heap = measure_fleet_pss(args.fleet_rss_heap, args.fleet_n, paths)
+        print(json.dumps({"leg": "heap", **heap}), flush=True)
+        ratio = (round(mapped["total_mb"] / heap["total_mb"], 4)
+                 if mapped["total_mb"] and heap["total_mb"] else None)
+        print(json.dumps({"pss_ratio": ratio, "n": args.fleet_n}),
+              flush=True)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=20_000_000)
@@ -100,7 +203,20 @@ def main() -> int:
     ap.add_argument("--modes", default="ram,spill")
     ap.add_argument("--workdir", default=None,
                     help="default: a fresh temp dir (removed on exit)")
+    ap.add_argument("--fleet-rss", default=None, metavar="SPEC",
+                    help="serve-fleet Pss probe over this store spec "
+                    "(e.g. tilefs:levels/) instead of the merge probe")
+    ap.add_argument("--fleet-rss-heap", default=None, metavar="SPEC",
+                    help="heap comparison leg (e.g. arrays:levels/); "
+                    "with --fleet-rss, also prints the Pss ratio")
+    ap.add_argument("--fleet-n", type=int, default=3,
+                    help="backends per fleet leg")
+    ap.add_argument("--fleet-tiles", type=int, default=128,
+                    help="tile universe size swept per backend")
     args = ap.parse_args()
+
+    if args.fleet_rss:
+        return fleet_rss_mode(args)
 
     import shutil
 

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""Convert any existing store artifact to the zero-copy tilefs format.
+
+Usage:
+    python tools/tilefs_convert.py STORE_SPEC [--out DIR] [--no-levels]
+                                   [--verify]
+
+- ``arrays:DIR`` (or a bare npz dir, including multihost ``host*/``
+  shards): writes ``tilefs-z*.bin`` mirrors alongside the existing
+  levels (in place by default, or into ``--out``). The npz levels stay
+  — they are the per-zoom fallback when a tilefs file is torn.
+- ``delta:ROOT``: writes the mirrors into the CURRENT base directory,
+  so the store serves zero-copy immediately (``TileStore`` sniffs the
+  converted base) and live deltas keep overlaying in heap; the next
+  compaction rebuilds the mirrors automatically (the staged base
+  inherits the tilefs flag).
+- ``jsonl:PATH`` / ``dir:PATH`` blob stores: require ``--out`` — the
+  blob documents are materialized into columnar levels first
+  (npz + tilefs), after which serving renders docs in stored Morton
+  order like every other columnar store.
+
+``--verify`` deep-checks every written file (heatmap_tpu.tilefs
+verify_tilefs: header/footer/trailer + payload crcs) before reporting.
+Writes are atomic (tmp + rename), so a crashed conversion never leaves
+a half-written mirror a server could open.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from heatmap_tpu.io.sinks import LevelArraysSink  # noqa: E402
+from heatmap_tpu.serve.store import (TileStore, _load_levels,  # noqa: E402
+                                     _parse_store_spec)
+from heatmap_tpu.tilefs import format as tilefs_format  # noqa: E402
+from heatmap_tpu.tilemath.morton import morton_decode_np  # noqa: E402
+
+
+def _store_to_loaded(store: TileStore) -> dict:
+    """Blob-store layers -> loaded-column levels ({zoom: cols})."""
+    staged: dict[int, dict[str, list]] = {}
+    seen = set()
+    for layer in store.layers.values():
+        if (layer.user, layer.timespan) in seen:
+            continue  # the "default" alias shares the all|alltime layer
+        seen.add((layer.user, layer.timespan))
+        for zoom, lvl in layer.levels.items():
+            rows, cols = morton_decode_np(lvl.codes)
+            cz = zoom - (layer.result_delta or 0)
+            dst = staged.setdefault(int(zoom), {
+                "row": [], "col": [], "value": [], "user": [],
+                "timespan": [], "coarse_row": [], "coarse_col": [],
+                "zoom": int(zoom), "coarse_zoom": int(cz)})
+            dst["row"].append(rows)
+            dst["col"].append(cols)
+            dst["value"].append(np.asarray(lvl.values, np.float64))
+            n = len(lvl.codes)
+            dst["user"].append(np.full(n, layer.user, dtype=object))
+            dst["timespan"].append(np.full(n, layer.timespan,
+                                           dtype=object))
+            dst["coarse_row"].append(rows >> (layer.result_delta or 0))
+            dst["coarse_col"].append(cols >> (layer.result_delta or 0))
+    out = {}
+    for zoom, cols in staged.items():
+        merged = {"zoom": np.asarray(cols["zoom"]),
+                  "coarse_zoom": np.asarray(cols["coarse_zoom"])}
+        for k in ("row", "col", "value", "coarse_row", "coarse_col"):
+            merged[k] = np.concatenate(cols[k]) if cols[k] else np.array([])
+        for k in ("user", "timespan"):
+            merged[k] = np.concatenate(cols[k]).astype(str)
+        out[zoom] = merged
+    return out
+
+
+def _loaded_to_finalized(levels: dict) -> list:
+    """Loaded columns -> finalized dicts (write_levels input)."""
+    out = []
+    for zoom in sorted(levels):
+        cols = dict(levels[zoom])
+        for name in ("user", "timespan"):
+            vals = np.asarray(cols.pop(name), str)
+            names, idx = np.unique(vals, return_inverse=True)
+            cols[f"{name}_idx"] = idx.astype(np.int32)
+            cols[f"{name}_names"] = names
+        cols["zoom"] = int(np.asarray(cols["zoom"]))
+        cols["coarse_zoom"] = int(np.asarray(cols["coarse_zoom"]))
+        for k in ("row", "col", "coarse_row", "coarse_col"):
+            cols[k] = np.asarray(cols[k], np.int64)
+        cols["value"] = np.asarray(cols["value"], np.float64)
+        out.append(cols)
+    return out
+
+
+def convert(spec: str, out: str | None = None, *,
+            write_levels: bool = True) -> dict:
+    """Convert ``spec``; returns a summary dict (the CLI prints it)."""
+    kind, path = _parse_store_spec(spec)
+    written: list[str] = []
+    if kind in ("arrays", "tilefs"):
+        levels = _load_levels(path)
+        dest = out or path
+        if out and os.path.abspath(out) != os.path.abspath(path):
+            os.makedirs(out, exist_ok=True)
+            if write_levels:
+                LevelArraysSink(out).write_levels(
+                    _loaded_to_finalized(levels))
+        written = tilefs_format.write_tilefs_from_loaded(dest, levels)
+    elif kind == "delta":
+        from heatmap_tpu.delta.compact import read_current
+
+        if out:
+            raise SystemExit("--out is not supported for delta stores: "
+                             "mirrors go into the CURRENT base")
+        cur = read_current(path)
+        if not cur.get("base"):
+            raise SystemExit(f"{spec}: empty delta store (no base); "
+                             "apply a batch or compact first")
+        base = os.path.join(path, cur["base"])
+        dest = base
+        written = tilefs_format.write_tilefs_from_loaded(
+            base, LevelArraysSink.load(base))
+    else:  # jsonl / dir blob stores
+        if not out:
+            raise SystemExit(f"{spec}: blob stores need --out DIR (the "
+                             "columnar materialization target)")
+        dest = out
+        store = TileStore(spec)
+        levels = _store_to_loaded(store)
+        os.makedirs(out, exist_ok=True)
+        if write_levels:
+            LevelArraysSink(out).write_levels(_loaded_to_finalized(levels))
+        written = tilefs_format.write_tilefs_from_loaded(out, levels)
+    return {"spec": spec, "kind": kind, "dest": dest,
+            "files": [os.path.basename(p) for p in written],
+            "bytes": int(sum(os.path.getsize(p) for p in written))}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="convert a store artifact to the zero-copy tilefs "
+                    "format (heatmap_tpu.tilefs; see docs/tilefs.md)")
+    ap.add_argument("spec", help="store spec: arrays:DIR, delta:ROOT, "
+                                 "jsonl:PATH, dir:PATH, or a bare path")
+    ap.add_argument("--out", default=None, metavar="DIR",
+                    help="write the converted store here instead of in "
+                         "place (required for blob stores)")
+    ap.add_argument("--no-levels", action="store_true",
+                    help="skip the npz level mirror when materializing "
+                         "to --out (tilefs only: no torn-file fallback)")
+    ap.add_argument("--verify", action="store_true",
+                    help="deep-verify every written file (payload crcs)")
+    args = ap.parse_args(argv)
+
+    summary = convert(args.spec, args.out,
+                      write_levels=not args.no_levels)
+    if args.verify:
+        bad = {}
+        for name in summary["files"]:
+            full = os.path.join(summary["dest"], name)
+            reason = tilefs_format.verify_tilefs(full)
+            if reason is not None:
+                bad[name] = reason
+        summary["verified"] = not bad
+        if bad:
+            summary["corrupt"] = bad
+    print(json.dumps(summary, indent=2))
+    return 1 if summary.get("corrupt") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
