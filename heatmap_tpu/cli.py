@@ -207,6 +207,16 @@ def _add_run_flags(p):
                    "on real volume; morton forces it; off pins the "
                    "uniform round-robin dispatch. Blobs byte-identical "
                    "in every mode")
+    p.add_argument("--dispatch", choices=("auto", "gspmd", "shard_map"),
+                   default="auto",
+                   help="how the data-parallel cascade is dispatched: "
+                   "gspmd runs the whole cascade (routing, rollup, "
+                   "boundary merge, egress ordering) as ONE compiled "
+                   "program over a NamedSharding mesh with no host "
+                   "round-trips (docs/gspmd.md); shard_map keeps the "
+                   "per-stage host-routed dispatch as a differential-"
+                   "testing oracle. auto (default) picks gspmd wherever "
+                   "a program exists. Blobs byte-identical either way")
     p.add_argument("--fast", action="store_true",
                    help="force the integer-only native-decoder path "
                    "(csv/hmpb sources; dated timespans use the i64 "
@@ -415,6 +425,7 @@ def cmd_run(args) -> int:
             dp_merge=args.dp_merge,
             dp_min_emissions=args.dp_min_emissions,
             spatial_partition=args.spatial_partition,
+            dispatch=args.dispatch,
         )
     except ValueError as e:
         raise SystemExit(str(e)) from e
@@ -1340,6 +1351,11 @@ def _add_update_flags(p):
                    choices=("auto", "scatter", "partitioned"))
     p.add_argument("--data-parallel", choices=("auto", "on", "off"),
                    default="auto")
+    p.add_argument("--dispatch", choices=("auto", "gspmd", "shard_map"),
+                   default="auto",
+                   help="data-parallel cascade dispatch (docs/gspmd.md); "
+                   "auto picks the one-program gspmd path wherever it "
+                   "exists")
     p.add_argument("--metrics-dir", default=None, metavar="DIR",
                    help="enable the metrics registry and write "
                    "DIR/metrics.prom at command end")
@@ -1398,6 +1414,7 @@ def cmd_update(args) -> int:
                 cascade_backend=args.cascade_backend,
                 data_parallel={"auto": None, "on": True, "off": False}[
                     args.data_parallel],
+                dispatch=args.dispatch,
             )
         except ValueError as e:
             raise SystemExit(str(e)) from e
@@ -1577,6 +1594,11 @@ def _add_ingest_flags(p):
                    choices=("auto", "scatter", "partitioned"))
     p.add_argument("--data-parallel", choices=("auto", "on", "off"),
                    default="auto")
+    p.add_argument("--dispatch", choices=("auto", "gspmd", "shard_map"),
+                   default="auto",
+                   help="data-parallel cascade dispatch (docs/gspmd.md); "
+                   "auto picks the one-program gspmd path wherever it "
+                   "exists")
     p.add_argument("--metrics-dir", default=None, metavar="DIR",
                    help="enable the metrics registry and write "
                    "DIR/metrics.prom at command end")
@@ -1624,6 +1646,7 @@ def cmd_ingest(args) -> int:
             cascade_backend=args.cascade_backend,
             data_parallel={"auto": None, "on": True, "off": False}[
                 args.data_parallel],
+            dispatch=args.dispatch,
             pad_bucketing=args.pad_bucketing,
             pad_bucket_min=args.pad_bucket_min,
         )

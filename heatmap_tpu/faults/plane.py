@@ -21,7 +21,10 @@ fault and skips the liveness update instead of failing the caller),
 ``ingest.tick`` / ``ingest.publish`` (continuous-ingest micro-batch
 boundaries), ``ingest.synopsis`` (the loop's best-effort provisional
 synopsis publish for early serving — a terminal failure is swallowed,
-never kills the loop), ``elastic.reassign`` (each orphaned-shard re-execution
+never kills the loop), ``feeder.put`` (each host->device transfer the
+double-buffered feeder makes — pipeline/feeder.py; re-feeding the same
+batch is idempotent, and on the ingest path the journal's content hash
+keeps a re-fed batch exactly-once), ``elastic.reassign`` (each orphaned-shard re-execution
 on a surviving host — parallel/elastic.py), ``router.forward`` (one
 check per fleet-router forward attempt to a backend — serve/router.py;
 an injected fault reads as a connection failure and burns the
@@ -72,6 +75,7 @@ SITES = (
     "ingest.tick",
     "ingest.publish",
     "ingest.synopsis",
+    "feeder.put",
     "elastic.reassign",
     "router.forward",
     "backend.probe",

@@ -123,6 +123,12 @@ POLICIES = {
     # of dying.
     "ingest.synopsis": RetryPolicy(retries=2, base_s=0.02, cap_s=0.5,
                                    deadline_s=10.0),
+    # Host->device feeder transfer (pipeline/feeder.py). device_put is
+    # idempotent (nothing downstream saw the batch), so re-feeding is
+    # always safe; short caps because the feeder thread stalling just
+    # degrades overlap back to synchronous transfer.
+    "feeder.put": RetryPolicy(retries=2, base_s=0.02, cap_s=0.5,
+                              deadline_s=10.0),
     # Orphaned-shard re-execution on a surviving host. The shard
     # already failed once on the dead host, so the retry budget here
     # guards only the survivor's own transients; a shard that also

@@ -30,7 +30,10 @@ The loop is a first-class citizen of the existing planes:
   ``ingest.publish`` sites with their retry policies; both operations
   are idempotent end to end, which is what makes retrying the whole
   tick safe. Crash mid-tick heals byte-identical through
-  ``delta/recover.py`` on the next apply's startup sweep.
+  ``delta/recover.py`` on the next apply's startup sweep. The
+  host->device feeder (``pipeline/feeder.py``, ``feed_depth``) runs
+  each transfer under ``feeder.put`` — a re-fed batch is idempotent by
+  the same content-hash contract.
 
 **Early serving** (docs/synopsis.md): before the exact apply, a tick
 overlays the micro-batch's coarse cell counts onto the store's decoded
@@ -170,6 +173,12 @@ class IngestConfig:
     #: Publish a provisional synopsis overlay before each exact apply
     #: (no-op when the serve store carries no synopsis views).
     provisional_synopsis: bool = True
+    #: Host->device feeder depth (pipeline/feeder.py): micro-batch k+1's
+    #: numeric columns transfer to the device while tick k computes,
+    #: with at most this many fed batches resident ahead of the apply
+    #: loop. 0 disables the feeder (columns transfer synchronously
+    #: inside each tick). Byte-identical either way.
+    feed_depth: int = 1
 
     def __post_init__(self):
         if self.micro_batch < 1:
@@ -179,6 +188,9 @@ class IngestConfig:
             raise ValueError("sign must be +1 (insert) or -1 (retraction)")
         if self.compact_every < 0 or self.compact_max_age_s < 0:
             raise ValueError("compaction thresholds must be >= 0")
+        if self.feed_depth < 0:
+            raise ValueError(
+                f"feed_depth must be >= 0, got {self.feed_depth}")
 
 
 @dataclasses.dataclass
@@ -194,6 +206,14 @@ class IngestStats:
     compactions: int = 0
     keys_invalidated: int = 0
     seconds: float = 0.0
+    #: Feeder outcome (zeros / 100.0 with feed_depth=0): worker seconds
+    #: spent in host->device transfer, consumer seconds blocked waiting
+    #: for a fed batch, share of transfer time hidden behind compute,
+    #: and the high-water mark of fed batches resident ahead.
+    feed_s: float = 0.0
+    feed_wait_s: float = 0.0
+    feed_overlap_pct: float = 100.0
+    feeder_depth_hwm: int = 0
 
 
 def _provisional_rows(store, cols, config, sign: int) -> dict:
@@ -404,8 +424,27 @@ def run_ingest(root: str, source, config=None, *,
     batches = source.batches(ing.micro_batch)
     if ing.max_ticks is not None:
         batches = itertools.islice(batches, ing.max_ticks)
+    fstats = None
+    if ing.feed_depth:
+        # Double-buffered host->device feeder: batch k+1's numeric
+        # columns transfer while tick k journals/applies/publishes.
+        # Order-preserving, so journal epochs and content hashes are
+        # identical to the unfed drain (the hash reads values, and the
+        # feeder moves buffers, never values).
+        from heatmap_tpu.pipeline import feeder as feeder_mod
+
+        fstats = feeder_mod.FeederStats()
+        batches = feeder_mod.feed(
+            batches, feeder_mod.device_put_columns,
+            depth=ing.feed_depth, stats=fstats,
+            thread_name="ingest-feeder")
     with tracing.span("ingest.loop"):
         pump = run_ticks(batches, _tick, queue_depth=ing.queue_depth)
     stats.max_queue_depth = pump["max_queue_depth"]
     stats.seconds = time.monotonic() - t_loop
+    if fstats is not None:
+        stats.feed_s = fstats.feed_s
+        stats.feed_wait_s = fstats.wait_s
+        stats.feed_overlap_pct = fstats.overlap_pct
+        stats.feeder_depth_hwm = fstats.depth_hwm
     return stats

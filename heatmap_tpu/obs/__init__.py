@@ -101,6 +101,17 @@ SPECULATIVE_LAUNCHES = _registry.counter(
     "speculative_launches_total",
     "Speculative duplicate shard executions by race outcome",
     labelnames=("outcome",))  # outcome = win | lose
+DISPATCH_OVERHEAD = _registry.histogram(
+    "dispatch_overhead_seconds",
+    "Host-side share of one cascade dispatch (routing, padding, plan, "
+    "argument prep — everything before the compiled program runs; the "
+    "overhead the gspmd one-program dispatch removes)",
+    labelnames=("dispatch",))
+FEEDER_DEPTH = _registry.gauge(
+    "feeder_depth",
+    "Device-resident batches queued ahead of the consumer in the "
+    "host->device feeder (pipeline/feeder.py; depth > 0 means the next "
+    "batch's transfer fully overlapped compute)")
 FAULTS_INJECTED = _registry.counter(
     "faults_injected_total", "Faults fired by the injection plane",
     labelnames=("site",))
@@ -138,6 +149,50 @@ def refresh_process_gauges():
 def telemetry_enabled() -> bool:
     """True when any sink (registry or event log) is live."""
     return _registry.enabled or events._current is not None
+
+
+class DispatchTimer:
+    """Host/device wall-time split for ONE cascade dispatch.
+
+    Splits the cascade's ``stage_duration_seconds`` attribution into
+    ``cascade.dispatch.host`` (routing, padding, partition planning,
+    argument prep — everything before the compiled program runs) and
+    ``cascade.dispatch.device`` (program execution to outputs-ready),
+    and feeds ``dispatch_overhead_seconds{dispatch}`` with the host
+    share. Construct at the start of the host phase, call
+    :meth:`dispatched` when the program has been handed to the
+    runtime, :meth:`finished` once outputs are ready (the caller
+    blocks on the result in between). Everything no-ops when telemetry
+    is off, so the production path pays two global reads. Lives here
+    because wall-clock reads are banned outside obs/ and utils/trace
+    (tests/test_obs.py grep guards).
+    """
+
+    __slots__ = ("dispatch", "enabled", "_t0", "_t1")
+
+    def __init__(self, dispatch: str):
+        self.dispatch = dispatch
+        self.enabled = telemetry_enabled()
+        self._t0 = time.perf_counter() if self.enabled else 0.0
+        self._t1 = None
+
+    def dispatched(self) -> None:
+        """Host phase over: the compiled program owns the clock now."""
+        if self.enabled:
+            self._t1 = time.perf_counter()
+
+    def finished(self, items=None):
+        """Outputs ready; record both phases. Returns (host_s,
+        device_s) when telemetry is on, else None."""
+        if not self.enabled or self._t1 is None:
+            return None
+        t2 = time.perf_counter()
+        host_s, device_s = self._t1 - self._t0, t2 - self._t1
+        record_stage("cascade.dispatch.host", host_s, items)
+        record_stage("cascade.dispatch.device", device_s, items)
+        if _registry.enabled:
+            DISPATCH_OVERHEAD.observe(host_s, dispatch=self.dispatch)
+        return host_s, device_s
 
 
 def record_stage(stage: str, wall_s: float, items=None, **attrs):
@@ -382,7 +437,9 @@ def record_speculative_result(shard, winner, loser=None, won: bool = False,
 
 
 __all__ = [
-    "EVENT_SCHEMA", "EventLog", "FlightRecorder", "IncidentManager",
+    "DISPATCH_OVERHEAD", "DispatchTimer",
+    "EVENT_SCHEMA", "EventLog", "FEEDER_DEPTH", "FlightRecorder",
+    "IncidentManager",
     "MetricsRegistry", "SLOEngine", "SLOSpec",
     "TraceCollector", "blob_checksum", "build_run_report", "current_span",
     "current_traceparent", "device_topology", "disable_tracing", "emit",

@@ -35,15 +35,19 @@ EVENT_SCHEMA = {
                   "optional": ("items", "bytes", "backend", "level",
                                "window", "trace_id", "span_id")},
     # Job-level routing decision: how cascade_backend="auto" resolved.
+    # ``dispatch`` records how the mesh formulation resolved ("gspmd"
+    # one-program NamedSharding vs "shard_map" oracle — pipeline/batch
+    # resolved_dispatch), so dispatcher routing stays auditable.
     "backend_resolved": {"required": ("requested", "resolved"),
                          "optional": ("reason", "weighted", "data_parallel",
-                                      "n_emissions", "spatial_partition")},
+                                      "n_emissions", "spatial_partition",
+                                      "dispatch")},
     # Per-call cascade dispatch record (the audit trail behind
     # backend_resolved: what run_cascade actually executed).
     "cascade_dispatch": {"required": ("backend",),
                          "optional": ("jit", "mesh", "merge", "n_emissions",
                                       "n_slots", "trace_id", "span_id",
-                                      "partition")},
+                                      "partition", "dispatch")},
     # Morton-range partition plan for a cascade dispatch
     # (parallel/partition.plan_partition): the split codes, the sampled
     # evidence they were chosen from, and the post-resplit balance.

@@ -19,8 +19,19 @@ mesh construction differs (mesh.py).
 from heatmap_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     TILE_AXIS,
+    force_cpu_devices,
     make_mesh,
+    named_sharding,
     pad_to_multiple,
+)
+from heatmap_tpu.parallel.gspmd import (  # noqa: F401
+    DonatedBufferError,
+    DonationLedger,
+    donating_jit,
+    donation_supported,
+    pyramid_gspmd_range,
+    pyramid_gspmd_uniform,
+    route_on_device,
 )
 from heatmap_tpu.parallel.sharded import (  # noqa: F401
     aggregate_keys_sharded,

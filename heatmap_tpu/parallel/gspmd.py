@@ -1,0 +1,490 @@
+"""Device-resident GSPMD cascade: global-view NamedSharding programs.
+
+The shard_map kernels in parallel/sharded.py are hand-routed: the host
+pads and routes emissions before the kernel (parallel/partition.
+route_emissions), and per-shard buffer widths are derived from the
+routed segment length. This module re-expresses the same two cascade
+pyramids — uniform data-parallel and Morton-range partitioned — as
+*global-view* jax programs annotated with ``NamedSharding`` constraints
+(mesh.named_sharding), so the WHOLE cascade — emission routing,
+range-local rollup, boundary merge, and canonical egress ordering —
+is one compiled program with no host round-trips between stages:
+
+- routing happens on-device against a TRACED splits array
+  (``searchsorted`` on the detail code bits) instead of a host numpy
+  scatter, which is also what lets ``adaptive_capacity`` compose with
+  Morton partitioning (the host router is shape-static; the traced
+  router is not);
+- every per-shard stage is a ``vmap`` over a leading ``(n_shards,)``
+  axis pinned to the mesh's point axes, so XLA's SPMD partitioner
+  places each row's compute on its owning device;
+- the final canonical-order argsort (sorted uniques, sentinel pad)
+  runs on-device inside the same program, byte-identical to the
+  post-shard_map egress of parallel/sharded.py.
+
+Byte identity with the shard_map kernels is the contract (pinned by
+tests/test_gspmd.py and the chaos ``dispatch`` phase): counts and
+bounded-integer weighted sums are exact in any summation order, and
+float64 weighted sums accumulate per key in original lane order on
+both paths (stable sorts; masked lanes carry sentinel keys that sort
+past every real run, so they never interleave a segment).
+
+Routing layout note: the range program replicates the batch across the
+point axes and masks each shard to its owned lanes (``dest == k``) —
+per-device memory O(n), same as the host router's input, and the
+detail reduce scans the full batch per shard. That redundancy buys
+zero host routing, zero host<->device round-trips, and a traced (plan-
+agnostic) program; the dispatch-overhead bench (tools/bench_job.py
+--dispatch-sweep) measures the trade. The uniform program has no
+redundancy: it reduces contiguous 1/n_shards slices exactly like the
+shard_map body.
+
+Donation: `donating_jit` adds ``donate_argnums`` where the platform
+supports in-place donation (TPU/GPU) and drops it where it does not
+(CPU), while a platform-independent :class:`DonationLedger` makes
+re-use of a donated buffer a typed :class:`DonatedBufferError` on
+every backend — the classic pjit footgun caught at the API boundary
+rather than as a backend-specific crash.
+"""
+
+from __future__ import annotations
+
+import weakref
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+
+from heatmap_tpu.ops import pyramid as pyramid_ops, sparse as sparse_ops
+from heatmap_tpu.parallel.mesh import (
+    DATA_AXIS,
+    TILE_AXIS,
+    named_sharding,
+)
+from heatmap_tpu.parallel.sharded import (
+    _local_detail_stage,
+    _ones_like_weights,
+    _shard_axes,
+)
+
+__all__ = [
+    "DonatedBufferError",
+    "DonationLedger",
+    "donating_jit",
+    "donation_supported",
+    "ledger",
+    "pyramid_gspmd_range",
+    "pyramid_gspmd_uniform",
+    "route_on_device",
+]
+
+
+# ---------------------------------------------------------------------------
+# sharding helpers
+
+
+def _point_spec(mesh: Mesh):
+    """The PartitionSpec leading-axis entry the point-parallel programs
+    shard their ``(n_shards, ...)`` layout over — the NamedSharding
+    analog of sharded._shard_axes (tile==1 keeps the single data axis,
+    else the leading axis flattens over both)."""
+    axes, ndev = _shard_axes(mesh)
+    return (axes[0] if len(axes) == 1 else tuple(axes)), ndev
+
+
+def _constrain(x, mesh: Mesh, *spec):
+    """``with_sharding_constraint`` under trace, ``device_put`` eagerly.
+
+    The gspmd programs run both jitted (the production path — the
+    constraint tells the SPMD partitioner where each stage lives) and
+    eagerly (stage tracing, adaptive_capacity reads concrete counts);
+    eager jax rejects bare sharding constraints, so commit the array
+    instead — same placement, same values.
+    """
+    sharding = named_sharding(mesh, *spec)
+    if isinstance(x, jax.core.Tracer):
+        return lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
+
+
+# ---------------------------------------------------------------------------
+# on-device routing
+
+
+def route_on_device(keys, splits, *, code_bits: int, n_shards: int,
+                    valid=None):
+    """Owning-shard mask per emission lane, from a traced splits array.
+
+    ``keys`` are composite cascade keys (slot bits above ``code_bits``
+    detail-code bits); routing is by the detail Morton code alone,
+    mirroring the host router (partition.route_emissions →
+    shard_of_codes: ``shard = #{splits <= code}``). Returns a
+    ``(n_shards, n)`` bool mask whose row ``k`` is True exactly on the
+    valid lanes shard ``k`` owns — the replicate-and-mask layout the
+    range program reduces over.
+    """
+    keys = jnp.asarray(keys)
+    code = keys & ((1 << code_bits) - 1)
+    dest = jnp.searchsorted(jnp.asarray(splits, keys.dtype), code,
+                            side="right")
+    owned = dest[None, :] == jnp.arange(n_shards)[:, None]
+    if valid is not None:
+        owned = owned & jnp.asarray(valid, bool)[None, :]
+    return owned
+
+
+# ---------------------------------------------------------------------------
+# uniform data-parallel program
+
+
+def pyramid_gspmd_uniform(
+    codes,
+    mesh: Mesh,
+    weights=None,
+    valid=None,
+    levels: int = 0,
+    capacity=None,
+    acc_dtype=None,
+    backend: str = "scatter",
+    weight_bound: int | None = None,
+    adaptive: bool = False,
+):
+    """Global-view uniform-DP sparse pyramid, byte-identical to
+    :func:`parallel.sharded.pyramid_sparse_morton_sharded`.
+
+    Same staging as the shard_map kernel — per-shard detail reduce,
+    merge + rollup over the flattened compact partials — but the shard
+    axis is an explicit leading dimension constrained to the mesh's
+    point axes rather than a shard_map body, so the whole pyramid is
+    one partitionable program (jit it together with projection and
+    egress). Per-shard buffer widths reuse the shard_map formulas
+    exactly so the merged partial stream is element-identical.
+
+    ``adaptive`` (EAGER callers only, like ops.pyramid) forwards to the
+    merged rollup: deep levels shrink to the real unique counts. The
+    shard_map path cannot take this flag (its widths are baked into
+    the body specs); here the rollup runs on the global view, so the
+    composition is free — and result-neutral, the dropped slots are
+    sentinel padding.
+    """
+    spec, ndev = _point_spec(mesh)
+    codes = jnp.asarray(codes)
+    n = codes.shape[0]
+    if n % ndev != 0:
+        raise ValueError(
+            f"gspmd uniform cascade needs n % n_shards == 0, got "
+            f"{n} % {ndev} (pad with mesh.pad_to_multiple)")
+    caps = pyramid_ops._level_caps(capacity, n, levels)
+    local_capacity = max(1, min(caps[0], n // ndev))
+    if acc_dtype is None:
+        acc_dtype = jnp.int32 if weights is None else jnp.float32
+    counts_only = weights is None
+    w = _ones_like_weights(weights, n, acc_dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+    sentinel = jnp.iinfo(codes.dtype).max
+    stage = _local_detail_stage(backend, counts_only, local_capacity,
+                                acc_dtype, sentinel,
+                                weight_bound=weight_bound)
+
+    shard = n // ndev
+    ck = _constrain(codes.reshape(ndev, shard), mesh, spec, None)
+    cw = _constrain(w.reshape(ndev, shard), mesh, spec, None)
+    cv = _constrain(v.reshape(ndev, shard), mesh, spec, None)
+    u, s, ln = jax.vmap(stage)(ck, cw, cv)
+    u = _constrain(u, mesh, spec, None)
+    s = _constrain(s, mesh, spec, None)
+    gu, gs = u.reshape(-1), s.reshape(-1)
+    out = pyramid_ops.pyramid_sparse_morton(
+        gu,
+        weights=gs,
+        valid=gu != sentinel,
+        levels=levels,
+        capacity=caps,
+        acc_dtype=acc_dtype,
+        adaptive=adaptive,
+    )
+    local_overflow = (ln > local_capacity).any()
+    return [
+        (
+            lu,
+            ls,
+            jnp.where(local_overflow, jnp.maximum(lnn, caps[lvl] + 1), lnn),
+        )
+        for lvl, (lu, ls, lnn) in enumerate(out)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Morton-range partitioned program (on-device routing)
+
+
+def pyramid_gspmd_range(
+    keys,
+    mesh: Mesh,
+    splits,
+    *,
+    code_bits: int,
+    slot_bound: int,
+    weights=None,
+    valid=None,
+    levels: int = 0,
+    capacity=None,
+    acc_dtype=None,
+    backend: str = "scatter",
+    weight_bound: int | None = None,
+    adaptive: bool = False,
+):
+    """Range-partitioned sparse pyramid with ON-DEVICE routing.
+
+    Input is UNROUTED — the full emission stream plus the traced
+    ``(n_shards - 1,)`` split codes; :func:`route_on_device` assigns
+    lanes to shards inside the program (replicate-and-mask layout, see
+    module docstring), replacing the host scatter the shard_map path
+    requires. Every stage after routing mirrors
+    :func:`parallel.sharded.pyramid_sparse_morton_range_sharded`
+    verbatim, with the shard axis as an explicit vmapped leading
+    dimension and the cross-shard boundary exchange written as plain
+    array ops over that axis (the SPMD partitioner lowers them to the
+    same all_gather):
+
+    - detail reduce per shard (routing is by detail code, so shards
+      never share a detail key and the boundary set is empty);
+    - per coarse level: local parent rollup, boundary-tile extraction
+      against the traced splits, fixed-width exchange, first-holder
+      patch (cross-shard total lands on the lowest-indexed holder,
+      every other holder drops its row), local reorder;
+    - canonical egress: global argsort of the sentinel-padded shard
+      blocks, truncated/padded to the level capacity — byte-identical
+      to the shard_map path's host-graph egress.
+
+    The loud-overflow contract holds: any shard-local buffer overflow
+    forces every level's count past capacity. Because the replicated
+    layout sizes per-shard buffers by the FULL level capacity rather
+    than the routed segment length, some shapes that overflow a
+    narrow routed segment do not overflow here; non-overflow shapes
+    (the contract everything downstream serves) are byte-identical.
+    """
+    spec, ndev = _point_spec(mesh)
+    keys = jnp.asarray(keys)
+    n = keys.shape[0]
+    splits = jnp.asarray(splits)
+    if splits.shape != (ndev - 1,):
+        raise ValueError(
+            f"need {ndev - 1} split codes for {ndev} shards, got "
+            f"shape {splits.shape}")
+    caps = pyramid_ops._level_caps(capacity, n, levels)
+    lcaps = [max(1, caps[lvl]) for lvl in range(levels + 1)]
+    bcaps = [max(1, min(lcaps[lvl], 2 * slot_bound))
+             for lvl in range(levels + 1)]
+    if acc_dtype is None:
+        acc_dtype = jnp.int32 if weights is None else jnp.float32
+    counts_only = weights is None
+    w = _ones_like_weights(weights, n, acc_dtype)
+    v = jnp.ones((n,), bool) if valid is None else jnp.asarray(valid, bool)
+    sentinel = jnp.iinfo(keys.dtype).max
+    stage = _local_detail_stage(backend, counts_only, lcaps[0],
+                                acc_dtype, sentinel,
+                                weight_bound=weight_bound)
+
+    owned = route_on_device(keys, splits, code_bits=code_bits,
+                            n_shards=ndev, valid=v)
+    bk = _constrain(jnp.broadcast_to(keys, (ndev, n)), mesh, spec, None)
+    bw = _constrain(jnp.broadcast_to(w, (ndev, n)), mesh, spec, None)
+    bv = _constrain(owned, mesh, spec, None)
+
+    u, s, ln = jax.vmap(stage)(bk, bw, bv)
+    over = ln > lcaps[0]
+    u = _constrain(u, mesh, spec, None)
+    s = _constrain(s, mesh, spec, None)
+
+    me = jnp.arange(ndev)
+    spl = splits.astype(keys.dtype)
+    per_level = [(u, s, jnp.sum(u != sentinel, axis=1))]
+    cur_u, cur_s = u, s
+    for lvl in range(1, levels + 1):
+        if adaptive:
+            # EAGER callers only (counts are concrete): shrink the
+            # per-shard columns to the next power of two above the
+            # widest shard's real unique count before the next rollup —
+            # the ops.pyramid adaptive trick applied per shard. Rows
+            # are sorted with sentinels last, so the dropped columns
+            # are pure padding; never slice below any shard's n_real
+            # (overflow detection relies on the true counts). This is
+            # the composition the host-routed shard_map path cannot
+            # express: its widths are baked into static body specs,
+            # while the traced router leaves the rollup global-view.
+            n_real = int(jnp.max(per_level[-1][2]))
+            if n_real <= cur_u.shape[1]:
+                keep = max(64, 1 << max(0, n_real - 1).bit_length())
+                if keep < cur_u.shape[1]:
+                    cur_u = cur_u[:, :keep]
+                    cur_s = cur_s[:, :keep]
+        parents = jnp.where(cur_u == sentinel, sentinel, cur_u >> 2)
+        out_cap = (min(lcaps[lvl], cur_u.shape[1]) if adaptive
+                   else lcaps[lvl])
+        pu, ps, pn = jax.vmap(
+            lambda p, ps_: sparse_ops.aggregate_sorted_keys(
+                p, ps_, out_cap, sentinel=sentinel))(parents, cur_s)
+        over = over | (pn > out_cap)
+        # Boundary codes at this level, from the traced splits: the
+        # split's ancestor, unless the split is tile-aligned.
+        blk = (1 << (2 * lvl)) - 1
+        b = jnp.where((spl & blk) != 0, spl >> (2 * lvl), sentinel)
+        code_mask = (1 << (code_bits - 2 * lvl)) - 1
+        is_b = (pu != sentinel) & jnp.any(
+            (pu & code_mask)[:, :, None] == b[None, None, :], axis=2)
+        cb = min(bcaps[lvl], pu.shape[1])
+        over = over | (jnp.sum(is_b, axis=1) > cb)
+        # Boundary rows to the front (sentinel-masked argsort), fixed
+        # cb-wide send buffers — the all_gather payload of the
+        # shard_map body, here simply the stacked (ndev, cb) arrays.
+        bkey = jnp.where(is_b, pu, sentinel)
+        border = jnp.argsort(bkey, axis=1)[:, :cb]
+        send_u = jnp.take_along_axis(bkey, border, axis=1)
+        send_s = jnp.take_along_axis(
+            jnp.where(is_b, ps, jnp.zeros((), ps.dtype)), border, axis=1)
+
+        def lookup(bu, bs, pu_k):
+            pos = jnp.clip(jnp.searchsorted(bu, pu_k), 0, cb - 1)
+            hit = (bu[pos] == pu_k) & (pu_k != sentinel)
+            return jnp.where(hit, bs[pos], jnp.zeros((), bs.dtype)), hit
+
+        # vals[k, j]: shard k's boundary keys looked up in shard j's
+        # gathered block — (ndev, ndev, lcap); summed over j in block
+        # order, exactly the shard_map body's gathered-axis sum.
+        vals, hits = jax.vmap(
+            lambda pu_k: jax.vmap(lookup, in_axes=(0, 0, None))(
+                send_u, send_s, pu_k))(pu)
+        total = jnp.sum(vals, axis=1)
+        holder = me[jnp.argmax(hits, axis=1)]
+        keep = ~is_b | (holder == me[:, None])
+        new_u = jnp.where(keep, pu, sentinel)
+        new_s = jnp.where(keep & is_b, total, ps)
+        new_s = jnp.where(keep, new_s, jnp.zeros((), ps.dtype))
+        reorder = jnp.argsort(new_u, axis=1)
+        cur_u = jnp.take_along_axis(new_u, reorder, axis=1)
+        cur_s = jnp.take_along_axis(new_s, reorder, axis=1)
+        cur_u = _constrain(cur_u, mesh, spec, None)
+        cur_s = _constrain(cur_s, mesh, spec, None)
+        per_level.append((cur_u, cur_s, jnp.sum(cur_u != sentinel, axis=1)))
+
+    any_over = over.any()
+    out = []
+    for lvl in range(levels + 1):
+        cu, cs, cn = per_level[lvl]
+        cap = caps[lvl]
+        gu, gs = cu.reshape(-1), cs.reshape(-1)
+        # Keys are globally disjoint post-patch, so a global argsort of
+        # the sentinel-padded shard blocks IS the canonical merged
+        # order (sentinels sort last, their sums are zero) — the same
+        # egress the shard_map path runs, now inside the program.
+        order = jnp.argsort(gu)
+        su, ss = gu[order], gs[order]
+        if su.shape[0] >= cap:
+            su, ss = su[:cap], ss[:cap]
+        else:
+            su = jnp.concatenate(
+                [su, jnp.full((cap - su.shape[0],), sentinel, su.dtype)])
+            ss = jnp.concatenate(
+                [ss, jnp.zeros((cap - ss.shape[0],), ss.dtype)])
+        ln = cn.sum()
+        out.append((su, ss,
+                    jnp.where(any_over, jnp.maximum(ln, cap + 1), ln)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# donation
+
+
+def donation_supported(platform: str | None = None) -> bool:
+    """True where XLA honors ``donate_argnums`` (TPU/GPU; CPU emits a
+    "donated buffers were not usable" warning and copies instead)."""
+    platform = platform or jax.default_backend()
+    return platform in ("tpu", "gpu", "cuda", "rocm")
+
+
+class DonatedBufferError(ValueError):
+    """A buffer donated to a previous dispatch was passed again.
+
+    On TPU/GPU the donated buffer's memory was reused in place, so a
+    second read is undefined; on CPU donation is a no-op and the read
+    would silently "work" — the ledger raises on every platform so the
+    bug cannot hide behind the backend.
+    """
+
+
+class DonationLedger:
+    """Tracks buffers consumed by donating dispatches, by identity.
+
+    Entries are weak so the ledger never extends a donated buffer's
+    lifetime (which would defeat donation); a collected buffer cannot
+    be re-passed, so dropping its entry is safe.
+    """
+
+    def __init__(self):
+        self._spent: dict[int, object] = {}
+
+    def mark(self, *arrays) -> None:
+        for a in arrays:
+            if a is None or not isinstance(a, jax.Array):
+                continue
+            key = id(a)
+            try:
+                self._spent[key] = weakref.ref(
+                    a, lambda _r, k=key: self._spent.pop(k, None))
+            except TypeError:  # pragma: no cover - non-weakrefable array
+                self._spent[key] = None
+
+    def check(self, *arrays) -> None:
+        for a in arrays:
+            if a is not None and id(a) in self._spent:
+                raise DonatedBufferError(
+                    "buffer was donated to a previous cascade dispatch "
+                    "and may have been overwritten in place; re-feed the "
+                    "batch (pipeline/feeder.py) instead of re-passing it")
+
+    def clear(self) -> None:
+        self._spent.clear()
+
+
+#: Process-wide ledger for the cascade dispatch path.
+ledger = DonationLedger()
+
+
+def donating_jit(fn, *, donate_argnums=(), donate_argnames=(),
+                 static_argnames=(), ledger=None):
+    """``jax.jit`` with donation where supported, ledger-guarded always.
+
+    Returns a callable with the jitted function's signature plus two
+    attributes: ``donation_active`` (whether donation was actually
+    passed to jit on this platform) and ``ledger``. Donated arguments
+    (positional via ``donate_argnums``, keyword via ``donate_argnames``)
+    are checked against the ledger before dispatch and marked consumed
+    after — so re-using a donated buffer raises
+    :class:`DonatedBufferError` on CPU exactly as it would corrupt on
+    TPU, and the byte-identity tests can run the same assertions on
+    both.
+    """
+    active = donation_supported() and bool(donate_argnums
+                                           or donate_argnames)
+    jfn = jax.jit(fn, static_argnames=static_argnames,
+                  donate_argnums=donate_argnums if active else (),
+                  donate_argnames=donate_argnames if active else ())
+    led = ledger if ledger is not None else globals()["ledger"]
+    donate_argnums = tuple(donate_argnums)
+    donate_argnames = tuple(donate_argnames)
+
+    def call(*args, **kwargs):
+        donated = [args[i] for i in donate_argnums if i < len(args)]
+        donated += [kwargs[k] for k in donate_argnames if k in kwargs]
+        led.check(*donated)
+        out = jfn(*args, **kwargs)
+        led.mark(*donated)
+        return out
+
+    call.donation_active = active
+    call.ledger = led
+    call.__wrapped__ = jfn
+    return call

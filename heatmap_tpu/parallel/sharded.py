@@ -18,6 +18,12 @@ axes (collectives run over the flattened axes), and
 parallelism: an ``all_to_all`` regroups points so each device only
 ever materializes its own raster band — the groupByKey analog for
 rasters too big for one device's HBM.
+
+The two cascade pyramids here (uniform and Morton-range) also exist as
+global-view NamedSharding programs in parallel/gspmd.py — one compiled
+program with on-device routing, byte-identical outputs (pinned by
+tests/test_gspmd.py). This shard_map formulation stays selectable via
+``dispatch="shard_map"`` as the differential-testing oracle.
 """
 
 from __future__ import annotations

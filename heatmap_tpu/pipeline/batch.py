@@ -140,6 +140,21 @@ class BatchJobConfig:
     #: pad to bucketed lengths so routed shapes hit the same compile
     #: cache.
     spatial_partition: str = "auto"
+    #: Mesh-cascade dispatch formulation: "auto" (default — "gspmd"
+    #: wherever its programs exist, which today is every mesh shape
+    #: except dp_merge="prefix"), "gspmd" (parallel/gspmd.py — the
+    #: whole cascade as ONE global-view NamedSharding program:
+    #: on-device emission routing against traced splits, range-local
+    #: rollup, boundary merge, and canonical egress ordering, with no
+    #: host round-trips between stages), or "shard_map" (the
+    #: parallel/sharded.py kernels with host-side range routing — kept
+    #: selectable for one release as the differential-testing oracle).
+    #: Byte-identical outputs either way (tests/test_gspmd.py pins
+    #: levels AND served blobs). Ignored off the mesh path. Only
+    #: "gspmd" composes with adaptive_capacity: its traced router and
+    #: global-view rollup accept concrete-count shrinking that the
+    #: shape-static shard_map bodies cannot.
+    dispatch: str = "auto"
 
     def __post_init__(self):
         from heatmap_tpu.pipeline.bucketing import BUCKETING_MODES
@@ -218,11 +233,26 @@ class BatchJobConfig:
                     "1024-element chunk) — use the scatter backend "
                     "for larger weights"
                 )
-        if self.data_parallel and self.adaptive_capacity:
+        if self.dispatch not in ("auto", "gspmd", "shard_map"):
             raise ValueError(
-                "data_parallel=True is shape-static; "
+                f"unknown dispatch {self.dispatch!r} (valid: auto, "
+                "gspmd, shard_map) — rejected at config time so a typo "
+                "fails before a multi-hour ingest"
+            )
+        if self.dispatch == "gspmd" and self.dp_merge == "prefix":
+            raise ValueError(
+                "dispatch='gspmd' has no prefix-merge program yet; "
+                "dp_merge='prefix' needs dispatch='shard_map' (or "
+                "'auto', which resolves it there)"
+            )
+        if (self.data_parallel and self.adaptive_capacity
+                and self.resolved_dispatch != "gspmd"):
+            raise ValueError(
+                "the shard_map mesh cascade is shape-static; "
                 "adaptive_capacity reads concrete per-level counts "
-                "and does not compose — disable one of them"
+                "and does not compose — disable one of them, or use "
+                "dispatch='gspmd' (its global-view rollup accepts "
+                "adaptive shrinking)"
             )
         if self.spatial_partition not in ("auto", "morton", "off"):
             raise ValueError(
@@ -238,12 +268,30 @@ class BatchJobConfig:
                     "the single-device path — rejected at config time "
                     "so a silently ignored partition cannot ship"
                 )
-            if self.adaptive_capacity:
+            if (self.adaptive_capacity
+                    and self.resolved_dispatch != "gspmd"):
+                # The host router (route_emissions) is shape-static, so
+                # morton + adaptive only composes when routing happens
+                # on-device — the gspmd dispatch. "auto" resolves to
+                # gspmd precisely so this combination Just Works.
                 raise ValueError(
-                    "spatial_partition='morton' rides the shape-static "
-                    "mesh path; adaptive_capacity does not compose — "
-                    "disable one of them"
+                    "spatial_partition='morton' with "
+                    "dispatch='shard_map' rides the host-routed "
+                    "shape-static mesh path; adaptive_capacity does "
+                    "not compose there — use dispatch='gspmd' (or "
+                    "'auto'), whose on-device routing accepts it"
                 )
+
+    @property
+    def resolved_dispatch(self) -> str:
+        """The mesh-dispatch formulation the cascade actually runs:
+        "auto" resolves to the one-program gspmd dispatch wherever its
+        programs exist — today everything except dp_merge="prefix",
+        which keeps the shard_map prefix kernel. Explicit requests are
+        honored as-is (gspmd + prefix is rejected at config time)."""
+        if self.dispatch != "auto":
+            return self.dispatch
+        return "shard_map" if self.dp_merge == "prefix" else "gspmd"
 
     @property
     def resolved_cascade_backend(self) -> str:
@@ -393,12 +441,14 @@ def _dp_mesh(config: BatchJobConfig):
     shard_map dispatch that a single chip gains nothing from. Both
     cascade backends compose with the mesh (the partitioned segment
     reduction runs inside the shard_map body — parallel/sharded.py);
-    adaptive capacities route single-device (True + adaptive is
-    already rejected at config time).
+    under the shard_map dispatch adaptive capacities route
+    single-device (True + adaptive rejected at config time there),
+    while the gspmd dispatch takes them onto the mesh — its
+    global-view rollup reads concrete counts eagerly.
     """
     if config.data_parallel is False:
         return None
-    if config.adaptive_capacity:
+    if config.adaptive_capacity and config.resolved_dispatch != "gspmd":
         return None
     if config.data_parallel is None and jax.local_device_count() < 2:
         return None
@@ -1002,7 +1052,13 @@ def _resolve_backend(config: BatchJobConfig, n_emissions: int | None = None,
     trail: a ``backend_resolved`` event recording how ``"auto"`` routed
     (and why), plus the ``points_binned_total`` ingress counter when the
     emission count is known at resolution time. Pure pass-through of
-    ``config.resolved_cascade_backend`` when telemetry is off."""
+    ``config.resolved_cascade_backend`` when telemetry is off.
+
+    When the mesh engages (``data_parallel``), the event also carries
+    ``dispatch`` — how the formulation knob resolved ("gspmd" vs
+    "shard_map"), so dispatcher routing stays auditable alongside the
+    kernel-backend decision.
+    """
     resolved = config.resolved_cascade_backend
     if not obs.telemetry_enabled():
         return resolved
@@ -1020,6 +1076,8 @@ def _resolve_backend(config: BatchJobConfig, n_emissions: int | None = None,
     fields = {"requested": config.cascade_backend, "resolved": resolved,
               "reason": reason, "weighted": bool(config.weighted),
               "data_parallel": bool(data_parallel)}
+    if data_parallel:
+        fields["dispatch"] = config.resolved_dispatch
     if n_emissions is not None:
         fields["n_emissions"] = int(n_emissions)
     obs.emit("backend_resolved", **fields)
@@ -1064,9 +1122,6 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
     temp dir (tmpfs /tmp) disables auto-spill rather than fake the
     memory win (_auto_spill_target).
     """
-    import queue as queue_mod
-    import threading
-
     from heatmap_tpu.utils.trace import get_tracer
 
     if max_points < 1:
@@ -1332,53 +1387,27 @@ def _run_job_bounded(source, sink, config: BatchJobConfig,
             for chunk in chunks():
                 process(chunk)
         else:
-            # Double-buffer: the producer thread builds chunk N+1
-            # (source IO, parsing, group routing — pure host work, no
-            # JAX) while this thread runs chunk N's device cascade +
-            # merge.
-            q: queue_mod.Queue = queue_mod.Queue(maxsize=1)
-            stop = threading.Event()
-            DONE = object()
-            errors: list = []
+            # Double-buffer through the shared host->device feeder
+            # (pipeline/feeder.py): the worker thread builds chunk N+1
+            # (source IO, parsing, group routing) AND device-feeds its
+            # numeric columns while this thread runs chunk N's cascade
+            # + merge. Depth-1 queue keeps the same peak-footprint
+            # bound as the old host-only prefetch (at most 3 chunks:
+            # building + queued + in-cascade); chunk ORDER — and
+            # therefore every vocab id and merge result — is identical
+            # to the sequential path.
+            from heatmap_tpu.pipeline import feeder as feeder_mod
 
-            def put(item) -> bool:
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.1)
-                        return True
-                    except queue_mod.Full:
-                        continue
-                return False
+            def feed_chunk(chunk):
+                if not jax.config.jax_enable_x64:
+                    return chunk  # device_put would downcast (feeder.py)
+                lat, lon, g, ts, v = chunk
+                return (jax.device_put(lat), jax.device_put(lon), g, ts,
+                        None if v is None else jax.device_put(v))
 
-            def producer():
-                try:
-                    for chunk in chunks():
-                        if not put(chunk):
-                            return
-                except BaseException as e:  # noqa: BLE001 — re-raised below
-                    errors.append(e)
-                finally:
-                    put(DONE)
-
-            # context_bound: the prefetch thread's ingest.batch spans
-            # must parent under the ambient job span, not open a
-            # disconnected trace of their own.
-            from heatmap_tpu.obs import tracing as _tracing
-
-            t = threading.Thread(target=_tracing.context_bound(producer),
-                                 name="ingest-prefetch", daemon=True)
-            t.start()
-            try:
-                while True:
-                    item = q.get()
-                    if item is DONE:
-                        break
-                    process(item)
-            finally:
-                stop.set()
-                t.join()
-            if errors:
-                raise errors[0]
+            for item in feeder_mod.feed(chunks(), feed_chunk, depth=1,
+                                        thread_name="ingest-prefetch"):
+                process(item)
     except BaseException:
         if spill is not None:
             spill.cleanup()
@@ -2143,11 +2172,16 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
     dp_mesh = _dp_mesh_for(mesh0, config, len(e_codes), plan=plan)
     if plan is not None and (dp_mesh is None or plan.degenerate):
         plan = None  # fallback recorded by _dp_mesh_for
-    if plan is not None:
-        # Host-side range routing: scatter each emission into its
-        # owning shard's contiguous segment (pad lanes valid=False),
-        # bucketing the segment length so routed shapes reuse the
-        # bucketed compile cache.
+    dispatch = config.resolved_dispatch if dp_mesh is not None else None
+    timer = obs.DispatchTimer(dispatch or "single")
+    if plan is not None and dispatch != "gspmd":
+        # Host-side range routing (shard_map dispatch only — the gspmd
+        # program routes ON-DEVICE against the traced splits, so its
+        # emissions stay unrouted and this whole host scatter
+        # disappears): scatter each emission into its owning shard's
+        # contiguous segment (pad lanes valid=False), bucketing the
+        # segment length so routed shapes reuse the bucketed compile
+        # cache.
         with tracer.span("cascade.partition_route", items=len(e_codes)):
             bucket = None
             if config.pad_bucketing != "exact":
@@ -2192,6 +2226,9 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
                     # distinct trace, but splits are TRACED, so every
                     # plan of the same shard count shares one compile.
                     None if plan is None else ("morton", len(plan.splits)),
+                    # Dispatch term: the gspmd and shard_map programs
+                    # are distinct traces of the same math.
+                    dispatch,
                 ),
                 config.pad_bucketing,
             )
@@ -2215,11 +2252,18 @@ def _run_grouped(lat, lon, group_ids, timestamps, vocab,
             merge=config.dp_merge,
             weight_bound=config.weight_bound,
             partition_splits=partition_splits,
+            dispatch=dispatch or "shard_map",
             # Stage tracing needs the cascade EAGER: under the fused jit
             # the sort/segment-reduce spans would time tracing, not
             # execution (utils/trace.py stage_span).
             jit=jit,
         )
+        timer.dispatched()
+        if timer.enabled:
+            # Force execution so the host/device split measures the
+            # program, not async dispatch latency.
+            levels = jax.block_until_ready(levels)
+        timer.finished(items=len(e_codes))
     with tracer.span("cascade.decode"):
         decoded = cascade_mod.decode_levels(levels, ccfg)
     return _finish_blobs(

@@ -100,7 +100,7 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                   adaptive: bool = False, backend: str = "scatter",
                   mesh=None, merge: str = "replicated",
                   weight_bound: int | None = None,
-                  partition_splits=None):
+                  partition_splits=None, dispatch: str = "shard_map"):
     """Device-side cascade: per-level (composite key, sum) aggregates.
 
     Args:
@@ -156,7 +156,19 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
     is boundary tiles only (parallel.sharded.
     pyramid_sparse_morton_range_sharded) instead of the full-pyramid
     replicated/prefix merge. Traced — every plan shares one compile.
+
+    ``dispatch`` selects the mesh path's formulation: "shard_map" (the
+    parallel/sharded.py kernels — host-routed range segments, the
+    differential-testing oracle) or "gspmd" (parallel/gspmd.py —
+    global-view NamedSharding programs; ``partition_splits`` then
+    routes ON-DEVICE, so emissions arrive UNROUTED, and
+    ``adaptive`` composes with the mesh). Byte-identical outputs
+    either way (tests/test_gspmd.py).
     """
+    if dispatch not in ("shard_map", "gspmd"):
+        raise ValueError(
+            f"unknown cascade dispatch {dispatch!r} "
+            "(valid: shard_map, gspmd)")
     if merge not in ("replicated", "prefix"):
         raise ValueError(
             f"unknown mesh merge {merge!r} (valid: replicated, prefix)"
@@ -166,11 +178,18 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             "partition_splits is the mesh path's range plan; it needs "
             "a mesh — plan routing happens in pipeline/batch.py"
         )
-    if mesh is not None and adaptive:
+    if mesh is not None and adaptive and dispatch != "gspmd":
         raise ValueError(
-            "mesh-parallel cascade is shape-static; "
+            "shard_map mesh cascade is shape-static; "
             "adaptive_capacity reads concrete per-level counts and "
-            "does not compose — disable one of them"
+            "does not compose — disable one of them, or use "
+            "dispatch='gspmd' (its traced router and global-view "
+            "rollup accept adaptive shrinking)"
+        )
+    if mesh is not None and dispatch == "gspmd" and merge == "prefix":
+        raise ValueError(
+            "the gspmd dispatch has no prefix-merge program yet; use "
+            "dispatch='shard_map' for dp_merge='prefix'"
         )
     if backend == "partitioned":
         # These hold on the mesh path too: every shard runs the same
@@ -225,6 +244,7 @@ def build_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             backend=backend,
             weight_bound=weight_bound if weights is not None else None,
             partition_splits=partition_splits, n_slots=n_slots,
+            dispatch=dispatch, adaptive=adaptive,
         )
     if backend == "partitioned":
         return pyramid_ops.pyramid_sparse_morton_partitioned(
@@ -251,10 +271,19 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
                            acc_dtype=None, merge: str = "replicated",
                            backend: str = "scatter",
                            weight_bound: int | None = None,
-                           partition_splits=None, n_slots: int = 1):
+                           partition_splits=None, n_slots: int = 1,
+                           dispatch: str = "shard_map",
+                           adaptive: bool = False):
     """Pad composite keys to the mesh shard count and run the sharded
     pyramid (see build_cascade's ``mesh`` doc). Pad lanes carry
-    valid=False, the masking path every kernel already drops."""
+    valid=False, the masking path every kernel already drops.
+
+    ``dispatch="gspmd"`` swaps each shard_map kernel for its
+    global-view NamedSharding twin (parallel/gspmd.py): same padding,
+    byte-identical outputs; with ``partition_splits`` the emissions
+    arrive UNROUTED and are routed on-device, so no segment-divisibility
+    requirement applies there.
+    """
     # Lazy import: parallel.sharded pulls in the pallas histogram stack,
     # which cascade-only consumers (spark_adapter, tools) never need.
     from heatmap_tpu.parallel import sharded as sharded_kernels
@@ -270,6 +299,19 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
             capacity=capacity, acc_dtype=acc_dtype,
         )
     if partition_splits is not None:
+        if dispatch == "gspmd":
+            from heatmap_tpu.parallel import gspmd as gspmd_kernels
+
+            # UNROUTED emissions + traced splits: routing happens
+            # inside the program (route_on_device), replacing the host
+            # scatter of partition.route_emissions.
+            return gspmd_kernels.pyramid_gspmd_range(
+                ck, mesh, partition_splits,
+                code_bits=2 * config.detail_zoom, slot_bound=n_slots,
+                weights=weights, valid=valid, levels=config.n_levels,
+                capacity=capacity, acc_dtype=acc_dtype, backend=backend,
+                weight_bound=weight_bound, adaptive=adaptive,
+            )
         # Emissions arrive pre-routed into per-shard contiguous range
         # segments of equal length (partition.route_emissions) — no
         # tail pad here, a pad would shift lanes across segment
@@ -292,6 +334,14 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
             weights = jnp.concatenate(
                 [weights, jnp.zeros((pad,), weights.dtype)]
             )
+    if dispatch == "gspmd":
+        from heatmap_tpu.parallel import gspmd as gspmd_kernels
+
+        return gspmd_kernels.pyramid_gspmd_uniform(
+            ck, mesh, weights=weights, valid=v, levels=config.n_levels,
+            capacity=capacity, acc_dtype=acc_dtype, backend=backend,
+            weight_bound=weight_bound, adaptive=adaptive,
+        )
     kernel = (sharded_kernels.pyramid_sparse_morton_prefix_sharded
               if merge == "prefix"
               else sharded_kernels.pyramid_sparse_morton_sharded)
@@ -311,8 +361,35 @@ def _build_cascade_sharded(ck, config: CascadeConfig, mesh,
 _build_cascade_jit = functools.partial(
     jax.jit,
     static_argnames=("config", "n_slots", "capacity", "acc_dtype",
-                     "backend", "mesh", "merge", "weight_bound"),
+                     "backend", "mesh", "merge", "weight_bound",
+                     "dispatch"),
 )(build_cascade)
+
+#: Lazily-built donating twin of _build_cascade_jit for the gspmd
+#: dispatch: the routed-emission buffers (codes/slots/weights/valid)
+#: are donated to the program, letting XLA reuse their device memory
+#: for the pyramid accumulators in-place on TPU/GPU. Built on first
+#: use because donation support depends on the initialized backend
+#: (parallel/gspmd.py donating_jit drops donation on CPU but keeps the
+#: ledger guard, so re-feeding a consumed buffer is a typed error on
+#: every platform).
+_donating_cascade_jit = None
+
+
+def _get_donating_cascade_jit():
+    global _donating_cascade_jit
+    if _donating_cascade_jit is None:
+        from heatmap_tpu.parallel import gspmd as gspmd_kernels
+
+        _donating_cascade_jit = gspmd_kernels.donating_jit(
+            build_cascade,
+            donate_argnums=(0, 1),  # codes, slots
+            donate_argnames=("weights", "valid"),
+            static_argnames=("config", "n_slots", "capacity",
+                             "acc_dtype", "backend", "mesh", "merge",
+                             "weight_bound", "dispatch"),
+        )
+    return _donating_cascade_jit
 
 
 def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
@@ -321,7 +398,7 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
                 backend: str = "scatter", mesh=None,
                 merge: str = "replicated",
                 weight_bound: int | None = None,
-                partition_splits=None):
+                partition_splits=None, dispatch: str = "shard_map"):
     """The production cascade entry: jitted whole, unless ``adaptive``
     (which must read concrete per-level unique counts and therefore
     runs eagerly — see ops.pyramid.pyramid_sparse_morton) or
@@ -340,28 +417,37 @@ def run_cascade(codes, slots, config: CascadeConfig, n_slots: int,
             # (shape info is static even on tracers, so this is safe in
             # eager AND pre-jit contexts). backend_resolved in batch.py
             # records the routing *decision*; this records each execution.
+            extra = {"dispatch": dispatch} if mesh is not None else {}
             obs_events.emit(
                 "cascade_dispatch", backend=backend,
                 jit=bool(jit and not adaptive), mesh=mesh is not None,
                 merge=merge, n_emissions=int(codes.shape[0]),
                 n_slots=int(n_slots),
-                partition=partition_splits is not None)
+                partition=partition_splits is not None, **extra)
         if adaptive or not jit:
             return build_cascade(
                 codes, slots, config, n_slots, weights=weights, valid=valid,
                 capacity=capacity, acc_dtype=acc_dtype, adaptive=adaptive,
                 backend=backend, mesh=mesh, merge=merge,
                 weight_bound=weight_bound,
-                partition_splits=partition_splits,
+                partition_splits=partition_splits, dispatch=dispatch,
             )
         if isinstance(capacity, list):
             capacity = tuple(capacity)  # static args must be hashable
-        return _build_cascade_jit(
+        # Donation engages only when the emission buffers are already
+        # device-resident jax Arrays (the feeder's put, or an upstream
+        # jnp producer): donating host numpy inputs would be a silent
+        # no-op on TPU plus a "donated buffer not usable" warning.
+        jit_entry = _build_cascade_jit
+        if (dispatch == "gspmd" and mesh is not None
+                and isinstance(codes, jax.Array)):
+            jit_entry = _get_donating_cascade_jit()
+        return jit_entry(
             codes, slots, config=config, n_slots=n_slots, weights=weights,
             valid=valid, capacity=capacity, acc_dtype=acc_dtype,
             backend=backend, mesh=mesh, merge=merge,
             weight_bound=weight_bound,
-            partition_splits=partition_splits,
+            partition_splits=partition_splits, dispatch=dispatch,
         )
     finally:
         tracing.end_span(tsp)

@@ -1,0 +1,188 @@
+"""Async double-buffered host->device feeder.
+
+The one-program gspmd dispatch (parallel/gspmd.py) removes the host
+round-trips *between* cascade stages; what remains on the host path is
+the transfer *into* each dispatch — ``jax.device_put`` of the next
+batch's numeric columns. This module overlaps that transfer with the
+current batch's compute: a worker thread feeds batch k+1 onto the
+device (optionally with a target ``NamedSharding``) while batch k runs,
+through a bounded queue so at most ``depth`` fed batches are resident
+ahead of the consumer.
+
+Used by both standing consumers of the bucketed compile cache:
+
+- ``pipeline/batch.py`` ``_run_job_bounded`` feeds chunk k+1's
+  latitude/longitude/weights columns while chunk k's cascade runs
+  (replacing the host-only prefetch queue — same overlap semantics,
+  plus the H2D copy now rides the worker thread);
+- ``ingest/loop.py`` ``run_ingest`` feeds micro-batch columns ahead of
+  the tick that journals and applies them.
+
+Byte identity: the feeder moves buffers, never values. ``device_put``
+canonicalizes dtypes when x64 is off (float64 -> float32), which WOULD
+change results, so :func:`device_put_columns` passes everything through
+untouched unless ``jax_enable_x64`` is on (the composite-key cascade
+requires x64 anyway, so in practice the guard only disarms the feeder
+in configurations that could not run the cascade at all). Fed order is
+the source order — the queue is FIFO and the single worker feeds
+sequentially — so vocab ids, journal epochs, and merge results are
+identical to the unfed path (pinned in tests/test_gspmd.py).
+
+Fault plane: every put runs under the ``feeder.put`` site via
+``faults.retry_call`` — a transient (or injected) failure re-feeds the
+same batch, which is idempotent (device_put again; nothing downstream
+has seen it). A terminal failure propagates to the consumer, and on the
+ingest path the journal's content hashes make the re-fed batch
+exactly-once after restart (the chaos ``dispatch`` phase pins this).
+
+Telemetry: ``feeder_depth`` gauge (batches resident ahead of the
+consumer at each dequeue) and :class:`FeederStats` — ``feed_s`` (worker
+time spent transferring), ``wait_s`` (consumer time blocked on the
+queue), and ``overlap_pct`` = the share of transfer time hidden behind
+compute, the ``ingest:feed_overlap_pct`` bench series.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue as queue_mod
+import threading
+import time
+
+from heatmap_tpu import faults, obs
+
+_DONE = object()   # worker -> consumer end-of-stream sentinel
+_POLL_S = 0.05     # bounded put/get poll interval (not a sleep loop)
+
+#: Default bound on fed batches resident ahead of the consumer.
+#: 1 = classic double buffering (next batch transfers while the
+#: current one computes); deeper only helps when feed times are spiky.
+DEFAULT_DEPTH = 1
+
+
+@dataclasses.dataclass
+class FeederStats:
+    """Outcome of one feeder drain (shared with the consumer live)."""
+
+    batches: int = 0     #: batches fed through
+    feed_s: float = 0.0  #: worker seconds spent in transfer (sum)
+    wait_s: float = 0.0  #: consumer seconds blocked on the queue (sum)
+    depth_hwm: int = 0   #: max batches resident ahead of the consumer
+
+    @property
+    def overlap_pct(self) -> float:
+        """Share of transfer time hidden behind compute, in percent.
+
+        100 means the consumer never waited (every transfer fully
+        overlapped); 0 means every transfer second was paid for in
+        consumer wait time (no overlap at all).
+        """
+        if self.feed_s <= 0.0:
+            return 100.0
+        return 100.0 * max(0.0, 1.0 - self.wait_s / self.feed_s)
+
+
+def device_put_columns(cols, *, sharding=None, columns=("latitude",
+                                                        "longitude",
+                                                        "value")):
+    """Device-put the numeric columns of one batch dict.
+
+    Only ndarray-valued float/int columns in ``columns`` move (the
+    cascade consumes exactly those on device); string/object columns
+    and host-labeled ones (``timestamp`` feeds the host-side timespan
+    labeler) stay put. With x64 off everything passes through untouched
+    — see the module docstring's byte-identity contract.
+    """
+    import jax
+    import numpy as np
+
+    if not jax.config.jax_enable_x64:
+        return cols
+    out = dict(cols)
+    for name in columns:
+        val = out.get(name)
+        if isinstance(val, np.ndarray) and val.dtype.kind in "fiu":
+            out[name] = jax.device_put(val, sharding)
+    return out
+
+
+def feed(items, transfer, *, depth: int = DEFAULT_DEPTH,
+         stats: FeederStats | None = None, thread_name: str = "feeder"):
+    """Yield ``transfer(item)`` for each item, transferring up to
+    ``depth`` items ahead of the consumer on a worker thread.
+
+    ``transfer`` runs under the ``feeder.put`` fault site (retried per
+    its policy; must be idempotent — ``device_put`` is). Items yield in
+    source order. A worker exception (source or transfer, retries
+    exhausted) re-raises here after in-flight items drain; a consumer
+    exception stops the worker before propagating. The worker is
+    trace-context bound so transfer-side spans parent under the ambient
+    job span.
+
+    Returns a generator; pass a :class:`FeederStats` to read overlap
+    numbers during/after the drain.
+    """
+    if depth < 1:
+        raise ValueError(f"feeder depth must be >= 1, got {depth}")
+    st = stats if stats is not None else FeederStats()
+    q: queue_mod.Queue = queue_mod.Queue(maxsize=depth)
+    abort = threading.Event()
+    worker_error: list = []
+
+    def _put(payload) -> bool:
+        while not abort.is_set():
+            try:
+                q.put(payload, timeout=_POLL_S)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def _work():
+        try:
+            for index, item in enumerate(items):
+                t0 = time.monotonic()
+                fed = faults.retry_call(
+                    transfer, item, site="feeder.put", key=index)
+                st.feed_s += time.monotonic() - t0
+                if not _put(fed):
+                    return
+            _put(_DONE)
+        except BaseException as e:  # re-raised in the consumer
+            worker_error.append(e)
+            abort.set()
+
+    from heatmap_tpu.obs import tracing
+
+    worker = threading.Thread(target=tracing.context_bound(_work),
+                              name=thread_name, daemon=True)
+    worker.start()
+
+    def _drain():
+        metrics_on = obs.metrics_enabled()
+        try:
+            while True:
+                t0 = time.monotonic()
+                try:
+                    got = q.get(timeout=_POLL_S)
+                except queue_mod.Empty:
+                    if abort.is_set():
+                        break
+                    st.wait_s += time.monotonic() - t0
+                    continue
+                st.wait_s += time.monotonic() - t0
+                if got is _DONE:
+                    break
+                resident = q.qsize() + 1  # this item + still queued
+                st.depth_hwm = max(st.depth_hwm, resident)
+                if metrics_on:
+                    obs.FEEDER_DEPTH.set(q.qsize())
+                st.batches += 1
+                yield got
+        finally:
+            abort.set()
+            worker.join(timeout=5.0)
+        if worker_error:
+            raise worker_error[0]
+
+    return _drain()

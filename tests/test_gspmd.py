@@ -1,0 +1,505 @@
+"""Device-resident GSPMD cascade (parallel/gspmd.py + the dispatch knob).
+
+Four layers under test:
+
+- the global-view NamedSharding programs themselves (uniform DP and
+  Morton-range), gated byte-identical against the shard_map oracle at
+  the kernel level (padded level arrays AND counts);
+- the end-to-end ``dispatch="gspmd"`` route through run_job — every
+  tested shape (weighted, retraction sign=-1, pow2-bucketed,
+  Morton-partitioned, morton + adaptive_capacity, multihost-elastic)
+  must serve blobs byte-identical to ``dispatch="shard_map"``;
+- donation safety: re-using a donated buffer is a typed
+  :class:`DonatedBufferError` on every platform, ``donate_argnums`` is
+  dropped automatically on CPU, and results are byte-identical either
+  way;
+- the host->device feeder (pipeline/feeder.py): order preservation,
+  overlap stats, the ``feeder.put`` fault site, and byte-identical
+  ingest with the feeder on/off.
+
+Plus the jax<0.5 compat-shim regression: importing the gspmd module
+under ``mesh.force_cpu_devices`` must yield a working multi-device CPU
+mesh (satellite of the same PR).
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from heatmap_tpu import faults, obs
+from heatmap_tpu.parallel import gspmd, sharded
+from heatmap_tpu.parallel.mesh import make_mesh, named_sharding
+from heatmap_tpu.pipeline import BatchJobConfig, feeder, run_job
+from heatmap_tpu.pipeline.batch import run_batch
+
+DZ = 12
+SPACE = 1 << (2 * DZ)
+
+
+def _rows(n=500, seed=0,
+          users=("alice", "bob", "rt-bus7", "xscout", "carol")):
+    rng = np.random.default_rng(seed)
+    return [{
+        "latitude": float(rng.uniform(40.0, 55.0)),
+        "longitude": float(rng.uniform(-5.0, 15.0)),
+        "user_id": users[int(rng.integers(0, len(users)))],
+        "timestamp": 1_500_000_000_000 + int(rng.integers(0, 10**9)),
+    } for _ in range(n)]
+
+
+class _ColSource:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def batches(self, batch_size):
+        for i in range(0, len(self.rows), batch_size):
+            chunk = self.rows[i:i + batch_size]
+            out = {
+                "latitude": [r["latitude"] for r in chunk],
+                "longitude": [r["longitude"] for r in chunk],
+                "user_id": [r["user_id"] for r in chunk],
+                "timestamp": [r.get("timestamp") for r in chunk],
+            }
+            if any("value" in r for r in chunk):
+                out["value"] = [float(r.get("value", 1.0)) for r in chunk]
+            yield out
+
+
+def _cfg(**kw):
+    base = dict(detail_zoom=DZ, min_detail_zoom=6, data_parallel=True)
+    base.update(kw)
+    return BatchJobConfig(**base)
+
+
+def _levels_equal(a, b):
+    """Level-tuple equality up to each level's REAL row count (the
+    padded tails may differ only past n; they don't here, but the
+    contract is the prefix)."""
+    assert len(a) == len(b)
+    for (au, as_, an), (bu, bs, bn) in zip(a, b):
+        n = int(an)
+        assert n == int(bn)
+        assert np.array_equal(np.asarray(au), np.asarray(bu))
+        assert np.array_equal(np.asarray(as_), np.asarray(bs))
+
+
+def _keys(n, seed, n_slots=20):
+    rng = np.random.default_rng(seed)
+    code = rng.integers(0, SPACE, n)
+    slot = rng.integers(0, n_slots, n)
+    return jnp.asarray((slot << np.int64(2 * DZ)) | code, jnp.int64)
+
+
+# -- kernel-level byte identity --------------------------------------------
+
+
+def test_gspmd_uniform_matches_shard_map_kernel():
+    mesh = make_mesh()
+    ck = _keys(4096, 3)
+    w = jnp.asarray(np.random.default_rng(4).integers(1, 9, 4096),
+                    jnp.float64)
+    valid = jnp.asarray(np.random.default_rng(5).random(4096) > 0.1)
+    for weights in (None, w):
+        got = gspmd.pyramid_gspmd_uniform(
+            ck, mesh, weights=weights, valid=valid, levels=6,
+            capacity=4096,
+            acc_dtype=jnp.float64 if weights is not None else None)
+        want = sharded.pyramid_sparse_morton_sharded(
+            ck, mesh, weights=weights, valid=valid, levels=6,
+            capacity=4096,
+            acc_dtype=jnp.float64 if weights is not None else None)
+        _levels_equal(got, want)
+
+
+def test_gspmd_uniform_eager_equals_jit():
+    mesh = make_mesh()
+    ck = _keys(2048, 7)
+    eager = gspmd.pyramid_gspmd_uniform(ck, mesh, levels=5, capacity=2048)
+    jitted = jax.jit(
+        lambda k: gspmd.pyramid_gspmd_uniform(k, mesh, levels=5,
+                                              capacity=2048))(ck)
+    _levels_equal(eager, jitted)
+
+
+def test_route_on_device_matches_host_router():
+    """On-device ownership mask == the host searchsorted convention
+    (shard = #{splits <= code}, side='right')."""
+    rng = np.random.default_rng(11)
+    n = 2048
+    code = rng.integers(0, SPACE, n)
+    ck = jnp.asarray((rng.integers(0, 8, n) << np.int64(2 * DZ)) | code)
+    splits = np.sort(rng.integers(1, SPACE, 7))
+    owned = np.asarray(gspmd.route_on_device(
+        ck, jnp.asarray(splits), code_bits=2 * DZ, n_shards=8))
+    want = np.searchsorted(splits, code, side="right")
+    assert owned.shape == (8, n)
+    assert np.array_equal(np.argmax(owned, axis=0), want)
+    assert np.array_equal(owned.sum(axis=0), np.ones(n))  # exactly one owner
+
+
+# -- end-to-end byte identity ----------------------------------------------
+
+
+def _ab(rows, **kw):
+    a = run_job(_ColSource(rows), config=_cfg(dispatch="gspmd", **kw))
+    b = run_job(_ColSource(rows), config=_cfg(dispatch="shard_map", **kw))
+    assert a == b and len(a) > 0
+    return a
+
+
+def test_run_job_gspmd_uniform_byte_identical():
+    _ab(_rows(n=800, seed=42), spatial_partition="off")
+
+
+def test_run_job_gspmd_morton_byte_identical():
+    _ab(_rows(n=800, seed=42), spatial_partition="morton")
+
+
+@pytest.mark.slow
+def test_run_job_gspmd_weighted_byte_identical():
+    rng = np.random.default_rng(15)
+    rows = _rows(n=1200, seed=15)
+    for r in rows:
+        r["value"] = float(rng.integers(1, 12))
+    _ab(rows, weighted=True, spatial_partition="morton")
+
+
+@pytest.mark.slow
+def test_run_job_gspmd_pad_bucketing_byte_identical():
+    _ab(_rows(n=1500, seed=5), pad_bucketing="pow2",
+        spatial_partition="morton")
+
+
+def test_run_job_gspmd_morton_adaptive_composes():
+    """The lifted rejection: morton + adaptive_capacity under gspmd
+    runs, and its blobs equal BOTH the shard_map uniform-DP oracle and
+    the non-adaptive gspmd run (adaptive is result-neutral)."""
+    rows = _rows(n=800, seed=9)
+    adaptive = run_job(_ColSource(rows), config=_cfg(
+        dispatch="gspmd", spatial_partition="morton",
+        adaptive_capacity=True))
+    plain = run_job(_ColSource(rows), config=_cfg(
+        dispatch="gspmd", spatial_partition="morton"))
+    oracle = run_job(_ColSource(rows), config=_cfg(
+        dispatch="shard_map", spatial_partition="off"))
+    assert adaptive == plain == oracle and len(adaptive) > 0
+
+
+@pytest.mark.slow
+def test_retraction_delta_gspmd_byte_identical(tmp_path):
+    """sign=-1 negates finalized levels AFTER the cascade; the gspmd
+    route must produce identical artifact files."""
+    from heatmap_tpu.delta.compute import compute_delta
+
+    rows = _rows(n=1000, seed=21)
+    dirs = {}
+    for name in ("gspmd", "shard_map"):
+        out = str(tmp_path / name)
+        compute_delta(_ColSource(rows), out,
+                      _cfg(dispatch=name, spatial_partition="morton"),
+                      sign=-1)
+        dirs[name] = out
+
+    def blob(d):
+        return {f: open(os.path.join(d, f), "rb").read()
+                for f in sorted(os.listdir(d))
+                if os.path.isfile(os.path.join(d, f))}
+
+    assert blob(dirs["gspmd"]) == blob(dirs["shard_map"])
+
+
+@pytest.mark.slow
+def test_run_job_elastic_gspmd_byte_identical(tmp_path):
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.io.sources import SyntheticSource
+    from heatmap_tpu.parallel import run_job_elastic
+
+    out = {}
+    for name in ("gspmd", "shard_map"):
+        cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                             result_delta=2, dispatch=name)
+        d = str(tmp_path / name)
+        run_job_elastic(SyntheticSource(n=900, seed=7),
+                        LevelArraysSink(d), cfg, batch_size=150,
+                        lineage_dir=str(tmp_path / f"lin-{name}"),
+                        n_hosts=3, partition="morton")
+        out[name] = {f: open(os.path.join(d, f), "rb").read()
+                     for f in sorted(os.listdir(d))
+                     if os.path.isfile(os.path.join(d, f))}
+    assert out["gspmd"] == out["shard_map"]
+
+
+# -- config surface --------------------------------------------------------
+
+
+def test_dispatch_config_surface():
+    with pytest.raises(ValueError, match="dispatch"):
+        BatchJobConfig(dispatch="pjit")
+    with pytest.raises(ValueError, match="prefix"):
+        BatchJobConfig(dispatch="gspmd", data_parallel=True,
+                       dp_merge="prefix")
+    # auto resolves to gspmd except where no program exists (prefix).
+    assert BatchJobConfig().resolved_dispatch == "gspmd"
+    assert BatchJobConfig(data_parallel=True, dp_merge="prefix")\
+        .resolved_dispatch == "shard_map"
+    # morton + adaptive composes under gspmd (auto included) and stays
+    # rejected under the shard_map oracle.
+    BatchJobConfig(spatial_partition="morton", data_parallel=True,
+                   adaptive_capacity=True)
+    BatchJobConfig(spatial_partition="morton", data_parallel=True,
+                   adaptive_capacity=True, dispatch="gspmd")
+    with pytest.raises(ValueError, match="adaptive"):
+        BatchJobConfig(spatial_partition="morton", data_parallel=True,
+                       adaptive_capacity=True, dispatch="shard_map")
+
+
+def test_backend_resolved_event_carries_dispatch(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    obs.set_event_log(obs.EventLog(path))
+    try:
+        run_job(_ColSource(_rows(n=200, seed=1)),
+                config=_cfg(spatial_partition="off"))
+    finally:
+        log = obs.get_event_log()
+        obs.set_event_log(None)
+        log.close()
+    recs = [r for r in obs.read_events(path)
+            if r["event"] == "backend_resolved"]
+    assert recs and recs[0]["dispatch"] == "gspmd"
+    dis = [r for r in obs.read_events(path)
+           if r["event"] == "cascade_dispatch"]
+    assert dis and dis[0]["dispatch"] == "gspmd"
+
+
+def test_dispatch_overhead_metrics(tmp_path):
+    """DispatchTimer splits stage attribution into host vs device and
+    feeds the dispatch_overhead_seconds histogram."""
+    obs.enable_metrics(True)
+    try:
+        run_job(_ColSource(_rows(n=200, seed=2)),
+                config=_cfg(spatial_partition="off"))
+        over = obs.DISPATCH_OVERHEAD.samples()
+        assert ("gspmd",) in over and over[("gspmd",)][2] >= 1
+        stages = obs.STAGE_SECONDS.samples()
+        assert ("cascade.dispatch.host",) in stages
+        assert ("cascade.dispatch.device",) in stages
+    finally:
+        obs.enable_metrics(False)
+        obs.get_registry().reset()
+
+
+# -- donation safety -------------------------------------------------------
+
+
+def test_donation_dropped_on_cpu():
+    assert not gspmd.donation_supported("cpu")
+    assert gspmd.donation_supported("tpu")
+    assert gspmd.donation_supported("gpu")
+    fn = gspmd.donating_jit(lambda x: x + 1, donate_argnums=(0,),
+                            ledger=gspmd.DonationLedger())
+    assert fn.donation_active is False  # CPU test session
+
+
+def test_donated_buffer_reuse_is_typed_error():
+    led = gspmd.DonationLedger()
+    fn = gspmd.donating_jit(lambda x: x * 2, donate_argnums=(0,),
+                            ledger=led)
+    x = jnp.arange(16, dtype=jnp.int64)
+    y = fn(x)
+    assert np.array_equal(np.asarray(y), np.arange(16) * 2)
+    with pytest.raises(gspmd.DonatedBufferError,
+                       match="donated to a previous cascade dispatch"):
+        fn(x)
+    # A FRESH buffer with identical contents is fine (identity, not
+    # value, is what donation consumes).
+    z = fn(jnp.arange(16, dtype=jnp.int64))
+    assert np.array_equal(np.asarray(y), np.asarray(z))
+
+
+def test_donation_argnames_guard_kwargs():
+    led = gspmd.DonationLedger()
+    fn = gspmd.donating_jit(lambda x, w=None: x if w is None else x + w,
+                            donate_argnames=("w",), ledger=led)
+    w = jnp.ones(8, jnp.float64)
+    fn(jnp.zeros(8, jnp.float64), w=w)
+    with pytest.raises(gspmd.DonatedBufferError):
+        fn(jnp.zeros(8, jnp.float64), w=w)
+
+
+def test_donating_cascade_byte_identity():
+    """The donating jit entry produces the same bytes as the plain
+    entry — donation changes buffer lifetime, never values."""
+    mesh = make_mesh()
+    ck = _keys(2048, 13)
+
+    def prog(k):
+        return gspmd.pyramid_gspmd_uniform(k, mesh, levels=5,
+                                           capacity=2048)
+
+    plain = jax.jit(prog)(ck)
+    donating = gspmd.donating_jit(prog, donate_argnums=(0,),
+                                  ledger=gspmd.DonationLedger())
+    donated = donating(jnp.array(ck))  # fresh copy — ck stays usable
+    _levels_equal(plain, donated)
+
+
+def test_run_cascade_gspmd_marks_device_inputs():
+    """run_cascade's gspmd jit path routes device-resident emissions
+    through the donating entry: re-passing the SAME consumed buffers is
+    the typed error, on CPU too."""
+    from heatmap_tpu.pipeline import cascade as cascade_mod
+
+    cfg = _cfg(spatial_partition="off")
+    ccfg = cfg.cascade_config()
+    mesh = make_mesh()
+    n = 4096
+    rng = np.random.default_rng(3)
+    codes = jax.device_put(rng.integers(0, SPACE, n))
+    slots = jax.device_put(rng.integers(0, 4, n))
+
+    def run():
+        return cascade_mod.run_cascade(
+            codes, slots, ccfg, n_slots=4, capacity=n, mesh=mesh,
+            dispatch="gspmd")
+
+    cascade_mod.decode_levels(run(), ccfg)
+    try:
+        with pytest.raises(gspmd.DonatedBufferError):
+            run()
+    finally:
+        gspmd.ledger.clear()
+
+
+# -- mesh compat shim ------------------------------------------------------
+
+
+def test_force_cpu_devices_shim_imports_gspmd():
+    """jax<0.5 has no jax_num_cpu_devices config: force_cpu_devices
+    must fall back to XLA_FLAGS and still give the gspmd entry points a
+    multi-device CPU mesh (regression for the stale compat shim)."""
+    code = (
+        "import os\n"
+        "os.environ.pop('XLA_FLAGS', None)\n"
+        "from heatmap_tpu.parallel import mesh\n"
+        "mesh.force_cpu_devices(4)\n"
+        "import jax\n"
+        "assert jax.device_count() == 4, jax.devices()\n"
+        "jax.config.update('jax_enable_x64', True)\n"
+        "import jax.numpy as jnp\n"
+        "from heatmap_tpu.parallel import gspmd\n"
+        "m = mesh.make_mesh()\n"
+        "assert m.devices.size == 4\n"
+        "lv = gspmd.pyramid_gspmd_uniform(\n"
+        "    jnp.arange(64, dtype=jnp.int64), m, levels=2, capacity=64)\n"
+        "assert int(lv[0][2]) == 64\n"
+        "s = mesh.named_sharding(m, mesh.DATA_AXIS)\n"
+        "assert s.is_fully_addressable\n"
+        "print('SHIM-OK')\n"
+    )
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr
+    assert "SHIM-OK" in out.stdout
+
+
+# -- feeder ----------------------------------------------------------------
+
+
+def test_feeder_preserves_order_and_counts():
+    stats = feeder.FeederStats()
+    got = list(feeder.feed(iter(range(20)), lambda x: x * 10, depth=2,
+                           stats=stats))
+    assert got == [x * 10 for x in range(20)]
+    assert stats.batches == 20
+    assert 0.0 <= stats.overlap_pct <= 100.0
+    assert stats.depth_hwm <= 2
+
+
+def test_feeder_depth_validation():
+    with pytest.raises(ValueError, match="depth"):
+        list(feeder.feed(iter([1]), lambda x: x, depth=0))
+
+
+def test_feeder_device_put_columns_moves_numeric_only():
+    cols = {"latitude": np.arange(4, dtype=np.float64),
+            "longitude": np.arange(4, dtype=np.float64),
+            "value": np.ones(4),
+            "timestamp": np.arange(4, dtype=np.int64),
+            "user_id": ["a", "b", "c", "d"]}
+    fed = feeder.device_put_columns(cols)
+    assert isinstance(fed["latitude"], jax.Array)
+    assert isinstance(fed["value"], jax.Array)
+    # timestamp feeds the host-side labeler; user_id is strings.
+    assert isinstance(fed["timestamp"], np.ndarray)
+    assert fed["user_id"] is cols["user_id"]
+    assert np.array_equal(np.asarray(fed["latitude"]), cols["latitude"])
+
+
+def test_feeder_fault_site_retries_then_propagates():
+    # One injected fault at feeder.put: absorbed by the retry policy,
+    # every item still arrives exactly once in order.
+    faults.install(faults.FaultPlane(seed=1, backoff_scale=0.0)
+                   .add_rule("feeder.put", count=1))
+    try:
+        got = list(feeder.feed(iter(range(8)), lambda x: x, depth=1))
+        assert got == list(range(8))
+        assert faults.get_plane().injected == 1
+    finally:
+        faults.install(None)
+    # A storm past the retry budget propagates to the consumer.
+    faults.install(faults.FaultPlane(seed=1, backoff_scale=0.0)
+                   .add_rule("feeder.put", count=50))
+    try:
+        with pytest.raises(faults.InjectedFault):
+            list(feeder.feed(iter(range(8)), lambda x: x, depth=1))
+    finally:
+        faults.install(None)
+
+
+def test_ingest_feeder_byte_identical_store(tmp_path):
+    """Draining the same source with the feeder on vs off produces
+    byte-identical delta stores: same journal content hashes (the
+    feeder moves buffers, never values) and identical artifact files.
+    Journal entry FILES carry a wall-clock ``ts`` so they compare by
+    content hash, not bytes."""
+    from heatmap_tpu import ingest as ingest_mod
+    from heatmap_tpu.delta.compact import journal_dir
+    from heatmap_tpu.delta.journal import DeltaJournal
+    from heatmap_tpu.io import open_source
+
+    digests, hashes = {}, {}
+    for depth in (0, 2):
+        root = str(tmp_path / f"d{depth}")
+        st = ingest_mod.run_ingest(
+            root, open_source("synthetic:2000:13"),
+            config=BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                                  result_delta=2, pad_bucketing="pow2"),
+            ingest=ingest_mod.IngestConfig(micro_batch=512,
+                                           feed_depth=depth))
+        assert st.ticks == 4 and st.points == 2000
+        if depth:
+            assert st.feeder_depth_hwm >= 1
+        hashes[depth] = [
+            (e["epoch"], e["content_hash"], e["points"], e["sign"])
+            for e in DeltaJournal(journal_dir(root)).entries()]
+        files = {}
+        for dirpath, _, names in os.walk(root):
+            if "journal" in os.path.relpath(dirpath, root).split(os.sep):
+                continue
+            for f in names:
+                p = os.path.join(dirpath, f)
+                files[os.path.relpath(p, root)] = open(p, "rb").read()
+        digests[depth] = files
+    assert hashes[0] == hashes[2] and len(hashes[0]) == 4
+    assert sorted(digests[0]) == sorted(digests[2])
+    diff = [k for k in digests[0] if digests[0][k] != digests[2][k]]
+    assert not diff, diff

@@ -256,13 +256,19 @@ def test_spatial_partition_config_rejections():
         BatchJobConfig(spatial_partition="hilbert")
     with pytest.raises(ValueError, match="data_parallel"):
         BatchJobConfig(spatial_partition="morton", data_parallel=False)
+    # morton + adaptive_capacity now composes (the gspmd dispatch
+    # routes on-device against traced splits); only the shard_map
+    # oracle — whose routing is host-side and shape-coupled — still
+    # rejects it at config time.
     with pytest.raises(ValueError, match="adaptive"):
         BatchJobConfig(spatial_partition="morton", data_parallel=True,
-                       adaptive_capacity=True)
+                       adaptive_capacity=True, dispatch="shard_map")
     # The composing modes construct fine.
     BatchJobConfig(spatial_partition="off")
     BatchJobConfig(spatial_partition="morton", data_parallel=True,
                    pad_bucketing="pow2")
+    BatchJobConfig(spatial_partition="morton", data_parallel=True,
+                   adaptive_capacity=True)
 
 
 # -- elastic Morton shards -------------------------------------------------

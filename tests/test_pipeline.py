@@ -988,8 +988,15 @@ def test_dp_mesh_auto_routing():
     assert _dp_mesh(
         _dp_cfg(data_parallel=None, cascade_backend="partitioned")
     ) is not None
+    # adaptive_capacity composes with the mesh under the gspmd
+    # dispatch (the default auto resolution); only the shard_map
+    # oracle still routes it single-device.
     assert _dp_mesh(
         _dp_cfg(data_parallel=None, adaptive_capacity=True)
+    ) is not None
+    assert _dp_mesh(
+        _dp_cfg(data_parallel=None, adaptive_capacity=True,
+                dispatch="shard_map")
     ) is None
     # The size gate: auto stays single-device below the threshold
     # (tiny shards lose to the dispatch), engages at it; explicit True
@@ -1005,8 +1012,12 @@ def test_dp_config_rejections():
     accepted."""
     cfg = _dp_cfg(data_parallel=True, cascade_backend="partitioned")
     assert cfg.resolved_cascade_backend == "partitioned"
+    # adaptive + DP is accepted under the gspmd dispatch (default auto
+    # resolution); the shard_map oracle still rejects at config time.
+    _dp_cfg(data_parallel=True, adaptive_capacity=True)
     with pytest.raises(ValueError, match="adaptive"):
-        _dp_cfg(data_parallel=True, adaptive_capacity=True)
+        _dp_cfg(data_parallel=True, adaptive_capacity=True,
+                dispatch="shard_map")
 
 
 def test_cascade_backend_auto_resolution(monkeypatch):

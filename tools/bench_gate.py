@@ -22,8 +22,14 @@ Reads every bench artifact the repo's tooling writes —
   (``adaptive:availability[on|off]``, higher), and the hot-stage p99
   with the ladder active (``adaptive:p99_ms[on]``, lower);
 - ``BENCH_ingest.json`` (tools/bench_ingest.py): per micro-batch and
-  padding mode, sustained points/sec (higher) and ingest->servable
-  p99 lag ms (lower);
+  padding mode, sustained points/sec (higher), ingest->servable p99
+  lag ms (lower), and the feeder's transfer-overlap share
+  (``ingest:feed_overlap_pct[...]``, higher, noise-floored at 50%);
+- ``BENCH_dispatch.json`` (tools/bench_job.py --dispatch-sweep):
+  gspmd vs shard_map host-dispatch overhead share per dataset
+  (``dispatch:overhead_pct[ds,mode]``, lower) and the gspmd leg's
+  end-to-end wall seconds (lower; rows that failed the byte gate are
+  never folded);
 - ``BENCH_synopsis.json`` (tools/bench_synopsis.py): wavelet-synopsis
   exact/synopsis bytes ratio (higher) and pair decode p99 ms (lower);
 - ``BENCH_query.json`` (tools/bench_query.py): per-op integral-path
@@ -191,6 +197,16 @@ def snapshot_metrics(root: str) -> dict:
             p99 = (row.get("lag_ms") or {}).get("p99")
             if isinstance(p99, (int, float)):
                 out[f"ingest:lag_p99_ms[{cell}]"] = (float(p99), False)
+            # Feeder overlap (pipeline/feeder.py): the share of
+            # host->device transfer time hidden behind tick compute
+            # must not quietly collapse. Floored at 50% before the
+            # relative comparison: on CPU the transfer is near-free
+            # and the honest value hovers anywhere in 0..100 where a
+            # ratio gate would flap; the raw value stays in
+            # BENCH_ingest.json.
+            if isinstance(row.get("feed_overlap_pct"), (int, float)):
+                out[f"ingest:feed_overlap_pct[{cell}]"] = (
+                    max(float(row["feed_overlap_pct"]), 50.0), True)
     doc = _load(os.path.join(root, "BENCH_partition.json"))
     if isinstance(doc, dict):
         # Morton-range sharding A/B (bench_job --partition-sweep): the
@@ -211,6 +227,26 @@ def snapshot_metrics(root: str) -> dict:
                                            (int, float)):
                 out["partition:skew_ratio[zipf]"] = (
                     float(row["skew_ratio"]), False)
+    doc = _load(os.path.join(root, "BENCH_dispatch.json"))
+    if isinstance(doc, dict):
+        # Device-resident dispatch A/B (bench_job --dispatch-sweep):
+        # the host share of a cascade dispatch must not creep back up
+        # for either program (the gspmd leg is the product, the
+        # shard_map leg anchors what the oracle costs), nor may the
+        # gspmd wall time regress; rows that failed the byte gate are
+        # never folded.
+        for row in doc.get("results", []):
+            ds = row.get("dataset")
+            if ds is None or not row.get("byte_identical"):
+                continue
+            for mode in ("gspmd", "shard_map"):
+                pct = (row.get("overhead_pct") or {}).get(mode)
+                if isinstance(pct, (int, float)):
+                    out[f"dispatch:overhead_pct[{ds},{mode}]"] = (
+                        float(pct), False)
+            wall = (row.get("wall_s") or {}).get("gspmd")
+            if isinstance(wall, (int, float)):
+                out[f"dispatch:wall_s[{ds}]"] = (float(wall), False)
     doc = _load(os.path.join(root, "BENCH_synopsis.json"))
     if isinstance(doc, dict):
         ratio = (doc.get("compression") or {}).get("bytes_ratio")

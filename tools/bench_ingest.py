@@ -18,7 +18,11 @@ Measured per cell:
 - ``tick_ms``      apply+publish p50/p99 (queue wait excluded);
 - ``compiles``     distinct cascade jit signatures this run
                    (``bucketing.cache_stats()["misses"]`` — counted for
-                   exact mode too, under its own mode label).
+                   exact mode too, under its own mode label);
+- ``feed_overlap_pct``  share of host->device transfer time the
+                   double-buffered feeder (pipeline/feeder.py) hid
+                   behind tick compute, plus the feeder's queue-depth
+                   high-water mark.
 
 The exact cell of each pair runs first so a warm jax cache can only
 ever favor exact; bucketed cells still win on jittered sizes because
@@ -127,6 +131,8 @@ def bench_cell(cols: dict, micro_batch: int, mode: str,
         "cache_hits": cache_stats["hits"],
         "keys_invalidated": stats.keys_invalidated,
         "max_queue_depth": stats.max_queue_depth,
+        "feed_overlap_pct": round(stats.feed_overlap_pct, 1),
+        "feeder_depth_hwm": stats.feeder_depth_hwm,
     }
 
 

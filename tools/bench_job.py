@@ -355,6 +355,146 @@ def partition_sweep(args) -> int:
     return 0 if all(r["byte_identical"] for r in rows) else 1
 
 
+def dispatch_sweep(args) -> int:
+    """gspmd vs shard_map dispatch A/B (device-resident cascade).
+
+    Runs the same point sets END TO END (run_job -> level-array sink)
+    under both dispatch programs — the one-program gspmd pjit path and
+    the shard_map oracle — for uniform DP (uniform points) and
+    Morton-range sharding (Zipf-clustered points). Each leg's
+    host-vs-device split comes from the dispatch timer
+    (``obs.DISPATCH_OVERHEAD`` + the ``cascade.dispatch.*`` stages):
+    ``overhead_pct`` is the host share of one dispatch — the routing,
+    padding, and argument-prep work the gspmd program moves on device.
+    The byte gate rides along: both dispatches must produce identical
+    level-array files or the row is marked failed (bench_gate never
+    folds a failed row, and reads the artifact as ``dispatch:*``
+    series).
+    """
+    if args.cpu:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu import obs
+    from heatmap_tpu.delta import ColumnsSource
+    from heatmap_tpu.io.sinks import LevelArraysSink
+    from heatmap_tpu.pipeline import BatchJobConfig, run_job
+
+    n = args.sweep_n
+    rng = np.random.default_rng(17)
+
+    def zipf_points(m):
+        n_c = 32
+        ranks = np.arange(1, n_c + 1, dtype=np.float64)
+        p = (1.0 / ranks) / np.sum(1.0 / ranks)
+        centers_lat = rng.uniform(-55.0, 55.0, n_c)
+        centers_lon = rng.uniform(-170.0, 170.0, n_c)
+        k = int(m * 0.8)
+        c = rng.choice(n_c, size=k, p=p)
+        lat = np.concatenate([centers_lat[c] + rng.normal(0, 0.3, k),
+                              rng.uniform(-55.0, 55.0, m - k)])
+        lon = np.concatenate([centers_lon[c] + rng.normal(0, 0.3, k),
+                              rng.uniform(-170.0, 170.0, m - k)])
+        return lat, lon
+
+    # Each row pairs a point shape with the partitioner it exercises:
+    # uniform points -> uniform DP, Zipf clusters -> Morton ranges.
+    cells = {
+        "uniform": ((rng.uniform(-55.0, 55.0, n),
+                     rng.uniform(-170.0, 170.0, n)), "off"),
+        "morton": (zipf_points(n), "morton"),
+    }
+
+    def levels_files(path):
+        out = {}
+        for name in sorted(os.listdir(path)):
+            full = os.path.join(path, name)
+            if os.path.isfile(full):
+                with open(full, "rb") as f:
+                    out[name] = f.read()
+        return out
+
+    obs.enable_metrics(True)
+    reg = obs.get_registry()
+    ndev = len(jax.devices())
+    tmpdir = tempfile.mkdtemp(prefix="benchdispatch-")
+    rows = []
+    try:
+        for name, ((lat, lon), partition) in cells.items():
+            cols = {"latitude": lat, "longitude": lon,
+                    "user_id": ["all"] * n}
+            wall, host, dev, pct, n_disp, gate = {}, {}, {}, {}, {}, {}
+            for mode in ("shard_map", "gspmd"):
+                cfg = BatchJobConfig(detail_zoom=16, min_detail_zoom=10,
+                                     result_delta=2, data_parallel=True,
+                                     dispatch=mode,
+                                     spatial_partition=partition)
+                out_dir = os.path.join(tmpdir, f"{name}-{mode}")
+
+                def one_run(d, cfg=cfg, cols=cols):
+                    run_job(ColumnsSource(cols), LevelArraysSink(d),
+                            config=cfg, batch_size=max(1, n // 4))
+
+                one_run(out_dir)  # warmup compiles + the byte-gate run
+                gate[mode] = levels_files(out_dir)
+                reg.reset()  # timed reps only in the folded samples
+                t0 = time.perf_counter()
+                for _ in range(args.sweep_reps):
+                    one_run(os.path.join(tmpdir, f"{name}-{mode}-rep"))
+                wall[mode] = ((time.perf_counter() - t0)
+                              / args.sweep_reps)
+                counts, total, count_n = obs.DISPATCH_OVERHEAD.samples()[
+                    (mode,)]
+                host[mode], n_disp[mode] = total, int(count_n)
+                dev[mode] = obs.STAGE_SECONDS.samples()[
+                    ("cascade.dispatch.device",)][1]
+                pct[mode] = round(
+                    100.0 * host[mode] / max(host[mode] + dev[mode],
+                                             1e-12), 2)
+            identical = (sorted(gate["gspmd"]) == sorted(gate["shard_map"])
+                         and all(gate["gspmd"][k] == gate["shard_map"][k]
+                                 for k in gate["gspmd"]))
+            rows.append({
+                "dataset": name,
+                "n_points": n,
+                "spatial_partition": partition,
+                "dispatches_timed": n_disp,
+                "wall_s": {m: round(w, 4) for m, w in wall.items()},
+                "host_s": {m: round(h, 4) for m, h in host.items()},
+                "device_s": {m: round(d, 4) for m, d in dev.items()},
+                "overhead_pct": pct,
+                "overhead_reduction_pct": round(
+                    pct["shard_map"] - pct["gspmd"], 2),
+                "byte_identical": bool(identical),
+            })
+            print(json.dumps(rows[-1]), flush=True)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    doc = {
+        "bench": "dispatch",
+        "device": jax.devices()[0].platform,
+        "ndev": ndev,
+        "detail_zoom": 16,
+        "reps": args.sweep_reps,
+        "results": rows,
+    }
+    with open(args.dispatch_sweep, "w") as f:
+        json.dump(doc, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"wrote": args.dispatch_sweep}), flush=True)
+    return 0 if all(r["byte_identical"] for r in rows) else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=20_000_000)
@@ -390,10 +530,18 @@ def main() -> int:
                     "plan skew, modeled merge bytes, byte gate "
                     "(bench_gate reads the artifact as partition:* "
                     "series)")
+    ap.add_argument("--dispatch-sweep", nargs="?",
+                    const="BENCH_dispatch.json", default=None,
+                    metavar="OUT.json",
+                    help="gspmd vs shard_map dispatch A/B, end to end: "
+                    "wall time, host/device split per dispatch "
+                    "(overhead_pct), byte gate (bench_gate reads the "
+                    "artifact as dispatch:* series)")
     ap.add_argument("--sweep-n", type=int, default=1 << 20,
-                    help="points per partition-sweep dataset")
+                    help="points per partition/dispatch-sweep dataset")
     ap.add_argument("--sweep-reps", type=int, default=3,
-                    help="timed repetitions per partition-sweep leg")
+                    help="timed repetitions per partition/dispatch-"
+                    "sweep leg")
     # --single: internal re-exec mode (one measurement, in-process).
     ap.add_argument("--single", action="store_true",
                     help=argparse.SUPPRESS)
@@ -403,6 +551,9 @@ def main() -> int:
 
     if args.partition_sweep:
         return partition_sweep(args)
+
+    if args.dispatch_sweep:
+        return dispatch_sweep(args)
 
     if args.single:
         if args.cascade_backend == "both":

@@ -9,7 +9,12 @@ publishes, shard compute, tile renders, HTTP requests, and lost
 multihost heartbeats. A separate phase soaks the continuous-ingest
 loop (heatmap_tpu/ingest/): an ``ingest.*`` storm the retries absorb,
 then a kill mid-tick whose restart must heal exactly-once and serve
-byte-identical to a one-shot apply. A host-loss phase kills one
+byte-identical to a one-shot apply. A dispatch phase storms the
+double-buffered host->device feeder (``feeder.put``): absorbed
+transfer faults re-feed the same batch invisibly, a kill mid-feed
+crashes the loop with exactly the fed-ahead ticks journaled, and the
+restart re-feeds the crashed batch exactly-once — served bytes
+identical to an unfed one-shot apply. A host-loss phase kills one
 simulated host mid-cascade (its heartbeats eaten by the
 ``multihost.heartbeat`` site) and requires the elastic layer
 (heatmap_tpu/parallel/elastic.py) to reassign its shards and still
@@ -52,6 +57,7 @@ failed. A fast subset runs in tier-1 as tests/test_chaos.py (-m chaos).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import random
@@ -411,6 +417,107 @@ def phase_ingest_crash(ctx):
     assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
     return {"ticks": ticks_total, "absorbed_faults": absorbed,
             "epochs": epochs, "tiles": len(got)}
+
+
+#: dispatch-phase storms (the feeder has its own planes, installed
+#: here). Absorbed storm: two spaced ``feeder.put`` faults, each inside
+#: the site's retry budget, so the re-fed batches are invisible.
+DISPATCH_CHAOS = "seed=31,scale=0,feeder.put=2x2"
+#: Kill storm: batch index 2's transfer fails past the whole retry
+#: budget — the loop crashes mid-feed after the fed-ahead ticks landed.
+DISPATCH_KILL = "seed=31,scale=0,feeder.put@2=99"
+
+
+def phase_dispatch(ctx):
+    """The double-buffered feeder (pipeline/feeder.py) under a
+    ``feeder.put`` storm with a kill mid-feed: absorbed faults re-feed
+    the same batch invisibly (``device_put`` is idempotent), the killed
+    run crashes with exactly the fed-ahead ticks journaled, the restart
+    re-feeds the crashed batch and the journal's content hashes keep
+    every batch exactly-once, and the recovered store serves
+    byte-identical to a one-shot apply of the same points. The overlap
+    telemetry must show the feeder actually ran ahead. Installs its own
+    planes (runs after fault_floor)."""
+    from heatmap_tpu import ingest
+
+    n = ctx["n"]
+    cols: dict = {}
+    for batch in SyntheticSource(n=n, seed=23).batches(1 << 20):
+        for c, v in batch.items():
+            cols.setdefault(c, []).extend(v)
+    micro = max(1, -(-n // 6))  # 6 ticks: 2 land, 1 killed, 3 recovered
+    ticks_total = -(-n // micro)
+    assert ticks_total >= 4, ticks_total
+    root = os.path.join(os.path.dirname(ctx["base_root"]),
+                        "store-dispatch")
+    # Multi-device runs soak the one-program gspmd dispatch under the
+    # storm too (parallel/gspmd.py); single-device runs still pin the
+    # feeder contract on the plain path.
+    dcfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                          result_delta=2,
+                          data_parallel=True if len(jax.devices()) > 1
+                          else None)
+    icfg = ingest.IngestConfig(micro_batch=micro, queue_depth=2,
+                               compact_every=0, feed_depth=1)
+
+    delta.init_store(root)
+    store, cache = TileStore(f"delta:{root}"), TileCache()
+
+    # 1. Absorbed storm: the first two ticks land despite one transfer
+    #    fault each (inside the feeder.put retry budget).
+    plane = faults.install_spec(DISPATCH_CHAOS)
+    first = ingest.run_ingest(
+        root, delta.ColumnsSource(cols), dcfg, store=store, cache=cache,
+        ingest=dataclasses.replace(icfg, max_ticks=2))
+    absorbed = plane.injected
+    assert first.ticks == 2 and first.duplicates == 0, vars(first)
+    assert absorbed >= 2, f"absorbed storm never fired ({absorbed})"
+
+    # 2. Kill mid-feed: duplicates of the landed ticks sail through the
+    #    feeder, then batch 2's transfer dies past its retries — the
+    #    worker aborts, the in-flight batches drain, and the loop
+    #    crashes with nothing new journaled.
+    faults.install_spec(DISPATCH_KILL)
+    try:
+        ingest.run_ingest(root, delta.ColumnsSource(cols), dcfg,
+                          store=store, cache=cache, ingest=icfg)
+    except faults.InjectedFault as e:
+        assert e.site == "feeder.put", e
+    else:
+        raise AssertionError("feeder kill never crashed the loop")
+    faults.install(None)
+    assert len(delta.live_entries(root)) == 2, "crashed feed journaled"
+
+    # 3. Recovery: re-drain the whole source; the crashed batch is
+    #    re-fed and every batch lands exactly once.
+    stats = ingest.run_ingest(root, delta.ColumnsSource(cols), dcfg,
+                              store=store, cache=cache, ingest=icfg)
+    assert stats.ticks == ticks_total and stats.duplicates == 2, \
+        vars(stats)
+    assert stats.feeder_depth_hwm >= 1, vars(stats)
+    live = delta.live_entries(root)
+    hashes = [e["content_hash"] for e in live]
+    assert len(live) == ticks_total and len(set(hashes)) == ticks_total
+    epochs = [e["epoch"] for e in live]
+    assert epochs == sorted(epochs)
+
+    # 4. Byte identity vs a one-shot (unfed, single-dispatch) apply.
+    ref = os.path.join(os.path.dirname(ctx["base_root"]),
+                       "store-dispatch-ref")
+    delta.apply_batch(ref, delta.ColumnsSource(cols),
+                      BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                                     result_delta=2))
+    got = _serve_docs(root)["docs"]
+    want = _serve_docs(ref)["docs"]
+    assert sorted(got) == sorted(want), (
+        f"served tile sets diverged: {len(got)} vs {len(want)}")
+    mism = [k for k in want if got[k] != want[k]]
+    assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
+    return {"ticks": ticks_total, "absorbed_faults": absorbed,
+            "refed_batch": 2, "epochs": epochs,
+            "feed_overlap_pct": round(stats.feed_overlap_pct, 1),
+            "feeder_depth_hwm": stats.feeder_depth_hwm,
+            "tiles": len(got)}
 
 
 #: host_loss wedge: the wedged worker installs this spec the moment it
@@ -1187,6 +1294,7 @@ PHASES = [
     ("heartbeat", phase_heartbeat),
     ("fault_floor", phase_fault_floor),
     ("ingest_crash", phase_ingest_crash),
+    ("dispatch", phase_dispatch),
     ("host_loss", phase_host_loss),
     ("host_loss_morton", phase_host_loss_morton),
     ("backend_loss", phase_backend_loss),
