@@ -326,6 +326,23 @@ def _add_trace_flags(p):
                    help="tail-based retention threshold: any trace "
                    "slower than this is promoted from the flight "
                    "recorder into the trace as if head-sampled")
+    p.add_argument("--telemetry-sample-interval", type=float, default=0.0,
+                   metavar="SEC",
+                   help="background telemetry sampler cadence: every "
+                   "SEC seconds the obs registry is snapshotted into "
+                   "the in-process time-series tiers that back "
+                   "/series, /dashboard, and incident-bundle history "
+                   "(docs/observability.md). 0 (the default) disables "
+                   "the sampler entirely — zero threads, zero hot-path "
+                   "cost")
+    p.add_argument("--watch", action="append", default=None, metavar="SPEC",
+                   help="watch a telemetry series for anomalies as "
+                   "NAME:k=v,... (params: z, alpha, min_count, "
+                   "clear_ratio; repeatable), e.g. "
+                   "'ingest_lag_seconds:z=6'. Each rising edge emits "
+                   "one anomaly_detected event and triggers an "
+                   "incident bundle with the surrounding history "
+                   "embedded; requires --telemetry-sample-interval > 0")
 
 
 def _setup_tracing(args):
@@ -364,10 +381,43 @@ def _setup_tracing(args):
             tail_latency_s=None if tail_ms is None else tail_ms / 1000.0))
     if incident_dir:
         obs.incident.set_manager(obs.IncidentManager(incident_dir))
+    # Telemetry sampler + anomaly watch list. Interval 0 (the default)
+    # arms nothing: no store installed, no thread started, so the
+    # sampler-off path is byte-identical to a build without this
+    # subsystem (pinned in tests/test_timeseries.py).
+    interval = getattr(args, "telemetry_sample_interval", 0.0) or 0.0
+    if interval < 0:
+        raise SystemExit(f"--telemetry-sample-interval {interval}: "
+                         "must be >= 0")
+    watches = getattr(args, "watch", None) or []
+    if watches and not interval:
+        raise SystemExit("--watch requires --telemetry-sample-interval "
+                         "> 0 (detectors score sampler ticks)")
+    if interval:
+        from heatmap_tpu.obs import anomaly, timeseries
+
+        engine = None
+        if watches:
+            try:
+                specs = [anomaly.parse_watch_spec(s) for s in watches]
+            except ValueError as e:
+                raise SystemExit(f"--watch: {e}") from e
+            engine = anomaly.AnomalyEngine(specs)
+            anomaly.set_engine(engine)
+        spill_dir = (os.path.join(incident_dir, "telemetry")
+                     if incident_dir else None)
+        timeseries.arm(interval, engine=engine, spill_dir=spill_dir)
     return collector
 
 
 def _export_trace(args, collector):
+    """End-of-job obs teardown: every command's exit path funnels
+    through here, so the telemetry sampler is stopped (with a final
+    crash-safe spill) before the trace export — both no-op when the
+    respective subsystem was never armed."""
+    from heatmap_tpu.obs import timeseries
+
+    timeseries.shutdown()
     if collector is None:
         return
     n = collector.export_chrome(args.trace_out)
@@ -1109,6 +1159,10 @@ def _serve_fleet(args, collector, ev_log) -> int:
         probe_interval_s=args.probe_interval,
         degrade_opts=degrade_opts,
         slo_specs=list(getattr(args, "slo", None) or []),
+        telemetry_opts=(
+            {"interval": args.telemetry_sample_interval,
+             "watches": list(getattr(args, "watch", None) or [])}
+            if getattr(args, "telemetry_sample_interval", 0.0) else None),
         disk_cache_opts=({"root": args.disk_cache,
                           "max_bytes": args.disk_cache_bytes}
                          if getattr(args, "disk_cache", None) else None),
@@ -1119,7 +1173,10 @@ def _serve_fleet(args, collector, ev_log) -> int:
                       if getattr(args, "prewarm_events", None) else None))
     from heatmap_tpu.obs import incident as incident_mod
 
-    incident_mod.add_state_provider("healthz", supervisor.router._health)
+    # Lazy: supervisor.router is None until supervisor.start() below.
+    incident_mod.add_state_provider(
+        "healthz",
+        lambda: supervisor.router._health() if supervisor.router else {})
     incident_mod.add_state_provider("config", lambda: {
         "store": args.store, "fleet": args.fleet,
         "backends": {bid: c.address for bid, c

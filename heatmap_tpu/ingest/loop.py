@@ -61,7 +61,7 @@ import time
 
 from heatmap_tpu import faults, obs
 from heatmap_tpu.obs import recorder as recorder_mod
-from heatmap_tpu.obs import tracing
+from heatmap_tpu.obs import timeseries, tracing
 
 _DONE = object()  # producer -> consumer end-of-stream sentinel
 _POLL_S = 0.05    # producer put/abort poll interval (bounded wait, not a sleep)
@@ -439,7 +439,15 @@ def run_ingest(root: str, source, config=None, *,
             depth=ing.feed_depth, stats=fstats,
             thread_name="ingest-feeder")
     with tracing.span("ingest.loop"):
-        pump = run_ticks(batches, _tick, queue_depth=ing.queue_depth)
+        try:
+            pump = run_ticks(batches, _tick, queue_depth=ing.queue_depth)
+        finally:
+            # Crash-safe telemetry: persist the sampled history so far
+            # (atomic publish, obs/timeseries.py) even when a tick
+            # raised — the post-mortem wants the lag/tick-latency
+            # trend leading up to the failure. No-op with the sampler
+            # off or without a spill dir.
+            timeseries.flush_spill()
     stats.max_queue_depth = pump["max_queue_depth"]
     stats.seconds = time.monotonic() - t_loop
     if fstats is not None:

@@ -23,9 +23,12 @@ from __future__ import annotations
 
 import time
 
-from heatmap_tpu.obs import events, incident, metrics, recorder, slo, tracing
+from heatmap_tpu.obs import (anomaly, events, incident, metrics, recorder,
+                             slo, timeseries, tracing)
+from heatmap_tpu.obs.anomaly import AnomalyEngine, WatchSpec, parse_watch_spec
 from heatmap_tpu.obs.incident import IncidentManager
 from heatmap_tpu.obs.recorder import FlightRecorder
+from heatmap_tpu.obs.timeseries import TelemetrySampler, TimeSeriesStore
 from heatmap_tpu.obs.events import (EVENT_SCHEMA, EventLog, emit,
                                     get_event_log, read_events,
                                     set_event_log, validate_event)
@@ -124,6 +127,10 @@ INCIDENTS_TOTAL = _registry.counter(
 RECORDER_DROPPED = _registry.counter(
     "recorder_dropped_total",
     "Flight-recorder ring evictions (spans + events)")
+ANOMALIES_TOTAL = _registry.counter(
+    "anomalies_total",
+    "Anomaly-detector rising edges, by watch spec",
+    labelnames=("watch",))
 PROCESS_UPTIME = _registry.gauge(
     "process_uptime_seconds", "Seconds since this process imported obs")
 BUILD_INFO = _registry.gauge(
@@ -437,10 +444,13 @@ def record_speculative_result(shard, winner, loser=None, won: bool = False,
 
 
 __all__ = [
+    "AnomalyEngine",
     "DISPATCH_OVERHEAD", "DispatchTimer",
     "EVENT_SCHEMA", "EventLog", "FEEDER_DEPTH", "FlightRecorder",
     "IncidentManager",
     "MetricsRegistry", "SLOEngine", "SLOSpec",
+    "TelemetrySampler", "TimeSeriesStore", "WatchSpec",
+    "anomaly", "parse_watch_spec", "timeseries",
     "TraceCollector", "blob_checksum", "build_run_report", "current_span",
     "current_traceparent", "device_topology", "disable_tracing", "emit",
     "enable_metrics", "enable_tracing", "events", "format_run_report",

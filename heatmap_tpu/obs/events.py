@@ -171,10 +171,17 @@ EVENT_SCHEMA = {
     "query_served": {"required": ("op", "zoom", "path"),
                      "optional": ("layer", "bbox_area", "cells", "k",
                                   "q", "max_err", "ms")},
+    # obs/anomaly.py: a watched series' EWMA+MAD z-score crossed its
+    # threshold (rising edge; one record per breach episode, cleared
+    # with hysteresis — never per sampler tick). series is the
+    # flattened telemetry key, watch the spec name that matched.
+    "anomaly_detected": {"required": ("series", "z"),
+                         "optional": ("threshold", "watch", "value",
+                                      "detail")},
     # obs/incident.py: one incident bundle flushed (trigger is the
     # edge kind — slo_breach | shed | fault_storm | degraded_enter |
-    # exception; path the bundle directory; seq the manager's own
-    # monotonic bundle counter).
+    # anomaly | exception; path the bundle directory; seq the
+    # manager's own monotonic bundle counter).
     "incident_flush": {"required": ("trigger", "path"),
                        "optional": ("seq", "detail", "bytes")},
     # tilefs/prewarm.py: one cache pre-warm pass finished (startup or

@@ -1,14 +1,17 @@
 """Incident bundles: flush the flight recorder on failure edges.
 
 A **trigger** — SLO breach rising edge, typed-503 shed, injected-fault
-storm over a rate threshold, degraded-enter, or an uncaught exception in
-a CLI job — flushes one self-contained bundle under
-``<incident_dir>/<run_id>-<seq>/``:
+storm over a rate threshold, degraded-enter, a telemetry anomaly edge
+(obs/anomaly.py), or an uncaught exception in a CLI job — flushes one
+self-contained bundle under ``<incident_dir>/<run_id>-<seq>/``:
 
 - ``trace.json``    ring spans as Perfetto/Chrome trace-event JSON
   (loadable in chrome://tracing and by tools/trace_analyze.py);
 - ``events.json``   the recent event tail from the ring;
 - ``metrics.json``  full registry snapshot (exemplars included);
+- ``telemetry.json`` the raw-tier time-series window preceding the
+  trigger (when a telemetry store is installed) — the "what changed
+  in the last 5 minutes" a point-in-time snapshot cannot answer;
 - ``state.json``    whatever state providers are registered —
   /healthz + breaker/fleet state from serve, config fingerprint and
   delta/synopsis epochs from the CLI;
@@ -48,7 +51,8 @@ DEFAULT_STORM_THRESHOLD = 8
 DEFAULT_STORM_WINDOW_S = 10.0
 
 TRIGGER_KINDS = ("slo_breach", "shed", "fault_storm", "degraded_enter",
-                 "exception")
+                 "anomaly", "exception")
+DEFAULT_TELEMETRY_WINDOW_S = 300.0
 
 
 class IncidentManager:
@@ -101,6 +105,8 @@ class IncidentManager:
             self.trigger("slo_breach", detail=rec.get("slo"))
         elif event == "degraded_enter":
             self.trigger("degraded_enter", detail=rec.get("cause"))
+        elif event == "anomaly_detected":
+            self.trigger("anomaly", detail=rec.get("series"))
         elif event == "fault_injected":
             ts = rec.get("ts", 0.0)
             storm = False
@@ -157,6 +163,17 @@ class IncidentManager:
             except Exception as e:  # a dying subsystem must not block
                 state[name] = {"error": repr(e)}
 
+        # Recent telemetry history (obs/timeseries.py): the raw-tier
+        # window preceding the trigger, so the bundle answers "what
+        # changed in the 5 minutes before this fired" — not just the
+        # instantaneous metrics.json snapshot. Bounded by the store's
+        # own rings, so it rides outside the trim loop.
+        from heatmap_tpu.obs import timeseries
+
+        ts_store = timeseries.get_store()
+        telemetry = (ts_store.recent_window(DEFAULT_TELEMETRY_WINDOW_S)
+                     if ts_store is not None else None)
+
         # Size cap: trim the tails oldest-first until the bundle fits.
         files = None
         while True:
@@ -170,6 +187,9 @@ class IncidentManager:
                 "state.json": json.dumps(state, indent=1, sort_keys=True,
                                          default=str),
             }
+            if telemetry is not None:
+                files["telemetry.json"] = json.dumps(
+                    telemetry, sort_keys=True, default=str)
             total = sum(len(v) for v in files.values())
             if total <= self.max_bytes or (not spans and not tail):
                 break

@@ -39,7 +39,7 @@ import threading
 import time
 
 from heatmap_tpu import faults, obs
-from heatmap_tpu.obs import slo
+from heatmap_tpu.obs import anomaly, slo, timeseries
 from heatmap_tpu.serve import degrade as degrade_mod
 from heatmap_tpu.serve.cache import TileCache
 from heatmap_tpu.serve.http import ServeApp, make_server, serve_in_thread
@@ -152,7 +152,8 @@ class _ProcessBackend:
                  degrade_opts: dict | None = None,
                  slo_specs: list | None = None,
                  disk_cache_opts: dict | None = None,
-                 prewarm_opts: dict | None = None):
+                 prewarm_opts: dict | None = None,
+                 telemetry_opts: dict | None = None):
         self.id = backend_id
         self._store_spec = store_spec
         self._host = host
@@ -166,6 +167,7 @@ class _ProcessBackend:
         self._slo_specs = list(slo_specs or [])
         self._disk_cache_opts = disk_cache_opts
         self._prewarm_opts = prewarm_opts
+        self._telemetry_opts = telemetry_opts
         self.proc: subprocess.Popen | None = None
         self.started_at = 0.0
         self._seq = 0
@@ -186,6 +188,14 @@ class _ProcessBackend:
             argv += ["--chaos", self._chaos]
         for spec in self._slo_specs:
             argv += ["--slo", spec]
+        if self._telemetry_opts and self._telemetry_opts.get("interval"):
+            # Forwarded like --slo: each child samples its own registry
+            # so the router's fleet-merged /series carries per-backend
+            # history, and child-side watches score child-side traffic.
+            argv += ["--telemetry-sample-interval",
+                     str(self._telemetry_opts["interval"])]
+            for spec in self._telemetry_opts.get("watches") or []:
+                argv += ["--watch", spec]
         if self._degrade_opts:
             argv += ["--degrade",
                      "--degrade-dwell",
@@ -286,7 +296,8 @@ class FleetSupervisor:
                  degrade_opts: dict | None = None,
                  slo_specs: list | None = None,
                  disk_cache_opts: dict | None = None,
-                 prewarm_opts: dict | None = None):
+                 prewarm_opts: dict | None = None,
+                 telemetry_opts: dict | None = None):
         if mode not in ("process", "thread"):
             raise ValueError(f"unknown fleet mode {mode!r}")
         if mode == "process" and not store_spec:
@@ -308,6 +319,10 @@ class FleetSupervisor:
         self._slo_specs = list(slo_specs or [])
         self._disk_cache_opts = disk_cache_opts
         self._prewarm_opts = prewarm_opts
+        # process mode only: thread-mode backends share the supervisor
+        # process's global sampler/engine (same sharing as the SLO
+        # engine above), so there is nothing per-backend to arm.
+        self._telemetry_opts = telemetry_opts
         self.restart_base_s = restart_base_s
         self.restart_cap_s = restart_cap_s
         self.monitor_interval_s = monitor_interval_s
@@ -362,7 +377,8 @@ class FleetSupervisor:
             workdir=self._workdir, spawn_timeout_s=self._spawn_timeout_s,
             degrade_opts=self._degrade_opts, slo_specs=self._slo_specs,
             disk_cache_opts=self._disk_cache_opts,
-            prewarm_opts=self._prewarm_opts)
+            prewarm_opts=self._prewarm_opts,
+            telemetry_opts=self._telemetry_opts)
 
     def stop(self):
         self._stop.set()
@@ -467,6 +483,9 @@ def backend_main(argv=None) -> int:
     parser.add_argument("--render-timeout", type=float, default=None)
     parser.add_argument("--chaos", default=None)
     parser.add_argument("--slo", action="append", default=[])
+    parser.add_argument("--telemetry-sample-interval", type=float,
+                        default=0.0)
+    parser.add_argument("--watch", action="append", default=[])
     parser.add_argument("--degrade", action="store_true")
     parser.add_argument("--degrade-dwell", type=float, default=10.0)
     parser.add_argument("--degrade-hold", type=float, default=30.0)
@@ -486,6 +505,17 @@ def backend_main(argv=None) -> int:
     # backend evaluates the same objectives over its own traffic.
     if args.slo:
         slo.install_specs(args.slo)
+    # Per-child telemetry sampler + watches (forwarded like --slo):
+    # each backend samples its own registry so the router's
+    # fleet-merged /series carries per-backend history. 0 = the
+    # pinned zero-cost off path — nothing armed.
+    if args.telemetry_sample_interval:
+        engine = None
+        if args.watch:
+            engine = anomaly.AnomalyEngine(
+                [anomaly.parse_watch_spec(s) for s in args.watch])
+            anomaly.set_engine(engine)
+        timeseries.arm(args.telemetry_sample_interval, engine=engine)
     controller = degrade_mod.controller_from_flags(
         args.degrade, args.degrade_dwell, args.degrade_hold,
         args.degrade_ladder)
@@ -515,6 +545,7 @@ def backend_main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        timeseries.shutdown()
         server.server_close()
     return 0
 

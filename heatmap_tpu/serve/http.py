@@ -23,6 +23,13 @@ Routes:
   parameters get typed 400s; ETags live in a ``"q-``-prefixed
   namespace and results ride the same byte-capped LRU with
   stale-if-error semantics as tiles.
+- ``GET /series?name=&label=&from=&to=&step=`` — aligned history
+  frames from the embedded telemetry tiers (obs/timeseries.py) with
+  the achieved resolution stamped per frame; a well-formed
+  ``enabled: false`` answer when the sampler is off
+- ``GET /dashboard``                      — self-contained operational
+  page (serve/dashboard.py): inline HTML/SVG sparklines over
+  ``/series`` + ``/healthz``, zero external assets
 - ``GET /healthz``                        — store/cache stats (JSON)
 - ``GET /metrics``                        — Prometheus 0.0.4 text from
   the process-wide obs registry (so serving metrics sit next to any
@@ -70,7 +77,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from heatmap_tpu import faults, obs
 from heatmap_tpu.analytics import metrics as analytics_metrics
 from heatmap_tpu.analytics import query as analytics_query
-from heatmap_tpu.obs import incident, recorder, slo, tracing
+from heatmap_tpu.obs import (anomaly, incident, recorder, slo, timeseries,
+                             tracing)
+from heatmap_tpu.serve import dashboard as dashboard_mod
 from heatmap_tpu.serve import degrade as degrade_mod
 from heatmap_tpu.serve.cache import TileCache
 from heatmap_tpu.serve.render import (SynopsisLayer, synopsis_source,
@@ -104,6 +113,58 @@ def _query_etag(body: bytes) -> str:
     # Query results get their own namespace too: a /query body must
     # never revalidate against a tile's (or a synopsis tile's) ETag.
     return f'"q-{zlib.crc32(body):08x}"'
+
+
+def local_series_response(query: str):
+    """Answer ``GET /series`` from this process's telemetry store —
+    the same 6-tuple contract as ``handle()``. Module-level (not a
+    ServeApp method) so the fleet router serves its own history
+    through the identical parser before merging backend frames."""
+    params = urllib.parse.parse_qs(query) if query else {}
+
+    def _param(key, default=None):
+        vals = params.get(key)
+        return vals[-1] if vals else default
+
+    try:
+        name = _param("name")
+        if not name:
+            raise ValueError("missing required parameter name")
+        labels = {}
+        for raw in params.get("label", []):
+            key, eq, value = raw.partition("=")
+            if not eq or not key:
+                raise ValueError(
+                    f"label must be key=value, got {raw!r}")
+            labels[key] = value
+        bounds = {}
+        for key, attr in (("from", "start"), ("to", "end"),
+                          ("step", "step")):
+            raw = _param(key)
+            if raw is None:
+                continue
+            try:
+                bounds[attr] = float(raw)
+            except ValueError:
+                raise ValueError(f"{key} must be a number, got {raw!r}")
+        if bounds.get("step") is not None and bounds["step"] <= 0:
+            raise ValueError(f"step must be > 0, got {bounds['step']}")
+    except ValueError as e:
+        body = json.dumps({"error": "bad query",
+                           "detail": str(e)}).encode()
+        return 400, "application/json", body, None, "series", None
+    store = timeseries.get_store()
+    if store is None:
+        body = json.dumps({
+            "enabled": False, "name": name, "frames": [],
+            "detail": "telemetry sampler off "
+                      "(--telemetry-sample-interval 0)",
+        }, sort_keys=True).encode()
+        return 200, "application/json", body, None, "series", None
+    doc = store.query(name, labels=labels or None, **bounds)
+    doc["enabled"] = True
+    body = json.dumps(doc, sort_keys=True).encode()
+    return 200, "application/json", body, None, "series", None
 
 
 class Response(tuple):
@@ -237,6 +298,12 @@ class ServeApp:
                                        self._synopsis_opt(query))
         if method == "GET" and path == "/query":
             return self._handle_query(query, if_none_match)
+        if method == "GET" and path == "/series":
+            return self._handle_series(query)
+        if method == "GET" and path == "/dashboard":
+            body = dashboard_mod.render_page()
+            return (200, "text/html; charset=utf-8", body, None,
+                    "dashboard", None)
         if method == "GET" and path == "/healthz":
             body = json.dumps(self._health(), indent=2).encode()
             return 200, "application/json", body, None, "healthz", None
@@ -375,6 +442,19 @@ class ServeApp:
                                    source=source)
         self._prewarm_last = summary
         return summary
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _handle_series(self, query: str):
+        """``GET /series?name=&label=k=v&from=&to=&step=``: aligned
+        frames from the telemetry tiers (obs/timeseries.py), achieved
+        resolution stamped per frame. Sampler off is a well-formed
+        answer (``enabled: false``, no frames), not an error — the
+        dashboard polls this unconditionally. Deterministic: the same
+        explicit ``from``/``to`` window over a quiescent store answers
+        byte-identically on every query (pinned in
+        tests/test_timeseries.py)."""
+        return local_series_response(query)
 
     # -- range queries -----------------------------------------------------
 
@@ -727,6 +807,15 @@ class ServeApp:
                                  for k, v in sorted(burns.items())}
         if self.degrade is not None:
             stats["degrade"] = self.degrade.snapshot()
+        # Telemetry store + anomaly engine state (when armed): the
+        # dashboard's status chips and anomaly panel read these.
+        ts_store = timeseries.get_store()
+        if ts_store is not None:
+            stats["telemetry"] = ts_store.stats()
+        engine = anomaly.get_engine()
+        if engine is not None:
+            stats["anomalies"] = engine.recent(16)
+            stats["anomaly_watches"] = engine.status()["watches"]
         return stats
 
 

@@ -36,7 +36,8 @@ Robustness machinery, in the order a request meets it:
   failover is the backoff, a request handler never sleeps.
 
 Byte-equality contract: everything that is not a router-owned
-endpoint (``/healthz``, ``/metrics``, ``/reload``, ``/fleet/*``) is
+endpoint (``/healthz``, ``/metrics``, ``/series``, ``/dashboard``,
+``/reload``, ``/fleet/*``) is
 forwarded verbatim — status, body, ETag, and ``If-None-Match``
 revalidation all come from an ordinary ``ServeApp`` backend, so a
 fleet response is byte-identical to a single process no matter which
@@ -58,9 +59,10 @@ import urllib.parse
 from collections import deque
 
 from heatmap_tpu import faults, obs
-from heatmap_tpu.obs import incident, tracing
+from heatmap_tpu.obs import anomaly, incident, timeseries, tracing
+from heatmap_tpu.serve import dashboard as dashboard_mod
 from heatmap_tpu.serve import degrade as degrade_mod
-from heatmap_tpu.serve.http import _TILE_RE, Response
+from heatmap_tpu.serve.http import _TILE_RE, Response, local_series_response
 
 _registry = obs.get_registry()
 FLEET_REQUESTS = _registry.counter(
@@ -154,14 +156,19 @@ _SAMPLE_RE = re.compile(
 
 def relabel_metrics(text: str, **extra_labels) -> str:
     """Inject labels (e.g. ``backend="b0"``) into every sample line of
-    a Prometheus text exposition. Comment lines are dropped — the
-    merged fleet page keeps one HELP/TYPE block per metric (the
-    scraping router's own) instead of one per backend."""
+    a Prometheus text exposition. HELP/TYPE comment lines pass through
+    unchanged — :func:`merge_expositions` dedupes them so the merged
+    fleet page keeps one header block per metric family (the scraping
+    router's own when it shares the family, else one adopted from the
+    first backend that exposes it)."""
     injected = ",".join(f'{k}="{v}"' for k, v in sorted(
         extra_labels.items()))
     out = []
     for line in text.splitlines():
-        if not line or line.startswith("#"):
+        if not line:
+            continue
+        if line.startswith("#"):
+            out.append(line)
             continue
         m = _SAMPLE_RE.match(line)
         if m is None:
@@ -169,6 +176,87 @@ def relabel_metrics(text: str, **extra_labels) -> str:
         labels = m["labels"]
         merged = f"{injected},{labels}" if labels else injected
         out.append(f"{m['name']}{{{merged}}}{m['rest']}")
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def merge_expositions(own: str, extra: str) -> str:
+    """Fold relabeled backend sample lines into the router's own
+    exposition, grouped by metric family. Naively concatenating the
+    per-backend chunks after the router's page puts the same family
+    (``http_requests_total`` on the router shell AND on every backend)
+    in non-contiguous runs — which strict Prometheus text parsers
+    reject, silently costing the scrape the router's own registry
+    (``fleet_*``, its shell's ``http_requests_total``). Here every
+    family appears exactly once: the router's HELP/TYPE block and own
+    samples first, backend-labeled samples appended inside the same
+    block, backend-only families as new blocks at the end (pinned by
+    the scrape-parse test in tests/test_fleet.py)."""
+    families: list = []     # (family, header_lines, sample_lines)
+    by_family: dict = {}
+
+    def _group(name):
+        entry = by_family.get(name)
+        if entry is None:
+            entry = (name, [], [])
+            families.append(entry)
+            by_family[name] = entry
+        return entry
+
+    current = None
+    for line in own.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            name = line.split(" ", 3)[2]
+            current = _group(name)
+            current[1].append(line)
+        elif line:
+            m = _SAMPLE_RE.match(line)
+            if current is None or (m is not None
+                                   and not m["name"].startswith(
+                                       current[0])):
+                current = _group(m["name"] if m is not None else line)
+            current[2].append(line)
+    # Histogram families expose suffixed sample names; map them back so
+    # a backend's _bucket lines land inside the family's block.
+    sample_to_family = {}
+    for name, _header, _samples in families:
+        sample_to_family[name] = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            sample_to_family[name + suffix] = name
+    for line in extra.splitlines():
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            # Backend-only family: adopt its header block (one copy —
+            # every backend chunk repeats it) so suffixed histogram
+            # samples still parse as ONE typed family downstream.
+            name = line.split(" ", 3)[2]
+            family = sample_to_family.get(name)
+            if family is None:
+                family = name
+                for sname in (name, name + "_bucket", name + "_sum",
+                              name + "_count"):
+                    sample_to_family.setdefault(sname, family)
+            entry = _group(family)
+            kind = line.split(" ", 2)[1]
+            if not any(h.split(" ", 2)[1] == kind for h in entry[1]):
+                entry[1].append(line)
+            continue
+        m = _SAMPLE_RE.match(line) if line else None
+        if m is None:
+            continue
+        family = sample_to_family.get(m["name"])
+        if family is None:
+            family = m["name"]
+            for suffix in ("_bucket", "_sum", "_count"):
+                if family.endswith(suffix):
+                    family = family[:-len(suffix)]
+                    break
+            for sname in (family, family + "_bucket", family + "_sum",
+                          family + "_count"):
+                sample_to_family.setdefault(sname, family)
+        _group(family)[2].append(line)
+    out = []
+    for _name, header, samples in families:
+        out.extend(header)
+        out.extend(samples)
     return "\n".join(out) + ("\n" if out else "")
 
 
@@ -520,10 +608,20 @@ class RouterApp:
             obs.refresh_process_gauges()
             text = _registry.render_prometheus()
             if _flag_opt(query, "fleet"):
-                text += self._fleet_metrics()
+                # Family-grouped merge: the router's own registry and
+                # every backend's samples in one parse-valid exposition
+                # (a plain concat puts shared families in
+                # non-contiguous runs, which strict scrapers reject).
+                text = merge_expositions(text, self._fleet_metrics())
             body = text.encode()
             return (200, "text/plain; version=0.0.4", body, None,
                     "metrics", None)
+        if method == "GET" and bare == "/series":
+            return self._handle_series(query)
+        if method == "GET" and bare == "/dashboard":
+            body = dashboard_mod.render_page(title="heatmap-tpu fleet ops")
+            return (200, "text/html; charset=utf-8", body, None,
+                    "dashboard", None)
         if method == "POST" and bare == "/reload":
             return self._rolling_reload()
         if method == "POST" and bare.startswith("/fleet/"):
@@ -550,6 +648,49 @@ class RouterApp:
             chunks.append(relabel_metrics(
                 body.decode("utf-8", "replace"), backend=bid))
         return "".join(chunks)
+
+    def _handle_series(self, query: str):
+        """``GET /series`` router-side: the router's own telemetry
+        store through the same parser as ServeApp, and — under
+        ``?fleet=1``, the ``/metrics?fleet=1`` fan-out shape — each
+        live backend's frames merged in, stamped with a ``backend``
+        label (router-own frames stamped ``"router"``). Unreachable
+        backends are skipped, never a 5xx: a dashboard poll must not
+        trip breakers or fail on a dead ring member."""
+        result = local_series_response(query)
+        status, ctype, body, etag, route, cache = result
+        if status != 200 or not _flag_opt(query, "fleet"):
+            return result
+        doc = json.loads(body)
+        frames = doc.get("frames") or []
+        for frame in frames:
+            frame["backend"] = "router"
+        enabled = bool(doc.get("enabled"))
+        for bid in sorted(self.backends):
+            backend = self.backends[bid]
+            if not backend.eligible():
+                continue
+            try:
+                b_status, _, b_body = backend.fetch(
+                    "GET", f"/series?{query}")
+            except Exception:
+                continue
+            if b_status != 200:
+                continue
+            try:
+                b_doc = json.loads(b_body)
+            except ValueError:
+                continue
+            for frame in b_doc.get("frames") or []:
+                frame["backend"] = bid
+                frames.append(frame)
+            enabled = enabled or bool(b_doc.get("enabled"))
+        doc["frames"] = frames
+        doc["enabled"] = enabled
+        if enabled:
+            doc.pop("detail", None)  # at least one sampler is on
+        body = json.dumps(doc, sort_keys=True).encode()
+        return 200, "application/json", body, None, "series", None
 
     # -- routing -----------------------------------------------------------
 
@@ -885,4 +1026,12 @@ class RouterApp:
             # The agreed fleet-wide ladder state (max rung across the
             # ring) — what operators and upstream layers should read.
             doc["degrade"] = snap
+        # Router-process telemetry + anomaly state, when armed — the
+        # dashboard served off the router reads these chips.
+        ts_store = timeseries.get_store()
+        if ts_store is not None:
+            doc["telemetry"] = ts_store.stats()
+        engine = anomaly.get_engine()
+        if engine is not None:
+            doc["anomalies"] = engine.recent(16)
         return doc

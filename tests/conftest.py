@@ -51,7 +51,8 @@ def _reset_telemetry():
     yield
     from heatmap_tpu import faults, obs
     from heatmap_tpu.delta import recover
-    from heatmap_tpu.obs import incident, recorder, slo, tracing
+    from heatmap_tpu.obs import (anomaly, incident, recorder, slo,
+                                 timeseries, tracing)
     from heatmap_tpu.utils import trace
 
     trace.get_tracer().reset()
@@ -65,6 +66,8 @@ def _reset_telemetry():
     tracing.disable_tracing()  # unhooks trace/events integrations too
     slo.set_engine(None)
     incident.set_manager(None)
+    timeseries.shutdown()  # stops any sampler thread + clears the store
+    anomaly.set_engine(None)
     recorder.install(None)  # restores the tracing/events hooks to None
     faults.install(None)  # disarm any chaos a test left installed
     recover.clear_verified_cache()

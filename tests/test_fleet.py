@@ -569,3 +569,184 @@ class TestSupervisorRestart:
             sup.stop()
             obs.set_event_log(None)
             log.close()
+
+
+# -- merged /metrics exposition ---------------------------------------------
+
+
+class TestFleetMetricsMerge:
+    """``/metrics?fleet=1`` must be ONE valid Prometheus document.
+
+    The regression this pins: backend chunks naively appended after the
+    router's own exposition repeat metric families (``http_requests_total``
+    lives on the router shell AND on every backend), and strict
+    text-format parsers reject families split into non-contiguous runs —
+    effectively losing the router's own registry (``fleet_*``, its
+    shell's request counter) on a real scrape. ``merge_expositions``
+    regroups samples under one contiguous block per family.
+    """
+
+    BACKEND_TEXT = (
+        "# HELP http_requests_total HTTP requests served\n"
+        "# TYPE http_requests_total counter\n"
+        'http_requests_total{route="tile",status="200"} 5\n'
+        "# HELP serve_request_seconds Request latency\n"
+        "# TYPE serve_request_seconds histogram\n"
+        'serve_request_seconds_bucket{le="0.1"} 3\n'
+        'serve_request_seconds_bucket{le="+Inf"} 5\n'
+        "serve_request_seconds_sum 0.4\n"
+        "serve_request_seconds_count 5\n"
+    )
+
+    @staticmethod
+    def _scrape_parse(text):
+        """Strict text-format walk: returns ``{family: [sample lines]}``
+        and fails the test if any family appears in two separate runs —
+        exactly the property a conforming scraper relies on."""
+        runs: dict[str, list[str]] = {}
+        histograms = set()
+        current = None
+
+        def enter(family):
+            nonlocal current
+            if family != current:
+                assert family not in runs, (
+                    f"family {family!r} split into non-contiguous runs")
+                runs[family] = []
+                current = family
+
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split()
+                assert parts[1] in ("HELP", "TYPE"), line
+                if parts[1] == "TYPE" and parts[3] == "histogram":
+                    histograms.add(parts[2])
+                enter(parts[2])
+                continue
+            name = line.split("{", 1)[0].split(" ", 1)[0]
+            family = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = name[: -len(suffix)]
+                if name.endswith(suffix) and base in histograms:
+                    family = base
+            enter(family)
+            runs[family].append(line)
+        return runs
+
+    def _router_with_fakes(self):
+        from heatmap_tpu.serve.router import RouterApp
+
+        text = self.BACKEND_TEXT
+
+        class _Backend:
+            def __init__(self, bid):
+                self.id = bid
+
+            def eligible(self):
+                return True
+
+            def fetch(self, method, path):
+                return 200, {}, text.encode()
+
+        router = RouterApp([])
+        router.backends = {"b0": _Backend("b0"), "b1": _Backend("b1")}
+        return router
+
+    def test_merged_exposition_scrape_parses_with_router_registry(self):
+        from heatmap_tpu.serve.http import HTTP_REQUESTS
+        from heatmap_tpu.serve.router import FLEET_REQUESTS
+
+        obs.enable_metrics(True)
+        # Router-own samples that share a family with every backend
+        # (http_requests_total) and one that exists only on the router.
+        HTTP_REQUESTS.inc(route="metrics", status="200")
+        FLEET_REQUESTS.inc(backend="b0", outcome="ok")
+        router = self._router_with_fakes()
+        status, ctype, body, *_ = router.handle("GET", "/metrics?fleet=1")
+        assert status == 200 and ctype.startswith("text/plain")
+        runs = self._scrape_parse(body.decode())
+
+        # Router's own registry survives the merge un-relabeled...
+        assert any('backend=' not in line
+                   for line in runs["http_requests_total"])
+        assert runs["fleet_requests_total"]
+        # ...next to both backends' relabeled samples, in ONE run.
+        for bid in ("b0", "b1"):
+            assert any(f'backend="{bid}"' in line
+                       for line in runs["http_requests_total"]), bid
+            assert any(f'backend="{bid}"' in line
+                       for line in runs["serve_request_seconds"]), bid
+        # Histogram suffix series stay grouped under their family.
+        kinds = {s.split("{", 1)[0].split(" ", 1)[0]
+                 for s in runs["serve_request_seconds"]}
+        assert {"serve_request_seconds_sum",
+                "serve_request_seconds_count"} <= kinds
+
+    def test_plain_metrics_unchanged_without_fleet_flag(self):
+        obs.enable_metrics(True)
+        from heatmap_tpu.serve.router import FLEET_REQUESTS
+
+        FLEET_REQUESTS.inc(backend="b0", outcome="ok")
+        router = self._router_with_fakes()
+        status, _, body, *_ = router.handle("GET", "/metrics")
+        assert status == 200
+        assert b'backend="b0"' not in body or b"fleet_" in body
+        # No backend scrape happened: the fake backends' histogram
+        # family never appears.
+        assert b"serve_request_seconds" not in body
+
+
+class TestFleetTelemetryForwarding:
+    """The supervisor forwards --telemetry-sample-interval/--watch to
+    process-mode children the same way it forwards --slo, so the
+    router's fleet-merged /series carries per-backend history."""
+
+    def test_process_backend_argv_carries_telemetry_flags(self, tmp_path):
+        from heatmap_tpu.serve.fleet import _ProcessBackend
+
+        backend = _ProcessBackend(
+            "b0", "arrays:/nonexistent", workdir=str(tmp_path),
+            telemetry_opts={"interval": 2.5,
+                            "watches": ["ingest_lag_seconds:z=6"]})
+        captured = {}
+
+        class _Boom(Exception):
+            pass
+
+        def fake_popen(argv, **kwargs):
+            captured["argv"] = argv
+            raise _Boom
+
+        import subprocess
+
+        real = subprocess.Popen
+        subprocess.Popen = fake_popen
+        try:
+            with pytest.raises(_Boom):
+                backend.start()
+        finally:
+            subprocess.Popen = real
+        argv = captured["argv"]
+        i = argv.index("--telemetry-sample-interval")
+        assert argv[i + 1] == "2.5"
+        j = argv.index("--watch")
+        assert argv[j + 1] == "ingest_lag_seconds:z=6"
+
+    def test_supervisor_plumbs_telemetry_opts_to_handles(self):
+        from heatmap_tpu.serve.fleet import FleetSupervisor
+
+        sup = FleetSupervisor(
+            "arrays:/nonexistent", 1,
+            telemetry_opts={"interval": 1.0, "watches": []})
+        sup._workdir = "."
+        handle = sup._make_handle("b0")
+        assert handle._telemetry_opts == {"interval": 1.0, "watches": []}
+
+    def test_no_telemetry_opts_means_no_forwarding(self):
+        from heatmap_tpu.serve.fleet import _ProcessBackend
+
+        backend = _ProcessBackend("b0", "arrays:/nonexistent",
+                                  workdir=".")
+        assert backend._telemetry_opts is None

@@ -70,6 +70,9 @@ _PAYLOADS = {
     "quarantine": {"root": "store/", "path": "journal/ckpt-3.npz",
                    "reason": "digest_mismatch", "kind": "journal_entry",
                    "detail": "recorded sha256:aa..., actual sha256:bb..."},
+    "anomaly_detected": {"series": "ingest_lag_seconds", "z": 7.2,
+                         "threshold": 6.0, "watch": "ingest_lag_seconds",
+                         "value": 42.5},
     "shard_orphaned": {"shard": "5", "host": "2", "reason": "heartbeat"},
     "shard_reassigned": {"shard": "5", "from_host": "2", "to_host": "0"},
     "speculative_launch": {"shard": "3", "host": "1", "runtime_s": 4.2,
@@ -658,6 +661,7 @@ class TestNoRawInstrumentation:
     JAX_FREE = ("heatmap_tpu/serve/store.py", "heatmap_tpu/serve/render.py",
                 "heatmap_tpu/serve/http.py", "heatmap_tpu/serve/cache.py",
                 "heatmap_tpu/serve/router.py",
+                "heatmap_tpu/serve/dashboard.py",
                 "heatmap_tpu/serve/degrade.py", "heatmap_tpu/synopsis/",
                 "heatmap_tpu/analytics/", "heatmap_tpu/tilefs/")
     JAX_IMPORT = re.compile(r"^(?:import jax\b|from jax\b)")

@@ -446,6 +446,46 @@ class TestIncidentReportTool:
         assert [h["name"] for h in trace_row["critical_path"]] == [
             "serve.request", "tile.render"]
 
+    def test_report_prints_pre_trigger_telemetry_movers(self, tmp_path):
+        import subprocess
+        import sys
+
+        from heatmap_tpu.obs import timeseries
+        from heatmap_tpu.obs.timeseries import TimeSeriesStore
+
+        clock = _fake_clock()
+        store = TimeSeriesStore(clock=clock)
+        for i in range(20):
+            store.observe("ingest_lag_seconds", 2.0 + (8.0 if i >= 15
+                                                       else 0.0),
+                          ts=clock() + i * 10.0)
+        timeseries.install(store)
+        mgr = IncidentManager(str(tmp_path / "inc"), run_id="tel",
+                              clock=lambda: clock() + 200.0)
+        incident.set_manager(mgr)
+        try:
+            path = mgr.trigger("anomaly", detail="ingest_lag_seconds")
+        finally:
+            incident.set_manager(None)
+            timeseries.install(None)
+        assert os.path.exists(os.path.join(path, "telemetry.json"))
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        tool = os.path.join(repo, "tools", "incident_report.py")
+        report = json.loads(subprocess.run(
+            [sys.executable, tool, path, "--json"],
+            capture_output=True, text=True, check=True).stdout)
+        assert report["trigger"] == "anomaly"
+        (mover,) = report["telemetry"]["movers"]
+        assert mover["series"] == "ingest_lag_seconds"
+        assert mover["first"] == 2.0 and mover["last"] == 10.0
+        assert mover["delta"] == 8.0
+        # The human rendering answers "what changed before the trigger".
+        text = subprocess.run(
+            [sys.executable, tool, path],
+            capture_output=True, text=True, check=True).stdout
+        assert "before the trigger" in text
+        assert "ingest_lag_seconds" in text
+
     def test_trace_analyze_accepts_bundle_dir(self, tmp_path):
         import sys
         sys.path.insert(0, os.path.join(

@@ -1,0 +1,688 @@
+"""Telemetry time-series store, anomaly detection, /series, /dashboard.
+
+Pins for PR 17's observability tentpole:
+
+- tier rollups are *deterministic and exact*: every rollup bucket's
+  min/max/sum/count/last equals a brute-force recomputation from the
+  raw sample stream (no float drift, no order dependence);
+- /series answers are byte-identical across repeated queries and stamp
+  the achieved tier resolution;
+- the sampler-off path is invisible: zero new threads and byte-identical
+  tile blobs;
+- crash-safety: torn spill snapshots are quarantined (never crash
+  startup) and the next spill still works;
+- the anomaly pipeline fires exactly one ``anomaly_detected`` edge per
+  excursion and exactly one incident bundle with the surrounding
+  telemetry history embedded.
+"""
+
+import json
+import os
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import obs
+from heatmap_tpu.obs import anomaly, incident, timeseries
+from heatmap_tpu.obs.anomaly import (AnomalyEngine, SeriesDetector, WatchSpec,
+                                     parse_watch_spec)
+from heatmap_tpu.obs.timeseries import (TelemetrySampler, TimeSeriesStore,
+                                        flatten_snapshot, parse_series_key,
+                                        series_key)
+from heatmap_tpu.serve import ServeApp, TileCache
+from heatmap_tpu.serve.router import RouterApp
+from heatmap_tpu.serve.store import Layer, Level
+from heatmap_tpu.tilemath.morton import morton_encode_np
+
+_TS, _MIN, _MAX, _SUM, _COUNT, _LAST = range(6)
+
+
+class _Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------- keys
+
+
+class TestSeriesKey:
+    def test_round_trip_with_sorted_labels(self):
+        key = series_key("ingest_lag_seconds", {"shard": "3", "az": "b"})
+        assert key == "ingest_lag_seconds{az=b,shard=3}"
+        name, labels = parse_series_key(key)
+        assert name == "ingest_lag_seconds"
+        assert labels == {"az": "b", "shard": "3"}
+
+    def test_bare_name(self):
+        assert series_key("up", {}) == "up"
+        assert parse_series_key("up") == ("up", {})
+
+    def test_flatten_snapshot_histogram_to_sum_count(self):
+        from heatmap_tpu.obs.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.enabled = True
+        reg.counter("reqs_total", labelnames=("route",)).inc(route="tile")
+        reg.gauge("lag_seconds").set(2.5)
+        h = reg.histogram("latency_seconds")
+        h.observe(0.1)
+        h.observe(0.3)
+        flat = flatten_snapshot(reg.snapshot())
+        assert flat["reqs_total{route=tile}"] == ("counter", 1.0)
+        assert flat["lag_seconds"] == ("gauge", 2.5)
+        # Histogram buckets are dropped; _sum/_count survive as counters
+        # so the dashboard can derive a windowed mean.
+        assert flat["latency_seconds_sum"] == ("counter", pytest.approx(0.4))
+        assert flat["latency_seconds_count"] == ("counter", 2.0)
+        assert not any(k.startswith("latency_seconds_bucket")
+                       for k in flat)
+
+
+# ------------------------------------------------------- rollup math
+
+
+def _brute_force_tiers(samples, tiers):
+    """Independently recompute the expected ring contents of every tier
+    from the raw (ts, value) stream, simulating capacity-driven eviction
+    exactly as specified: finest tier holds the newest ``cap`` buckets;
+    each evicted *bucket's stats row* folds (in arrival order) into the
+    next tier's bucket of its timestamp; rows past the last tier drop.
+    Folding stats rows — not re-summing raw samples — matters: it
+    reproduces the store's float accumulation order bit-for-bit, so the
+    comparison can demand exact equality."""
+    def fold(rows, step):
+        out = []  # stats rows [bucket_ts, min, max, sum, count, last]
+        for ts, mn, mx, sm, ct, last in rows:
+            b = ts - (ts % step)
+            if out and out[-1][0] == b:
+                cur = out[-1]
+                cur[1] = min(cur[1], mn)
+                cur[2] = max(cur[2], mx)
+                cur[3] = cur[3] + sm
+                cur[4] = cur[4] + ct
+                cur[5] = last
+            else:
+                out.append([b, mn, mx, sm, ct, last])
+        return out
+
+    rows = [(ts, v, v, v, 1, v) for ts, v in samples]
+    expect = []
+    for step, cap in tiers:
+        rows = fold(rows, step)
+        expect.append([list(r) for r in rows[-cap:]])
+        rows = rows[:-cap]  # evicted rows cascade to the next tier
+    return expect
+
+
+class TestRollupDeterminism:
+    TIERS = ((10.0, 4), (60.0, 6), (600.0, 64))
+
+    def _feed(self, store, seed=5, n=400):
+        rng = random.Random(seed)
+        clock = _Clock(0.0)
+        stream = []
+        for _ in range(n):
+            clock.advance(rng.uniform(3.0, 17.0))
+            v = rng.uniform(-50.0, 50.0)
+            stream.append((clock.t, v))
+            store.observe("sig", v, ts=clock.t)
+        return stream
+
+    def test_rollups_match_brute_force_exactly(self):
+        store = TimeSeriesStore(tiers=self.TIERS, clock=_Clock(0.0))
+        stream = self._feed(store)
+        expect = _brute_force_tiers(stream, self.TIERS)
+        entry = store._series["sig"]
+        for level, rows in enumerate(expect):
+            got = [list(p[:6]) for p in entry["tiers"][level]]
+            # Exact equality: rollups are pure min/max/sum/count folds,
+            # so there is no tolerance to hide drift behind.
+            assert got == rows, f"tier {level} mismatch"
+
+    def test_identical_streams_identical_dumps(self):
+        a = TimeSeriesStore(tiers=self.TIERS, clock=_Clock(0.0))
+        b = TimeSeriesStore(tiers=self.TIERS, clock=_Clock(0.0))
+        self._feed(a)
+        self._feed(b)
+        assert json.dumps(a._dump_locked(), sort_keys=True) == \
+            json.dumps(b._dump_locked(), sort_keys=True)
+
+    def test_byte_cap_bounds_series_count(self):
+        caps = sum(c for _, c in self.TIERS)
+        store = TimeSeriesStore(
+            tiers=self.TIERS,
+            max_bytes=3 * timeseries.POINT_BYTES * caps)
+        assert store.max_series == 3
+        for i in range(7):
+            store.observe(f"s{i}", 1.0, ts=100.0)
+        stats = store.stats()
+        assert stats["series"] == 3
+        assert stats["dropped_series"] == 4
+
+    def test_tiers_must_be_finest_first(self):
+        with pytest.raises(ValueError):
+            TimeSeriesStore(tiers=((60.0, 10), (10.0, 10)))
+        with pytest.raises(ValueError):
+            TimeSeriesStore(tiers=())
+
+
+# ------------------------------------------------------------ queries
+
+
+class TestQuery:
+    def _hour_store(self):
+        # Raw tier only retains 30 buckets (5 min); a 1 h query must be
+        # answered from the 60 s rollup tier.
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(
+            tiers=((10.0, 30), (60.0, 120), (600.0, 432)), clock=clock)
+        for i in range(720):  # 2 h at 10 s cadence
+            clock.advance(10.0)
+            store.observe("lag", float(i % 7), ts=clock.t)
+        return store, clock
+
+    def test_one_hour_answered_from_rollup_with_resolution_stamp(self):
+        store, clock = self._hour_store()
+        doc = store.query("lag", start=clock.t - 3600.0, end=clock.t)
+        assert doc["requested_step"] is None
+        (frame,) = doc["frames"]
+        assert frame["tier"] == 1
+        assert frame["step"] == 60.0
+        pts = frame["points"]
+        assert pts, "rollup tier should cover the hour"
+        assert all(p[_TS] % 60.0 == 0 for p in pts)
+        assert all(clock.t - 3600.0 <= p[_TS] + 60.0 for p in pts)
+        # The newest ~5 min still lives in the raw tier, so the rollup
+        # frame holds the remaining ~55 one-minute buckets of the hour.
+        assert 54 <= len(pts) <= 61
+
+    def test_repeat_queries_byte_identical(self):
+        store, clock = self._hour_store()
+        kw = dict(start=clock.t - 3600.0, end=clock.t)
+        a = json.dumps(store.query("lag", **kw), sort_keys=True)
+        b = json.dumps(store.query("lag", **kw), sort_keys=True)
+        assert a == b
+
+    def test_step_regroup_preserves_mass(self):
+        store, clock = self._hour_store()
+        kw = dict(start=clock.t - 3600.0, end=clock.t)
+        fine = store.query("lag", **kw)["frames"][0]
+        coarse = store.query("lag", step=120.0, **kw)["frames"][0]
+        assert coarse["step"] == 120.0
+        assert all(p[_TS] % 120.0 == 0 for p in coarse["points"])
+        # Regrouping is a pure fold: total count and sum conserved.
+        assert sum(p[_COUNT] for p in coarse["points"]) == \
+            sum(p[_COUNT] for p in fine["points"])
+        assert sum(p[_SUM] for p in coarse["points"]) == \
+            pytest.approx(sum(p[_SUM] for p in fine["points"]))
+
+    def test_label_filter_selects_subset(self):
+        store = TimeSeriesStore(clock=_Clock(100.0))
+        store.observe(series_key("q", {"shard": "0"}), 1.0, ts=100.0)
+        store.observe(series_key("q", {"shard": "1"}), 2.0, ts=100.0)
+        doc = store.query("q", labels={"shard": "1"})
+        assert [f["labels"] for f in doc["frames"]] == [{"shard": "1"}]
+        assert store.query("q")["frames"][0]["labels"] == {"shard": "0"}
+        assert len(store.query("q")["frames"]) == 2
+
+    def test_recent_window_is_raw_tier(self):
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock)
+        for i in range(40):
+            clock.advance(10.0)
+            store.observe("x", float(i), ts=clock.t)
+        win = store.recent_window(seconds=120.0)
+        assert win["window_s"] == 120.0
+        pts = win["series"]["x"]["points"]
+        assert win["series"]["x"]["step"] == 10.0
+        assert all(p[_TS] >= clock.t - 120.0 - 10.0 for p in pts)
+
+
+# -------------------------------------------------------------- spill
+
+
+class TestSpill:
+    def _seeded(self, root, clock):
+        store = TimeSeriesStore(spill_dir=str(root), clock=clock)
+        for i in range(30):
+            clock.advance(10.0)
+            store.observe("lag", float(i), ts=clock.t)
+        return store
+
+    def test_round_trip(self, tmp_path):
+        clock = _Clock(0.0)
+        store = self._seeded(tmp_path / "tel", clock)
+        store.spill()
+        reloaded = TimeSeriesStore(spill_dir=str(tmp_path / "tel"),
+                                   clock=clock)
+        reloaded.load_spill()
+        assert json.dumps(reloaded._dump_locked()["series"],
+                          sort_keys=True) == \
+            json.dumps(store._dump_locked()["series"], sort_keys=True)
+
+    def test_torn_snap_quarantined_next_spill_works(self, tmp_path):
+        clock = _Clock(0.0)
+        root = tmp_path / "tel"
+        store = self._seeded(root, clock)
+        store.spill()
+        # Tear the snapshot: manifest byte count no longer matches.
+        (snap,) = [p for p in os.listdir(root) if p.startswith("snap-")]
+        with open(root / snap / "series.json", "w") as f:
+            f.write('{"torn')
+        # Plus an orphan tmp dir from a simulated crash mid-publish.
+        os.makedirs(root / ".tmp-snap-crashed")
+        log_path = tmp_path / "events.jsonl"
+        obs.set_event_log(obs.EventLog(str(log_path)))
+        try:
+            fresh = TimeSeriesStore(spill_dir=str(root), clock=clock)
+            fresh.load_spill()  # must not raise
+        finally:
+            obs.get_event_log().close()
+            obs.set_event_log(None)
+        assert fresh.stats()["series"] == 0  # nothing restorable
+        qdir = root / "quarantine"
+        assert qdir.is_dir() and len(os.listdir(qdir)) == 2
+        recs = [json.loads(line) for line in
+                open(log_path).read().splitlines() if line.strip()]
+        reasons = sorted(r["reason"] for r in recs
+                         if r.get("event") == "quarantine")
+        assert reasons == ["orphan_tmp", "torn_telemetry"]
+        assert all(r["kind"] == "telemetry" for r in recs
+                   if r.get("event") == "quarantine")
+        # The torn snap never blocks forward progress.
+        clock.advance(10.0)
+        fresh.observe("lag", 1.0, ts=clock.t)
+        fresh.spill()
+        again = TimeSeriesStore(spill_dir=str(root), clock=clock)
+        again.load_spill()
+        assert again.stats()["series"] == 1
+
+
+# ------------------------------------------------------------ sampler
+
+
+class TestSampler:
+    def test_interval_must_be_positive(self):
+        with pytest.raises(ValueError):
+            TelemetrySampler(TimeSeriesStore(), 0.0)
+
+    def test_sample_once_feeds_store_and_engine(self):
+        from heatmap_tpu.obs.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.enabled = True
+        reg.gauge("lag_seconds").set(4.0)
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock)
+        engine = AnomalyEngine([WatchSpec("lag_seconds")], clock=clock)
+        sampler = TelemetrySampler(store, 10.0, registry=reg,
+                                   engine=engine, clock=clock)
+        for _ in range(3):
+            clock.advance(10.0)
+            sampler.sample_once(clock.t)
+        assert sampler.ticks == 3
+        assert sampler.errors == 0
+        assert store.stats()["samples_total"] == 3
+        assert "lag_seconds" in store.series_names()
+        assert engine.status()["series_tracked"] == 1
+
+    def test_periodic_spill_every_n_ticks(self, tmp_path):
+        from heatmap_tpu.obs.metrics import MetricsRegistry
+        reg = MetricsRegistry()
+        reg.enabled = True
+        reg.gauge("g").set(1.0)
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(spill_dir=str(tmp_path), clock=clock)
+        sampler = TelemetrySampler(store, 10.0, registry=reg, clock=clock,
+                                   spill_every_ticks=2)
+        for _ in range(4):
+            sampler.sample_once(clock.advance(10.0))
+        snaps = [p for p in os.listdir(tmp_path) if p.startswith("snap-")]
+        assert snaps, "expected a periodic spill after 2 ticks"
+
+    def test_arm_off_means_zero_threads(self):
+        # With the sampler never armed there is no store, no engine, and
+        # crucially no background thread.
+        assert timeseries.get_store() is None
+        assert timeseries.get_sampler() is None
+        names = [t.name for t in threading.enumerate()]
+        assert "telemetry-sampler" not in names
+
+    def test_arm_and_shutdown_lifecycle(self):
+        timeseries.arm(30.0)
+        try:
+            assert timeseries.get_store() is not None
+            names = [t.name for t in threading.enumerate()]
+            assert "telemetry-sampler" in names
+        finally:
+            timeseries.shutdown()
+        names = [t.name for t in threading.enumerate()]
+        assert "telemetry-sampler" not in names
+        assert timeseries.get_store() is None
+
+
+# ---------------------------------------------------- watch grammar
+
+
+class TestWatchGrammar:
+    def test_defaults(self):
+        spec = parse_watch_spec("ingest_lag_seconds")
+        assert spec == WatchSpec("ingest_lag_seconds")
+        assert spec.z == 6.0 and spec.alpha == 0.3
+
+    def test_full_spec(self):
+        spec = parse_watch_spec(
+            "lag:z=4,alpha=0.5,min_count=20,clear_ratio=0.25")
+        assert (spec.name, spec.z, spec.alpha, spec.min_count,
+                spec.clear_ratio) == ("lag", 4.0, 0.5, 20, 0.25)
+
+    @pytest.mark.parametrize("bad", [
+        "", ":z=4", "lag:z", "lag:zz=4", "lag:z=abc",
+        "lag:z=0", "lag:alpha=2",
+    ])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            parse_watch_spec(bad)
+
+
+# ----------------------------------------------------------- detector
+
+
+class TestDetector:
+    def _spec(self):
+        return WatchSpec("lag", z=4.0, min_count=5)
+
+    def test_exactly_one_edge_per_excursion(self):
+        det = SeriesDetector(self._spec())
+        edges = 0
+        for i in range(30):  # quiet baseline with deterministic wiggle
+            edges += bool(det.observe(10.0 + (i % 3) * 0.01))
+        assert edges == 0
+        for _ in range(5):  # sustained excursion: one rising edge only
+            edges += bool(det.observe(100.0))
+        assert edges == 1
+
+    def test_hysteresis_rearms_after_clear(self):
+        det = SeriesDetector(self._spec())
+        for i in range(30):
+            det.observe(10.0 + (i % 3) * 0.01)
+        assert sum(bool(det.observe(100.0)) for _ in range(3)) == 1
+        for i in range(40):  # long return to baseline clears the breach
+            det.observe(10.0 + (i % 3) * 0.01)
+        assert not det.breaching
+        assert sum(bool(det.observe(100.0)) for _ in range(3)) == 1
+
+
+# ------------------------------------------- anomaly -> incident path
+
+
+class TestAnomalyToIncident:
+    def test_one_edge_one_bundle_with_embedded_history(self, tmp_path):
+        clock = _Clock(1_000.0)
+        store = TimeSeriesStore(clock=clock)
+        timeseries.install(store)
+        engine = AnomalyEngine(
+            [WatchSpec("lag_seconds", z=4.0, min_count=5)], clock=clock)
+        anomaly.set_engine(engine)
+        mgr = incident.IncidentManager(str(tmp_path / "inc"),
+                                       min_interval_s=3600.0, clock=clock)
+        incident.set_manager(mgr)
+        log_path = tmp_path / "events.jsonl"
+        obs.set_event_log(obs.EventLog(str(log_path)))
+        obs.enable_metrics(True)
+        try:
+            def tick(value):
+                clock.advance(10.0)
+                flat = {"lag_seconds": ("gauge", value)}
+                store.append_flat(flat, ts=clock.t)
+                engine.observe_tick(flat, ts=clock.t)
+
+            for i in range(30):
+                tick(2.0 + (i % 3) * 0.01)
+            for _ in range(5):  # sustained spike: one edge, not five
+                tick(50.0)
+            obs.get_event_log().close()
+            obs.set_event_log(None)
+            recs = [json.loads(line) for line in
+                    open(log_path).read().splitlines() if line.strip()]
+            edges = [r for r in recs if r.get("event") == "anomaly_detected"]
+            assert len(edges) == 1
+            assert edges[0]["series"] == "lag_seconds"
+            assert edges[0]["z"] >= 4.0
+            snap = obs.get_registry().snapshot()
+            (sample,) = snap["anomalies_total"]["samples"]
+            assert sample == {"labels": {"watch": "lag_seconds"},
+                              "value": 1.0}
+
+            bundles = sorted((tmp_path / "inc").iterdir())
+            assert len(bundles) == 1
+            bundle = str(bundles[0])
+            manifest = json.loads(
+                open(os.path.join(bundle, "manifest.json")).read())
+            assert manifest["trigger"] == "anomaly"
+            tel = json.loads(
+                open(os.path.join(bundle, "telemetry.json")).read())
+            pts = tel["series"]["lag_seconds"]["points"]
+            assert pts, "bundle must embed the surrounding history"
+            # The embedded window covers the pre-spike baseline too.
+            assert min(p[_LAST] for p in pts) < 3.0
+            assert max(p[_LAST] for p in pts) == 50.0
+        finally:
+            incident.set_manager(None)
+            anomaly.set_engine(None)
+            timeseries.install(None)
+
+    def test_engine_recent_and_status(self):
+        clock = _Clock(0.0)
+        engine = AnomalyEngine([WatchSpec("x", z=4.0, min_count=5)],
+                               clock=clock)
+        for i in range(30):
+            engine.observe_tick({"x": ("gauge", 1.0 + (i % 3) * 0.01)},
+                                ts=clock.advance(10.0))
+        engine.observe_tick({"x": ("gauge", 99.0)}, ts=clock.advance(10.0))
+        status = engine.status()
+        assert status["edges"] == 1
+        assert status["breaching"] == ["x"]
+        (rec,) = engine.recent()
+        assert rec["series"] == "x" and rec["z"] >= 4.0
+
+
+# ---------------------------------------------------- HTTP endpoints
+
+
+class _BareTileStore:
+    """Just enough TileStore surface for ServeApp routes that don't
+    read tiles from disk (/series, /dashboard, cache-keyed renders of
+    attached layers)."""
+    generation = 0
+    delta_epoch = 0
+    synopsis_epoch = 0
+
+    def layer(self, name):
+        return None
+
+    def layer_names(self):
+        return []
+
+    def stats(self):
+        return {"layers": {}}
+
+
+def _bare_app():
+    app = ServeApp(_BareTileStore(), TileCache())
+    layer = Layer("u", "t", result_delta=2)
+    layer.levels[6] = Level(
+        6,
+        morton_encode_np(np.asarray([16, 17], np.int64),
+                         np.asarray([16, 21], np.int64)),
+        np.asarray([1.0, 4.0], np.float64),
+    )
+    app.attach_layer("default", layer)
+    return app
+
+
+class TestSeriesEndpoint:
+    def test_missing_name_is_typed_400(self):
+        status, ctype, body, *_ = _bare_app().handle("GET", "/series")
+        assert status == 400 and ctype == "application/json"
+        assert "name" in json.loads(body)["detail"]
+
+    @pytest.mark.parametrize("query", ["name=x&step=-1", "name=x&from=abc"])
+    def test_bad_params_are_typed_400(self, query):
+        status, _, body, *_ = _bare_app().handle("GET", "/series?" + query)
+        assert status == 400
+        assert json.loads(body)["error"] == "bad query"
+
+    def test_sampler_off_is_wellformed_not_error(self):
+        status, _, body, *_ = _bare_app().handle("GET", "/series?name=x")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["enabled"] is False and doc["frames"] == []
+        assert "--telemetry-sample-interval" in doc["detail"]
+
+    def test_query_with_store_and_repeat_identity(self):
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock)
+        for i in range(20):
+            clock.advance(10.0)
+            store.observe("lag", float(i), ts=clock.t)
+        timeseries.install(store)
+        try:
+            app = _bare_app()
+            q = f"name=lag&from={clock.t - 100}&to={clock.t}"
+            status, _, body, *_ = app.handle("GET", "/series?" + q)
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["enabled"] is True
+            (frame,) = doc["frames"]
+            assert frame["step"] == 10.0 and frame["tier"] == 0
+            assert app.handle("GET", "/series?" + q)[2] == body
+        finally:
+            timeseries.install(None)
+
+    def test_sampler_off_blobs_byte_identical_and_no_threads(self):
+        # The flagship zero-cost pin: the tile bytes a ServeApp produces
+        # must not depend on whether telemetry is armed, and the off
+        # path must not create threads.
+        before = {t.name for t in threading.enumerate()}
+        path = "/tiles/default/2/1/1.png"
+        off = _bare_app().handle("GET", path)
+        assert off[0] == 200
+        assert {t.name for t in threading.enumerate()} == before
+        store = TimeSeriesStore(clock=_Clock(0.0))
+        store.observe("noise", 1.0, ts=1.0)
+        timeseries.install(store)
+        try:
+            on = _bare_app().handle("GET", path)
+        finally:
+            timeseries.install(None)
+        assert on[2] == off[2]
+
+    def test_health_reports_telemetry_and_anomalies(self):
+        clock = _Clock(0.0)
+        store = TimeSeriesStore(clock=clock)
+        store.observe("x", 1.0, ts=clock.advance(10.0))
+        timeseries.install(store)
+        anomaly.set_engine(AnomalyEngine([WatchSpec("x")], clock=clock))
+        try:
+            status, _, body, *_ = _bare_app().handle("GET", "/healthz")
+            doc = json.loads(body)
+            assert doc["telemetry"]["series"] == 1
+            assert doc["anomalies"] == []
+            assert [w["name"] for w in doc["anomaly_watches"]] == ["x"]
+        finally:
+            anomaly.set_engine(None)
+            timeseries.install(None)
+
+
+class TestDashboard:
+    def test_serve_page_is_self_contained_html(self):
+        status, ctype, body, *_ = _bare_app().handle("GET", "/dashboard")
+        assert status == 200
+        assert ctype.startswith("text/html")
+        page = body.decode("utf-8")
+        assert page.startswith("<!DOCTYPE html>")
+        # No external assets: everything inline, stdlib-served.
+        for banned in ("http://", "https://", "src=", "@import",
+                       "<link"):
+            assert banned not in page, f"external asset ref: {banned}"
+        # The page polls the endpoints this PR ships.
+        assert "/series" in page and "/healthz" in page
+
+    def test_router_serves_dashboard_too(self):
+        router = RouterApp([])
+        status, ctype, body, *_ = router.handle("GET", "/dashboard")
+        assert status == 200 and ctype.startswith("text/html")
+        assert b"fleet" in body
+
+
+class _FakeBackend:
+    def __init__(self, bid, doc=None, status=200, fail=False):
+        self.id = bid
+        self._doc = doc
+        self._status = status
+        self._fail = fail
+
+    def eligible(self):
+        return True
+
+    def fetch(self, method, path):
+        if self._fail:
+            raise OSError("connection refused")
+        body = json.dumps(self._doc or {}).encode()
+        return self._status, {"Content-Type": "application/json"}, body
+
+
+class TestRouterSeries:
+    def _backend_doc(self):
+        return {"enabled": True, "name": "lag", "frames": [
+            {"key": "lag", "labels": {}, "step": 10.0, "tier": 0,
+             "points": [[10.0, 1.0, 1.0, 1.0, 1.0, 1.0]]}]}
+
+    def test_fleet_merge_labels_origins(self):
+        clock = _Clock(100.0)
+        store = TimeSeriesStore(clock=clock)
+        store.observe("lag", 2.0, ts=clock.t)
+        timeseries.install(store)
+        try:
+            router = RouterApp([])
+            router.backends = {
+                "b0": _FakeBackend("b0", self._backend_doc()),
+                "b1": _FakeBackend("b1", fail=True),  # skipped, not fatal
+            }
+            status, _, body, *_ = router.handle(
+                "GET", "/series?name=lag&fleet=1")
+            assert status == 200
+            doc = json.loads(body)
+            assert doc["enabled"] is True
+            origins = sorted(f["backend"] for f in doc["frames"])
+            assert origins == ["b0", "router"]
+        finally:
+            timeseries.install(None)
+
+    def test_without_fleet_flag_local_only(self):
+        router = RouterApp([])
+        router.backends = {"b0": _FakeBackend("b0", self._backend_doc())}
+        status, _, body, *_ = router.handle("GET", "/series?name=lag")
+        doc = json.loads(body)
+        assert status == 200
+        assert doc["enabled"] is False and doc["frames"] == []
+
+    def test_fleet_merge_enabled_when_any_backend_samples(self):
+        # Router itself unarmed, but a backend has history: merged doc
+        # reports enabled and carries the backend frames.
+        router = RouterApp([])
+        router.backends = {"b0": _FakeBackend("b0", self._backend_doc())}
+        status, _, body, *_ = router.handle(
+            "GET", "/series?name=lag&fleet=1")
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert [f["backend"] for f in doc["frames"]] == ["b0"]
+        assert "detail" not in doc

@@ -13,6 +13,9 @@ Reads every bench artifact the repo's tooling writes —
   (``serve:fleet:rps[N]`` / ``p99_ms[N]``), kill-one-backend
   availability when ``--fleet`` was run, the flight-recorder A/B
   tax (``obs:recorder_overhead_pct``, lower, noise-floored at 5%),
+  the telemetry-sampler A/B tax (``obs:telemetry_overhead_pct``,
+  lower, same 5% floor) with the dashboard's ``/series`` polling
+  latency (``obs:series_query_p99_ms``, lower, 1 ms floor),
   and — when ``--cold-vs-warm`` ran — the tilefs restart A/B
   (``serve:cold_p99_ms[cold|warmed]`` lower, the cold/warmed
   ``serve:cold_warm_speedup`` higher) plus the mapped/heap fleet
@@ -151,6 +154,21 @@ def snapshot_metrics(root: str) -> dict:
         if isinstance(pct, (int, float)):
             out["obs:recorder_overhead_pct"] = (max(float(pct), 5.0),
                                                 False)
+        # Telemetry-sampler A/B tax (load_gen._telemetry_overhead) under
+        # the same 5% noise floor — the sampler is a background thread
+        # with zero hot-path hooks, so any real regression here means
+        # someone wired telemetry into the request path. The /series
+        # query latency rides along: the dashboard polls it every few
+        # seconds, so it must stay interactive.
+        pct = (doc.get("obs") or {}).get("telemetry_overhead_pct")
+        if isinstance(pct, (int, float)):
+            out["obs:telemetry_overhead_pct"] = (max(float(pct), 5.0),
+                                                 False)
+        q99 = (((doc.get("obs") or {}).get("series_query_ms") or {})
+               .get("p99"))
+        if isinstance(q99, (int, float)):
+            out["obs:series_query_p99_ms"] = (max(float(q99), 1.0),
+                                              False)
         # tilefs cold-vs-warmed restart A/B (load_gen --cold-vs-warm):
         # first-touch p99 for both legs, the cold/warmed speedup (the
         # ISSUE bar is warmed materially below cold — a shrinking

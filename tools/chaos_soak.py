@@ -1162,6 +1162,116 @@ def phase_incident(ctx):
         obs.enable_metrics(False)
 
 
+def phase_telemetry(ctx):
+    """Telemetry pipeline chaos under a fake clock: a scripted lag
+    spike through the real sampler must fire exactly ONE anomaly edge
+    and flush exactly one anomaly-triggered incident bundle with the
+    surrounding raw-tier history embedded; the telemetry surface
+    (``/series`` fleet-merged, ``/dashboard``, ``/healthz``) answers
+    every request with zero 5xx while the sampler keeps ticking; and a
+    torn telemetry spill snapshot (simulated crash mid-write plus an
+    orphaned publish tmp dir) is quarantined on re-arm — never a crash,
+    never blocking the next spill."""
+    from heatmap_tpu.obs import anomaly as anomaly_mod
+    from heatmap_tpu.obs import incident as incident_mod
+    from heatmap_tpu.obs import timeseries
+    from heatmap_tpu.obs.anomaly import AnomalyEngine, parse_watch_spec
+    from heatmap_tpu.obs.incident import IncidentManager
+    from heatmap_tpu.obs.timeseries import TelemetrySampler, TimeSeriesStore
+    from heatmap_tpu.serve.router import RouterApp
+
+    scratch = os.path.dirname(ctx["base_root"])
+    tel_dir = os.path.join(scratch, "telemetry")
+    inc_dir = os.path.join(scratch, "incidents-telemetry")
+    clock = {"t": 1_000_000.0}
+
+    def now():
+        return clock["t"]
+
+    obs.enable_metrics(True)
+    reg = obs.get_registry()
+    lag = reg.gauge("soak_lag_seconds")
+    engine = AnomalyEngine(
+        [parse_watch_spec("soak_lag_seconds:z=5,min_count=8")], clock=now)
+    anomaly_mod.set_engine(engine)
+    store = TimeSeriesStore(spill_dir=tel_dir, clock=now)
+    timeseries.install(store)
+    sampler = TelemetrySampler(store, 10.0, registry=reg, engine=engine,
+                               clock=now, spill_every_ticks=4)
+    mgr = IncidentManager(inc_dir, run_id="tel", min_interval_s=3600.0,
+                          clock=now)
+    incident_mod.set_manager(mgr)
+    try:
+        # Scripted baseline, then a sustained spike: one rising edge,
+        # not one per breaching tick.
+        for i in range(30):
+            clock["t"] += 10.0
+            lag.set(1.0 + (i % 4) * 0.02)
+            sampler.sample_once(clock["t"])
+        for _ in range(6):
+            clock["t"] += 10.0
+            lag.set(40.0)
+            sampler.sample_once(clock["t"])
+        assert engine.status()["edges"] == 1, engine.status()
+        bundles = [d for d in os.listdir(inc_dir)
+                   if not d.startswith(".tmp-")]
+        assert len(bundles) == 1, bundles
+        manifest = json.load(open(os.path.join(inc_dir, bundles[0],
+                                               "manifest.json")))
+        assert manifest["trigger"] == "anomaly", manifest
+        tel = json.load(open(os.path.join(inc_dir, bundles[0],
+                                          "telemetry.json")))
+        pts = tel["series"]["soak_lag_seconds"]["points"]
+        assert pts, "bundle must embed the pre-trigger history"
+        assert max(p[5] for p in pts) == 40.0
+        assert min(p[5] for p in pts) < 2.0  # baseline is in the window
+
+        # Zero 5xx on the telemetry surface while sampling continues —
+        # through the fleet router, the strictest path (local parse +
+        # fleet merge + dashboard shell).
+        router = RouterApp([])
+        statuses = set()
+        for _ in range(20):
+            clock["t"] += 10.0
+            sampler.sample_once(clock["t"])
+            for path in ("/series?name=soak_lag_seconds&fleet=1",
+                         "/dashboard", "/healthz"):
+                statuses.add(router.handle("GET", path)[0])
+        assert statuses == {200}, statuses
+        doc = json.loads(router.handle(
+            "GET", "/series?name=soak_lag_seconds")[2])
+        assert doc["enabled"] and doc["frames"], doc
+
+        # Torn spill: corrupt the newest snapshot and plant an orphaned
+        # publish tmp dir; re-arming quarantines both and restores the
+        # newest intact snapshot without raising.
+        store.spill()
+        snaps = sorted(d for d in os.listdir(tel_dir)
+                       if d.startswith("snap-"))
+        with open(os.path.join(tel_dir, snaps[-1], "series.json"),
+                  "w") as f:
+            f.write('{"torn')
+        os.makedirs(os.path.join(tel_dir, ".tmp-snap-crash"),
+                    exist_ok=True)
+        fresh = TimeSeriesStore(spill_dir=tel_dir, clock=now)
+        fresh.load_spill()  # must not raise
+        qdir = os.path.join(tel_dir, "quarantine")
+        assert os.path.isdir(qdir), "torn spill was not quarantined"
+        quarantined = len(os.listdir(qdir))
+        assert quarantined >= 2, os.listdir(qdir)
+        clock["t"] += 10.0
+        fresh.observe("soak_lag_seconds", 1.0, ts=clock["t"])
+        fresh.spill()  # quarantine never blocks the next spill
+        return {"bundles": len(bundles), "edges": 1,
+                "statuses": sorted(statuses), "quarantined": quarantined,
+                "restored_series": fresh.stats()["series"]}
+    finally:
+        incident_mod.set_manager(None)
+        anomaly_mod.set_engine(None)
+        timeseries.install(None)
+        obs.enable_metrics(False)
+
+
 def phase_adaptive(ctx):
     """Brownout-ladder chaos: one overload episode under a fake clock
     and a scripted burn schedule must walk the ladder up 0->1->2->3
@@ -1302,6 +1412,7 @@ PHASES = [
     ("query", phase_query),
     ("tilefs", phase_tilefs),
     ("incident", phase_incident),
+    ("telemetry", phase_telemetry),
     ("adaptive", phase_adaptive),
     ("byte_equality", phase_byte_equality),
 ]

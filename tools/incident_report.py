@@ -12,6 +12,9 @@ the story an on-call wants first:
 - the critical path of the slowest captured trace (the exact
   tools/trace_analyze.py analysis, partial-tree tolerant);
 - the event tail leading up to the flush (errors, sheds, faults last);
+- what changed in the telemetry window before the trigger — per-series
+  first→last movement from the embedded ``telemetry.json`` raw-tier
+  history (obs/timeseries.py), biggest movers first;
 - headline failure metrics (5xx, sheds, breaker opens, incidents);
 - the degraded/breaker state the serving tier reported.
 
@@ -61,6 +64,47 @@ def load_bundle(path: str) -> dict:
         "events": _load("events.json", []),
         "metrics": _load("metrics.json", {}),
         "state": _load("state.json", {}),
+        "telemetry": _load("telemetry.json", {}),
+    }
+
+
+def telemetry_deltas(telemetry: dict, limit: int = 24) -> dict:
+    """Per-series movement over the embedded pre-trigger window.
+
+    Each row summarizes one series' raw-tier history — first and last
+    bucket value, the window min/max, and the first→last delta — sorted
+    by relative movement so the biggest movers (the "what changed"
+    answer) print first. Points are ``[ts,min,max,sum,count,last]``
+    rows from TimeSeriesStore.recent_window.
+    """
+    series = telemetry.get("series") or {}
+    rows = []
+    for key, entry in series.items():
+        pts = entry.get("points") or []
+        if not pts:
+            continue
+        first, last = pts[0][5], pts[-1][5]
+        lo = min(p[1] for p in pts)
+        hi = max(p[2] for p in pts)
+        denom = max(abs(first), abs(last), 1e-9)
+        rows.append({
+            "series": key,
+            "first": first,
+            "last": last,
+            "delta": last - first,
+            "min": lo,
+            "max": hi,
+            "buckets": len(pts),
+            "rel_change": abs(last - first) / denom,
+        })
+    rows.sort(key=lambda r: (-r["rel_change"], r["series"]))
+    return {
+        "window_s": telemetry.get("window_s"),
+        "from": telemetry.get("from"),
+        "to": telemetry.get("to"),
+        "n_series": len(series),
+        "truncated_series": telemetry.get("truncated_series", 0),
+        "movers": rows[:limit],
     }
 
 
@@ -108,6 +152,7 @@ def build_report(bundle: dict, top: int = 8) -> dict:
         "trace": trace,
         "event_tail": event_tail(bundle["events"]),
         "metrics": headline_metrics(bundle["metrics"]),
+        "telemetry": telemetry_deltas(bundle.get("telemetry") or {}),
         "state": bundle["state"],
     }
 
@@ -130,6 +175,22 @@ def format_report(report: dict, max_traces: int = 2) -> str:
         for row in report["metrics"]:
             label = f"{{{row['labels']}}}" if row["labels"] else ""
             lines.append(f"  {row['metric']}{label} = {row['value']}")
+    tel = report.get("telemetry") or {}
+    if tel.get("movers"):
+        window = tel.get("window_s")
+        head = (f"what changed in the {window:.0f} s before the trigger"
+                if isinstance(window, (int, float))
+                else "what changed before the trigger")
+        if tel.get("truncated_series"):
+            head += f" ({tel['truncated_series']} series truncated)"
+        lines += ["", head + ":"]
+        lines.append(f"  {'series':<40} {'first':>10} {'last':>10} "
+                     f"{'delta':>10} {'min':>10} {'max':>10}")
+        for row in tel["movers"]:
+            lines.append(
+                f"  {row['series']:<40} {row['first']:>10.4g} "
+                f"{row['last']:>10.4g} {row['delta']:>+10.4g} "
+                f"{row['min']:>10.4g} {row['max']:>10.4g}")
     if report["event_tail"]:
         lines += ["", "event tail (oldest first):"]
         for row in report["event_tail"]:

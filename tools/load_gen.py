@@ -424,6 +424,70 @@ def _recorder_overhead(host, port, universe, passes: int = 3) -> dict:
     return result
 
 
+def _telemetry_overhead(host, port, universe, passes: int = 3) -> dict:
+    """A/B the telemetry sampler against the live server: sequential
+    full-universe sweeps with the sampler off, then armed at a 0.25 s
+    interval (40x the production default cadence) with an anomaly
+    engine attached — so registry snapshots, ring folds, and detector
+    scoring all run while the sweep drives the hot path. The sampler is
+    a background thread with zero hot-path hooks, so the promise is
+    stronger than the recorder's: the delta should be measurement noise
+    (bench_gate folds it as obs:telemetry_overhead_pct with the same
+    5% noise floor). Also times ``/series`` queries against the
+    freshly sampled history — the dashboard's polling cost."""
+    from heatmap_tpu.obs import anomaly as anomaly_mod
+    from heatmap_tpu.obs import timeseries
+    from heatmap_tpu.obs.anomaly import AnomalyEngine, parse_watch_spec
+
+    def sweep() -> float:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        t0 = time.perf_counter()
+        for layer, z, x, y, fmt in universe:
+            conn.request("GET", f"/tiles/{layer}/{z}/{x}/{y}.{fmt}")
+            conn.getresponse().read()
+        dt = time.perf_counter() - t0
+        conn.close()
+        return dt
+
+    sweep()
+    off_s = min(sweep() for _ in range(passes))
+    engine = AnomalyEngine([parse_watch_spec("ingest_lag_seconds:z=8")])
+    anomaly_mod.set_engine(engine)
+    timeseries.arm(0.25, engine=engine)
+    try:
+        sweep()
+        on_s = min(sweep() for _ in range(passes))
+        stats = timeseries.get_store().stats()
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        q_lat = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            conn.request("GET", "/series?name=http_requests_total")
+            conn.getresponse().read()
+            q_lat.append((time.perf_counter() - t0) * 1000.0)
+        conn.close()
+    finally:
+        timeseries.shutdown()
+        anomaly_mod.set_engine(None)
+    q_lat.sort()
+    pct = max(0.0, (on_s - off_s) / off_s * 100.0) if off_s else None
+    result = {
+        "telemetry_overhead_pct": round(pct, 2) if pct is not None else None,
+        "telemetry_off_s": round(off_s, 4),
+        "telemetry_on_s": round(on_s, 4),
+        "sample_interval_s": 0.25,
+        "store_series": stats["series"],
+        "store_samples": stats["samples_total"],
+        "series_query_ms": {
+            "p50": round(q_lat[len(q_lat) // 2], 3),
+            "p99": round(q_lat[int(0.99 * (len(q_lat) - 1))], 3),
+            "n": len(q_lat),
+        },
+    }
+    print(json.dumps({"stage": "telemetry_overhead", **result}), flush=True)
+    return result
+
+
 def _fleet_bench(args, spec: str, universe, tmpdir: str) -> dict:
     """The N=1/2/4 scaling curve + kill-one-backend availability, all
     through real child serve processes and a threaded router frontend.
@@ -797,6 +861,7 @@ def main() -> int:
         w.join()
     measured_s = time.perf_counter() - t0
     obs_overhead = _recorder_overhead(host, port, universe)
+    obs_overhead.update(_telemetry_overhead(host, port, universe))
     server.shutdown()
 
     lat = np.sort(np.concatenate(
