@@ -1828,6 +1828,232 @@ def cmd_ingest(args) -> int:
     return 0
 
 
+def _add_writeplane_flags(p):
+    p.add_argument("--root", required=True, metavar="ROOT",
+                   help="write-plane root (created on first use; serve "
+                   "mounts it as writeplane:ROOT — docs/write-plane.md)")
+    p.add_argument("--input", default=None,
+                   help="insert source spec, drained as micro-batches "
+                   "routed by Morton range")
+    p.add_argument("--retractions", default=None,
+                   help="retraction source spec (sign=-1 batches, "
+                   "applied after --input)")
+    p.add_argument("--writers", type=int, default=2,
+                   help="ingest pumps = initial Morton ranges "
+                   "(rebalance can add more)")
+    p.add_argument("--micro-batch", type=int, default=1 << 14,
+                   help="points per routed batch (the ledger/dedup "
+                   "granularity — replays must use the same batching)")
+    p.add_argument("--queue-depth", type=int, default=4,
+                   help="bounded per-range queue depth between the "
+                   "router and each pump")
+    p.add_argument("--publish-every", type=int, default=1, metavar="N",
+                   help="flip a manifest epoch every N finished batches")
+    p.add_argument("--compact-every", type=int, default=16, metavar="N",
+                   help="fold a range whenever N live deltas accumulate "
+                   "(0 = never)")
+    p.add_argument("--retention", type=int, default=2,
+                   help="per-range journal entries kept after "
+                   "compaction (refused below the retention floor or "
+                   "the in-flight queue depth)")
+    p.add_argument("--retention-floor", type=int, default=2,
+                   help="hard floor under --retention (docs/"
+                   "write-plane.md)")
+    p.add_argument("--ledger-keep", type=int, default=64,
+                   help="full-batch ledger entries retained (the "
+                   "cross-rebalance dedup window)")
+    p.add_argument("--max-ticks", type=int, default=None,
+                   help="stop after N micro-batches (default: drain)")
+    p.add_argument("--rebalance", action="store_true",
+                   help="run one skew-triggered hot-range re-split "
+                   "after the drain (docs/write-plane.md runbook)")
+    p.add_argument("--pad-bucketing", default="pow2",
+                   choices=("pow2", "geometric", "exact"),
+                   help="bucketed-padding compile cache for the "
+                   "cascade (pipeline/bucketing.py); routed sub-batch "
+                   "sizes vary every tick, so exact mode compiles per "
+                   "distinct size")
+    p.add_argument("--pad-bucket-min", type=int, default=1 << 12,
+                   help="bucket floor: sub-batches below this many "
+                   "emissions share one compilation")
+    p.add_argument("--detail-zoom", type=int, default=21)
+    p.add_argument("--min-detail-zoom", type=int, default=5)
+    p.add_argument("--result-delta", type=int, default=5)
+    p.add_argument("--timespans", default="alltime")
+    p.add_argument("--weighted", action="store_true")
+    p.add_argument("--cascade-backend", default="auto",
+                   choices=("auto", "scatter", "partitioned"))
+    p.add_argument("--data-parallel", choices=("auto", "on", "off"),
+                   default="auto")
+    p.add_argument("--dispatch", choices=("auto", "gspmd", "shard_map"),
+                   default="auto")
+    p.add_argument("--metrics-dir", default=None, metavar="DIR",
+                   help="enable the metrics registry and write "
+                   "DIR/metrics.prom at command end")
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="append structured events to PATH "
+                   "(writeplane_append/publish/rebalance — "
+                   "docs/observability.md)")
+    p.add_argument("--report", nargs="?", const="run_report.json",
+                   default=None, metavar="PATH")
+    _add_trace_flags(p)
+
+
+def cmd_writeplane(args) -> int:
+    """Partitioned multi-writer ingest: batches route by Morton range
+    to independent per-range delta stores (one pump each), unified for
+    readers by an epoch-flipped manifest (heatmap_tpu.writeplane).
+    Serve mounts the root as ``writeplane:ROOT``."""
+    from heatmap_tpu.pipeline.timespan import VALID_TYPES
+
+    requested = tuple(t.strip() for t in args.timespans.split(",")
+                      if t.strip())
+    bad = [t for t in requested if t not in VALID_TYPES]
+    if bad:
+        raise SystemExit(f"--timespans: unknown type(s) {bad}; valid: "
+                         f"{', '.join(VALID_TYPES)}")
+    _init_backend(args)
+    import statistics
+
+    from heatmap_tpu import writeplane as wp_mod
+    from heatmap_tpu.io import open_source
+    from heatmap_tpu.pipeline import BatchJobConfig
+
+    try:
+        config = BatchJobConfig(
+            detail_zoom=args.detail_zoom,
+            min_detail_zoom=args.min_detail_zoom,
+            result_delta=args.result_delta,
+            timespans=requested,
+            weighted=args.weighted,
+            cascade_backend=args.cascade_backend,
+            data_parallel={"auto": None, "on": True, "off": False}[
+                args.data_parallel],
+            dispatch=args.dispatch,
+            pad_bucketing=args.pad_bucketing,
+            pad_bucket_min=args.pad_bucket_min,
+        )
+        plane_cfg = wp_mod.PlaneConfig(
+            n_writers=args.writers,
+            retention=args.retention,
+            retention_floor=args.retention_floor,
+            compact_every=args.compact_every,
+            ledger_keep=args.ledger_keep,
+        )
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
+
+    telemetry = bool(args.metrics_dir or args.events
+                     or args.report is not None)
+    ev_log = None
+    if telemetry:
+        from heatmap_tpu import obs
+
+        obs.enable_metrics(True)
+        if args.events:
+            ev_log = obs.EventLog(args.events)
+            obs.set_event_log(ev_log)
+            import dataclasses as _dc
+
+            manifest = {k: (list(v) if isinstance(v, tuple) else v)
+                        for k, v in _dc.asdict(config).items()}
+            obs.emit("run_start", config=manifest, backend=args.backend,
+                     devices=obs.device_topology(), argv=sys.argv[1:])
+    from heatmap_tpu.obs import tracing as tracing_mod
+
+    collector = _setup_tracing(args)
+    from heatmap_tpu.obs import incident as incident_mod
+
+    incident_mod.add_state_provider("writeplane", lambda: {
+        "root": args.root,
+        "epoch": wp_mod.read_pointer(args.root)})
+    root_span = tracing_mod.begin_span("writeplane")
+    t0 = time.perf_counter()
+    job_error = None
+    summary = {"root": args.root}
+    try:
+        plane = wp_mod.WritePlane(args.root, config, plane_cfg)
+        runs = []
+        jobs = [(args.input, 1)] if args.input else []
+        if args.retractions:
+            jobs.append((args.retractions, -1))
+        for spec, sign in jobs:
+            stats = wp_mod.run_plane_ingest(
+                plane, open_source(spec, read_value=args.weighted),
+                micro_batch=args.micro_batch, sign=sign,
+                queue_depth=args.queue_depth,
+                publish_every=args.publish_every,
+                max_ticks=args.max_ticks)
+            runs.append({
+                "input": spec, "sign": sign, "batches": stats.batches,
+                "completed": stats.completed,
+                "duplicates": stats.duplicates, "failed": stats.failed,
+                "points": stats.points, "publishes": stats.publishes,
+                "publish_errors": stats.publish_errors,
+                "lag_p50_s": (round(statistics.median(stats.lags_s), 6)
+                              if stats.lags_s else None),
+            })
+        if runs:
+            summary["runs"] = runs
+        if args.rebalance:
+            rb = plane.rebalance()
+            summary["rebalance"] = (
+                None if rb is None else
+                {k: rb[k] for k in ("range", "new_range", "split",
+                                    "epoch")})
+        summary["epoch"] = plane.publish()
+        summary["ranges"] = plane.order
+    except ValueError as e:
+        _fail_telemetry(root_span, e)
+        if not telemetry:
+            tracing_mod.end_span(root_span)
+            _export_trace(args, collector)
+            raise SystemExit(str(e)) from e
+        job_error = e
+    except BaseException as e:  # noqa: BLE001 — run_end must record it
+        _fail_telemetry(root_span, e)
+        if not telemetry:
+            tracing_mod.end_span(root_span)
+            _export_trace(args, collector)
+            raise
+        job_error = e
+    dt = time.perf_counter() - t0
+    tracing_mod.end_span(root_span)
+    if telemetry:
+        from heatmap_tpu import obs
+        from heatmap_tpu.utils.trace import get_tracer
+
+        if ev_log is not None:
+            end = {"status": "error" if job_error is not None else "ok",
+                   "seconds": round(dt, 3)}
+            if job_error is not None:
+                end["error"] = repr(job_error)
+            else:
+                end["rows"] = int(sum(r["points"] for r in
+                                      summary.get("runs", [])))
+            obs.emit("run_end", **end)
+            obs.set_event_log(None)
+            ev_log.close()
+        if args.metrics_dir:
+            obs.get_registry().write_prometheus(
+                os.path.join(args.metrics_dir, "metrics.prom"))
+        if args.report is not None:
+            report = obs.build_run_report(
+                tracer=get_tracer(), registry=obs.get_registry(),
+                events_path=args.events)
+            obs.write_run_report(args.report, report)
+            print(obs.format_run_report(report), file=sys.stderr)
+        if job_error is not None:
+            _export_trace(args, collector)
+            if isinstance(job_error, ValueError):
+                raise SystemExit(str(job_error)) from job_error
+            raise job_error
+    _export_trace(args, collector)
+    summary["seconds"] = round(dt, 3)
+    print(json.dumps(summary))
+    return 0
+
+
 def cmd_info(args) -> int:
     # info reports unreachability as structured JSON (below) rather
     # than the fail-fast SystemExit the job commands want; an explicit
@@ -2164,6 +2390,15 @@ def build_parser() -> argparse.ArgumentParser:
     _add_backend_flags(p_ingest)
     _add_ingest_flags(p_ingest)
     p_ingest.set_defaults(fn=cmd_ingest)
+
+    p_wp = sub.add_parser(
+        "writeplane",
+        help="partitioned multi-writer ingest: Morton-range-sharded "
+        "journals + epoch-unified manifest (serve mounts the root as "
+        "writeplane:ROOT — docs/write-plane.md)")
+    _add_backend_flags(p_wp)
+    _add_writeplane_flags(p_wp)
+    p_wp.set_defaults(fn=cmd_writeplane)
 
     p_info = sub.add_parser("info", help="resolved config + devices")
     _add_backend_flags(p_info)

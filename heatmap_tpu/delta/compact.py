@@ -213,17 +213,30 @@ def load_overlay_levels(root: str) -> list:
     return drop_zero_rows(merge_level_dirs(dirs))
 
 
-def compact(root: str, *, retention: int = 2) -> dict:
+def compact(root: str, *, retention: int = 2, inflight: int = 0) -> dict:
     """Fold the live delta stack into a new base and prune.
 
     Returns a summary dict; a store with no live deltas is a no-op
     (compacting nothing would only rewrite the base it already has).
+
+    ``inflight`` is the caller's in-flight journal depth — batches
+    queued for this root but not yet journaled (a write-plane pump's
+    queue, an ingest loop's backlog). A ``retention`` below it is
+    refused: pruning would shrink the exactly-once dedup window under
+    batches that can still be replayed against this store, turning a
+    crash-replay into a double count (docs/ingest.md).
     """
     from heatmap_tpu import obs
     from heatmap_tpu.delta import recover
     from heatmap_tpu.delta.metrics import COMPACTION_SECONDS
     from heatmap_tpu.obs import tracing
 
+    if inflight > 0 and retention < inflight:
+        raise ValueError(
+            f"compact({root}): retention {retention} is below the "
+            f"in-flight journal depth {inflight} — refusing to shrink "
+            "the exactly-once dedup window under queued batches "
+            "(docs/ingest.md)")
     recover.sweep(root)
     cur = read_current(root)
     journal = DeltaJournal(journal_dir(root))

@@ -104,6 +104,17 @@ def _quarantine(root: str, path: str, reason: str, kind: str,
              **fields)
 
 
+def quarantine_item(root: str, path: str, reason: str, kind: str,
+                    items: list, detail: str | None = None):
+    """Public quarantine move: relocate ``path`` under
+    ``root/quarantine/`` (never delete), record it in ``items`` and as
+    a ``quarantine`` event. The write plane's sweep
+    (writeplane/recover.py) reuses this for torn/orphan manifests and
+    ledger entries so every quarantine in the system shares one
+    discipline and one event shape."""
+    _quarantine(root, path, reason, kind, items, detail)
+
+
 def _entry_fault(root: str, name: str, verify: bool):
     """-> (meta, reason, detail): reason is None for a valid entry."""
     path = os.path.join(root, "journal", name)

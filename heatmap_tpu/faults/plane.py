@@ -81,6 +81,9 @@ SITES = (
     "backend.probe",
     "tilefs.read",
     "diskcache.write",
+    "writeplane.append",
+    "writeplane.publish",
+    "writeplane.rebalance",
 )
 _SITE_SET = frozenset(SITES)
 

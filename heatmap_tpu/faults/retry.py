@@ -158,6 +158,24 @@ POLICIES = {
     # optimization — never worth sleeping for on the serve path.
     "diskcache.write": RetryPolicy(retries=0, base_s=0.0, cap_s=0.0,
                                    deadline_s=None),
+    # Write-plane boundaries (heatmap_tpu/writeplane/). A per-range
+    # sub-apply is idempotent end to end (the range journal's content
+    # hash), so retrying the whole apply is safe; short caps because a
+    # stalling pump backs the router's bounded queue up — shed a
+    # poisoned sub-batch quickly and let the replay heal it.
+    "writeplane.append": RetryPolicy(retries=2, base_s=0.02, cap_s=0.5,
+                                     deadline_s=10.0),
+    # The manifest-epoch flip is atomic (tmp + rename, twice), so a
+    # retried publish lands the same epoch bytes exactly once — same
+    # stance as compact.publish.
+    "writeplane.publish": RetryPolicy(retries=3, base_s=0.02, cap_s=0.5,
+                                      deadline_s=10.0),
+    # Re-split is rare, coordinator-only, and heavyweight (it compacts
+    # the hot range first); one retry covers a transient, and a failed
+    # rebalance is safe to abandon — the skew check re-fires later and
+    # the sweep quarantines any orphan child range.
+    "writeplane.rebalance": RetryPolicy(retries=1, base_s=0.05, cap_s=2.0,
+                                        deadline_s=None),
 }
 
 

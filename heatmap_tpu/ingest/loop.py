@@ -76,9 +76,15 @@ class TickContext:
     queue_depth: int    #: items still waiting behind this one at dequeue
 
 
-def run_ticks(items, tick, *, queue_depth: int | None = None) -> dict:
+def run_ticks(items, tick, *, queue_depth: int | None = None,
+              name: str = "ingest") -> dict:
     """Drive ``tick(item, ctx)`` over an iterable, optionally through a
     bounded producer/consumer queue.
+
+    ``name`` labels the producer thread (``{name}-producer``) so
+    multi-loop processes — the write plane's router runs this same
+    loop per plane (writeplane/pumps.py) — stay tellable apart in
+    stack dumps and the flight recorder.
 
     ``queue_depth=None`` runs synchronously in the calling thread (the
     legacy ``streaming.run_stream`` cadence). With a depth, a producer
@@ -121,7 +127,7 @@ def run_ticks(items, tick, *, queue_depth: int | None = None) -> dict:
             abort.set()
 
     producer = threading.Thread(
-        target=_produce, name="ingest-producer", daemon=True)
+        target=_produce, name=f"{name}-producer", daemon=True)
     producer.start()
     try:
         index = 0

@@ -191,6 +191,21 @@ EVENT_SCHEMA = {
     "prewarm_done": {"required": ("keys", "seconds"),
                      "optional": ("bytes", "errors", "planned",
                                   "budget_exhausted", "source")},
+    # writeplane/plane.py: one full batch routed across Morton ranges
+    # (ranges = sub-applies routed; 0 with duplicate=True means the
+    # full-batch ledger deduped it before routing).
+    "writeplane_append": {"required": ("points", "ranges"),
+                          "optional": ("sign", "duplicate", "seconds",
+                                       "content_hash")},
+    # writeplane/manifest.py epoch flip: the cross-range visibility
+    # point (live_deltas = journal entries not yet compacted, summed
+    # over ranges — the reader-side merge width).
+    "writeplane_publish": {"required": ("epoch", "ranges"),
+                          "optional": ("seconds", "live_deltas")},
+    # writeplane/plane.py hot-range re-split: journal handoff + a new
+    # range owning [split, hi) — one record per rebalance.
+    "writeplane_rebalance": {"required": ("range", "new_range", "split"),
+                             "optional": ("reason", "seconds")},
     # Terminal record: exit status + output fingerprint.
     "run_end": {"required": ("status",),
                 "optional": ("blobs", "rows", "levels", "checksum",

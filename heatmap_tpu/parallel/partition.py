@@ -209,6 +209,41 @@ def plan_partition(codes, n_shards: int, *, detail_zoom: int, valid=None,
     return plan
 
 
+def split_range_median(codes, weights, lo: int, hi: int):
+    """Weighted-median split code for one hot Morton range ``[lo, hi)``.
+
+    The write plane's rebalance uses the same move the re-split loop
+    above makes — cut the heavy range at its mass median — but against
+    the range's *materialized* cell codes/weights (the compacted base's
+    detail rows) instead of a point sample. Returns an int split code
+    ``s`` with ``lo < s < hi`` such that roughly half the in-range mass
+    lands in ``[lo, s)``, or ``None`` when the range is irreducible
+    (empty, or all mass on its smallest code).
+    """
+    codes = np.asarray(codes, np.int64)
+    weights = np.asarray(weights, np.float64)
+    keep = (codes >= lo) & (codes < hi) & (weights > 0)
+    codes, weights = codes[keep], weights[keep]
+    if len(codes) == 0:
+        return None
+    order = np.argsort(codes, kind="stable")
+    codes, weights = codes[order], weights[order]
+    cum = np.cumsum(weights)
+    idx = int(np.searchsorted(cum, cum[-1] / 2.0, side="left"))
+    med = int(codes[min(idx, len(codes) - 1)])
+    if med <= lo:
+        # All of the left half sits on the smallest code; the first
+        # strictly-greater code still moves mass left (same escape the
+        # planner's re-split loop takes).
+        gt = int(np.searchsorted(codes, med, side="right"))
+        if gt >= len(codes):
+            return None  # single-code hotspot: irreducible
+        med = int(codes[gt])
+    if not (lo < med < hi):
+        return None
+    return med
+
+
 def route_emissions(plan: PartitionPlan, codes, slots, valid=None,
                     weights=None, bucket=None):
     """Scatter emission lanes into per-shard contiguous segments.

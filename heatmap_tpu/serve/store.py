@@ -63,7 +63,7 @@ from heatmap_tpu.tilemath.morton import morton_encode_np
 #: Store spec kinds ``TileStore`` accepts (subset of the sink kinds —
 #: the batch egress surfaces that persist to disk — plus the delta
 #: store overlay).
-STORE_KINDS = ("arrays", "jsonl", "dir", "delta", "tilefs")
+STORE_KINDS = ("arrays", "jsonl", "dir", "delta", "tilefs", "writeplane")
 
 
 class Level:
@@ -190,6 +190,12 @@ def _parse_store_spec(spec: str) -> tuple[str, str]:
         from heatmap_tpu.tilefs.format import sniff_tilefs
 
         names = os.listdir(spec)
+        if "MANIFEST" in names or (
+                "ranges" in names and any(
+                    n.startswith("manifest-") for n in names)):
+            # A write-plane root (epoch-unified manifest over per-range
+            # delta stores — heatmap_tpu/writeplane/).
+            return "writeplane", spec
         if "CURRENT" in names or "journal" in names:
             # A converted delta store (tilefs files in the CURRENT
             # base) serves zero-copy by default — byte-identity makes
@@ -399,6 +405,26 @@ class TileStore:
             else:
                 by_pair = self._build_from_levels(
                     _finalized_to_loaded(load_overlay_levels(self.path)))
+        elif self.kind == "writeplane":
+            from heatmap_tpu.delta.compact import drop_zero_rows
+            from heatmap_tpu.io.merge import merge_level_dirs
+            from heatmap_tpu.writeplane import manifest as wp_manifest
+
+            # One manifest read pins the whole cross-range overlay:
+            # the snapshot names immutable artifact dirs, so the merge
+            # below can never mix two epochs' views even while writers
+            # advance. The manifest epoch is the disk-cache token (the
+            # writeplane analog of _live_delta_epoch — it bumps on
+            # every publish, i.e. exactly when visible bytes can
+            # change). A torn newest manifest falls back to the last
+            # good epoch inside read_manifest.
+            snap = wp_manifest.read_manifest(self.path)
+            dirs = ([] if snap is None
+                    else wp_manifest.overlay_dirs(self.path, snap))
+            delta_epoch = 0 if snap is None else int(snap["epoch"])
+            merged = (drop_zero_rows(merge_level_dirs(dirs))
+                      if dirs else [])
+            by_pair = self._build_from_levels(_finalized_to_loaded(merged))
         elif self.kind == "tilefs":
             names = (os.listdir(self.path)
                      if os.path.isdir(self.path) else [])

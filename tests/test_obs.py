@@ -104,6 +104,14 @@ _PAYLOADS = {
     "prewarm_done": {"keys": 12, "seconds": 0.8, "bytes": 65536,
                      "errors": 0, "planned": 16,
                      "budget_exhausted": False, "source": "startup"},
+    "writeplane_append": {"points": 1500, "ranges": 3, "sign": 1,
+                          "duplicate": False, "seconds": 0.4,
+                          "content_hash": "sha256:00"},
+    "writeplane_publish": {"epoch": 4, "ranges": 3, "seconds": 0.02,
+                           "live_deltas": 5},
+    "writeplane_rebalance": {"range": "r000", "new_range": "r004",
+                             "split": 123456, "reason": "hot_range",
+                             "seconds": 0.3},
     "run_end": {"status": "ok", "blobs": 42, "checksum": "crc32:00000000",
                 "seconds": 1.0},
 }

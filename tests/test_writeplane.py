@@ -1,0 +1,410 @@
+"""Partitioned multi-writer write plane tests (heatmap_tpu/writeplane/).
+
+The anchor: **an N-writer plane serves byte-identical docs to a
+single-writer delta store fed the same batches** — including a
+retraction batch, a boundary-straddling batch, a mid-run hot-range
+re-split, duplicate re-submits, and per-range compaction. Plus the
+operational contracts: a torn manifest quarantines and readers fall
+back to the last good epoch (never a mixed-epoch overlay), a writer
+killed mid-apply heals exactly-once on restart, and a per-range
+compaction below the retention floor or the in-flight depth is
+refused.
+
+Tier-1: CPU backend, real cascade runs (small shapes), no network.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import delta, faults
+from heatmap_tpu.delta.compute import ColumnsSource, read_columns
+from heatmap_tpu.io import open_source
+from heatmap_tpu.pipeline import BatchJobConfig
+from heatmap_tpu.serve import TileStore
+from heatmap_tpu.serve.render import tile_json_bytes
+from heatmap_tpu.tilemath.morton import morton_decode_np
+from heatmap_tpu.writeplane import (PlaneConfig, WritePlane, load_snapshot,
+                                    overlay_dirs, read_manifest, read_pointer,
+                                    run_plane_ingest, sweep_plane)
+from heatmap_tpu.writeplane import manifest as wp_manifest
+
+BASE_SPEC = "synthetic:600:7"
+DELTA_SPEC = "synthetic:400:11"
+RETRACT_ROWS = 150  # first N base rows get retracted
+
+CONFIG = dict(detail_zoom=8, min_detail_zoom=6, result_delta=2)
+
+
+def _collect_docs(store: TileStore) -> dict:
+    """Every servable JSON tile of every layer: {(layer, z, x, y):
+    bytes} — the same enumeration test_delta.py anchors on, so the two
+    stores must agree on which tiles exist, not just their contents."""
+    docs = {}
+    for name, layer in store.layers.items():
+        if name == "default":  # alias of all|alltime, not a new layer
+            continue
+        shift = 2 * layer.result_delta
+        for want, level in layer.levels.items():
+            z = want - layer.result_delta
+            if z < 0:
+                continue
+            rows, cols = morton_decode_np(np.unique(level.codes >> shift))
+            for r, c in zip(rows, cols):
+                docs[(name, z, int(c), int(r))] = tile_json_bytes(
+                    layer, z, int(c), int(r))
+    return docs
+
+
+def _slice_cols(cols: dict, sl: slice) -> dict:
+    return {k: v[sl] for k, v in cols.items()}
+
+
+@pytest.fixture(scope="module")
+def scenario(tmp_path_factory):
+    """One 4-writer run with every hard case folded in — rebalance
+    mid-stream, a retraction, a duplicate re-submit, per-range
+    compaction — against a single-writer reference fed the identical
+    batches."""
+    config = BatchJobConfig(**CONFIG)
+    b1 = read_columns(open_source(BASE_SPEC))
+    b2 = read_columns(open_source(DELTA_SPEC))
+    retract = _slice_cols(b1, slice(0, RETRACT_ROWS))
+
+    sroot = str(tmp_path_factory.mktemp("wp_single") / "store")
+    delta.apply_batch(sroot, ColumnsSource(b1), config)
+    delta.apply_batch(sroot, ColumnsSource(b2), config)
+    delta.apply_batch(sroot, ColumnsSource(retract), config, sign=-1)
+    docs_ref = _collect_docs(TileStore(f"delta:{sroot}"))
+
+    proot = str(tmp_path_factory.mktemp("wp_plane") / "plane")
+    plane = WritePlane(proot, config, PlaneConfig(n_writers=4))
+    r1 = plane.append_columns(b1)
+    rb = plane.rebalance(force_range="r000", reason="test")
+    r2 = plane.append_columns(b2)
+    r3 = plane.append_columns(retract, sign=-1)
+    plane.publish()
+    docs_before = _collect_docs(TileStore(proot))
+
+    r2_dup = plane.append_columns(b2)
+    plane.publish()
+    docs_after_dup = _collect_docs(TileStore(proot))
+
+    for name in plane.order:
+        plane.compact_range(name)
+    docs_after_compact = _collect_docs(TileStore(proot))
+
+    return {
+        "config": config, "b1": b1, "b2": b2, "retract": retract,
+        "sroot": sroot, "proot": proot, "plane": plane,
+        "r1": r1, "r2": r2, "r3": r3, "r2_dup": r2_dup, "rebalance": rb,
+        "docs_ref": docs_ref, "docs_before": docs_before,
+        "docs_after_dup": docs_after_dup,
+        "docs_after_compact": docs_after_compact,
+    }
+
+
+class TestRouting:
+    def test_route_is_a_disjoint_union(self, scenario):
+        plane, b1 = scenario["plane"], scenario["b1"]
+        parts = plane.route(b1)
+        total = sum(len(sub["latitude"]) for _, sub in parts)
+        assert total == len(b1["latitude"])
+        names = [name for name, _ in parts]
+        assert len(names) == len(set(names))
+
+    def test_route_is_deterministic(self, scenario):
+        plane, b1 = scenario["plane"], scenario["b1"]
+        first = plane.route(b1)
+        second = plane.route(b1)
+        assert [n for n, _ in first] == [n for n, _ in second]
+        for (_, a), (_, b) in zip(first, second):
+            np.testing.assert_array_equal(a["latitude"], b["latitude"])
+
+    def test_batches_straddle_range_boundaries(self, scenario):
+        """The scenario batches genuinely split across writers — the
+        byte-identity tests below are vacuous otherwise."""
+        assert len(scenario["r1"].results) >= 2
+        assert len(scenario["r2"].results) >= 2
+
+    def test_route_requires_a_plan(self, tmp_path):
+        plane = WritePlane(str(tmp_path / "p"), BatchJobConfig(**CONFIG),
+                           PlaneConfig(n_writers=2))
+        with pytest.raises(ValueError, match="no partition plan"):
+            plane.route({"latitude": np.zeros(1), "longitude": np.zeros(1)})
+
+
+class TestByteIdentity:
+    def test_four_writers_with_rebalance_and_retraction(self, scenario):
+        """The acceptance gate: 4 writers + a mid-run re-split + a
+        retraction batch serve byte-identical docs to one writer."""
+        assert scenario["rebalance"] is not None
+        assert len(scenario["docs_ref"]) > 50  # non-trivial pyramid
+        assert scenario["docs_before"] == scenario["docs_ref"]
+
+    def test_duplicate_resubmit_changes_nothing(self, scenario):
+        assert scenario["r2_dup"].duplicate
+        assert scenario["docs_after_dup"] == scenario["docs_ref"]
+
+    def test_identity_survives_per_range_compaction(self, scenario):
+        assert scenario["docs_after_compact"] == scenario["docs_ref"]
+
+    def test_two_writer_pumps_match_single_writer(self, tmp_path):
+        """The CI fast leg: a pumped 2-writer drain over micro-batches
+        is byte-identical to a single-writer delta store fed the same
+        micro-batches."""
+        config = BatchJobConfig(**CONFIG)
+        sroot = str(tmp_path / "single")
+        for batch in open_source(BASE_SPEC).batches(200):
+            delta.apply_batch(sroot, ColumnsSource(batch), config)
+        ref = _collect_docs(TileStore(f"delta:{sroot}"))
+
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, config, PlaneConfig(n_writers=2))
+        stats = run_plane_ingest(plane, open_source(BASE_SPEC),
+                                 micro_batch=200)
+        assert stats.failed == 0
+        assert stats.completed == stats.batches
+        assert _collect_docs(TileStore(proot)) == ref
+
+    def test_bucketed_padding_is_byte_neutral(self, tmp_path):
+        """With ``pad_bucketing="pow2"`` the plane pads each routed
+        sub-batch to a bucketed point count (masked-invalid lanes, the
+        ``pad_emissions`` contract) — the overlay must not notice, and
+        point accounting must count real rows only."""
+        config = BatchJobConfig(**CONFIG, pad_bucketing="pow2",
+                                pad_bucket_min=1 << 7)
+        sroot = str(tmp_path / "single")
+        for batch in open_source(BASE_SPEC).batches(200):
+            delta.apply_batch(sroot, ColumnsSource(batch), config)
+        ref = _collect_docs(TileStore(f"delta:{sroot}"))
+
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, config, PlaneConfig(n_writers=3))
+        stats = run_plane_ingest(plane, open_source(BASE_SPEC),
+                                 micro_batch=200)
+        assert stats.failed == 0
+        assert stats.points == 600  # real rows, not pad lanes
+        assert _collect_docs(TileStore(proot)) == ref
+
+
+class TestManifest:
+    def test_snapshots_are_digest_stamped(self, scenario):
+        proot = scenario["proot"]
+        epoch = read_pointer(proot)
+        snap = load_snapshot(proot, epoch)
+        assert snap["epoch"] == epoch
+        assert snap["digest"].startswith("sha256:")
+
+    def test_overlay_never_mixes_epochs(self, scenario):
+        """A reader pinned to an older epoch sees exactly that
+        snapshot's artifact list — overlay_dirs derives from the
+        snapshot alone, never from globbing live range state."""
+        proot = scenario["proot"]
+        epochs = wp_manifest.list_epochs(proot)
+        assert len(epochs) >= 2
+        old = load_snapshot(proot, epochs[-2])
+        for d in overlay_dirs(proot, old):
+            rel = os.path.relpath(d, proot)
+            parts = rel.split(os.sep)  # ranges/rNNN/<artifact>
+            entry = old["ranges"][parts[1]]
+            assert parts[2] in ([entry["base"]] + list(entry["deltas"]))
+
+    def test_torn_manifest_falls_back_and_quarantines(self, scenario,
+                                                      tmp_path):
+        """Corrupting the pointed-at snapshot mid-write: readers serve
+        the last good epoch; the sweep quarantines the torn file and
+        repairs the pointer."""
+        config = scenario["config"]
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, config, PlaneConfig(n_writers=2))
+        plane.append_columns(scenario["b1"])
+        plane.publish()
+        good_docs = _collect_docs(TileStore(proot))
+        good_epoch = read_pointer(proot)
+
+        plane.append_columns(scenario["b2"])
+        plane.publish()
+        torn = wp_manifest.manifest_path(proot, read_pointer(proot))
+        with open(torn, "w") as f:
+            f.write('{"epoch": tru')  # torn mid-write
+
+        # Readers fall back to the last valid epoch, not an error and
+        # not a mix of old pointer + new range dirs.
+        assert _collect_docs(TileStore(proot)) == good_docs
+        res = sweep_plane(proot)
+        reasons = [q["reason"] for q in res["quarantined"]]
+        assert "torn_manifest" in reasons
+        assert not os.path.exists(torn)
+        assert read_pointer(proot) == good_epoch
+        assert _collect_docs(TileStore(proot)) == good_docs
+
+    def test_orphan_range_is_quarantined(self, scenario, tmp_path):
+        config = scenario["config"]
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, config, PlaneConfig(n_writers=2))
+        plane.append_columns(scenario["b1"])
+        plane.publish()
+        orphan = os.path.join(proot, "ranges", "r099")
+        os.makedirs(orphan)
+        res = sweep_plane(proot)
+        assert "orphan_range" in [q["reason"] for q in res["quarantined"]]
+        assert not os.path.exists(orphan)
+
+    def test_manifest_history_is_bounded(self, scenario):
+        proot = scenario["proot"]
+        plane = scenario["plane"]
+        n = len(glob.glob(os.path.join(proot, "manifest-*.json")))
+        assert n <= plane.plane.manifest_keep
+
+
+class TestExactlyOnce:
+    def test_writer_killed_mid_apply_heals_on_restart(self, scenario,
+                                                      tmp_path):
+        """Kill one of three writers terminally mid-run: survivors keep
+        applying and publishing; re-running the same stream after a
+        restart heals to byte-identity with the single-writer store."""
+        config = scenario["config"]
+        sroot = str(tmp_path / "single")
+        for batch in open_source(BASE_SPEC).batches(200):
+            delta.apply_batch(sroot, ColumnsSource(batch), config)
+        ref = _collect_docs(TileStore(f"delta:{sroot}"))
+
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, config, PlaneConfig(n_writers=3))
+        victim = "r001"
+        faults.install_spec(
+            f"scale=0,writeplane.append@{victim}=99")
+        try:
+            stats = run_plane_ingest(plane, open_source(BASE_SPEC),
+                                     micro_batch=200)
+        finally:
+            faults.install(None)
+        assert stats.pumps[victim].dead
+        assert stats.failed > 0
+        # Survivors kept publishing: the manifest advanced past the
+        # planning epoch even though every batch had a dead part.
+        assert stats.epoch > 1
+        survivors = [n for n in plane.order if n != victim]
+        assert any(stats.pumps[n].applied for n in survivors)
+
+        plane2 = WritePlane(proot, config, PlaneConfig(n_writers=3))
+        stats2 = run_plane_ingest(plane2, open_source(BASE_SPEC),
+                                  micro_batch=200)
+        assert stats2.failed == 0
+        assert _collect_docs(TileStore(proot)) == ref
+
+    def test_replay_after_resplit_still_dedups(self, scenario, tmp_path):
+        """The ledger layer: after a rebalance changes routing, a
+        replayed stream dedups at the full-batch hash, so the re-split
+        cannot double-apply anything."""
+        config = scenario["config"]
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, config, PlaneConfig(n_writers=2))
+        run_plane_ingest(plane, open_source(BASE_SPEC), micro_batch=200)
+        before = _collect_docs(TileStore(proot))
+
+        plane2 = WritePlane(proot, config, PlaneConfig(n_writers=2))
+        assert plane2.rebalance(force_range="r000") is not None
+        stats = run_plane_ingest(plane2, open_source(BASE_SPEC),
+                                 micro_batch=200)
+        assert stats.duplicates == stats.batches
+        assert _collect_docs(TileStore(proot)) == before
+
+    def test_restart_adopts_the_persisted_plan(self, scenario, tmp_path):
+        config = scenario["config"]
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, config, PlaneConfig(n_writers=3))
+        plane.append_columns(scenario["b1"])
+        plane.publish()
+        plane2 = WritePlane(proot, config, PlaneConfig(n_writers=3))
+        assert plane2.planned
+        assert plane2.splits == plane.splits
+        assert plane2.order == plane.order
+
+    def test_config_mismatch_is_refused(self, scenario, tmp_path):
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, scenario["config"],
+                           PlaneConfig(n_writers=2))
+        plane.append_columns(scenario["b1"])
+        plane.publish()
+        other = BatchJobConfig(detail_zoom=9, min_detail_zoom=6,
+                               result_delta=2)
+        with pytest.raises(ValueError, match="detail_zoom"):
+            WritePlane(proot, other, PlaneConfig(n_writers=2))
+
+
+class TestRetentionFloor:
+    def test_compact_below_floor_is_refused(self, scenario):
+        plane = scenario["plane"]
+        with pytest.raises(ValueError, match="retention_floor|floor"):
+            plane.compact_range(plane.order[0], retention=1)
+
+    def test_compact_below_inflight_depth_is_refused(self, tmp_path):
+        """The delta-store guard the plane rides on: shrinking the
+        dedup window below the queued-batch depth is refused."""
+        root = str(tmp_path / "store")
+        delta.apply_batch(root, open_source("synthetic:100:7"),
+                          BatchJobConfig(**CONFIG))
+        with pytest.raises(ValueError, match="in-flight"):
+            delta.compact(root, retention=2, inflight=5)
+
+    def test_plane_config_floor_is_validated(self):
+        with pytest.raises(ValueError, match="retention_floor"):
+            PlaneConfig(retention=1, retention_floor=3)
+
+    def test_deep_queue_defers_compaction(self, scenario):
+        plane = scenario["plane"]
+        # compact_every=0 planes never auto-compact...
+        assert plane.maybe_compact(plane.order[0], inflight=0) is None
+        # ...and an over-deep queue defers rather than raises.
+        deep = WritePlane.maybe_compact
+        assert deep(plane, plane.order[0],
+                    inflight=plane.plane.retention + 1) is None
+
+
+class TestRebalance:
+    def test_resplit_summary_and_lineage(self, scenario):
+        rb = scenario["rebalance"]
+        assert rb["range"] == "r000"
+        assert rb["new_range"] == "r004"
+        snap = read_manifest(scenario["proot"])
+        assert snap["ranges"][rb["new_range"]]["parent"] == "r000"
+        # The child owns the right half: it sits directly after its
+        # parent in interval order.
+        order = snap["order"]
+        assert order.index(rb["new_range"]) == order.index("r000") + 1
+
+    def test_balanced_plane_declines_to_split(self, scenario, tmp_path):
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, scenario["config"],
+                           PlaneConfig(n_writers=2, balance_factor=1e9))
+        plane.append_columns(scenario["b1"])
+        assert plane.rebalance() is None
+
+    def test_unknown_force_range_is_refused(self, scenario):
+        with pytest.raises(ValueError, match="unknown range"):
+            scenario["plane"].rebalance(force_range="r999")
+
+
+class TestServeIntegration:
+    def test_bare_path_sniffs_as_writeplane(self, scenario):
+        store = TileStore(scenario["proot"])
+        assert store.kind == "writeplane"
+        explicit = TileStore(f"writeplane:{scenario['proot']}")
+        assert explicit.kind == "writeplane"
+
+    def test_delta_epoch_tracks_the_manifest(self, scenario):
+        store = TileStore(scenario["proot"])
+        assert store.delta_epoch == read_pointer(scenario["proot"])
+
+    def test_empty_plane_serves_empty(self, tmp_path):
+        proot = str(tmp_path / "plane")
+        WritePlane(proot, BatchJobConfig(**CONFIG), PlaneConfig())
+        store = TileStore(f"writeplane:{proot}")
+        assert _collect_docs(store) == {}

@@ -33,6 +33,11 @@ Reads every bench artifact the repo's tooling writes —
   (``dispatch:overhead_pct[ds,mode]``, lower) and the gspmd leg's
   end-to-end wall seconds (lower; rows that failed the byte gate are
   never folded);
+- ``BENCH_writeplane.json`` (tools/bench_writeplane.py): per writer
+  count, multi-writer drain points/sec (``writeplane:pts_per_s[N]``,
+  higher) and enqueue->servable p50 lag seconds
+  (``writeplane:lag_p50_s[N]``, lower; cells that failed the byte gate
+  against the single-writer reference are never folded);
 - ``BENCH_synopsis.json`` (tools/bench_synopsis.py): wavelet-synopsis
   exact/synopsis bytes ratio (higher) and pair decode p99 ms (lower);
 - ``BENCH_query.json`` (tools/bench_query.py): per-op integral-path
@@ -265,6 +270,22 @@ def snapshot_metrics(root: str) -> dict:
             wall = (row.get("wall_s") or {}).get("gspmd")
             if isinstance(wall, (int, float)):
                 out[f"dispatch:wall_s[{ds}]"] = (float(wall), False)
+    doc = _load(os.path.join(root, "BENCH_writeplane.json"))
+    if isinstance(doc, dict):
+        # Partitioned write plane (bench_writeplane): per writer count,
+        # drain throughput (higher) and enqueue->servable p50 lag
+        # seconds (lower); cells that failed the byte gate against the
+        # single-writer reference are never folded.
+        for row in doc.get("results", []):
+            n = row.get("writers")
+            if n is None or not row.get("byte_identical"):
+                continue
+            if isinstance(row.get("pts_per_s"), (int, float)):
+                out[f"writeplane:pts_per_s[{n}]"] = (
+                    float(row["pts_per_s"]), True)
+            p50 = (row.get("lag_s") or {}).get("p50")
+            if isinstance(p50, (int, float)):
+                out[f"writeplane:lag_p50_s[{n}]"] = (float(p50), False)
     doc = _load(os.path.join(root, "BENCH_synopsis.json"))
     if isinstance(doc, dict):
         ratio = (doc.get("compression") or {}).get("bytes_ratio")

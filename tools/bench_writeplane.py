@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Write-plane bench: multi-writer throughput + enqueue->servable lag
+at writers ∈ {1, 2, 4}: BENCH_writeplane.json.
+
+Each cell drains the same hotspot-clustered synthetic stream (the
+Zipf-ish mixture ``SyntheticSource`` generates — a few metro hotspots
+absorb most points, the shape that makes range partitioning earn its
+keep) through ``writeplane.run_plane_ingest`` with N pumps, then
+byte-gates the plane against a single-writer delta store fed the
+identical micro-batches. Cells that fail the byte gate report
+``byte_identical: false`` and are never folded into the trend state
+(tools/bench_gate.py skips them).
+
+Measured per cell:
+
+- ``pts_per_s``   completed points / drain wall seconds;
+- ``lag_s``       enqueue -> servable p50/p99: micro-batch enqueued at
+                  the router -> covered by a flipped manifest epoch
+                  (``PlaneStats.lags_s``);
+- ``publishes``   manifest epochs flipped during the drain.
+
+The 1-writer cell runs first so a warm jax cache can only ever favor
+it; multi-writer cells still win on wall clock because per-range
+applies overlap across pump threads.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/bench_writeplane.py \
+        [--points 20000] [--writers 1,2,4] [--micro-batch 2048] \
+        [--out BENCH_writeplane.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+def _pct(sorted_vals: list, q: float) -> float | None:
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def _collect_docs(store) -> dict:
+    """Every servable JSON tile: {(layer, z, x, y): bytes} — the byte
+    gate enumerates tiles from the level Morton codes, so the stores
+    must agree on which tiles exist, not just their contents."""
+    import numpy as np
+
+    from heatmap_tpu.serve.render import tile_json_bytes
+    from heatmap_tpu.tilemath.morton import morton_decode_np
+
+    docs = {}
+    for name, layer in store.layers.items():
+        if name == "default":
+            continue
+        shift = 2 * layer.result_delta
+        for want, level in layer.levels.items():
+            z = want - layer.result_delta
+            if z < 0:
+                continue
+            rows, cols = morton_decode_np(np.unique(level.codes >> shift))
+            for r, c in zip(rows, cols):
+                docs[(name, z, int(c), int(r))] = tile_json_bytes(
+                    layer, z, int(c), int(r))
+    return docs
+
+
+def bench_cell(spec: str, n_writers: int, micro_batch: int,
+               tmpdir: str, ref_docs: dict) -> dict:
+    from heatmap_tpu.io import open_source
+    from heatmap_tpu.pipeline import BatchJobConfig
+    from heatmap_tpu.serve import TileStore
+    from heatmap_tpu.writeplane import (PlaneConfig, WritePlane,
+                                        run_plane_ingest)
+
+    # Routed sub-batch sizes vary tick to tick (a range owns whatever
+    # share of each micro-batch falls in its interval), so the cells
+    # run the pow2 bucketed compile cache — byte-neutral by contract
+    # (delta/compact.py CONFIG_FIELDS) and the only way multi-writer
+    # wall clock measures applies instead of XLA compiles.
+    config = BatchJobConfig(detail_zoom=11, min_detail_zoom=5,
+                            result_delta=3, pad_bucketing="pow2",
+                            pad_bucket_min=1 << 8)
+    root = os.path.join(tmpdir, f"plane-{n_writers}")
+    plane = WritePlane(root, config, PlaneConfig(n_writers=n_writers))
+    t0 = time.perf_counter()
+    stats = run_plane_ingest(plane, open_source(spec),
+                             micro_batch=micro_batch)
+    wall_s = time.perf_counter() - t0
+    docs = _collect_docs(TileStore(f"writeplane:{root}"))
+    byte_identical = docs == ref_docs
+    lags = sorted(stats.lags_s)
+    shutil.rmtree(root, ignore_errors=True)
+    return {
+        "writers": n_writers,
+        "batches": stats.batches,
+        "completed": stats.completed,
+        "failed": stats.failed,
+        "points": stats.points,
+        "pts_per_s": round(stats.points / wall_s, 1) if wall_s else None,
+        "lag_s": {"p50": _pct(lags, 0.50), "p99": _pct(lags, 0.99)},
+        "publishes": stats.publishes,
+        "byte_identical": byte_identical,
+        "wall_s": round(wall_s, 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=int, default=20_000)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--writers", default="1,2,4",
+                    help="comma list of writer counts")
+    ap.add_argument("--micro-batch", type=int, default=2048)
+    ap.add_argument("--out", default="BENCH_writeplane.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu import delta
+    from heatmap_tpu.io import open_source
+    from heatmap_tpu.pipeline import BatchJobConfig
+    from heatmap_tpu.serve import TileStore
+
+    spec = f"synthetic:{args.points}:{args.seed}"
+    counts = [int(w) for w in args.writers.split(",") if w.strip()]
+    tmpdir = tempfile.mkdtemp(prefix="benchwriteplane-")
+    try:
+        # Single-writer delta-store reference over the same
+        # micro-batches: the byte gate every cell must clear.
+        ref_root = os.path.join(tmpdir, "ref")
+        config = BatchJobConfig(detail_zoom=11, min_detail_zoom=5,
+                                result_delta=3, pad_bucketing="pow2",
+                                pad_bucket_min=1 << 8)
+        for batch in open_source(spec).batches(args.micro_batch):
+            delta.apply_batch(ref_root, delta.ColumnsSource(batch), config)
+        ref_docs = _collect_docs(TileStore(f"delta:{ref_root}"))
+
+        results = []
+        for n_writers in counts:  # 1 first: warm cache favors the ref
+            row = bench_cell(spec, n_writers, args.micro_batch, tmpdir,
+                             ref_docs)
+            print(json.dumps({k: row[k] for k in
+                              ("writers", "pts_per_s", "lag_s",
+                               "byte_identical")}), flush=True)
+            results.append(row)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    doc = {
+        "bench": "writeplane",
+        "points": args.points,
+        "micro_batch": args.micro_batch,
+        "tiles": len(ref_docs),
+        "results": results,
+    }
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}", file=sys.stderr)
+    return 0 if all(r["byte_identical"] for r in results) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

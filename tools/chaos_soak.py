@@ -120,6 +120,19 @@ INGEST_CHAOS = "seed=13,scale=0,ingest.tick=2x2,ingest.publish=2x2"
 #: torn state delta/recover.py heals on the next run's startup sweep.
 INGEST_KILL = "seed=13,scale=0,journal.append=99"
 
+#: Write-plane storms (the writer_loss phase installs its own planes).
+#: Absorbed storm: spaced writeplane.append + writeplane.publish
+#: faults, each inside its site's retry budget — the 3-writer drain
+#: must complete with zero failed batches and byte-identical output.
+WRITEPLANE_CHAOS = ("seed=17,scale=0,"
+                    "writeplane.append=4x3,writeplane.publish=2x3")
+#: Kill storm: every apply on range r001 fails past the whole retry
+#: budget — that pump dies mid-run (writer loss), the survivors keep
+#: applying and publishing manifest epochs, and the dead range's
+#: batches are never ledgered, so a restart re-drain heals them
+#: exactly-once.
+WRITEPLANE_KILL = "seed=17,scale=0,writeplane.append@r001=99"
+
 
 # ---------------------------------------------------------------- pipeline
 
@@ -209,10 +222,10 @@ def _fetch_all(base: str, coords, ctx):
     return docs
 
 
-def _serve_docs(root: str, ctx=None):
-    """Serve the delta store over real HTTP and fetch every tile."""
+def _serve_docs(root: str, ctx=None, kind: str = "delta"):
+    """Serve a store root over real HTTP and fetch every tile."""
     ctx = ctx if ctx is not None else {"codes": {}, "saw_degraded": False}
-    store = TileStore(f"delta:{root}")
+    store = TileStore(f"{kind}:{root}")
     app = ServeApp(store, TileCache(max_bytes=64 << 20),
                    render_timeout_s=30.0)
     server, base = serve_in_thread(app)
@@ -417,6 +430,74 @@ def phase_ingest_crash(ctx):
     assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
     return {"ticks": ticks_total, "absorbed_faults": absorbed,
             "epochs": epochs, "tiles": len(got)}
+
+
+def phase_writer_loss(ctx):
+    """The partitioned write plane under its own storms: an absorbed
+    append/publish storm is invisible in the outcome; killing 1 of 3
+    writers mid-apply leaves the survivors applying and publishing
+    manifest epochs; a restart re-drain of the same stream heals the
+    dead range exactly-once and the plane serves byte-identical to a
+    single-writer delta store fed the same micro-batches."""
+    from heatmap_tpu.writeplane import PlaneConfig, WritePlane, \
+        run_plane_ingest
+
+    n = ctx["n"]
+    wcfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=8,
+                          result_delta=2)
+    micro = max(1, -(-n // 6))  # 6 micro-batches
+    base_dir = os.path.dirname(ctx["base_root"])
+
+    # Single-writer reference over the same micro-batches.
+    ref = os.path.join(base_dir, "store-wp-ref")
+    for batch in SyntheticSource(n=n, seed=23).batches(micro):
+        delta.apply_batch(ref, delta.ColumnsSource(batch), wcfg)
+
+    # 1. Absorbed storm: spaced append + publish faults inside the
+    #    retry budgets — the drain completes as if nothing happened.
+    root_a = os.path.join(base_dir, "wp-absorbed")
+    plane = faults.install_spec(WRITEPLANE_CHAOS)
+    stats = run_plane_ingest(
+        WritePlane(root_a, wcfg, PlaneConfig(n_writers=3)),
+        SyntheticSource(n=n, seed=23), micro_batch=micro)
+    absorbed = plane.injected
+    faults.install(None)
+    assert stats.failed == 0 and stats.completed == stats.batches, \
+        vars(stats)
+    assert absorbed >= 4, f"absorbed storm never fired ({absorbed})"
+    got = _serve_docs(root_a, kind="writeplane")["docs"]
+    want = _serve_docs(ref)["docs"]
+    assert sorted(got) == sorted(want) and all(
+        got[k] == want[k] for k in want), "absorbed storm changed bytes"
+
+    # 2. Writer loss: r001's pump dies terminally mid-run; the other
+    #    two writers keep applying and the manifest keeps advancing.
+    root_k = os.path.join(base_dir, "wp-killed")
+    faults.install_spec(WRITEPLANE_KILL)
+    stats = run_plane_ingest(
+        WritePlane(root_k, wcfg, PlaneConfig(n_writers=3)),
+        SyntheticSource(n=n, seed=23), micro_batch=micro)
+    faults.install(None)
+    assert stats.pumps["r001"].dead, "kill storm never killed the pump"
+    assert stats.failed > 0
+    assert stats.epoch > 1, "survivors stopped publishing"
+    survivors = [p for name, p in stats.pumps.items() if name != "r001"]
+    assert any(p.applied for p in survivors), "survivors applied nothing"
+
+    # 3. Restart re-drain heals exactly-once: survivors' sub-batches
+    #    dedup in their range journals, r001 applies its missing
+    #    halves, and the plane converges to the reference bytes.
+    heal = run_plane_ingest(
+        WritePlane(root_k, wcfg, PlaneConfig(n_writers=3)),
+        SyntheticSource(n=n, seed=23), micro_batch=micro)
+    assert heal.failed == 0, vars(heal)
+    got = _serve_docs(root_k, kind="writeplane")["docs"]
+    assert sorted(got) == sorted(want), (
+        f"served tile sets diverged: {len(got)} vs {len(want)}")
+    mism = [k for k in want if got[k] != want[k]]
+    assert not mism, f"{len(mism)} tiles diverged, e.g. {mism[:3]}"
+    return {"absorbed_faults": absorbed, "batches": stats.batches,
+            "healed_duplicates": heal.duplicates, "tiles": len(got)}
 
 
 #: dispatch-phase storms (the feeder has its own planes, installed
@@ -1404,6 +1485,7 @@ PHASES = [
     ("heartbeat", phase_heartbeat),
     ("fault_floor", phase_fault_floor),
     ("ingest_crash", phase_ingest_crash),
+    ("writer_loss", phase_writer_loss),
     ("dispatch", phase_dispatch),
     ("host_loss", phase_host_loss),
     ("host_loss_morton", phase_host_loss_morton),
