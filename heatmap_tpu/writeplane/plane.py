@@ -270,11 +270,13 @@ class WritePlane:
 
     @property
     def order(self) -> list:
-        return list(self._order)
+        with self._lock:
+            return list(self._order)
 
     @property
     def splits(self) -> list:
-        return list(self._splits or [])
+        with self._lock:
+            return list(self._splits or [])
 
     def range_root(self, name: str) -> str:
         return manifest_mod.range_root(self.root, name)
@@ -314,16 +316,23 @@ class WritePlane:
         fixed plan; row order is preserved within each part, so a
         replayed batch re-splits into byte-identical sub-batches.
         Invalid (out-of-projection) rows ride range 0 — the cascade
-        drops them there exactly as a single writer would."""
-        if self._splits is None:
-            raise ValueError("write plane has no partition plan yet "
-                             "(ensure_plan runs on the first append)")
+        drops them there exactly as a single writer would.
+
+        The (splits, order) pair is snapshotted under the plane lock so
+        a concurrent ``rebalance`` (which mutates both) can never be
+        observed half-applied — routing sees either the old plan or the
+        new one, whole."""
+        with self._lock:
+            if self._splits is None:
+                raise ValueError("write plane has no partition plan yet "
+                                 "(ensure_plan runs on the first append)")
+            splits = np.asarray(self._splits, np.int64)
+            order = tuple(self._order)
         codes, valid = self._codes(cols)
-        shards = morton_range_shards_np(
-            np.asarray(self._splits, np.int64), codes)
+        shards = morton_range_shards_np(splits, codes)
         shards = np.where(np.asarray(valid, bool), shards, 0)
         parts = []
-        for k, name in enumerate(self._order):
+        for k, name in enumerate(order):
             idx = np.flatnonzero(shards == k)
             if len(idx):
                 parts.append((name, _take_cols(cols, idx)))
@@ -332,17 +341,27 @@ class WritePlane:
     # -- append ------------------------------------------------------------
 
     def ledger_find(self, content_hash: str):
-        return self._ledger.find(content_hash)
+        with self._lock:
+            return self._ledger.find(content_hash)
 
     def record_batch(self, content_hash: str, *, points: int, sign: int,
                      watermark=None) -> dict:
         """Ledger a fully-applied batch (idempotent). Only call after
         every routed sub-apply landed — the ledger hit short-circuits
-        routing, so a premature record would lose the tail ranges."""
-        return self._ledger.append(content_hash=content_hash,
-                                   points=points, sign=sign,
-                                   artifact=LEDGER_ARTIFACT,
-                                   watermark=watermark)
+        routing, so a premature record would lose the tail ranges.
+
+        Serialized on the plane lock: ``DeltaJournal.append`` is a
+        non-atomic find → next_epoch → rename sequence, so two batches
+        completing on different pump threads could otherwise claim the
+        same epoch and the later rename would silently drop the
+        earlier batch's hash from the exactly-once ledger (and the
+        prune in ``_publish_locked`` could race an append and shrink
+        the keep window by one)."""
+        with self._lock:
+            return self._ledger.append(content_hash=content_hash,
+                                       points=points, sign=sign,
+                                       artifact=LEDGER_ARTIFACT,
+                                       watermark=watermark)
 
     def apply_range(self, name: str, cols: dict, *, sign: int = 1,
                     batch_size: int = 1 << 20) -> DeltaResult:
@@ -536,7 +555,7 @@ class WritePlane:
         return f"r{(max(nums) + 1 if nums else 0):03d}"
 
     def rebalance(self, *, force_range: str | None = None,
-                  reason: str = "skew") -> dict | None:
+                  reason: str = "skew", inflight: int = 0) -> dict | None:
         """Hot-range re-split: journal handoff (compact folds the hot
         range's live journal into its base) + a weighted-median split
         of its materialized detail mass + a fresh empty range owning
@@ -546,7 +565,17 @@ class WritePlane:
         Returns a summary dict, or None when no range exceeds
         ``balance_factor`` times the mean applied mass (or the hot
         range is a single-code irreducible hotspot). ``force_range``
-        skips the skew check (the operator runbook's knob)."""
+        skips the skew check (the operator runbook's knob).
+
+        ``inflight`` is the hot range's queued-but-unapplied batch
+        depth (a pump's queue size; 0 after a drain). The handoff
+        compact runs through :meth:`compact_range`, so the per-range
+        retention floor and in-flight guard apply to it exactly as to
+        a pump-triggered fold; a rebalance whose handoff would shrink
+        the dedup window below the queue is deferred (returns None)
+        rather than forced."""
+        if inflight > self.plane.retention:
+            return None  # handoff would prune under queued batches; defer
         with self._lock:
             if self._splits is None:
                 return None
@@ -573,8 +602,9 @@ class WritePlane:
                 # base so the split votes on everything applied (and
                 # the child starts from an empty store — the parent's
                 # base keeps serving both halves' history by merge).
-                compact_mod.compact(self.range_root(hot),
-                                    retention=self.plane.retention)
+                # Through compact_range so the retention-floor and
+                # in-flight-depth guards cover the handoff too.
+                self.compact_range(hot, inflight=inflight)
                 levels = compact_mod.load_overlay_levels(
                     self.range_root(hot))
                 dz = int(self.config.detail_zoom)

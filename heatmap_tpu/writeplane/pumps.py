@@ -119,40 +119,65 @@ class PlanePumps:
             try:
                 if item is _STOP:
                     return
-                seq, sub, sign = item
-                if ps.dead:
-                    # Fast-fail so the router never blocks on a corpse.
-                    self._part_done(seq, ok=False)
-                    continue
                 try:
-                    res = self.plane.apply_range(name, sub, sign=sign)
-                except BaseException as e:  # noqa: BLE001 — writer loss
+                    self._pump_one(name, q, ps, *item)
+                except BaseException as e:  # noqa: BLE001 — keep the loop
+                    # _pump_one already routes apply failures through
+                    # the writer-loss path; anything escaping it is a
+                    # coordinator/bookkeeping failure. If it killed the
+                    # thread, the router's bounded queue for this range
+                    # would fill and q.put would block forever — so
+                    # reuse the writer-loss path: mark the pump dead
+                    # (subsequent items fast-fail) and best-effort fail
+                    # the part so the batch resolves instead of
+                    # dangling in _outstanding.
                     ps.errors += 1
                     ps.dead = True
                     ps.error = repr(e)
-                    self._part_done(seq, ok=False)
-                    continue
-                if res.duplicate:
-                    ps.duplicates += 1
-                else:
-                    ps.applied += 1
-                    ps.points += res.points
-                self._part_done(seq, ok=True)
-                try:
-                    if self.plane.maybe_compact(
-                            name, inflight=q.qsize()) is not None:
-                        ps.compactions += 1
-                except Exception as e:  # noqa: BLE001 — defer, don't die
-                    ps.errors += 1
-                    ps.error = repr(e)
+                    try:
+                        self._part_done(item[0], ok=False)
+                    except BaseException:  # noqa: BLE001 — stay alive
+                        pass
             finally:
                 q.task_done()
+
+    def _pump_one(self, name: str, q, ps: PumpStats, seq, sub, sign):
+        if ps.dead:
+            # Fast-fail so the router never blocks on a corpse.
+            self._part_done(seq, ok=False)
+            return
+        try:
+            res = self.plane.apply_range(name, sub, sign=sign)
+        except BaseException as e:  # noqa: BLE001 — writer loss
+            ps.errors += 1
+            ps.dead = True
+            ps.error = repr(e)
+            self._part_done(seq, ok=False)
+            return
+        if res.duplicate:
+            ps.duplicates += 1
+        else:
+            ps.applied += 1
+            ps.points += res.points
+        self._part_done(seq, ok=True)
+        try:
+            if self.plane.maybe_compact(
+                    name, inflight=q.qsize()) is not None:
+                ps.compactions += 1
+        except Exception as e:  # noqa: BLE001 — defer, don't die
+            ps.errors += 1
+            ps.error = repr(e)
 
     # -- coordinator -------------------------------------------------------
 
     def _part_done(self, seq: int, *, ok: bool):
         with self._mu:
-            ent = self._outstanding[seq]
+            # .get, not []: a batch can already be resolved when the
+            # pump's failure handler re-fails a part (double-completion
+            # must be a no-op, never a KeyError that kills the thread).
+            ent = self._outstanding.get(seq)
+            if ent is None:
+                return
             ent["left"] -= 1
             if not ok:
                 ent["failed"] = True

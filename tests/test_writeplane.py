@@ -18,6 +18,7 @@ from __future__ import annotations
 import glob
 import json
 import os
+import threading
 
 import numpy as np
 import pytest
@@ -390,6 +391,73 @@ class TestRebalance:
     def test_unknown_force_range_is_refused(self, scenario):
         with pytest.raises(ValueError, match="unknown range"):
             scenario["plane"].rebalance(force_range="r999")
+
+    def test_rebalance_defers_under_inflight_queue(self, scenario):
+        """The handoff compact obeys the in-flight guard: a rebalance
+        whose fold would shrink the dedup window below the hot range's
+        queued batches defers instead of double-count-arming it."""
+        plane = scenario["plane"]
+        assert plane.rebalance(force_range=plane.order[0],
+                               inflight=plane.plane.retention + 1) is None
+
+
+class TestConcurrency:
+    def test_concurrent_ledger_records_never_lose_entries(self, scenario,
+                                                          tmp_path):
+        """Ledger appends from many pump threads serialize on the plane
+        lock: every hash lands under a distinct epoch. (The unguarded
+        find → next_epoch → rename sequence would let two threads claim
+        one epoch, and the later rename silently drops the earlier
+        batch from the exactly-once ledger.)"""
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, scenario["config"],
+                           PlaneConfig(n_writers=2, ledger_keep=256))
+        hashes = [f"sha256:{i:064x}" for i in range(24)]
+        threads = [threading.Thread(target=plane.record_batch, args=(h,),
+                                    kwargs=dict(points=1, sign=1))
+                   for h in hashes]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        entries = plane._ledger.entries()
+        assert sorted(e["content_hash"] for e in entries) == sorted(hashes)
+        assert len({e["epoch"] for e in entries}) == len(hashes)
+
+    def test_pump_bookkeeping_failure_fails_fast(self, scenario, tmp_path,
+                                                 monkeypatch):
+        """A failure escaping the pump body (a coordinator bug, not an
+        apply error) takes the writer-loss path: the pump marks itself
+        dead and fails its parts, so the router keeps draining instead
+        of blocking forever on the dead range's full queue."""
+        from heatmap_tpu.writeplane import pumps as pumps_mod
+
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, scenario["config"],
+                           PlaneConfig(n_writers=2))
+        orig = pumps_mod.PlanePumps._pump_one
+
+        def boom(self, name, q, ps, seq, sub, sign):
+            if name == "r000":
+                raise KeyError("bookkeeping bug")
+            return orig(self, name, q, ps, seq, sub, sign)
+
+        monkeypatch.setattr(pumps_mod.PlanePumps, "_pump_one", boom)
+        stats = pumps_mod.run_plane_ingest(plane, open_source(BASE_SPEC),
+                                           micro_batch=100)
+        assert stats.pumps["r000"].dead
+        assert "bookkeeping bug" in stats.pumps["r000"].error
+        assert stats.failed > 0
+        assert stats.batches == 6  # the whole stream drained — no hang
+
+    def test_double_completed_part_is_a_noop(self, scenario, tmp_path):
+        from heatmap_tpu.writeplane.pumps import PlanePumps
+
+        proot = str(tmp_path / "plane")
+        plane = WritePlane(proot, scenario["config"], PlaneConfig())
+        pumps = PlanePumps(plane)
+        pumps._part_done(999, ok=False)  # unknown seq: no KeyError
+        assert pumps.stats.failed == 0
 
 
 class TestServeIntegration:
