@@ -39,20 +39,28 @@ from heatmap_tpu.analytics.integral import IntegralPair
 from heatmap_tpu.tilemath.morton import morton_decode_np
 
 __all__ = [
-    "VALID_OPS", "level_cells", "parse_bbox", "quantile", "quantile_rows", "range_sum",
+    "TEMPORAL_OPS", "VALID_OPS", "level_cells", "parse_bbox", "quantile",
+    "quantile_rows", "range_sum",
     "range_sum_rows", "top_k_hotspots", "top_k_rows", "validate_op",
 ]
 
-#: The /query operations (serve/http.py 400s and CLI flags validate
-#: against this single source of truth).
+#: The spatial /query operations (serve/http.py 400s and CLI flags
+#: validate against this single source of truth).
 VALID_OPS = ("sum", "topk", "quantile")
+
+#: Time-axis operations (heatmap_tpu.temporal.timequery): listed
+#: separately because they take a ``window`` instead of a ``bbox`` and
+#: tools that sweep the spatial ops (tools/bench_query.py) must not
+#: pick them up implicitly.
+TEMPORAL_OPS = ("topk_growth",)
 
 
 def validate_op(op: str) -> str:
     """``op`` unchanged, or a one-line ValueError naming the valid set."""
-    if op not in VALID_OPS:
+    if op not in VALID_OPS and op not in TEMPORAL_OPS:
         raise ValueError(
-            f"unknown query op {op!r}: valid ops are {', '.join(VALID_OPS)}")
+            f"unknown query op {op!r}: valid ops are "
+            f"{', '.join(VALID_OPS + TEMPORAL_OPS)}")
     return op
 
 

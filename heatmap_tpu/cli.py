@@ -1372,6 +1372,53 @@ def cmd_merge(args) -> int:
     return 0
 
 
+def _add_temporal_flags(p):
+    g = p.add_argument_group(
+        "temporal buckets",
+        "pin the epoch-bucketed partial-pyramid config "
+        "(docs/temporal.md). Byte-affecting for temporal folds, so it "
+        "follows the config-fingerprint discipline: the first writer "
+        "sets it, later runs must match. Compactions then fold history "
+        "into buckets/ and serve answers ?as_of=/?window=/?decay= "
+        "tiles and op=topk_growth queries.")
+    g.add_argument("--bucket-width", type=float, default=None,
+                   metavar="UNITS",
+                   help="tier-0 bucket width in watermark units "
+                   "(setting any --bucket-* flag enables the temporal "
+                   "plane; default width 3600)")
+    g.add_argument("--bucket-fanout", type=int, default=None,
+                   help="geometric ladder fanout: tier-j buckets are "
+                   "width * fanout**j wide (default 4)")
+    g.add_argument("--bucket-keep", type=int, default=None,
+                   help="newest intervals kept per tier before history "
+                   "coarsens into the next tier (default 8)")
+    g.add_argument("--bucket-tiers", type=int, default=None,
+                   help="ladder height; the top tier is unbounded "
+                   "(default 4)")
+    g.add_argument("--bucket-unit-s", type=float, default=None,
+                   metavar="S",
+                   help="seconds per watermark unit — scales the named "
+                   "?window= values (1h/1d/1w); ms timestamps use "
+                   "0.001 (default 1)")
+
+
+def _ensure_temporal(args, root: str):
+    """Pin the temporal config when any --bucket-* flag was passed;
+    returns the active config (None = temporal plane not enabled)."""
+    overrides = {"width": args.bucket_width, "fanout": args.bucket_fanout,
+                 "keep": args.bucket_keep, "tiers": args.bucket_tiers,
+                 "unit_s": args.bucket_unit_s}
+    if all(v is None for v in overrides.values()):
+        return None
+    from heatmap_tpu.temporal import ensure_config
+
+    os.makedirs(root, exist_ok=True)
+    try:
+        return ensure_config(root, **overrides)
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
+
+
 def _add_update_flags(p):
     p.add_argument("--journal", required=True, metavar="ROOT",
                    help="delta store root (journal/ + base + delta "
@@ -1423,6 +1470,7 @@ def _add_update_flags(p):
                    default=None, metavar="PATH",
                    help="fold tracer + metrics + events into a run "
                    "report at PATH and print the span table to stderr")
+    _add_temporal_flags(p)
     _add_trace_flags(p)
 
 
@@ -1513,6 +1561,9 @@ def cmd_update(args) -> int:
         if base_dir is not None:
             delta_mod.init_store(args.journal, base_dir)
             summary["base_adopted"] = args.base
+        tcfg = _ensure_temporal(args, args.journal)
+        if tcfg is not None:
+            summary["temporal"] = tcfg
         applied = []
         if args.input or args.retractions:
             from heatmap_tpu.io import open_source
@@ -1595,6 +1646,65 @@ def cmd_update(args) -> int:
     return 0
 
 
+def cmd_retract(args) -> int:
+    """Predicate retraction (delta/retract.py): scan the journal's
+    point payloads for rows matching every --where clause, net them as
+    a signed multiset, and apply exact sign=-1 counter-batches — one
+    per (temporal bucket, column signature) group, so the all-time
+    store AND every temporal fold converge to a clean recompute over
+    the surviving points."""
+    _init_backend(args)
+    from heatmap_tpu.delta import retract as retract_mod
+
+    pairs = list(args.where or [])
+    if args.layer:
+        pairs.append(f"user={args.layer}")
+    try:
+        where = retract_mod.parse_where(pairs)
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
+    ev_log = None
+    if args.events:
+        from heatmap_tpu import obs
+
+        ev_log = obs.EventLog(args.events)
+        obs.set_event_log(ev_log)
+    try:
+        summary = retract_mod.retract_predicate(
+            args.journal, where, batch_size=args.batch_size)
+    except ValueError as e:
+        raise SystemExit(str(e)) from e
+    finally:
+        if ev_log is not None:
+            from heatmap_tpu import obs
+
+            obs.set_event_log(None)
+            ev_log.close()
+    out = {k: v for k, v in summary.items() if k != "results"}
+    out["journal"] = args.journal
+    out["where"] = {k: str(v) for k, v in sorted(where.items())}
+    out["seconds"] = round(out["seconds"], 3)
+    print(json.dumps(out))
+    return 0
+
+
+def _add_retract_flags(p):
+    p.add_argument("--journal", required=True, metavar="ROOT",
+                   help="delta store root whose journal is scanned")
+    p.add_argument("--where", action="append", default=[],
+                   metavar="COL=VALUE",
+                   help="equality clause on a point column (repeatable; "
+                   "clauses AND). Columns: user/user_id, source, "
+                   "timestamp, latitude, longitude, value")
+    p.add_argument("--layer", default=None, metavar="USER",
+                   help="shorthand for --where user=USER (the serve "
+                   "tier's layer name)")
+    p.add_argument("--batch-size", type=int, default=1 << 20)
+    p.add_argument("--events", default=None, metavar="PATH",
+                   help="append structured events to PATH "
+                   "(retraction_applied, delta_applied)")
+
+
 def _add_ingest_flags(p):
     p.add_argument("--journal", required=True, metavar="ROOT",
                    help="delta store root the loop journals into "
@@ -1667,6 +1777,7 @@ def _add_ingest_flags(p):
                    default=None, metavar="PATH",
                    help="fold tracer + metrics + events into a run "
                    "report at PATH and print the span table to stderr")
+    _add_temporal_flags(p)
     _add_trace_flags(p)
 
 
@@ -1750,6 +1861,9 @@ def cmd_ingest(args) -> int:
     summary = {"journal": args.journal}
     try:
         delta_mod.init_store(args.journal)
+        tcfg = _ensure_temporal(args, args.journal)
+        if tcfg is not None:
+            summary["temporal"] = tcfg
         store = cache = None
         if args.serve_port is not None:
             from heatmap_tpu.serve import (ServeApp, TileCache, TileStore,
@@ -2390,6 +2504,15 @@ def build_parser() -> argparse.ArgumentParser:
     _add_backend_flags(p_ingest)
     _add_ingest_flags(p_ingest)
     p_ingest.set_defaults(fn=cmd_ingest)
+
+    p_retract = sub.add_parser(
+        "retract",
+        help="predicate retraction against a delta store: journal scan "
+        "-> exact signed counter-batches, byte-identical to a "
+        "recompute over the surviving points (docs/temporal.md)")
+    _add_backend_flags(p_retract)
+    _add_retract_flags(p_retract)
+    p_retract.set_defaults(fn=cmd_retract)
 
     p_wp = sub.add_parser(
         "writeplane",

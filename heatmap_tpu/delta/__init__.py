@@ -47,6 +47,12 @@ from heatmap_tpu.delta.metrics import (COMPACTION_SECONDS,
 from heatmap_tpu.delta.recover import sweep
 from heatmap_tpu.io.sinks import LevelArraysSink
 
+# retract imports back into this package lazily, so this import must
+# stay below the names it uses (apply_batch is defined further down —
+# the lazy function-body import in retract.py resolves it at call
+# time, not here).
+from heatmap_tpu.delta.retract import parse_where, retract_predicate
+
 
 @dataclasses.dataclass
 class DeltaResult:
@@ -72,8 +78,16 @@ def _watermark(cols) -> float | None:
         return None
 
 
+#: apply_batch sentinel: derive the watermark from the batch's own
+#: timestamps (the default). Retraction passes an explicit override so
+#: a counter-batch lands in the SAME temporal bucket as the entry it
+#: cancels (heatmap_tpu.temporal) instead of at its submission time.
+_AUTO_WATERMARK = object()
+
+
 def apply_batch(root: str, source, config, *, sign: int = 1,
-                batch_size: int = 1 << 20) -> DeltaResult:
+                batch_size: int = 1 << 20,
+                watermark=_AUTO_WATERMARK) -> DeltaResult:
     """Journal + compute one incremental batch against a delta store.
 
     Idempotent: a batch whose content hash is already journaled is a
@@ -90,7 +104,9 @@ def apply_batch(root: str, source, config, *, sign: int = 1,
         t0 = time.monotonic()
         init_store(root)
         cols = read_columns(source, batch_size=batch_size)
-        content_hash = batch_content_hash(cols, sign=sign)
+        salt = (None if watermark is _AUTO_WATERMARK
+                else f"watermark={watermark}")
+        content_hash = batch_content_hash(cols, sign=sign, salt=salt)
         journal = DeltaJournal(compact_mod.journal_dir(root))
         existing = journal.find(content_hash)
         if existing is not None:
@@ -112,9 +128,11 @@ def apply_batch(root: str, source, config, *, sign: int = 1,
         stats = compute_delta(ColumnsSource(cols), out_dir, config,
                               sign=sign, batch_size=batch_size)
         rows = int(stats.get("rows", 0)) if isinstance(stats, dict) else 0
-        watermark = _watermark(cols)
+        if watermark is _AUTO_WATERMARK:
+            watermark = _watermark(cols)
         journal.append(content_hash=content_hash, points=n_points,
-                       sign=sign, artifact=artifact, watermark=watermark)
+                       sign=sign, artifact=artifact, watermark=watermark,
+                       cols=cols)
         keys = affected_tile_keys(LevelArraysSink.load(out_dir))
         seconds = time.monotonic() - t0
         DELTA_POINTS.inc(n_points, kind="insert" if sign > 0 else "retract")
@@ -136,14 +154,23 @@ def refresh_serving(result: DeltaResult, store, cache=None) -> int:
     ``store.reload()``: the overlay index is rebuilt WITHOUT a
     generation bump (an additive delta cannot change untouched tiles'
     bytes, so their cache entries stay valid) and only the affected
-    tile keys are invalidated. Returns the number of cache entries
-    dropped."""
+    tile keys are invalidated. Sliding-window fold variants of the
+    same keys (heatmap_tpu.temporal; the cache tracks which window
+    params it has served) ride the same targeted pass — a new batch
+    changes a window tile exactly where it changes the all-time tile.
+    Returns the number of cache entries dropped."""
     if result.duplicate:
         return 0
     store.refresh_layers()
     if cache is None:
         return 0
-    return cache.invalidate_keys(result.affected_keys)
+    keys = set(result.affected_keys)
+    params = getattr(cache, "window_params", lambda: ())()
+    if params:
+        from heatmap_tpu.temporal.fold import window_variants
+
+        keys.update(window_variants(result.affected_keys, params))
+    return cache.invalidate_keys(keys)
 
 
 __all__ = [
@@ -151,6 +178,6 @@ __all__ = [
     "DELTA_POINTS", "DeltaJournal", "DeltaResult", "affected_tile_keys",
     "apply_batch", "batch_content_hash", "check_config", "compact",
     "compute_delta", "entry_digest", "init_store", "live_entries",
-    "load_overlay_levels", "overlay_dirs", "read_columns", "read_current",
-    "refresh_serving", "sweep",
+    "load_overlay_levels", "overlay_dirs", "parse_where", "read_columns",
+    "read_current", "refresh_serving", "retract_predicate", "sweep",
 ]

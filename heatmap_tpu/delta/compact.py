@@ -200,6 +200,28 @@ def drop_zero_rows(levels: list) -> list:
         for k in LevelArraysSink.COLUMNS:
             if k in pruned:
                 pruned[k] = np.asarray(pruned[k])[keep]
+        # Re-compact the name vocabularies: a fully-retracted user (or
+        # timespan) must vanish from the name table too, or the bytes
+        # diverge from the clean recompute (which derives names from
+        # the rows it actually has). Dropping entries from a sorted
+        # vocab keeps it sorted, so only the indices need remapping.
+        for prefix in ("user", "timespan"):
+            names = pruned.get(f"{prefix}_names")
+            idx = pruned.get(f"{prefix}_idx")
+            if names is None or idx is None:
+                continue
+            names = np.asarray(names)
+            idx = np.asarray(idx)
+            used = np.unique(idx)
+            if len(used) == len(names):
+                continue
+            remap = np.full(len(names), -1, np.int32)
+            remap[used] = np.arange(len(used), dtype=np.int32)
+            # Rebuild through a list so the dtype re-tightens to the
+            # widest SURVIVING name — a <U5 array keeping only "bob"
+            # would otherwise differ on disk from the recompute's <U3.
+            pruned[f"{prefix}_names"] = np.asarray(names[used].tolist())
+            pruned[f"{prefix}_idx"] = remap[idx]
         out.append(pruned)
     return out
 
@@ -211,6 +233,103 @@ def load_overlay_levels(root: str) -> list:
     if not dirs:
         return []
     return drop_zero_rows(merge_level_dirs(dirs))
+
+
+def _write_buckets(root: str, cur: dict, live: list, tmp_path: str,
+                   tcfg: dict) -> dict:
+    """Stage the temporal bucket partition inside the compaction tmp
+    dir (heatmap_tpu.temporal): carry the previous base's buckets
+    forward, fold each live delta into the tier-0 bucket containing
+    its watermark, coarsen old buckets up the geometric ladder, and
+    write TEMPORAL.json — all under ``tmp_path`` so buckets and
+    manifest publish atomically with the base itself.
+
+    The top-level merged artifact is untouched: the all-time read path
+    never sees buckets, which is what keeps it byte-identical to an
+    un-bucketed store (the tier-1 identity gate); buckets are an
+    additional, derived partition of the same journal entries.
+    """
+    from heatmap_tpu.temporal import buckets as tb
+
+    base_name = cur.get("base")
+    prev = (tb.read_manifest(os.path.join(root, base_name))
+            if base_name else None)
+    timed: list[dict] = []
+    none_dirs: list[str] = []
+    none_epochs: list[int] = []
+    none_points = 0
+    if prev is not None:
+        bdir = os.path.join(root, base_name, tb.BUCKETS_DIRNAME)
+        for b in prev.get("buckets") or []:
+            d = os.path.join(bdir, b["name"])
+            if os.path.isdir(d):
+                timed.append({"t0": float(b["t0"]), "t1": float(b["t1"]),
+                              "tier": int(b.get("tier", 0)), "dirs": [d],
+                              "epochs": list(b.get("epochs") or []),
+                              "points": int(b.get("points", 0))})
+        pn = prev.get("none")
+        if pn is not None:
+            d = os.path.join(bdir, tb.NONE_NAME)
+            if os.path.isdir(d):
+                none_dirs.append(d)
+                none_epochs += list(pn.get("epochs") or [])
+                none_points += int(pn.get("points", 0))
+    elif base_name and os.path.isdir(os.path.join(root, base_name)):
+        # Pre-temporal base: its history has no per-batch resolution
+        # left, so it folds into the timeless bucket — the all-time
+        # layer is preserved exactly; temporal cuts treat the legacy
+        # rows as always-present (docs/temporal.md).
+        none_dirs.append(os.path.join(root, base_name))
+    for e in live:
+        d = os.path.join(root, e["artifact"])
+        if not os.path.isdir(d):
+            continue
+        wm = e.get("watermark")
+        if wm is None:
+            none_dirs.append(d)
+            none_epochs.append(int(e["epoch"]))
+            none_points += int(e.get("points", 0))
+            continue
+        t0, t1 = tb.bucket_of(float(wm), tcfg)
+        timed.append({"t0": t0, "t1": t1, "tier": 0, "dirs": [d],
+                      "epochs": [int(e["epoch"])],
+                      "points": int(e.get("points", 0))})
+    entries = []
+    if timed:
+        max_edge = max(u["t1"] for u in timed)
+        plan = tb.plan_partition(timed, tcfg, max_edge)
+        for (t0, t1, tier), members in sorted(plan.items()):
+            dirs = [d for u in members for d in u["dirs"]]
+            levels = drop_zero_rows(merge_level_dirs(dirs))
+            if not any(len(lvl["row"]) for lvl in levels):
+                continue  # fully cancelled by retraction: no bucket
+            name = tb.bucket_name(t0, t1)
+            out = os.path.join(tmp_path, tb.BUCKETS_DIRNAME, name)
+            LevelArraysSink(out).write_levels(levels)
+            entries.append({
+                "name": name, "t0": t0, "t1": t1, "tier": int(tier),
+                "epochs": sorted({ep for u in members
+                                  for ep in u["epochs"]}),
+                "points": sum(u["points"] for u in members),
+                "digest": tb.bucket_digest(out),
+            })
+    else:
+        max_edge = None
+    none_entry = None
+    if none_dirs:
+        levels = drop_zero_rows(merge_level_dirs(none_dirs))
+        if any(len(lvl["row"]) for lvl in levels):
+            out = os.path.join(tmp_path, tb.BUCKETS_DIRNAME, tb.NONE_NAME)
+            LevelArraysSink(out).write_levels(levels)
+            none_entry = {"name": tb.NONE_NAME,
+                          "epochs": sorted(set(none_epochs)),
+                          "points": none_points,
+                          "digest": tb.bucket_digest(out)}
+    manifest = {"schema": tb.TEMPORAL_SCHEMA, "config": tcfg,
+                "max_edge": max_edge, "buckets": entries,
+                "none": none_entry}
+    tb.write_manifest(tmp_path, manifest)
+    return manifest
 
 
 def compact(root: str, *, retention: int = 2, inflight: int = 0) -> dict:
@@ -274,6 +393,9 @@ def compact(root: str, *, retention: int = 2, inflight: int = 0) -> dict:
             os.path.join(root, base_name))
         rows = LevelArraysSink(tmp_path, synopses=True, integrals=True,
                                tilefs=keep_tilefs).write_levels(merged)
+        tcfg = cur.get("temporal")
+        manifest = (_write_buckets(root, cur, live, tmp_path, tcfg)
+                    if tcfg is not None else None)
         faults.retry_call(publish_dir, tmp_path, new_path,
                           site="compact.publish", key="base")
         cur = dict(cur)
@@ -297,14 +419,17 @@ def compact(root: str, *, retention: int = 2, inflight: int = 0) -> dict:
                                  min_age_s=QUARANTINE_MIN_AGE_S)
         seconds = time.monotonic() - t0
         COMPACTION_SECONDS.observe(seconds)
+        buckets = (len(manifest["buckets"]) +
+                   (1 if manifest["none"] else 0)) if manifest else None
+        extra = {"buckets": buckets} if buckets is not None else {}
         obs.emit("compaction_end", root=root, seconds=round(seconds, 6),
                  status="ok", base=new_name, levels=len(merged),
-                 rows=int(rows), pruned_entries=len(pruned))
+                 rows=int(rows), pruned_entries=len(pruned), **extra)
         return {"status": "ok", "base": new_name,
                 "applied_through": int(new_epoch),
                 "deltas": len(live), "levels": len(merged),
                 "rows": int(rows), "pruned_entries": len(pruned),
-                "seconds": seconds}
+                "buckets": buckets, "seconds": seconds}
     except BaseException as exc:
         obs.emit("compaction_end", root=root,
                  seconds=round(time.monotonic() - t0, 6),

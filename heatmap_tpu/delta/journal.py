@@ -39,15 +39,22 @@ HASH_FLOAT_COLUMNS = ("latitude", "longitude", "value")
 HASH_OBJECT_COLUMNS = ("user_id", "source", "timestamp")
 
 
-def batch_content_hash(cols: dict, sign: int = 1) -> str:
+def batch_content_hash(cols: dict, sign: int = 1,
+                       salt: str | None = None) -> str:
     """Deterministic identity of a point batch (+ its sign).
 
     The sign participates so that retracting a batch is a different
     journal entry from inserting it — submitting both is the intended
-    way to express a correction, not a duplicate.
+    way to express a correction, not a duplicate. ``salt`` extends the
+    identity for callers whose batches differ by something outside the
+    point columns — predicate retraction salts with the overridden
+    watermark, so cancelling identical rows out of two different
+    temporal buckets is two entries, not one dedup'd no-op.
     """
     h = hashlib.sha256()
     h.update(f"sign={int(sign)}".encode())
+    if salt is not None:
+        h.update(f"salt={salt}".encode())
     for name in HASH_FLOAT_COLUMNS:
         if name in cols:
             arr = np.ascontiguousarray(np.asarray(cols[name], np.float64))
@@ -83,6 +90,47 @@ def entry_digest(root: str, *, content_hash: str, sign: int, points: int,
             with open(full, "rb") as f:
                 h.update(f.read())
     return "sha256:" + h.hexdigest()
+
+
+#: Journal-payload encoding of point columns (delta retraction's scan
+#: substrate). Floats stay raw f64 (exact); everything else is stored
+#: as ``str(v)`` — identical to how batch_content_hash consumes it, and
+#: exact under ``float()`` round-trip for numeric timestamps — with
+#: ``str(None)`` decoding back to None.
+_PAYLOAD_FLOAT = ("latitude", "longitude", "value")
+_PAYLOAD_STR = ("user_id", "source", "timestamp")
+_NONE_TOKEN = str(None)
+
+
+def encode_points(cols: dict) -> dict:
+    """Point columns -> npz-safe arrays (``pt_``-prefixed, no object
+    dtypes, no pickle)."""
+    arrays = {}
+    for name in _PAYLOAD_FLOAT:
+        if name in cols:
+            arrays["pt_" + name] = np.asarray(cols[name], np.float64)
+    for name in _PAYLOAD_STR:
+        if name in cols:
+            arrays["pt_" + name] = np.asarray(
+                [_NONE_TOKEN if v is None else str(v)
+                 for v in cols[name]])
+    return arrays
+
+
+def decode_points(arrays: dict) -> dict | None:
+    """Inverse of :func:`encode_points`; None for a legacy entry that
+    predates point payloads (retraction cannot scan it)."""
+    cols: dict = {}
+    for name in _PAYLOAD_FLOAT:
+        key = "pt_" + name
+        if key in arrays:
+            cols[name] = np.asarray(arrays[key], np.float64)
+    for name in _PAYLOAD_STR:
+        key = "pt_" + name
+        if key in arrays:
+            cols[name] = [None if v == _NONE_TOKEN else v
+                          for v in np.asarray(arrays[key], str).tolist()]
+    return cols or None
 
 
 class DeltaJournal:
@@ -128,10 +176,25 @@ class DeltaJournal:
                 return meta
         return None
 
+    def load_points(self, epoch: int) -> dict | None:
+        """The point columns journaled with ``epoch`` (retraction's
+        scan input), or None for a legacy entry without a payload."""
+        arrays, _meta = self._mgr.load(int(epoch))
+        return decode_points(arrays)
+
     def append(self, *, content_hash: str, points: int, sign: int,
-               artifact: str, watermark: float | None = None) -> dict:
+               artifact: str, watermark: float | None = None,
+               cols: dict | None = None) -> dict:
         """Record an accepted batch; returns the existing entry
-        unchanged if the hash is already journaled (idempotent)."""
+        unchanged if the hash is already journaled (idempotent).
+
+        ``cols`` (the batch's point columns) are stored in the entry's
+        npz arrays — the extension point the empty-arrays checkpoint
+        always reserved — so predicate retraction can reconstruct
+        exact counter-batches by scanning retained entries
+        (delta/retract.py). A torn payload fails the entry's npz load
+        and is quarantined by the recovery sweep like any torn entry.
+        """
         existing = self.find(content_hash)
         if existing is not None:
             return existing
@@ -151,8 +214,9 @@ class DeltaJournal:
         }
         # save_checkpoint is atomic, so a retried append (real transient
         # or injected journal.append fault) lands the entry exactly once.
-        faults.retry_call(save_checkpoint, self._mgr._path(epoch), {}, meta,
-                          site="journal.append")
+        arrays = encode_points(cols) if cols else {}
+        faults.retry_call(save_checkpoint, self._mgr._path(epoch), arrays,
+                          meta, site="journal.append")
         return meta
 
     def prune(self, *, applied_through: int, retention: int) -> list[dict]:

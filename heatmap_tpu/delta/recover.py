@@ -306,5 +306,64 @@ def sweep(root: str, *, verify: bool = True) -> dict:
                     _quarantine(root, full, "torn_tilefs", "tilefs",
                                 items, detail)
 
+    # 6. Temporal buckets inside CURRENT's base (heatmap_tpu.temporal):
+    #    torn buckets quarantine; folds over a quarantined bucket raise
+    #    TornBucketError and the serve tier answers stale-if-error,
+    #    while the all-time path — which never reads buckets — is
+    #    untouched.
+    if bdir and os.path.isdir(bdir):
+        _sweep_buckets(root, bdir, items)
+
     quarantine_bytes(root)  # refresh the growth gauge every sweep
     return {"quarantined": items}
+
+
+def _sweep_buckets(root: str, bdir: str, items: list):
+    """Verify the base's TEMPORAL.json manifest against its bucket
+    dirs: a bucket whose recomputed digest mismatches the manifest
+    (torn write, tampered levels) is quarantined, as is any bucket dir
+    the manifest does not list (a crashed pass's stray). Digest
+    results are memoised per (dir, recorded digest) — published
+    buckets are immutable by contract, same stance as journal entry
+    verification."""
+    from heatmap_tpu.temporal import buckets as tb
+
+    subdir = os.path.join(bdir, tb.BUCKETS_DIRNAME)
+    manifest = tb.read_manifest(bdir)
+    if manifest is None:
+        mpath = os.path.join(bdir, tb.MANIFEST_NAME)
+        if os.path.isdir(subdir):
+            if os.path.exists(mpath):
+                # Unreadable manifest over existing buckets: temporal
+                # serving for this base is gone either way; make the
+                # corruption visible instead of re-parsing every read.
+                _quarantine(root, mpath, "torn_manifest",
+                            "temporal_manifest", items)
+            for name in sorted(os.listdir(subdir)):
+                _quarantine(root, os.path.join(subdir, name),
+                            "orphan_bucket", "temporal_bucket", items)
+        return
+    listed = {}
+    for b in manifest.get("buckets") or []:
+        listed[b["name"]] = b.get("digest")
+    if manifest.get("none"):
+        listed[tb.NONE_NAME] = manifest["none"].get("digest")
+    present = sorted(os.listdir(subdir)) if os.path.isdir(subdir) else []
+    for name in present:
+        full = os.path.join(subdir, name)
+        recorded = listed.get(name)
+        if recorded is None:
+            _quarantine(root, full, "orphan_bucket", "temporal_bucket",
+                        items)
+            continue
+        cache_key = (os.path.abspath(full), recorded)
+        if cache_key in _VERIFIED:
+            continue
+        actual = tb.bucket_digest(full)
+        if actual != recorded:
+            _quarantine(root, full, "torn_bucket", "temporal_bucket",
+                        items,
+                        f"recorded {recorded[:23]}..., "
+                        f"actual {actual[:23]}...")
+        else:
+            _VERIFIED[cache_key] = True

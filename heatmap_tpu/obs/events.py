@@ -99,7 +99,26 @@ EVENT_SCHEMA = {
                          "optional": ("base",)},
     "compaction_end": {"required": ("root", "seconds", "status"),
                        "optional": ("base", "levels", "rows",
-                                    "pruned_entries", "error")},
+                                    "pruned_entries", "error", "buckets")},
+    # delta/retract.py: one predicate retraction completed — journal
+    # scanned, exact signed counter-batches applied per epoch bucket.
+    # rows counts retracted source points, batches the counter-batches
+    # (one per surviving (bucket, column-signature) group).
+    "retraction_applied": {"required": ("root", "rows", "batches"),
+                           "optional": ("scanned", "where", "epochs",
+                                        "seconds")},
+    # serve/http.py: a tile answered from a temporal fold (?as_of=,
+    # ?window=, ?decay= — mode names which). Raw request params ride
+    # along so traffic replay can rebuild the fold population.
+    "temporal_served": {"required": ("layer", "zoom", "mode"),
+                        "optional": ("as_of", "window", "decay",
+                                     "cache", "ms")},
+    # ingest/loop.py: the newest bucket edge advanced past a window
+    # boundary — exactly the retiring bucket's tile keys (x their
+    # served window variants) were invalidated; everything else stays.
+    "bucket_roll": {"required": ("root", "prev_ref", "ref"),
+                    "optional": ("retired", "keys_invalidated",
+                                 "windows")},
     # faults/: one record per injected fault. ``seq`` is the plane's own
     # monotonic injection counter (not the envelope seq), so a chaos run
     # can be replayed check-for-check from its event log.
@@ -170,7 +189,8 @@ EVENT_SCHEMA = {
     # propagated error bound in max_err).
     "query_served": {"required": ("op", "zoom", "path"),
                      "optional": ("layer", "bbox_area", "cells", "k",
-                                  "q", "max_err", "ms")},
+                                  "q", "max_err", "ms", "window",
+                                  "slots")},
     # obs/anomaly.py: a watched series' EWMA+MAD z-score crossed its
     # threshold (rising edge; one record per breach episode, cleared
     # with hysteresis — never per sampler tick). series is the

@@ -196,20 +196,47 @@ def _channel_path(cells, chans, good, capacity, n_blocks, chunk,
     )
     zeros = jnp.zeros((streams * n_blocks, n_channels, side, side),
                       jnp.float32)
-    blocks = pl.pallas_call(
-        functools.partial(_segment_kernel, chunk=chunk,
-                          block_cells=block_cells, side=side,
-                          n_blocks=n_blocks, n_channels=n_channels),
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct(
-            (streams * n_blocks, n_channels, side, side), jnp.float32
-        ),
-        input_output_aliases={6: 0},  # zeros operand -> output
-        interpret=interpret,
-    )(base, gi, first_visit, last_visit,
-      cells.reshape(nck, 1, chunk),
-      chans.reshape(n_channels, nck, chunk).transpose(1, 0, 2),
-      zeros)
+
+    def _kernel_call(base_, gi_, first_, last_, cells_, chans_, zeros_):
+        return pl.pallas_call(
+            functools.partial(_segment_kernel, chunk=chunk,
+                              block_cells=block_cells, side=side,
+                              n_blocks=n_blocks, n_channels=n_channels),
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(
+                (streams * n_blocks, n_channels, side, side), jnp.float32
+            ),
+            input_output_aliases={6: 0},  # zeros operand -> output
+            interpret=interpret,
+        )(base_, gi_, first_, last_, cells_, chans_, zeros_)
+
+    # vmap of a pallas_call whose scalar-prefetch operands are batched
+    # (the gspmd dispatch vmaps this whole stage over the shard axis)
+    # falls back to jax's explicit batch loop, whose weak-typed
+    # fori_loop counter lands as s64 under x64; the SPMD partitioner
+    # then compares that s64 update index against its own s32 shard
+    # offsets and the HLO verifier rejects the module ("Binary op
+    # compare with different element types: s64[] and s32[]"). The
+    # batch axis is the static shard count, so unroll it instead:
+    # constant-index slices of a shard-dim-sharded operand are exactly
+    # what the partitioner handles natively — no dynamic update index
+    # of either width, and no per-iteration collectives either.
+    kernel_call = jax.custom_batching.custom_vmap(_kernel_call)
+
+    @kernel_call.def_vmap
+    def _kernel_vmap_rule(axis_size, in_batched, *args):
+        outs = [
+            _kernel_call(*[a[i] if b else a
+                           for a, b in zip(args, in_batched)])
+            for i in range(axis_size)
+        ]
+        return jnp.stack(outs), True
+
+    blocks = kernel_call(
+        base, gi, first_visit, last_visit,
+        cells.reshape(nck, 1, chunk),
+        chans.reshape(n_channels, nck, chunk).transpose(1, 0, 2),
+        zeros)
     if streams > 1:
         blocks = blocks.reshape(
             streams, n_blocks, n_channels, side, side

@@ -61,6 +61,7 @@ from heatmap_tpu.parallel.mesh import (
     DATA_AXIS,
     TILE_AXIS,
     named_sharding,
+    shard_map,
 )
 from heatmap_tpu.parallel.sharded import (
     _local_detail_stage,
@@ -91,6 +92,36 @@ def _point_spec(mesh: Mesh):
     else the leading axis flattens over both)."""
     axes, ndev = _shard_axes(mesh)
     return (axes[0] if len(axes) == 1 else tuple(axes)), ndev
+
+
+def _mapped_stage(stage, mesh: Mesh, spec, backend: str):
+    """Map the per-shard detail stage over the leading shard axis.
+
+    The scatter stage is plain gather/segment arithmetic — ``vmap``
+    keeps it global-view and the SPMD partitioner places each row on
+    its owning device. The partitioned stage wraps a pallas_call, and
+    vmapping a pallas_call whose scalar-prefetch operands are batched
+    falls back to jax's sequential batch loop; the partitioner then
+    threads every grid step's dynamic slices through cross-device
+    collectives (and, under x64, trips an s64-vs-s32 HLO verifier
+    error against its own s32 shard offsets — the CHANGES.md line 19
+    failure). Run that stage under shard_map instead: the body is
+    device-local by construction, so the kernel never meets the
+    batching fallback or the partitioner. Same stage function, same
+    per-shard blocks, byte-identical outputs.
+    """
+    if backend != "partitioned":
+        return jax.vmap(stage)
+    from jax.sharding import PartitionSpec as P
+
+    row = P(spec, None)
+
+    def body(k, w, v):
+        u, s, n = stage(k[0], w[0], v[0])
+        return u[None], s[None], jnp.asarray(n)[None]
+
+    return shard_map(body, mesh, in_specs=(row, row, row),
+                     out_specs=(row, row, P(spec)), check_vma=False)
 
 
 def _constrain(x, mesh: Mesh, *spec):
@@ -191,7 +222,7 @@ def pyramid_gspmd_uniform(
     ck = _constrain(codes.reshape(ndev, shard), mesh, spec, None)
     cw = _constrain(w.reshape(ndev, shard), mesh, spec, None)
     cv = _constrain(v.reshape(ndev, shard), mesh, spec, None)
-    u, s, ln = jax.vmap(stage)(ck, cw, cv)
+    u, s, ln = _mapped_stage(stage, mesh, spec, backend)(ck, cw, cv)
     u = _constrain(u, mesh, spec, None)
     s = _constrain(s, mesh, spec, None)
     gu, gs = u.reshape(-1), s.reshape(-1)
@@ -293,7 +324,7 @@ def pyramid_gspmd_range(
     bw = _constrain(jnp.broadcast_to(w, (ndev, n)), mesh, spec, None)
     bv = _constrain(owned, mesh, spec, None)
 
-    u, s, ln = jax.vmap(stage)(bk, bw, bv)
+    u, s, ln = _mapped_stage(stage, mesh, spec, backend)(bk, bw, bv)
     over = ln > lcaps[0]
     u = _constrain(u, mesh, spec, None)
     s = _constrain(s, mesh, spec, None)

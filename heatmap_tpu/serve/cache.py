@@ -109,6 +109,23 @@ class TileCache:
         self._flights: dict = {}
         self._bytes = 0
         self._ttl_scale = 1.0
+        # Sliding-window params this cache has served (heatmap_tpu.
+        # temporal): targeted invalidation needs to enumerate the
+        # window-variant keys of an affected tile, and only the cache
+        # knows which ``?window=`` values are actually in play.
+        self._window_params: set = set()
+
+    # -- temporal window registry ------------------------------------------
+
+    def note_window_param(self, param: str):
+        """Record a served ``?window=`` param so delta refreshes and
+        bucket rolls can invalidate its key variants."""
+        with self._lock:
+            self._window_params.add(str(param))
+
+    def window_params(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._window_params))
 
     # -- introspection -----------------------------------------------------
 

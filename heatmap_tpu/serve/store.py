@@ -319,6 +319,11 @@ class TileStore:
         # renders survive compaction but can never outlive an apply.
         self.delta_epoch = 0
         self._layers: dict[str, Layer] = {}
+        # Temporal fold views (heatmap_tpu.temporal), keyed by fold
+        # token: tiny LRU — each view is a full layer index over the
+        # cut, and distinct live cuts are few (the active windows plus
+        # whatever as_of epochs clients are replaying).
+        self._temporal_views: dict = {}
         self.reload(_initial=True)
 
     # -- queries -----------------------------------------------------------
@@ -377,6 +382,65 @@ class TileStore:
             # published since the last swap (the early-serve contract).
             self.synopsis_epoch += 1
             return self.generation
+
+    #: Max distinct fold views kept per store (LRU).
+    TEMPORAL_VIEW_CAP = 8
+
+    def temporal_root(self) -> str | None:
+        """The delta-store root behind this store, if its spec has one
+        (delta: always; tilefs: when the path is a delta-shaped root).
+        Temporal folds need CURRENT + journal + buckets — a plain
+        artifact has no history to cut."""
+        if self.kind == "delta":
+            return self.path
+        if self.kind == "tilefs" and os.path.exists(
+                os.path.join(self.path, "CURRENT")):
+            return self.path
+        return None
+
+    def temporal_view(self, *, as_of: float | None = None,
+                      window: float | None = None,
+                      decay: float | None = None):
+        """Layers for a temporal cut: fold the selected buckets + live
+        deltas (heatmap_tpu.temporal.fold) and index them exactly like
+        the all-time build — same Morton levels, same naming — so the
+        render path is unchanged downstream of layer lookup.
+
+        Returns ``(layers, token)``; the token names the fold inputs
+        and is the cache-key component for as_of/decay tiles. Views are
+        memoised per (token, generation): history below a cut is
+        immutable under ingest, so a view keeps serving until the cut
+        itself changes (retraction/compaction below it, or a reload).
+        Raises ``ValueError`` for a store with no temporal config and
+        ``TornBucketError`` when a selected bucket is quarantined —
+        the serve tier's stale-if-error path takes it from there."""
+        root = self.temporal_root()
+        if root is None:
+            raise ValueError(
+                f"store {self.spec} has no delta root — temporal "
+                "queries need a delta-shaped store")
+        from heatmap_tpu.temporal import fold as tfold
+        from heatmap_tpu.temporal.metrics import TEMPORAL_FOLD_SECONDS
+
+        sel = tfold.select_fold(root, as_of=as_of, window=window,
+                                decay=decay)
+        key = (sel.token, self.generation)
+        with self._lock:
+            view = self._temporal_views.get(key)
+            if view is not None:
+                return view
+        t0 = time.monotonic()
+        levels = tfold.fold_levels(root, sel, decay_half_life=decay)
+        by_pair = self._build_from_levels(_finalized_to_loaded(levels))
+        named = self._name_layers(by_pair, strict=False)
+        TEMPORAL_FOLD_SECONDS.observe(time.monotonic() - t0)
+        view = (named, sel.token)
+        with self._lock:
+            self._temporal_views[key] = view
+            while len(self._temporal_views) > self.TEMPORAL_VIEW_CAP:
+                self._temporal_views.pop(
+                    next(iter(self._temporal_views)))
+        return view
 
     def _build(self) -> dict[str, Layer]:
         syn_dir: str | None = None
@@ -451,6 +515,17 @@ class TileStore:
         if syn_dir is not None:
             self._attach_synopses(by_pair, syn_dir, delta_dirs)
             self._attach_integrals(by_pair, syn_dir, delta_dirs)
+        named = self._name_layers(by_pair, strict=True)
+        self.delta_epoch = delta_epoch
+        return named
+
+    def _name_layers(self, by_pair: dict, *, strict: bool) -> dict:
+        """Apply the exposed-layer naming to a (user, timespan) -> Layer
+        map: the ``--layers`` spec when given, else every pair under its
+        own name plus the ``default`` alias. ``strict`` raises on a
+        spec'd pair the artifact lacks (a typo'd --layers must not 404
+        forever); temporal folds pass strict=False — a window with no
+        data for some pair is an honest 404, not a config error."""
         named: dict[str, Layer] = {}
         if self._layer_spec is None:
             for (user, ts), layer in by_pair.items():
@@ -462,13 +537,15 @@ class TileStore:
                 user, _, ts = sel.partition("|")
                 layer = by_pair.get((user, ts or "alltime"))
                 if layer is None:
-                    raise ValueError(
-                        f"layer {name!r}: no ({user!r}, {ts or 'alltime'!r}) "
-                        f"slice in {self.spec}; available: "
-                        f"{sorted('|'.join(p) for p in by_pair)}"
-                    )
+                    if strict:
+                        raise ValueError(
+                            f"layer {name!r}: no ({user!r}, "
+                            f"{ts or 'alltime'!r}) slice in {self.spec}; "
+                            "available: "
+                            f"{sorted('|'.join(p) for p in by_pair)}"
+                        )
+                    continue
                 named[name] = layer
-        self.delta_epoch = delta_epoch
         return named
 
     def _build_from_tilefs(self, base_dir: str | None,

@@ -35,7 +35,7 @@ import numpy as np
 
 __all__ = [
     "haar2d_np", "inv_haar2d_np", "haar2d_jax", "grid_from_rows_jax",
-    "grid_from_rows_np",
+    "grid_from_rows_np", "haar1d_np", "inv_haar1d_np",
 ]
 
 
@@ -80,6 +80,51 @@ def inv_haar2d_np(coeffs: np.ndarray) -> np.ndarray:
         out[0:2 * h:2, 1:2 * h:2] = (s - dh + dv - dd) / 4.0
         out[1:2 * h:2, 0:2 * h:2] = (s + dh - dv - dd) / 4.0
         out[1:2 * h:2, 1:2 * h:2] = (s - dh - dv + dd) / 4.0
+        h *= 2
+    return out
+
+
+def _check_series(series) -> int:
+    n = int(series.shape[-1])
+    if n & (n - 1) or n == 0:
+        raise ValueError(f"haar1d wants a power-of-two length, got {n}")
+    return n
+
+
+def haar1d_np(series: np.ndarray) -> np.ndarray:
+    """Full 1D Haar transform along the LAST axis (f64).
+
+    Same unnormalized square-arrangement family as :func:`haar2d_np`,
+    applied to one axis: per pair ``(a, b)`` emit ``a + b`` (front
+    half) and ``a - b`` (back half), recursing on the front half. The
+    temporal plane runs this over the per-bucket cell series (time as
+    the axis), vectorized across cells via leading batch axes — the
+    epoch-dimension reuse of the spatial synopsis substrate. Exact in
+    f64 for integer series below 2^53, like the 2D twin.
+    """
+    n = _check_series(np.asarray(series))
+    out = np.asarray(series, np.float64).copy()
+    h = n // 2
+    while h >= 1:
+        a = out[..., 0:2 * h:2].copy()
+        b = out[..., 1:2 * h:2].copy()
+        out[..., :h] = a + b
+        out[..., h:2 * h] = a - b
+        h //= 2
+    return out
+
+
+def inv_haar1d_np(coeffs: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`haar1d_np` (divide-by-2 per pass — a
+    power-of-two scale, so integer series round-trip bit-exact)."""
+    n = _check_series(np.asarray(coeffs))
+    out = np.asarray(coeffs, np.float64).copy()
+    h = 1
+    while h < n:
+        s = out[..., :h].copy()
+        d = out[..., h:2 * h].copy()
+        out[..., 0:2 * h:2] = (s + d) / 2.0
+        out[..., 1:2 * h:2] = (s - d) / 2.0
         h *= 2
     return out
 

@@ -60,15 +60,16 @@ def publish_dir(tmp_path: str, final_path: str):
         raise FileExistsError(
             f"publish target {final_path!r} already exists; run the "
             "recovery sweep (delta/recover.py) to quarantine it first")
-    for name in sorted(os.listdir(tmp_path)):
-        full = os.path.join(tmp_path, name)
-        if not os.path.isfile(full):
-            continue
-        fd = os.open(full, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+    for dirpath, dirnames, filenames in os.walk(tmp_path):
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            fd = os.open(full, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        for name in sorted(dirnames):
+            fsync_dir(os.path.join(dirpath, name))
     fsync_dir(tmp_path)
     os.rename(tmp_path, final_path)
     fsync_dir(os.path.dirname(os.path.abspath(final_path)))

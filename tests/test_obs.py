@@ -60,7 +60,15 @@ _PAYLOADS = {
                          "base": "base-000001"},
     "compaction_end": {"root": "store/", "seconds": 0.4, "status": "ok",
                        "base": "base-000004", "levels": 5, "rows": 2048,
-                       "pruned_entries": 2},
+                       "pruned_entries": 2, "buckets": 4},
+    "retraction_applied": {"root": "store/", "rows": 40, "batches": 2,
+                           "scanned": 80, "where": {"user_id": "alice"},
+                           "epochs": [3, 4], "seconds": 1.2},
+    "temporal_served": {"layer": "default", "zoom": 2, "mode": "as_of",
+                        "as_of": "1250", "cache": "hit", "ms": 0.8},
+    "bucket_roll": {"root": "store/", "prev_ref": 1600.0, "ref": 1700.0,
+                    "retired": 1, "keys_invalidated": 12,
+                    "windows": ["150"]},
     "fault_injected": {"site": "source.read", "fault_seq": 0, "key": "jsonl",
                        "rule": "source.read=3x5"},
     "degraded_enter": {"cause": "render", "detail": "serving stale tiles"},

@@ -1,0 +1,600 @@
+"""Temporal plane tests (heatmap_tpu/temporal/ + delta/retract.py).
+
+The anchors, all byte-level:
+
+1. **Bucketing is invisible to all-time serving** — a bucketed
+   compaction's top-level base artifact is byte-identical to the
+   un-bucketed twin's, and a fold over ALL buckets equals the
+   un-bucketed overlay.
+2. **Every cut equals a clean recompute** — ``as_of`` folds equal a
+   recompute over exactly the batches inside the cut; window folds
+   equal a recompute over the trailing buckets; decay folds equal the
+   per-bucket-weighted recompute through the same deterministic merge.
+3. **Immutable history, targeted invalidation** — an as_of token
+   survives unrelated ingest; a bucket roll invalidates exactly the
+   retiring bucket's window-variant keys.
+4. **Failure containment** — a torn bucket quarantines under the
+   recovery sweep and serves last-good bytes (stale-if-error), while
+   the all-time path never notices.
+5. **Bounded-error time queries** — topk_growth's stamped bound is
+   sound against a brute-force series oracle, and a full coefficient
+   budget is exact.
+6. **Predicate retraction** — ``retract --where user=U`` leaves the
+   store byte-identical to a recompute over the surviving points,
+   before and after compaction, idempotently.
+
+Tier-1: CPU backend, real cascade runs (small shapes), no network.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from heatmap_tpu import delta
+from heatmap_tpu.delta.compact import (
+    drop_zero_rows,
+    load_overlay_levels,
+    read_current,
+)
+from heatmap_tpu.delta.retract import parse_where, retract_predicate
+from heatmap_tpu.io.merge import _loaded_to_finalized, merge_level_parts
+from heatmap_tpu.io.sinks import LevelArraysSink
+from heatmap_tpu.pipeline import BatchJobConfig, run_job
+from heatmap_tpu.serve import ServeApp, TileCache, TileStore
+from heatmap_tpu.serve.render import tile_json_bytes
+from heatmap_tpu.temporal import buckets as tb
+from heatmap_tpu.temporal import fold as tfold
+from heatmap_tpu.temporal import timequery
+from heatmap_tpu.temporal.fold import TornBucketError
+
+CONFIG = BatchJobConfig(detail_zoom=8, min_detail_zoom=5)
+TCFG = {"width": 100.0, "fanout": 2, "keep": 2, "tiers": 3}
+
+
+def _batch(seed: int, t0: float | None, n: int = 40) -> dict:
+    rng = np.random.default_rng(seed)
+    cols = {
+        "latitude": rng.uniform(30.0, 50.0, n),
+        "longitude": rng.uniform(-120.0, -70.0, n),
+        "user_id": ["alice" if i % 2 else "bob" for i in range(n)],
+    }
+    if t0 is not None:
+        cols["timestamp"] = [str(float(t0 + i)) for i in range(n)]
+    return cols
+
+
+def _union(*batches: dict) -> dict:
+    keys = set()
+    for b in batches:
+        keys |= set(b)
+    out = {}
+    for k in keys:
+        vals = []
+        for b in batches:
+            v = b.get(k)
+            if v is None:
+                vals.extend([None] * len(b["latitude"]))
+            else:
+                vals.extend(list(np.asarray(v)) if isinstance(v, np.ndarray)
+                            else list(v))
+        out[k] = vals
+    # timestamp None placeholders only arise when mixing timed and
+    # timeless batches; the oracles never do that.
+    assert all(v is not None for vs in out.values() for v in vs)
+    return out
+
+
+def _levelbytes(levels: list) -> list:
+    """Canonical (dtype + raw bytes) form of finalized level dicts —
+    equality here means the artifacts serialize identically."""
+    out = []
+    for lvl in levels:
+        rec = {}
+        for k, v in sorted(lvl.items()):
+            if hasattr(v, "__len__") and not isinstance(v, str):
+                a = np.asarray(v)
+                rec[k] = (str(a.dtype), a.tobytes())
+            else:
+                rec[k] = v
+        out.append((int(lvl["zoom"]), rec))
+    return out
+
+
+def _oracle_levels(*dir_weight_pairs) -> list:
+    """Clean-recompute oracle: merge per-group run_job artifacts
+    through the SAME deterministic combine the fold uses (per-unit
+    value scaling -> merge_level_parts -> drop_zero_rows)."""
+    parts = []
+    for d, w in dir_weight_pairs:
+        loaded = LevelArraysSink.load(d)
+        part = []
+        for z in sorted(loaded):
+            cols = loaded[z]
+            if w != 1.0:
+                cols = dict(cols)
+                cols["value"] = np.asarray(cols["value"], np.float64) * w
+            part.append(_loaded_to_finalized(cols))
+        parts.append(part)
+    return drop_zero_rows(merge_level_parts(parts))
+
+
+def _tree_digest(root: str) -> str:
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for fn in sorted(filenames):
+            path = os.path.join(dirpath, fn)
+            h.update(os.path.relpath(path, root).encode())
+            with open(path, "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def _base_file_hashes(root: str, *, skip=("TEMPORAL.json",)) -> dict:
+    """sha256 of every top-level file in CURRENT's base dir (the
+    all-time artifact; buckets/ and the manifest are temporal-only)."""
+    base = os.path.join(root, read_current(root)["base"])
+    out = {}
+    for name in sorted(os.listdir(base)):
+        p = os.path.join(base, name)
+        if os.path.isfile(p) and name not in skip:
+            with open(p, "rb") as f:
+                out[name] = hashlib.sha256(f.read()).hexdigest()
+    return out
+
+
+@pytest.fixture(scope="module")
+def scenario(tmp_path_factory):
+    """One bucketed store lifecycle with per-group recompute oracles.
+
+    Batches (width=100, fanout=2, keep=2, tiers=3):
+
+      b1 t0=1000 -> bucket (1000,1100)   b2 t0=1120 -> (1100,1200)
+      b3 t0=1310 -> bucket (1300,1400)   b4 t0=1440 -> (1400,1500)
+      b5 timeless -> bucket-none
+
+    After compaction max_edge=1500 coarsens b1+b2 into tier-1
+    bucket-1000-1200 while b3/b4 stay tier-0. Fold snapshots are taken
+    at the compacted state (ref=1500); a live batch b6 (t0=1520) is
+    applied afterwards to pin live-delta folding and as_of-token
+    immutability under ingest.
+    """
+    tp = tmp_path_factory.mktemp("temporal")
+    root = str(tp / "store")
+    rootu = str(tp / "store_unbucketed")
+    batches = {k: _batch(i, t0) for i, (k, t0) in enumerate(
+        [("b1", 1000), ("b2", 1120), ("b3", 1310), ("b4", 1440),
+         ("b5", None)])}
+
+    os.makedirs(root)
+    tfold.ensure_config(root, **TCFG)
+    for key in ("b1", "b2", "b3", "b4", "b5"):
+        delta.apply_batch(root, delta.ColumnsSource(batches[key]), CONFIG)
+        delta.apply_batch(rootu, delta.ColumnsSource(batches[key]), CONFIG)
+    comp = delta.compact(root, retention=10)
+    compu = delta.compact(rootu, retention=10)
+
+    # Clean per-group recomputes, one run_job per bucket's points.
+    groups = {
+        "g12": _union(batches["b1"], batches["b2"]),
+        "g3": batches["b3"], "g4": batches["b4"], "gnone": batches["b5"],
+    }
+    gdirs = {}
+    for name, cols in groups.items():
+        d = str(tp / f"oracle_{name}")
+        run_job(delta.ColumnsSource(cols), LevelArraysSink(d), CONFIG)
+        gdirs[name] = d
+
+    folds = {
+        "all": tfold.fold_levels(root, tfold.select_fold(root)),
+        "asof": tfold.fold_levels(root, tfold.select_fold(root,
+                                                          as_of=1250)),
+        "window": tfold.fold_levels(root, tfold.select_fold(
+            root, window=150.0)),
+        "decay": tfold.fold_levels(root, tfold.select_fold(
+            root, decay=100.0), decay_half_life=100.0),
+    }
+    token_before_live = tfold.select_fold(root, as_of=1250).token
+
+    res6 = delta.apply_batch(
+        root, delta.ColumnsSource(_batch(6, 1520, n=20)), CONFIG)
+
+    return {
+        "root": root, "rootu": rootu, "batches": batches,
+        "gdirs": gdirs, "folds": folds, "comp": comp, "compu": compu,
+        "token_before_live": token_before_live, "res6": res6,
+    }
+
+
+class TestBucketedCompaction:
+    def test_manifest_shape_and_coarsening(self, scenario):
+        cur = read_current(scenario["root"])
+        man = tb.read_manifest(os.path.join(scenario["root"], cur["base"]))
+        assert man is not None and man["schema"] == tb.TEMPORAL_SCHEMA
+        names = {b["name"]: b for b in man["buckets"]}
+        assert set(names) == {"bucket-1000-1200", "bucket-1300-1400",
+                              "bucket-1400-1500"}
+        assert names["bucket-1000-1200"]["tier"] == 1  # b1+b2 coarsened
+        assert sorted(names["bucket-1000-1200"]["epochs"]) == [1, 2]
+        assert man["none"] is not None  # the timeless batch b5
+        assert scenario["comp"]["buckets"] == 4  # 3 timed + none
+
+    def test_alltime_artifact_byte_identical_to_unbucketed(self, scenario):
+        """The tentpole gate: bucketing adds buckets/ + TEMPORAL.json
+        and changes NOTHING else — the all-time base files match the
+        un-bucketed twin's byte for byte."""
+        assert (_base_file_hashes(scenario["root"])
+                == _base_file_hashes(scenario["rootu"]))
+        assert scenario["compu"].get("buckets") is None
+
+    def test_fold_over_everything_equals_overlay(self, scenario):
+        """Fold(all buckets + live) == the un-bucketed overlay, live
+        delta included."""
+        got = tfold.fold_levels(scenario["root"],
+                                tfold.select_fold(scenario["root"]))
+        assert _levelbytes(got) == _levelbytes(
+            load_overlay_levels(scenario["root"]))
+
+    def test_config_pinned_first_writer_wins(self, scenario, tmp_path):
+        with pytest.raises(ValueError, match="pinned temporal config"):
+            tfold.ensure_config(scenario["root"], width=999.0)
+        # absent config + no offer stays off
+        assert tfold.ensure_config(str(tmp_path / "empty")) is None
+
+
+class TestCuts:
+    def test_as_of_equals_clean_recompute(self, scenario):
+        g = scenario["gdirs"]
+        assert _levelbytes(scenario["folds"]["asof"]) == _levelbytes(
+            _oracle_levels((g["g12"], 1.0), (g["gnone"], 1.0)))
+
+    def test_window_equals_clean_recompute(self, scenario):
+        g = scenario["gdirs"]
+        assert _levelbytes(scenario["folds"]["window"]) == _levelbytes(
+            _oracle_levels((g["g3"], 1.0), (g["g4"], 1.0),
+                           (g["gnone"], 1.0)))
+
+    def test_decay_equals_weighted_recompute(self, scenario):
+        """Per-bucket scalar decay at ref=1500, half-life 100:
+        bucket-1000-1200 -> 0.125, 1300-1400 -> 0.5, 1400-1500 -> 1.0,
+        bucket-none never ages."""
+        g = scenario["gdirs"]
+        assert _levelbytes(scenario["folds"]["decay"]) == _levelbytes(
+            _oracle_levels((g["g12"], 0.125), (g["g3"], 0.5),
+                           (g["g4"], 1.0), (g["gnone"], 1.0)))
+
+    def test_as_of_before_all_timed_data(self, scenario):
+        """A cut below every epoch selects no timed units; only the
+        timeless bucket-none rows (no timestamp -> no history axis)
+        remain, in every cut by design."""
+        sel = tfold.select_fold(scenario["root"], as_of=10.0)
+        assert not sel.buckets and not sel.live
+        assert sel.none is not None
+        assert _levelbytes(
+            tfold.fold_levels(scenario["root"], sel)) == _levelbytes(
+            _oracle_levels((scenario["gdirs"]["gnone"], 1.0)))
+
+    def test_as_of_token_survives_unrelated_ingest(self, scenario):
+        """History below a cut is immutable: applying b6 (wm 1520+)
+        did not move the as_of=1250 selection token, so every cache
+        entry keyed by it stays structurally valid."""
+        assert not scenario["res6"].duplicate
+        sel = tfold.select_fold(scenario["root"], as_of=1250)
+        assert sel.token == scenario["token_before_live"]
+
+    def test_live_delta_folds_into_window(self, scenario):
+        """b6 is live (not yet compacted) and newest: the window ref
+        advances to its tier-0 edge and the fold includes it."""
+        sel = tfold.select_fold(scenario["root"], window=150.0)
+        assert sel.ref == 1600.0
+        assert [u["epoch"] for u in sel.live] == [6]
+
+
+class TestServing:
+    @pytest.fixture()
+    def app(self, scenario):
+        return ServeApp(TileStore(f"delta:{scenario['root']}"),
+                        TileCache())
+
+    def test_as_of_tile_bytes_match_oracle_store(self, scenario, app,
+                                                 tmp_path):
+        g = scenario["gdirs"]
+        d = str(tmp_path / "asof_oracle")
+        LevelArraysSink(d).write_levels(
+            _oracle_levels((g["g12"], 1.0), (g["gnone"], 1.0)))
+        oracle = TileStore(f"arrays:{d}")
+        layer = oracle.layer("default")
+        zooms = sorted(z for z in layer.levels if z <= 6)
+        z = zooms[-1]
+        compared = 0
+        for x in range(1 << z):
+            for y in range(1 << z):
+                want = tile_json_bytes(layer, z, x, y)
+                r = app.handle("GET",
+                               f"/tiles/default/{z}/{x}/{y}.json?as_of=1250")
+                if want is None:
+                    assert r[0] == 404
+                else:
+                    assert r[0] == 200 and r[2] == want
+                    compared += 1
+        assert compared > 0
+
+    def test_temporal_etag_namespace_and_304(self, scenario, app):
+        r = app.handle("GET", "/tiles/default/2/0/1.json?window=150")
+        assert r[0] == 200 and r[3].startswith('"t-')
+        assert r.headers == {"X-Heatmap-Temporal": "window"}
+        r304 = app.handle("GET", "/tiles/default/2/0/1.json?window=150",
+                          if_none_match=r[3])
+        assert r304[0] == 304 and r304[2] == b""
+        # the all-time twin never revalidates against the temporal tag
+        r_all = app.handle("GET", "/tiles/default/2/0/1.json",
+                           if_none_match=r[3])
+        assert r_all[0] == 200 and not r_all[3].startswith('"t-')
+
+    def test_window_param_registered_for_invalidation(self, scenario,
+                                                      app):
+        app.handle("GET", "/tiles/default/2/0/1.json?window=150")
+        assert app.cache.window_params() == ("150",)
+
+    def test_bad_temporal_params_are_typed_400s(self, scenario, app):
+        for q in ("window=bogus", "as_of=nope", "decay=-3"):
+            r = app.handle("GET", f"/tiles/default/2/0/1.json?{q}")
+            assert r[0] == 400
+            assert json.loads(r[2])["error"] == "bad temporal query"
+
+    def test_store_without_temporal_config_400s(self, scenario):
+        app = ServeApp(TileStore(f"delta:{scenario['rootu']}"),
+                       TileCache())
+        r = app.handle("GET", "/tiles/default/2/0/1.json?as_of=1250")
+        assert r[0] == 400
+        assert "no temporal config" in json.loads(r[2])["detail"]
+
+    def test_torn_bucket_serves_last_good_stale(self, scenario,
+                                                tmp_path):
+        """Corrupting a bucket under a cached as_of tile: the re-render
+        raises TornBucketError inside the fold, the stale-if-error
+        cache answers 200 with the last-good bytes, and the all-time
+        path (which never reads buckets) is untouched."""
+        root = str(tmp_path / "store")
+        shutil.copytree(scenario["root"], root)
+        app = ServeApp(TileStore(f"delta:{root}"), TileCache())
+        # find a tile with data so there are last-good bytes to keep
+        sel = tfold.select_fold(root, as_of=1250)
+        url = None
+        for z in (3, 2, 1):
+            for x in range(1 << z):
+                for y in range(1 << z):
+                    r = app.handle(
+                        "GET", f"/tiles/default/{z}/{x}/{y}.json?as_of=1250")
+                    if r[0] == 200:
+                        url = f"/tiles/default/{z}/{x}/{y}.json?as_of=1250"
+                        good = r[2]
+                        break
+                if url:
+                    break
+            if url:
+                break
+        assert url is not None
+        all_before = app.handle("GET", "/tiles/default/2/0/1.json")
+        bdir = os.path.join(root, read_current(root)["base"],
+                            tb.BUCKETS_DIRNAME, "bucket-1000-1200")
+        levels = [f for f in os.listdir(bdir) if f.endswith(".npz")]
+        with open(os.path.join(bdir, levels[0]), "wb") as f:
+            f.write(b"torn")
+        app.store.reload()  # bump the generation -> entry goes stale
+        r = app.handle("GET", url)
+        assert r[0] == 200 and r[2] == good and r[5] == "stale"
+        assert "render" in app.degraded_causes()
+        # all-time serving never touches buckets
+        r_all = app.handle("GET", "/tiles/default/2/0/1.json")
+        assert r_all[0] == all_before[0] and r_all[2] == all_before[2]
+        # a cold key (no last-good bytes) is a typed 503, never a 500
+        r_cold = app.handle("GET",
+                            "/tiles/default/1/1/1.json?as_of=1250")
+        assert r_cold[0] in (404, 503)
+
+    def test_torn_bucket_quarantined_by_sweep(self, scenario, tmp_path):
+        from heatmap_tpu.delta import recover
+
+        root = str(tmp_path / "store")
+        shutil.copytree(scenario["root"], root)
+        bdir = os.path.join(root, read_current(root)["base"],
+                            tb.BUCKETS_DIRNAME, "bucket-1300-1400")
+        levels = [f for f in os.listdir(bdir) if f.endswith(".npz")]
+        with open(os.path.join(bdir, levels[0]), "wb") as f:
+            f.write(b"torn")
+        items = recover.sweep(root)["quarantined"]
+        torn = [i for i in items if i["reason"] == "torn_bucket"]
+        assert len(torn) == 1
+        assert not os.path.isdir(bdir)  # moved into quarantine
+        # fold over the quarantined bucket now raises (serve maps this
+        # to stale-if-error); the all-time overlay still loads
+        with pytest.raises(TornBucketError):
+            tfold.fold_levels(root, tfold.select_fold(root, window=300.0))
+        assert load_overlay_levels(root)
+
+
+class TestBucketRoll:
+    def test_roll_invalidates_exactly_the_retiring_keys(self, scenario,
+                                                        tmp_path):
+        from heatmap_tpu.delta.compute import affected_tile_keys
+        from heatmap_tpu.ingest.loop import _roll_windows
+
+        root = str(tmp_path / "store")
+        shutil.copytree(scenario["root"], root)
+        cache = TileCache()
+        holder: list = []
+        assert _roll_windows(root, cache, holder) == 0  # primes prev
+        assert holder == [1600.0]
+        cache.note_window_param("150")
+
+        cur = read_current(root)
+        bdir = os.path.join(root, cur["base"], tb.BUCKETS_DIRNAME,
+                            "bucket-1400-1500")
+        retiring = sorted(affected_tile_keys(LevelArraysSink.load(bdir)))
+        doomed = tuple(retiring[0]) + ("w", "150")
+        survivor_window = ("not-a-real-tile", 9, 9, 9, "json", "w", "150")
+        survivor_token = tuple(retiring[0]) + ("t", "sometoken")
+        for key in (doomed, survivor_window, survivor_token):
+            cache.get_or_render(key, 0, lambda: b"x")
+
+        # advance the newest edge 1600 -> 1700: window=150's trailing
+        # edge sweeps (1450, 1550], retiring bucket-1400-1500
+        delta.apply_batch(root, delta.ColumnsSource(_batch(7, 1610, n=10)),
+                          CONFIG)
+        n = _roll_windows(root, cache, holder)
+        assert holder == [1700.0]
+        assert n >= 1
+        assert cache.get_or_render(doomed, 0, lambda: b"re")[1] is False
+        assert cache.get_or_render(survivor_window, 0,
+                                   lambda: b"re")[1] is True
+        assert cache.get_or_render(survivor_token, 0,
+                                   lambda: b"re")[1] is True
+
+
+class TestTimeQuery:
+    def _brute_growth(self, root: str, *, zoom: int, window: float):
+        """Independent oracle: per-cell exact growth from the raw
+        bucket/live level rows — newer-half sum minus older-half sum
+        over the slot edges, no wavelets anywhere."""
+        sel = tfold.select_fold(root, window=window)
+        cur = read_current(root)
+        base = cur.get("base")
+        units = [(os.path.join(root, base, tb.BUCKETS_DIRNAME, b["name"]),
+                  float(b["t1"])) for b in sel.buckets]
+        units += [(os.path.join(root, u["artifact"]), u["t1"])
+                  for u in sel.live]
+        mid = sel.ref - window / 2.0
+        acc: dict = {}
+        for d, t1 in units:
+            loaded = LevelArraysSink.load(d)
+            lvl = loaded.get(zoom)
+            if lvl is None:
+                continue
+            keep = ((np.asarray(lvl["user"], str) == "all")
+                    & (np.asarray(lvl["timespan"], str) == "alltime"))
+            sign = 1.0 if t1 > mid else -1.0
+            for r, c, v in zip(np.asarray(lvl["row"])[keep],
+                               np.asarray(lvl["col"])[keep],
+                               np.asarray(lvl["value"])[keep]):
+                acc[(int(r), int(c))] = acc.get((int(r), int(c)), 0.0) \
+                    + sign * float(v)
+        return acc
+
+    def test_bound_is_sound_and_full_budget_exact(self, scenario):
+        doc = timequery.topk_growth(
+            scenario["root"], user="all", timespan="alltime", zoom=8,
+            window=300.0, k=10, coeffs=2)
+        oracle = self._brute_growth(scenario["root"], zoom=8,
+                                    window=300.0)
+        assert doc["cells"]
+        for cell in doc["cells"]:
+            exact = oracle.get((cell["row"], cell["col"]), 0.0)
+            assert abs(cell["growth"] - exact) <= cell["bound"] + 1e-12
+        full = timequery.topk_growth(
+            scenario["root"], user="all", timespan="alltime", zoom=8,
+            window=300.0, k=10, coeffs=64)
+        assert full["max_err"] == 0.0
+        for cell in full["cells"]:
+            assert cell["growth"] == oracle[(cell["row"], cell["col"])]
+
+    def test_query_endpoint(self, scenario):
+        app = ServeApp(TileStore(f"delta:{scenario['root']}"),
+                       TileCache())
+        r = app.handle(
+            "GET", "/query?op=topk_growth&layer=default&z=8&window=300&k=5")
+        assert r[0] == 200
+        doc = json.loads(r[2])
+        assert doc["op"] == "topk_growth" and len(doc["cells"]) == 5
+        assert r[3].startswith('"q-')
+        assert "X-Heatmap-Query-Error" in (r.headers or {})
+        r2 = app.handle(
+            "GET", "/query?op=topk_growth&layer=default&z=8&window=300&k=5")
+        assert r2[5] == "hit"
+        r400 = app.handle("GET", "/query?op=topk_growth&layer=default&z=8")
+        assert r400[0] == 400
+        assert "window" in json.loads(r400[2])["detail"]
+
+    def test_haar_roundtrip_exact_on_integers(self):
+        from heatmap_tpu.synopsis.transform import haar1d_np, inv_haar1d_np
+
+        rng = np.random.default_rng(3)
+        x = rng.integers(0, 1000, size=(5, 16)).astype(np.float64)
+        assert (inv_haar1d_np(haar1d_np(x)) == x).all()
+
+
+@pytest.fixture(scope="module")
+def retract_scenario(tmp_path_factory):
+    """Two twin stores: A gets alice+bob then a predicate retraction of
+    alice; B only ever sees bob (the clean survivor recompute)."""
+    tp = tmp_path_factory.mktemp("retract")
+    roots = {"A": str(tp / "A"), "B": str(tp / "B")}
+    for r in roots.values():
+        os.makedirs(r)
+        tfold.ensure_config(r, **TCFG)
+    for i, t0 in enumerate([1000, 1150]):
+        b = _batch(i, t0)
+        delta.apply_batch(roots["A"], delta.ColumnsSource(b), CONFIG)
+        keep = [j for j, u in enumerate(b["user_id"]) if u != "alice"]
+        bb = {k: ([v[j] for j in keep] if isinstance(v, list)
+                  else np.asarray(v)[keep]) for k, v in b.items()}
+        delta.apply_batch(roots["B"], delta.ColumnsSource(bb), CONFIG)
+    summary = retract_predicate(roots["A"], parse_where(["user=alice"]))
+    return {"roots": roots, "summary": summary}
+
+
+class TestRetraction:
+    def test_counter_batches_land_per_bucket(self, retract_scenario):
+        s = retract_scenario["summary"]
+        assert s["rows"] == 40  # 20 alice rows per batch
+        assert s["batches"] == 2  # one per temporal bucket
+        assert s["scanned"] == 80
+
+    def test_byte_identical_to_survivor_recompute(self, retract_scenario):
+        roots = retract_scenario["roots"]
+        assert _levelbytes(load_overlay_levels(roots["A"])) == \
+            _levelbytes(load_overlay_levels(roots["B"]))
+
+    def test_idempotent_rerun_applies_nothing(self, retract_scenario):
+        roots = retract_scenario["roots"]
+        digest = _tree_digest(roots["A"])
+        again = retract_predicate(roots["A"], parse_where(["user=alice"]))
+        assert again["rows"] == 0 and again["batches"] == 0
+        assert _tree_digest(roots["A"]) == digest
+
+    def test_identity_holds_after_compaction(self, retract_scenario):
+        roots = retract_scenario["roots"]
+        delta.compact(roots["A"], retention=10)
+        delta.compact(roots["B"], retention=10)
+        assert _base_file_hashes(roots["A"]) == _base_file_hashes(
+            roots["B"])
+        # temporal folds converge too: the counter-batches landed in
+        # the same buckets as the rows they removed
+        for kw in ({"as_of": 1100}, {"window": 150.0}):
+            fa = tfold.fold_levels(roots["A"],
+                                   tfold.select_fold(roots["A"], **kw))
+            fb = tfold.fold_levels(roots["B"],
+                                   tfold.select_fold(roots["B"], **kw))
+            assert _levelbytes(fa) == _levelbytes(fb)
+
+    def test_where_parsing(self):
+        assert parse_where(["user=alice"]) == {"user_id": "alice"}
+        assert parse_where(["layer=x", "source=gps"]) == {
+            "user_id": "x", "source": "gps"}
+        with pytest.raises(ValueError, match="column=value"):
+            parse_where(["nonsense"])
+        with pytest.raises(ValueError, match="not a point column"):
+            parse_where(["zoom=3"])
+        with pytest.raises(ValueError, match="at least one"):
+            parse_where([])
+
+    def test_unpinned_store_refuses(self, tmp_path):
+        root = str(tmp_path / "empty")
+        with pytest.raises(ValueError, match="no pinned config"):
+            retract_predicate(root, parse_where(["user=alice"]))

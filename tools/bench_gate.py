@@ -45,6 +45,11 @@ Reads every bench artifact the repo's tooling writes —
   (``query:speedup_p99[sum]``, higher — the acceptance bar is >= 10x
   on a warmed store), and fleet-router query RPS (higher) with its
   p99 (lower);
+- ``BENCH_temporal.json`` (tools/bench_temporal.py): temporal-plane
+  fold p99 ms per cut kind (``temporal:fold_p99_ms[...]``, lower),
+  predicate-retraction rows/sec (``temporal:retract_rows_per_s``,
+  higher), and ``op=topk_growth`` evaluator p99 ms (lower); nothing
+  is folded when the all-time or retraction byte gate failed;
 - ``BENCH_partition.json`` (tools/bench_job.py --partition-sweep):
   Morton-range vs uniform-DP modeled merge-volume ratio per dataset
   (``partition:merge_ratio[...]``, higher), the Morton leg's wall
@@ -313,6 +318,27 @@ def snapshot_metrics(root: str) -> dict:
         p99 = (router.get("latency_ms") or {}).get("p99")
         if isinstance(p99, (int, float)):
             out["query:router_p99_ms"] = (float(p99), False)
+    doc = _load(os.path.join(root, "BENCH_temporal.json"))
+    if isinstance(doc, dict):
+        # Temporal plane (bench_temporal): fold latency per cut kind
+        # and growth-query latency (lower), retraction throughput
+        # (higher). The all-time byte gate guards every cell — a fast
+        # fold that diverged from the un-bucketed overlay is a bug,
+        # not a win.
+        if doc.get("alltime_byte_identical"):
+            for cut, row in (doc.get("fold") or {}).items():
+                p99 = (row.get("ms") or {}).get("p99")
+                if isinstance(p99, (int, float)):
+                    out[f"temporal:fold_p99_ms[{cut}]"] = (float(p99),
+                                                           False)
+            p99 = ((doc.get("growth") or {}).get("ms") or {}).get("p99")
+            if isinstance(p99, (int, float)):
+                out["temporal:growth_p99_ms"] = (float(p99), False)
+        retract = doc.get("retract") or {}
+        if retract.get("byte_identical") and isinstance(
+                retract.get("rows_per_s"), (int, float)):
+            out["temporal:retract_rows_per_s"] = (
+                float(retract["rows_per_s"]), True)
     out.update(stream_metrics(root))
     return out
 

@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Temporal-plane bench: fold latency per cut, retraction throughput,
+and time-axis query latency: BENCH_temporal.json.
+
+Four headline sections (docs/temporal.md):
+
+- ``fold``     p50/p99 wall ms of a full ``fold_levels`` pass per cut
+               kind (``alltime``, ``as_of``, ``window``, ``decay``)
+               over a bucketed store — every iteration re-selects and
+               re-merges, nothing is cached, so this is the cold-tile
+               render cost a cache miss pays;
+- ``serve``    p50/p99 of one ServeApp temporal tile request with the
+               cache DISABLED-by-rotation (a fresh key per request via
+               distinct as_of cuts), next to the all-time tile on the
+               same store — the quotient is the temporal overhead a
+               miss pays over the plain path;
+- ``retract``  rows/sec for a predicate retraction (journal scan ->
+               signed counter-batches), measured end to end including
+               the cascade applies, plus the byte gate: the retracted
+               store must equal a clean recompute over the survivors;
+- ``growth``   p50/p99 of ``op=topk_growth`` evaluations and the
+               stamped ``max_err`` at the default coefficient budget.
+
+The ``alltime_byte_identical`` gate pins the tentpole invariant while
+the clocks run: fold(all buckets + live) must equal the un-bucketed
+overlay byte for byte. bench_gate never folds temporal cells when the
+gate fails.
+
+    PYTHONPATH=.:$PYTHONPATH python tools/bench_temporal.py \
+        [--points 20000] [--iters 30] [--out BENCH_temporal.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _pct(vals: list, q: float) -> float | None:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, int(q * len(s)))]
+
+
+def _timed_source(n: int, seed: int, t0: float, span: float):
+    """Synthetic GPS points with timestamps spread over [t0, t0+span)
+    so compaction lands them across several buckets."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {
+        "latitude": rng.uniform(-60.0, 70.0, n),
+        "longitude": rng.uniform(-179.0, 179.0, n),
+        "user_id": ["u%d" % (j % 5) for j in range(n)],
+        "timestamp": [str(t0 + span * j / n) for j in range(n)],
+    }
+
+
+def _levelbytes(levels: list) -> list:
+    import numpy as np
+
+    out = []
+    for lvl in levels:
+        rec = {}
+        for k, v in sorted(lvl.items()):
+            if hasattr(v, "__len__") and not isinstance(v, str):
+                a = np.asarray(v)
+                rec[k] = (str(a.dtype), a.tobytes())
+            else:
+                rec[k] = v
+        out.append(rec)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--points", type=int, default=20000)
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--out", default="BENCH_temporal.json")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from heatmap_tpu import delta
+    from heatmap_tpu.delta.compact import load_overlay_levels
+    from heatmap_tpu.delta.retract import parse_where, retract_predicate
+    from heatmap_tpu.pipeline import BatchJobConfig
+    from heatmap_tpu.serve import ServeApp, TileCache, TileStore
+    from heatmap_tpu.temporal import fold as tfold
+    from heatmap_tpu.temporal import timequery
+
+    cfg = BatchJobConfig(detail_zoom=10, min_detail_zoom=6,
+                         result_delta=2)
+    tmp = tempfile.mkdtemp(prefix="bench-temporal-")
+    root = os.path.join(tmp, "store")
+    os.makedirs(root)
+    tfold.ensure_config(root, width=3600.0, fanout=4, keep=4, tiers=3)
+
+    # 4 timed epochs spanning ~20 tier-0 buckets, then a bucketed
+    # compaction and one live epoch on top (the fold's worst case:
+    # buckets + live units in one merge).
+    per = max(1, args.points // 5)
+    t0 = 1_500_000_000.0
+    build_s = time.monotonic()
+    for i in range(4):
+        delta.apply_batch(root, delta.ColumnsSource(
+            _timed_source(per, i, t0 + i * 18_000, 18_000.0)), cfg)
+    delta.compact(root, retention=10)
+    delta.apply_batch(root, delta.ColumnsSource(
+        _timed_source(per, 9, t0 + 4 * 18_000, 18_000.0)), cfg)
+    build_s = time.monotonic() - build_s
+
+    ref = tfold.newest_edge(root, tfold.temporal_config(root))
+    cuts = {
+        "alltime": {},
+        "as_of": {"as_of": t0 + 40_000},
+        "window": {"window": 86_400.0},
+        "decay": {"decay": 7200.0},
+    }
+    fold = {}
+    gate = None
+    for name, kw in cuts.items():
+        times = []
+        for _ in range(max(3, args.iters // 3)):
+            it0 = time.monotonic()
+            sel = tfold.select_fold(root, **kw)
+            levels = tfold.fold_levels(
+                root, sel, decay_half_life=kw.get("decay"))
+            times.append((time.monotonic() - it0) * 1000.0)
+        fold[name] = {"ms": {"p50": _pct(times, 0.5),
+                             "p99": _pct(times, 0.99)},
+                      "units": len(sel.buckets) + len(sel.live)
+                      + (1 if sel.none else 0)}
+        if name == "alltime":
+            gate = _levelbytes(levels) == _levelbytes(
+                load_overlay_levels(root))
+
+    # Serve leg: rotate the as_of cut each request so every hit is a
+    # genuine miss, next to the plain all-time tile on the same app.
+    app = ServeApp(TileStore(f"delta:{root}"), TileCache())
+    edges = sorted({b["t1"] for b in tfold.select_fold(root).buckets})
+    serve = {}
+    for leg, paths in {
+        "temporal": [f"/tiles/default/2/1/1.json?as_of={edges[i % len(edges)]}"
+                     for i in range(args.iters)],
+        "alltime": ["/tiles/default/2/1/1.json"] * args.iters,
+    }.items():
+        times = []
+        for i, path in enumerate(paths):
+            if leg == "alltime":
+                app.cache.clear()
+            it0 = time.monotonic()
+            res = app.handle("GET", path)
+            times.append((time.monotonic() - it0) * 1000.0)
+            assert res[0] in (200, 404), f"{path} -> {res[0]}"
+        serve[leg] = {"ms": {"p50": _pct(times, 0.5),
+                             "p99": _pct(times, 0.99)}}
+
+    # Retraction leg on a twin store: drop one of the five synthetic
+    # users end to end, then gate against the survivor recompute.
+    rootr = os.path.join(tmp, "store-retract")
+    roots = os.path.join(tmp, "store-survivors")
+    for r in (rootr, roots):
+        os.makedirs(r)
+        tfold.ensure_config(r, width=3600.0, fanout=4, keep=4, tiers=3)
+    import numpy as np
+
+    rcols = _timed_source(per, 17, t0, 18_000.0)
+    keep = [j for j, u in enumerate(rcols["user_id"]) if u != "u0"]
+    scols = {k: ([v[j] for j in keep] if isinstance(v, list)
+                 else np.asarray(v)[keep]) for k, v in rcols.items()}
+    delta.apply_batch(rootr, delta.ColumnsSource(rcols), cfg)
+    delta.apply_batch(roots, delta.ColumnsSource(scols), cfg)
+    it0 = time.monotonic()
+    summary = retract_predicate(rootr, parse_where(["user=u0"]))
+    retract_s = time.monotonic() - it0
+    retract = {
+        "rows": summary["rows"], "batches": summary["batches"],
+        "scanned": summary["scanned"], "seconds": round(retract_s, 3),
+        "rows_per_s": (summary["rows"] / retract_s) if retract_s else None,
+        "byte_identical": _levelbytes(load_overlay_levels(rootr))
+        == _levelbytes(load_overlay_levels(roots)),
+    }
+
+    # Time-axis query leg: repeated topk_growth evaluations (the serve
+    # layer caches by selection token; this measures the evaluator).
+    times = []
+    doc = None
+    for _ in range(max(3, args.iters // 3)):
+        it0 = time.monotonic()
+        doc = timequery.topk_growth(root, user="all", timespan="alltime",
+                                    zoom=8, window=86_400.0, k=20,
+                                    coeffs=timequery.DEFAULT_COEFFS)
+        times.append((time.monotonic() - it0) * 1000.0)
+    growth = {"ms": {"p50": _pct(times, 0.5), "p99": _pct(times, 0.99)},
+              "slots": doc["slots"], "max_err": doc["max_err"],
+              "cells": len(doc["cells"])}
+
+    out = {
+        "schema": "heatmap-tpu.bench_temporal.v1",
+        "points": args.points, "iters": args.iters,
+        "build_seconds": round(build_s, 1), "ref_edge": ref,
+        "alltime_byte_identical": bool(gate),
+        "fold": fold, "serve": serve, "retract": retract,
+        "growth": growth,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps({k: out[k] for k in
+                      ("alltime_byte_identical", "fold", "retract",
+                       "growth")}, indent=2, sort_keys=True))
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+    if not gate or not retract["byte_identical"]:
+        print("bench_temporal: BYTE GATE FAILED", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

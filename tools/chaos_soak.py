@@ -30,7 +30,12 @@ synopses, and no request may see a 500. A query phase does the same to
 an integral-histogram artifact: the sweep must quarantine the torn
 integral and its orphaned staging tmp, /query must fall through to
 exact level rows with answers identical modulo the path marker, and
-the surviving zooms must keep their O(1) fast path. A tilefs phase
+the surviving zooms must keep their O(1) fast path. A temporal phase
+tears one time bucket under a bucketed store mid-serve: warmed
+``?as_of``/``?decay`` tiles must keep answering their last-good bytes
+(stale-if-error), the sweep must quarantine exactly the torn bucket,
+all-time tiles must stay byte-identical (the plain path never reads
+buckets), and no request may see a 5xx. A tilefs phase
 serves a converted store zero-copy through the disk render cache while
 ``tilefs.read`` faults force per-zoom npz fallbacks mid-reload,
 ``diskcache.write`` faults skip fills, a torn disk-cache entry must
@@ -1027,6 +1032,97 @@ def phase_query(ctx):
             "codes": {str(k): v for k, v in sorted(codes.items())}}
 
 
+def phase_temporal(ctx):
+    """Temporal-plane chaos (docs/temporal.md): a bucketed store under
+    serve, one bucket torn mid-serve. Warmed temporal tiles must keep
+    answering with their last-good bytes (stale-if-error), the
+    recovery sweep must quarantine exactly the torn bucket, the
+    all-time tiles must stay byte-identical to their pre-tear
+    responses (the plain path never reads buckets), and no request
+    may see a 5xx."""
+    from heatmap_tpu.delta.compact import read_current
+    from heatmap_tpu.delta.recover import sweep
+    from heatmap_tpu.temporal import buckets as tb
+    from heatmap_tpu.temporal import fold as tfold
+
+    faults.install(None)
+    root = os.path.join(os.path.dirname(ctx["base_root"]),
+                        "store-temporal")
+    os.makedirs(root)
+    tfold.ensure_config(root, width=100.0, fanout=2, keep=2, tiers=3)
+    cfg = BatchJobConfig(detail_zoom=8, min_detail_zoom=2,
+                         result_delta=2)
+    rng = np.random.default_rng(23)
+    for t0 in (1000.0, 1150.0, 1300.0, 1450.0):
+        n = 60
+        delta.apply_batch(root, delta.ColumnsSource({
+            "latitude": rng.uniform(30.0, 50.0, n),
+            "longitude": rng.uniform(-120.0, -70.0, n),
+            "user_id": ["u%d" % (j % 3) for j in range(n)],
+            "timestamp": [str(t0 + j) for j in range(n)],
+        }), cfg)
+    delta.compact(root, retention=10)
+
+    store = TileStore(f"delta:{root}")
+    app = ServeApp(store, TileCache())
+    codes: dict = {}
+
+    def fetch(path):
+        res = app.handle("GET", path)
+        codes[res[0]] = codes.get(res[0], 0) + 1
+        return res
+
+    # Warm every z<=2 tile on three temporal cuts plus the plain path.
+    before = {}
+    for z in (1, 2):
+        for x in range(1 << z):
+            for y in range(1 << z):
+                for q in ("", "?as_of=1200", "?window=150",
+                          "?decay=100"):
+                    p = f"/tiles/default/{z}/{x}/{y}.json{q}"
+                    before[p] = fetch(p)
+    warmed = [p for p, r in before.items()
+              if r[0] == 200 and "as_of" in p]
+    assert warmed, "no as_of tiles warmed — scenario too sparse"
+
+    # Tear the oldest bucket mid-serve; the reload bumps the serving
+    # generation so every warmed entry must re-render (and fail into
+    # its last-good bytes).
+    bdir = os.path.join(root, read_current(root)["base"],
+                        tb.BUCKETS_DIRNAME)
+    victim = sorted(os.listdir(bdir))[0]
+    vdir = os.path.join(bdir, victim)
+    level_files = [f for f in os.listdir(vdir) if f.endswith(".npz")]
+    with open(os.path.join(vdir, level_files[0]), "wb") as f:
+        f.write(b"torn mid-write")
+    store.reload()
+
+    stale = 0
+    for p, was in before.items():
+        if "?" in p and was[0] != 200:
+            continue  # cold temporal miss: nothing last-good to keep
+        res = fetch(p)
+        assert res[0] == was[0] and res[2] == was[2], \
+            f"bytes moved after tear: {p} ({was[0]} -> {res[0]})"
+        if "?" in p and res[5] == "stale":
+            stale += 1
+    assert stale > 0, "no stale-if-error serves observed"
+
+    swept = sweep(root)
+    reasons = sorted(i["reason"] for i in swept["quarantined"])
+    assert reasons == ["torn_bucket"], reasons
+    assert not os.path.isdir(vdir), "torn bucket still in place"
+    # The all-time path never noticed the quarantine either.
+    for p, was in before.items():
+        if "?" not in p:
+            res = fetch(p)
+            assert res[0] == was[0] and res[2] == was[2], p
+    assert not any(c >= 500 for c in codes), f"5xx observed: {codes}"
+    return {"torn_bucket": victim, "stale_serves": stale,
+            "quarantined": reasons,
+            "codes": {str(k): v for k, v in sorted(codes.items())}}
+
+
 def phase_tilefs(ctx):
     """tilefs chaos (heatmap_tpu.tilefs): a converted store serving
     zero-copy through the disk render cache while the fault plane fires
@@ -1492,6 +1588,7 @@ PHASES = [
     ("backend_loss", phase_backend_loss),
     ("synopsis", phase_synopsis),
     ("query", phase_query),
+    ("temporal", phase_temporal),
     ("tilefs", phase_tilefs),
     ("incident", phase_incident),
     ("telemetry", phase_telemetry),
